@@ -1,0 +1,37 @@
+"""bee2bee-tpu: a TPU-native decentralized inference-serving mesh.
+
+A brand-new framework with the capability contract of Chatit-cloud/BEE2BEE
+(reference: /root/reference/bee2bee/__init__.py:1-11): peer-to-peer WebSocket
+mesh nodes that host models, advertise them, and stream generations — but the
+compute core is a jit-compiled JAX engine with a sharded KV cache on TPU, and
+model parallelism (TP/PP/EP/SP) rides `jax.sharding` mesh axes instead of
+per-layer JSON-over-WebSocket hops.
+
+Heavy submodules (engine, models, mesh runtime) are imported lazily so that
+`import bee2bee_tpu` stays cheap for CLI/metadata use.
+"""
+
+__version__ = "0.1.0"
+
+_LAZY = {
+    "P2PNode": ("bee2bee_tpu.meshnet.node", "P2PNode"),
+    "run_p2p_node": ("bee2bee_tpu.meshnet.runtime", "run_p2p_node"),
+    "InferenceEngine": ("bee2bee_tpu.engine.engine", "InferenceEngine"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        module, attr = _LAZY[name]
+        try:
+            return getattr(importlib.import_module(module), attr)
+        except ModuleNotFoundError as e:
+            raise AttributeError(
+                f"{name} is not available in this build: {e}"
+            ) from e
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = ["P2PNode", "run_p2p_node", "InferenceEngine", "__version__"]

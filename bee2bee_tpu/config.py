@@ -1,0 +1,102 @@
+"""Node configuration: dataclass defaults, JSON persistence, env precedence.
+
+Capability parity with reference config (/root/reference/bee2bee/config.py:11-47):
+persisted `~/.bee2bee_tpu/config.json`, env > file > defaults precedence
+(reference config.py:35-42). Extended with TPU-specific knobs (mesh shape,
+dtype, KV page size) that the reference has no analogue for.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import asdict, dataclass, field, fields
+
+from .utils import data_file, load_json, save_json
+
+CONFIG_FILE = "config.json"
+
+# env var name -> config field
+_ENV_MAP = {
+    "BEE2BEE_BOOTSTRAP": "bootstrap_url",
+    "BEE2BEE_HOST": "host",
+    "BEE2BEE_PORT": "port",
+    "BEE2BEE_API_PORT": "api_port",
+    "BEE2BEE_ANNOUNCE_HOST": "announce_host",
+    "BEE2BEE_ANNOUNCE_PORT": "announce_port",
+    "BEE2BEE_API_KEY": "api_key",
+    "BEE2BEE_MESH_SHAPE": "mesh_shape",
+    "BEE2BEE_DTYPE": "dtype",
+}
+
+_INT_FIELDS = {"port", "api_port", "announce_port", "kv_page_size", "max_seq_len"}
+
+
+@dataclass
+class NodeConfig:
+    """Flat config for one mesh node (serving + networking + compute)."""
+
+    # networking (reference config.py:11-17 defaults)
+    bootstrap_url: str = "ws://127.0.0.1:4003"
+    host: str = "0.0.0.0"
+    port: int = 4003
+    api_port: int = 4002
+    announce_host: str | None = None
+    announce_port: int | None = None
+    api_key: str | None = None
+    # compute (TPU-native additions)
+    mesh_shape: str = ""  # e.g. "data:1,model:8" — empty = all devices on model axis
+    dtype: str = "bfloat16"
+    kv_page_size: int = 128
+    max_seq_len: int = 2048
+    max_new_tokens: int = 2048  # reference default (services.py:28)
+    price_per_token: float = 0.0
+    extra: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+def load_config() -> NodeConfig:
+    """defaults <- config.json <- env (highest precedence)."""
+    raw = load_json(data_file(CONFIG_FILE), default={}) or {}
+    known = {f.name for f in fields(NodeConfig)}
+    kwargs = {k: v for k, v in raw.items() if k in known}
+    cfg = NodeConfig(**kwargs)
+    for env_name, field_name in _ENV_MAP.items():
+        val = os.environ.get(env_name)
+        if val is not None and val != "":
+            if field_name in _INT_FIELDS:
+                try:
+                    val = int(val)
+                except ValueError:
+                    continue
+            setattr(cfg, field_name, val)
+    return cfg
+
+
+def save_config(cfg: NodeConfig) -> None:
+    save_json(data_file(CONFIG_FILE), cfg.to_dict())
+
+
+def get_bootstrap_url() -> str:
+    return load_config().bootstrap_url
+
+
+def set_bootstrap_url(url: str) -> None:
+    cfg = load_config()
+    cfg.bootstrap_url = url
+    save_config(cfg)
+
+
+def parse_mesh_shape(spec: str) -> dict[str, int]:
+    """Parse "data:1,model:8" → {"data": 1, "model": 8}. Empty → {}."""
+    out: dict[str, int] = {}
+    if not spec:
+        return out
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, n = part.partition(":")
+        out[name.strip()] = int(n)
+    return out

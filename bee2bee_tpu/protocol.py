@@ -1,0 +1,152 @@
+"""Wire protocol: message constants + codec for the WebSocket mesh.
+
+Wire-compatible with the reference message set (/root/reference/bee2bee/
+protocol.py:17-53 and p2p_runtime.py:460-470) so the reference's JS bridge
+(app/api/bridge.js:163-223) can talk to our nodes unmodified. Adds a binary
+tensor frame codec the reference lacks — it ships tensors as JSON float lists
+(node.py:96-98) which is ~5x the bytes; we send raw little-endian buffers
+with a JSON header for the inter-peer pipeline/training paths.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any
+
+PROTOCOL_VERSION = 1
+MAX_FRAME = 32 * 1024 * 1024  # reference cap (p2p_runtime.py:175,350)
+
+# ---- mesh message types (reference protocol.py:17-34, p2p_runtime.py:460-470)
+HELLO = "hello"
+PEER_LIST = "peer_list"
+PING = "ping"
+PONG = "pong"
+SERVICE_ANNOUNCE = "service_announce"
+GEN_REQUEST = "gen_request"
+GEN_CHUNK = "gen_chunk"
+GEN_SUCCESS = "gen_success"
+GEN_ERROR = "gen_error"
+GEN_RESULT = "gen_result"
+PIECE_REQUEST = "piece_request"
+PIECE_DATA = "piece_data"
+PIECE_HAVE = "piece_have"
+GOODBYE = "goodbye"
+
+# ---- coordinator/worker task protocol (reference protocol.py:25-53, node.py:89+)
+REGISTER = "register"
+INFO = "info"
+TASK = "task"
+RESULT = "result"
+TASK_ERROR = "task_error"
+
+TASK_LAYER_FORWARD = "layer_forward"
+TASK_LAYER_FORWARD_TRAIN = "layer_forward_train"
+TASK_LAYER_BACKWARD = "layer_backward"
+TASK_MODEL_LOAD = "model_load"
+TASK_MODEL_INFER = "model_infer"
+TASK_MODEL_UNLOAD = "model_unload"
+TASK_PART_LOAD = "part_load"
+TASK_PART_FORWARD = "part_forward"
+TASK_TRAIN_STEP = "train_step"
+
+MESSAGE_TYPES = frozenset(
+    {
+        HELLO,
+        PEER_LIST,
+        PING,
+        PONG,
+        SERVICE_ANNOUNCE,
+        GEN_REQUEST,
+        GEN_CHUNK,
+        GEN_SUCCESS,
+        GEN_ERROR,
+        GEN_RESULT,
+        PIECE_REQUEST,
+        PIECE_DATA,
+        PIECE_HAVE,
+        GOODBYE,
+        REGISTER,
+        INFO,
+        TASK,
+        RESULT,
+        TASK_ERROR,
+    }
+)
+
+
+def msg(type_: str, **fields: Any) -> dict:
+    """Build a message dict (reference protocol.py:9-12)."""
+    out = {"type": type_}
+    out.update(fields)
+    return out
+
+
+def encode(message: dict) -> str:
+    return json.dumps(message, separators=(",", ":"))
+
+
+def decode(raw: str | bytes) -> dict:
+    if isinstance(raw, bytes):
+        return decode_binary(raw)[0]
+    obj = json.loads(raw)
+    if not is_message(obj):
+        raise ValueError("not a protocol message")
+    return obj
+
+
+def is_message(obj: Any) -> bool:
+    return isinstance(obj, dict) and isinstance(obj.get("type"), str)
+
+
+# ---- binary tensor frames ----------------------------------------------------
+# Layout: magic b"B2T1" | u32 header_len | header JSON (utf-8) | payload bytes.
+# Header carries {"type":..., any fields..., "tensors": [{"name","dtype","shape",
+# "nbytes"}...]}; tensor buffers are concatenated in order after the header.
+
+_MAGIC = b"B2T1"
+
+
+def encode_binary(message: dict, tensors: dict[str, "Any"] | None = None) -> bytes:
+    import numpy as np
+
+    tensors = tensors or {}
+    specs = []
+    buffers = []
+    for name, arr in tensors.items():
+        a = np.ascontiguousarray(arr)
+        specs.append(
+            {"name": name, "dtype": str(a.dtype), "shape": list(a.shape), "nbytes": a.nbytes}
+        )
+        buffers.append(a.tobytes())
+    header = dict(message)
+    header["tensors"] = specs
+    hb = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    return _MAGIC + struct.pack("<I", len(hb)) + hb + b"".join(buffers)
+
+
+def decode_binary(raw: bytes) -> tuple[dict, dict]:
+    """Returns (message, tensors). `message` keeps non-tensor fields."""
+    import numpy as np
+
+    if raw[:4] != _MAGIC:
+        raise ValueError("bad tensor-frame magic")
+    if len(raw) < 8:
+        raise ValueError("truncated tensor-frame header")
+    (hlen,) = struct.unpack("<I", raw[4:8])
+    if len(raw) < 8 + hlen:
+        raise ValueError("truncated tensor-frame header")
+    header = json.loads(raw[8 : 8 + hlen].decode("utf-8"))
+    specs = header.pop("tensors", [])
+    tensors = {}
+    off = 8 + hlen
+    for spec in specs:
+        n = spec["nbytes"]
+        buf = raw[off : off + n]
+        if len(buf) != n:
+            raise ValueError("truncated tensor frame")
+        tensors[spec["name"]] = np.frombuffer(buf, dtype=spec["dtype"]).reshape(spec["shape"])
+        off += n
+    if not is_message(header):
+        raise ValueError("not a protocol message")
+    return header, tensors

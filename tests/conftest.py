@@ -1,0 +1,27 @@
+"""Test fixtures. The 8-device virtual CPU mesh is enforced by the ROOT
+conftest (/root/repo/conftest.py), which re-execs pytest with the right env
+before fd capture starts; here we only verify it took effect."""
+
+import os
+
+os.environ.setdefault("BEE2BEE_TPU_HOME", "/tmp/bee2bee_tpu_test_home")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _verify_cpu_mesh():
+    # The root conftest re-execs pytest onto CPU with 8 virtual devices;
+    # by the time any test runs, that must have taken effect.
+    import jax
+
+    assert jax.default_backend() == "cpu" and jax.device_count() == 8, (
+        f"expected 8 virtual CPU devices, got {jax.device_count()} on "
+        f"{jax.default_backend()}"
+    )
+
+
+@pytest.fixture
+def tmp_home(tmp_path, monkeypatch):
+    monkeypatch.setenv("BEE2BEE_TPU_HOME", str(tmp_path))
+    return tmp_path

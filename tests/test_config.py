@@ -1,0 +1,37 @@
+from bee2bee_tpu import config
+
+
+def test_defaults_match_reference(tmp_home):
+    cfg = config.load_config()
+    assert cfg.bootstrap_url == "ws://127.0.0.1:4003"
+    assert cfg.api_port == 4002
+
+
+def test_file_persistence_roundtrip(tmp_home):
+    cfg = config.load_config()
+    cfg.port = 5555
+    cfg.dtype = "float32"
+    config.save_config(cfg)
+    cfg2 = config.load_config()
+    assert cfg2.port == 5555
+    assert cfg2.dtype == "float32"
+
+
+def test_env_beats_file(tmp_home, monkeypatch):
+    cfg = config.load_config()
+    cfg.bootstrap_url = "ws://file:1"
+    config.save_config(cfg)
+    monkeypatch.setenv("BEE2BEE_BOOTSTRAP", "ws://env:2")
+    assert config.get_bootstrap_url() == "ws://env:2"
+
+
+def test_env_int_coercion(tmp_home, monkeypatch):
+    monkeypatch.setenv("BEE2BEE_PORT", "9999")
+    assert config.load_config().port == 9999
+    monkeypatch.setenv("BEE2BEE_PORT", "not-a-number")
+    assert config.load_config().port == 4003  # bad env ignored, default kept
+
+
+def test_parse_mesh_shape():
+    assert config.parse_mesh_shape("") == {}
+    assert config.parse_mesh_shape("data:2,model:4") == {"data": 2, "model": 4}

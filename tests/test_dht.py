@@ -1,0 +1,59 @@
+"""DHT tests (model: reference tests/test_dht.py announce/find on the
+in-memory fallback) plus shard-aware provider selection."""
+
+import asyncio
+
+from bee2bee_tpu.dht import DHTNode, InMemoryDHT
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def test_inmemory_set_get():
+    async def go():
+        d = InMemoryDHT()
+        await d.set("k", {"v": 1})
+        assert await d.get("k") == {"v": 1}
+        assert await d.get("missing") is None
+
+    run(go())
+
+
+def test_dhtnode_falls_back_without_kademlia_server():
+    async def go():
+        d = DHTNode(port=0)
+        await d.start()
+        await d.set("x", [1, 2])
+        assert await d.get("x") == [1, 2]
+        await d.stop()
+
+    run(go())
+
+
+def test_announce_and_find_providers():
+    async def go():
+        d = DHTNode()
+        await d.announce_piece("hash1", "ws://a:1", mesh_axis="model", shard_index=0)
+        await d.announce_piece("hash1", "ws://b:2", mesh_axis="model", shard_index=1)
+        allp = await d.find_providers("hash1")
+        assert {p["addr"] for p in allp} == {"ws://a:1", "ws://b:2"}
+        exact = await d.find_providers("hash1", shard_index=1)
+        assert [p["addr"] for p in exact] == ["ws://b:2"]
+        # re-announce replaces, not duplicates
+        await d.announce_piece("hash1", "ws://a:1", shard_index=0)
+        assert len(await d.find_providers("hash1")) == 2
+        await d.stop()
+
+    run(go())
+
+
+def test_manifest_announce():
+    async def go():
+        d = DHTNode()
+        await d.announce_manifest("llama-3-8b", '{"model":"llama-3-8b"}', "ws://a:1")
+        rec = await d.get_manifest("llama-3-8b")
+        assert rec["addr"] == "ws://a:1"
+        await d.stop()
+
+    run(go())

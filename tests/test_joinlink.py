@@ -1,0 +1,43 @@
+import pytest
+
+from bee2bee_tpu import joinlink
+
+
+def test_join_link_roundtrip():
+    link = joinlink.generate_join_link(
+        "node-abc", ["ws://1.2.3.4:4003", "wss://peer.example:443"], name="my node"
+    )
+    out = joinlink.parse_join_link(link)
+    assert out["node_id"] == "node-abc"
+    assert out["bootstrap_addrs"] == ["ws://1.2.3.4:4003", "wss://peer.example:443"]
+    assert out["name"] == "my node"
+
+
+def test_parse_rejects_empty_addrs():
+    with pytest.raises(ValueError):
+        joinlink.parse_join_link("bee2bee-tpu://join?node=x&addrs=")
+
+
+def test_parse_rejects_bad_scheme():
+    with pytest.raises(ValueError):
+        joinlink.parse_join_link("ftp://join?node=x&addrs=YQ")
+
+
+def test_chunk_bytes():
+    assert joinlink.chunk_bytes(b"abcdefg", 3) == [b"abc", b"def", b"g"]
+    assert joinlink.chunk_bytes(b"", 3) == [b""]
+    with pytest.raises(ValueError):
+        joinlink.chunk_bytes(b"x", 0)
+
+
+def test_bitfield_roundtrip():
+    have = {0, 3, 9}
+    bf = joinlink.bitfield_from_pieces(have, total=10)
+    assert joinlink.pieces_from_bitfield(bf, total=10) == have
+
+
+def test_percent_in_node_id_survives_roundtrip():
+    link = joinlink.generate_join_link("id%41x", ["ws://h:1"], name="50%20off")
+    out = joinlink.parse_join_link(link)
+    assert out["node_id"] == "id%41x"
+    assert out["name"] == "50%20off"

@@ -1,0 +1,57 @@
+import numpy as np
+import pytest
+
+from bee2bee_tpu import protocol
+
+
+def test_msg_and_json_roundtrip():
+    m = protocol.msg(protocol.GEN_REQUEST, rid="r1", prompt="hi")
+    raw = protocol.encode(m)
+    back = protocol.decode(raw)
+    assert back == {"type": "gen_request", "rid": "r1", "prompt": "hi"}
+
+
+def test_decode_rejects_non_message():
+    with pytest.raises(ValueError):
+        protocol.decode('{"no_type": 1}')
+
+
+def test_message_set_is_reference_wire_compatible():
+    # the exact set the reference dispatches on (p2p_runtime.py:460-470)
+    for t in ("hello", "peer_list", "ping", "pong", "service_announce",
+              "gen_request", "gen_chunk", "gen_success", "gen_error",
+              "gen_result", "piece_request", "piece_data"):
+        assert t in protocol.MESSAGE_TYPES
+
+
+def test_binary_tensor_frame_roundtrip():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    h = (np.random.default_rng(0).standard_normal((2, 5)) * 3).astype(np.float16)
+    raw = protocol.encode_binary(
+        protocol.msg(protocol.TASK, kind=protocol.TASK_PART_FORWARD, rid="r9"),
+        {"hidden": x, "mask": h},
+    )
+    m, tensors = protocol.decode_binary(raw)
+    assert m["type"] == "task" and m["rid"] == "r9"
+    np.testing.assert_array_equal(tensors["hidden"], x)
+    np.testing.assert_array_equal(tensors["mask"], h)
+
+
+def test_binary_frame_truncation_detected():
+    raw = protocol.encode_binary(
+        protocol.msg(protocol.TASK), {"x": np.ones(100, np.float32)}
+    )
+    with pytest.raises(ValueError):
+        protocol.decode_binary(raw[:-10])
+
+
+def test_binary_frame_is_compact():
+    # the point of the binary codec: JSON float lists are ~5x larger
+    x = np.random.default_rng(1).standard_normal(10_000).astype(np.float32)
+    raw = protocol.encode_binary(protocol.msg(protocol.TASK), {"x": x})
+    assert len(raw) < x.nbytes + 500
+
+
+def test_short_magic_frame_raises_valueerror():
+    with pytest.raises(ValueError):
+        protocol.decode_binary(b"B2T1abc")

@@ -1,0 +1,72 @@
+"""L0 utils tests (model: reference tests/test_utils.py — id uniqueness,
+hash determinism — plus real-metrics guarantees the reference lacks)."""
+
+import json
+import threading
+
+from bee2bee_tpu import utils
+
+
+def test_new_id_unique_and_prefixed():
+    ids = {utils.new_id("req") for _ in range(200)}
+    assert len(ids) == 200
+    assert all(i.startswith("req-") for i in ids)
+
+
+def test_sha256_deterministic():
+    assert utils.sha256_hex(b"abc") == utils.sha256_hex("abc")
+    assert len(utils.sha256_hex(b"abc")) == 64
+
+
+def test_save_load_json_atomic(tmp_path):
+    p = tmp_path / "nested" / "x.json"
+    utils.save_json(p, {"a": 1})
+    assert utils.load_json(p) == {"a": 1}
+    # no stray tmp files
+    assert list(p.parent.glob("*.tmp")) == []
+
+
+def test_load_json_default_on_garbage(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text("{nope")
+    assert utils.load_json(p, default=7) == 7
+
+
+def test_metrics_aggregator_measures_not_simulates():
+    m = utils.MetricsAggregator(window_s=60)
+    for _ in range(10):
+        m.record(new_tokens=30, latency_s=0.5)
+    snap = m.snapshot()
+    assert snap["window_tokens"] == 300
+    assert snap["total_requests"] == 10
+    # span = elapsed since oldest event (floored by its 0.5 s latency)
+    assert 300 / 60 < snap["tokens_per_sec"] <= 300 / 0.5
+    assert snap["p50_latency_s"] == 0.5
+
+
+def test_metrics_aggregator_thread_safe():
+    m = utils.MetricsAggregator()
+    threads = [
+        threading.Thread(target=lambda: [m.record(1, 0.01) for _ in range(100)])
+        for _ in range(8)
+    ]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert m.snapshot()["total_tokens"] == 800
+
+
+def test_system_metrics_schema_and_no_fabrication():
+    snap = utils.get_system_metrics()
+    # reference-compatible keys (utils.py:128-133) ...
+    for key in ("cpu", "ram", "gpu", "throughput", "timestamp"):
+        assert key in snap
+    # ... but throughput is 0.0 when nothing was measured, never cpu*0.85
+    assert snap["throughput"] == 0.0
+    json.dumps(snap)  # must be JSON-serializable for registry sync
+
+
+def test_throughput_not_underreported_on_fresh_window():
+    m = utils.MetricsAggregator(window_s=60)
+    m.record(new_tokens=600, latency_s=0.5)
+    # a single 600-token/0.5s generation should read ~1200 tok/s, not 10
+    assert m.snapshot()["tokens_per_sec"] > 1000
