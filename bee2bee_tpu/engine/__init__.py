@@ -1,0 +1,7 @@
+"""Inference engine: jit-compiled prefill/decode with a fixed-capacity KV
+cache, bucketed shapes, on-device sampling, and token streaming. This is the
+TPU-native replacement for the reference's torch `model.generate` thread
+(reference hf.py:84-108)."""
+
+from .engine import EngineConfig, GenerationResult, InferenceEngine  # noqa: F401
+from .sampling import sample  # noqa: F401

@@ -1,0 +1,47 @@
+"""On-device token sampling: greedy / temperature / top-k / top-p.
+
+Replaces the sampling knobs the reference forwards to torch generate
+(reference services.py:44-59: temperature, max_new_tokens). Everything is
+shape-static and branchless via masking, so it lives inside the jit'd
+decode step — no host round-trip between logits and token.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample(
+    logits,  # [B, V] float32
+    key,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+):
+    """Sample next tokens [B]. temperature<=0 → greedy (argmax).
+
+    Static Python values for the knobs keep the jitted step monomorphic —
+    the engine compiles one step per (temperature==0?) variant, which is
+    the right trade: sampling params rarely change within a request.
+    """
+    if temperature is None or temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1)
+
+    logits = logits / jnp.asarray(max(temperature, 1e-6), logits.dtype)
+
+    if top_k and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative prob >= top_p; the top
+        # token is always kept (top_p=0 degrades to greedy, not to garbage)
+        keep = (cum - probs < top_p).at[:, 0].set(True)
+        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+
+    return jax.random.categorical(key, logits, axis=-1)

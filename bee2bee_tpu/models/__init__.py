@@ -1,0 +1,15 @@
+"""Model families: pure-JAX transformer definitions with explicit param
+pytrees and per-param partition rules.
+
+One configurable core (`core.py`) covers every family the serving ladder
+needs (BASELINE.md configs 1-5): GPT-2 (learned positions, MHA, gelu),
+Llama/Mistral/Zephyr (RoPE, GQA, silu-gated MLP, RMSNorm), Gemma (RoPE,
+geglu, embedding scaling), Mixtral (Llama core + top-2 MoE). The reference
+delegates all of this to `transformers` on torch (reference hf.py:23-44);
+here the model IS the framework's code, jit-compiled, with layer params
+stacked for `lax.scan` so compile time is O(1) in depth.
+"""
+
+from .config import CONFIGS, ModelConfig, get_config  # noqa: F401
+from .core import forward, init_params  # noqa: F401
+from .partition import partition_specs  # noqa: F401

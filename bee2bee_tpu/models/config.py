@@ -1,0 +1,129 @@
+"""Model configurations: one dataclass drives the shared transformer core.
+
+Preset registry covers the BASELINE.md measurement ladder (distilgpt2,
+gemma-2b, llama-3-8b, zephyr-7b, mixtral-8x7b) plus tiny variants for tests.
+HF checkpoint names map onto these presets by fuzzy match, mirroring the
+reference's model-tag matching (reference services.py:136-151).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    vocab_size: int
+    d_model: int
+    n_layers: int
+    n_heads: int
+    n_kv_heads: int
+    d_ff: int
+    max_seq_len: int = 2048
+    # architecture switches
+    pos_embedding: str = "rope"  # "rope" | "learned"
+    norm: str = "rmsnorm"  # "rmsnorm" | "layernorm"
+    activation: str = "silu"  # "silu" (gated) | "gelu" (gpt2 mlp) | "geglu"
+    use_bias: bool = False  # attn/mlp biases (gpt2 style)
+    tie_embeddings: bool = True
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    logits_softcap: float | None = None
+    embedding_scale: bool = False  # gemma multiplies embeds by sqrt(d_model)
+    norm_plus_one: bool = False  # gemma checkpoints store rmsnorm as (1 + w)
+    # MoE
+    n_experts: int = 0  # 0 = dense
+    n_experts_per_tok: int = 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.n_experts > 0
+
+
+def _gpt2(name, d_model, n_layers, n_heads, d_ff=None, vocab=50257, max_pos=1024):
+    return ModelConfig(
+        name=name,
+        vocab_size=vocab,
+        d_model=d_model,
+        n_layers=n_layers,
+        n_heads=n_heads,
+        n_kv_heads=n_heads,
+        d_ff=d_ff or 4 * d_model,
+        max_seq_len=max_pos,
+        pos_embedding="learned",
+        norm="layernorm",
+        activation="gelu",
+        use_bias=True,
+        tie_embeddings=True,
+    )
+
+
+CONFIGS: dict[str, ModelConfig] = {
+    # -- test-sized --
+    "tiny-gpt2": _gpt2("tiny-gpt2", d_model=64, n_layers=2, n_heads=4, vocab=512, max_pos=256),
+    "tiny-llama": ModelConfig(
+        name="tiny-llama", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+        n_kv_heads=2, d_ff=128, max_seq_len=256,
+    ),
+    "tiny-mixtral": ModelConfig(
+        name="tiny-mixtral", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+        n_kv_heads=2, d_ff=128, max_seq_len=256, n_experts=4, n_experts_per_tok=2,
+    ),
+    # -- BASELINE ladder --
+    "distilgpt2": _gpt2("distilgpt2", d_model=768, n_layers=6, n_heads=12),
+    "gpt2": _gpt2("gpt2", d_model=768, n_layers=12, n_heads=12),
+    "gemma-2b": ModelConfig(
+        # head_dim = 2048/8 = 256, matching gemma's 256-dim heads
+        name="gemma-2b", vocab_size=256000, d_model=2048, n_layers=18, n_heads=8,
+        n_kv_heads=1, d_ff=16384, max_seq_len=8192, activation="geglu",
+        embedding_scale=True, norm_eps=1e-6, norm_plus_one=True,
+    ),
+    "llama-3-8b": ModelConfig(
+        name="llama-3-8b", vocab_size=128256, d_model=4096, n_layers=32, n_heads=32,
+        n_kv_heads=8, d_ff=14336, max_seq_len=8192, rope_theta=500000.0,
+        tie_embeddings=False,
+    ),
+    "zephyr-7b": ModelConfig(  # mistral-7b architecture (HuggingFaceH4/zephyr-7b-beta)
+        name="zephyr-7b", vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
+        n_kv_heads=8, d_ff=14336, max_seq_len=4096, tie_embeddings=False,
+    ),
+    "mixtral-8x7b": ModelConfig(
+        name="mixtral-8x7b", vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
+        n_kv_heads=8, d_ff=14336, max_seq_len=8192, tie_embeddings=False,
+        n_experts=8, n_experts_per_tok=2,
+    ),
+}
+
+
+def get_config(name: str, **overrides) -> ModelConfig:
+    """Resolve a model name to a config, with the reference's both-ways fuzzy
+    match (`services.py:136-151`): exact key, else substring either way."""
+    key = name.lower().strip()
+    if key in CONFIGS:
+        cfg = CONFIGS[key]
+    else:
+        short = key.split("/")[-1]
+        flat = lambda s: s.replace("-", "").replace("_", "").replace(".", "")
+        # tiny-* test presets never match a real checkpoint name unless the
+        # query itself says "tiny"
+        pool = {
+            k: c for k, c in CONFIGS.items()
+            if "tiny" in short or not k.startswith("tiny-")
+        }
+        # tiers: exact short name > key contained in query > query contained
+        # in key; within a tier prefer the longest (most specific) key
+        tiers = (
+            [k for k in pool if k == short or flat(k) == flat(short)],
+            [k for k in pool if flat(k) in flat(short)],
+            [k for k in pool if flat(short) in flat(k)],
+        )
+        hit = next((t for t in tiers if t), None)
+        if hit is None:
+            raise KeyError(f"no model config matches {name!r}; known: {sorted(CONFIGS)}")
+        cfg = pool[max(hit, key=len)]
+    return replace(cfg, **overrides) if overrides else cfg

@@ -1,0 +1,337 @@
+"""The transformer core: init + forward for every supported family.
+
+Design (TPU-first, not a port of reference hf.py):
+
+- **Stacked layer params + `lax.scan`**: all per-layer weights carry a
+  leading `n_layers` dim and the layer loop is a `lax.scan`, so XLA traces
+  one layer body regardless of depth — compile time and HLO size are O(1)
+  in n_layers.
+- **Single forward for prefill and decode**: the same function handles a
+  [B, T] chunk against a fixed-capacity KV cache at a given offset. T=1 is
+  the decode step; T=bucket is prefill. Static shapes everywhere — the
+  cache is preallocated at `max_seq_len`, masking handles validity.
+- **GQA by construction**: K/V heads are repeated via reshape-broadcast
+  (no materialized repeat when XLA fuses).
+- **bfloat16 compute, f32 accumulations** where it matters (attention
+  logits, softmax, norms, router logits).
+
+The param tree is a flat-ish nested dict; see init_params for the schema.
+Partition rules over the same paths live in partition.py.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .config import ModelConfig
+
+Params = dict[str, Any]
+
+
+# ---------------------------------------------------------------- init
+
+
+def _dense_init(key, shape, scale=None, dtype=jnp.float32):
+    # fan-in is the second-to-last dim: layer-stacked weights are [L, in, out]
+    fan_in = shape[-2] if len(shape) > 1 else shape[0]
+    scale = scale if scale is not None else 1.0 / math.sqrt(fan_in)
+    return jax.random.normal(key, shape, dtype) * scale
+
+
+def init_params(cfg: ModelConfig, key, dtype=jnp.bfloat16) -> Params:
+    """Random-init params with the layout the whole framework shares.
+
+    Schema (leading L = n_layers stacked dim):
+      tok_embed [V, D]; pos_embed [P, D] (learned-pos only);
+      final_norm {scale[D], (bias[D])}; lm_head [D, V] (untied only)
+      layers/
+        ln1.scale|bias [L, D]
+        attn: wq [L, D, H*hd], wk|wv [L, D, Hkv*hd], wo [L, H*hd, D]
+              (+ bq, bk, bv [L, ...], bo [L, D] when use_bias)
+        ln2.scale|bias [L, D]
+        dense mlp: w_up [L, D, F], w_down [L, F, D], (w_gate [L, D, F])
+                   (+ b_up [L, F], b_down [L, D])
+        moe: router [L, D, E], experts w_up|w_gate [L, E, D, F],
+             w_down [L, E, F, D]
+    """
+    D, F, V, L = cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.n_layers
+    H, Hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    keys = iter(jax.random.split(key, 32))
+
+    def dense(shape, scale=None):
+        return _dense_init(next(keys), shape, scale, dtype)
+
+    params: Params = {
+        "tok_embed": _dense_init(next(keys), (V, D), scale=0.02, dtype=dtype),
+    }
+    if cfg.pos_embedding == "learned":
+        params["pos_embed"] = _dense_init(next(keys), (cfg.max_seq_len, D), 0.02, dtype)
+
+    layers: Params = {
+        "ln1": {"scale": jnp.ones((L, D), dtype)},
+        "ln2": {"scale": jnp.ones((L, D), dtype)},
+        "attn": {
+            "wq": dense((L, D, H * hd)),
+            "wk": dense((L, D, Hkv * hd)),
+            "wv": dense((L, D, Hkv * hd)),
+            "wo": dense((L, H * hd, D), scale=1.0 / math.sqrt(H * hd)),
+        },
+    }
+    if cfg.norm == "layernorm":
+        layers["ln1"]["bias"] = jnp.zeros((L, D), dtype)
+        layers["ln2"]["bias"] = jnp.zeros((L, D), dtype)
+    if cfg.use_bias:
+        layers["attn"]["bq"] = jnp.zeros((L, H * hd), dtype)
+        layers["attn"]["bk"] = jnp.zeros((L, Hkv * hd), dtype)
+        layers["attn"]["bv"] = jnp.zeros((L, Hkv * hd), dtype)
+        layers["attn"]["bo"] = jnp.zeros((L, D), dtype)
+
+    gated = cfg.activation in ("silu", "geglu")
+    if cfg.is_moe:
+        E = cfg.n_experts
+        moe = {
+            "router": dense((L, D, E)),
+            "w_up": dense((L, E, D, F)),
+            "w_down": dense((L, E, F, D), scale=1.0 / math.sqrt(F)),
+        }
+        if gated:
+            moe["w_gate"] = dense((L, E, D, F))
+        layers["moe"] = moe
+    else:
+        mlp = {
+            "w_up": dense((L, D, F)),
+            "w_down": dense((L, F, D), scale=1.0 / math.sqrt(F)),
+        }
+        if gated:
+            mlp["w_gate"] = dense((L, D, F))
+        if cfg.use_bias:
+            mlp["b_up"] = jnp.zeros((L, F), dtype)
+            mlp["b_down"] = jnp.zeros((L, D), dtype)
+        layers["mlp"] = mlp
+
+    params["layers"] = layers
+    params["final_norm"] = {"scale": jnp.ones((D,), dtype)}
+    if cfg.norm == "layernorm":
+        params["final_norm"]["bias"] = jnp.zeros((D,), dtype)
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense((D, V))
+    return params
+
+
+# ---------------------------------------------------------------- ops
+
+
+def _norm(x, p, cfg: ModelConfig):
+    xf = x.astype(jnp.float32)
+    if cfg.norm == "rmsnorm":
+        xf = xf * lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + cfg.norm_eps)
+    else:
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        xf = (xf - mean) * lax.rsqrt(var + cfg.norm_eps)
+    out = xf.astype(x.dtype) * p["scale"]
+    if "bias" in p:
+        out = out + p["bias"]
+    return out
+
+
+def _rope(x, positions, theta: float):
+    """Rotary embedding. x: [B, T, H, hd]; positions: [B, T]."""
+    hd = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, T, hd/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _activate(up, gate, cfg: ModelConfig):
+    if cfg.activation == "silu":
+        return jax.nn.silu(gate) * up
+    if cfg.activation == "geglu":
+        return jax.nn.gelu(gate, approximate=True) * up
+    return jax.nn.gelu(up, approximate=True)
+
+
+def _attention(q, k, v, mask, cfg: ModelConfig):
+    """q: [B, T, H, hd]; k, v: [B, S, Hkv, hd]; mask: [B, 1, T, S] bool."""
+    B, T, H, hd = q.shape
+    S, Hkv = k.shape[1], k.shape[2]
+    group = H // Hkv
+    q = q.reshape(B, T, Hkv, group, hd)
+    logits = jnp.einsum("btkgh,bskh->bkgts", q, k).astype(jnp.float32)
+    logits = logits / math.sqrt(hd)
+    # mask [B,1,T,S] -> broadcast over (kv_head, group) dims
+    logits = jnp.where(mask[:, :, None, :, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgts,bskh->btkgh", probs, v)
+    return out.reshape(B, T, H * hd)
+
+
+def _mlp(x, p, cfg: ModelConfig):
+    up = x @ p["w_up"]
+    if "b_up" in p:
+        up = up + p["b_up"]
+    gate = x @ p["w_gate"] if "w_gate" in p else None
+    h = _activate(up, gate, cfg)
+    out = h @ p["w_down"]
+    if "b_down" in p:
+        out = out + p["b_down"]
+    return out
+
+
+def _moe(x, p, cfg: ModelConfig):
+    """Top-k expert MLP, dense-einsum formulation.
+
+    Every token computes logits over E experts; the top-k probs are
+    renormalized and all experts run on all tokens with a weight mask —
+    the XLA-friendly dense formulation (no gather/scatter, static shapes).
+    Expert-parallel sharding splits the E dim across the `expert` mesh axis
+    and XLA turns the weighted sum into a reduce over that axis.
+    """
+    B, T, D = x.shape
+    E, k = cfg.n_experts, cfg.n_experts_per_tok
+    logits = (x @ p["router"]).astype(jnp.float32)  # [B, T, E]
+    topv, topi = lax.top_k(logits, k)
+    topp = jax.nn.softmax(topv, axis=-1)  # renormalized over the top-k
+    # dense per-expert weight [B, T, E]: scatter top-k probs via one-hot
+    weights = jnp.sum(jax.nn.one_hot(topi, E, dtype=jnp.float32) * topp[..., None], axis=-2)
+    up = jnp.einsum("btd,edf->btef", x, p["w_up"])
+    if "w_gate" in p:
+        gate = jnp.einsum("btd,edf->btef", x, p["w_gate"])
+    else:
+        gate = None
+    h = _activate(up, gate, cfg)  # [B, T, E, F]
+    out = jnp.einsum("btef,efd->bted", h, p["w_down"])
+    return jnp.einsum("bted,bte->btd", out, weights.astype(out.dtype))
+
+
+# ---------------------------------------------------------------- forward
+
+
+def forward(
+    params: Params,
+    cfg: ModelConfig,
+    input_ids,  # [B, T] int32
+    cache,  # {"k": [L,B,S,Hkv,hd], "v": ...} or None (no-cache full forward)
+    offset,  # [] or [B] int32: write position of input_ids[:, 0] in the cache
+):
+    """Run a [B, T] token chunk. Returns (logits [B, T, V], new_cache).
+
+    With a cache: K/V for this chunk are written at [offset, offset+T) and
+    attention looks at cache positions < offset+T (causally within the
+    chunk). Without a cache (cache=None): plain causal self-attention over
+    the chunk — the training/scoring path.
+    """
+    B, T = input_ids.shape
+    D = cfg.d_model
+    H, Hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    off = jnp.asarray(offset, jnp.int32)
+    off_b = jnp.broadcast_to(off.reshape(-1), (B,))  # [B]
+    positions = off_b[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]  # [B, T]
+
+    x = jnp.take(params["tok_embed"], input_ids, axis=0)
+    if cfg.embedding_scale:
+        x = x * jnp.asarray(math.sqrt(D), x.dtype)
+    if cfg.pos_embedding == "learned":
+        x = x + jnp.take(params["pos_embed"], positions, axis=0)
+
+    if cache is not None:
+        S = cache["k"].shape[2]
+        # mask over cache: position s visible to query t iff s <= off + t
+        s_idx = jnp.arange(S, dtype=jnp.int32)[None, None, :]  # [1,1,S]
+        q_pos = positions[:, :, None]  # [B,T,1]
+        mask = (s_idx <= q_pos)[:, None, :, :]  # [B,1,T,S]
+    else:
+        causal = jnp.tril(jnp.ones((T, T), bool))
+        mask = causal[None, None, :, :]
+
+    def layer(carry, xs):
+        x, cache_k, cache_v = carry
+        lp, layer_idx = xs
+
+        h = _norm(x, lp["ln1"], cfg)
+        q = h @ lp["attn"]["wq"]
+        k = h @ lp["attn"]["wk"]
+        v = h @ lp["attn"]["wv"]
+        if "bq" in lp["attn"]:
+            q = q + lp["attn"]["bq"]
+            k = k + lp["attn"]["bk"]
+            v = v + lp["attn"]["bv"]
+        q = q.reshape(B, T, H, hd)
+        k = k.reshape(B, T, Hkv, hd)
+        v = v.reshape(B, T, Hkv, hd)
+        if cfg.pos_embedding == "rope":
+            q = _rope(q, positions, cfg.rope_theta)
+            k = _rope(k, positions, cfg.rope_theta)
+
+        if cache_k is not None:
+            # write this chunk's K/V at [offset, offset+T) per batch row
+            def write(cache_row, new_row, start):
+                return lax.dynamic_update_slice(
+                    cache_row, new_row.astype(cache_row.dtype), (start, 0, 0)
+                )
+
+            ck = jax.vmap(write)(cache_k[layer_idx], k, off_b)
+            cv = jax.vmap(write)(cache_v[layer_idx], v, off_b)
+            cache_k = cache_k.at[layer_idx].set(ck)
+            cache_v = cache_v.at[layer_idx].set(cv)
+            attn_out = _attention(q, ck, cv, mask, cfg)
+        else:
+            attn_out = _attention(q, k, v, mask, cfg)
+
+        attn_out = attn_out @ lp["attn"]["wo"]
+        if "bo" in lp["attn"]:
+            attn_out = attn_out + lp["attn"]["bo"]
+        x = x + attn_out
+
+        h2 = _norm(x, lp["ln2"], cfg)
+        if cfg.is_moe:
+            x = x + _moe(h2, lp["moe"], cfg)
+        else:
+            x = x + _mlp(h2, lp["mlp"], cfg)
+        return (x, cache_k, cache_v), None
+
+    layer_params = params["layers"]
+    n_layers = cfg.n_layers
+    if cache is not None:
+        (x, ck, cv), _ = lax.scan(
+            layer,
+            (x, cache["k"], cache["v"]),
+            (layer_params, jnp.arange(n_layers)),
+        )
+        new_cache = {"k": ck, "v": cv}
+    else:
+        (x, _, _), _ = lax.scan(
+            layer,
+            (x, None, None),
+            (layer_params, jnp.arange(n_layers)),
+        )
+        new_cache = None
+
+    x = _norm(x, params["final_norm"], cfg)
+    if cfg.tie_embeddings:
+        logits = x @ params["tok_embed"].T
+    else:
+        logits = x @ params["lm_head"]
+    logits = logits.astype(jnp.float32)
+    if cfg.logits_softcap:
+        c = cfg.logits_softcap
+        logits = jnp.tanh(logits / c) * c
+    return logits, new_cache
+
+
+def init_cache(cfg: ModelConfig, batch: int, max_len: int | None = None, dtype=jnp.bfloat16):
+    """Preallocate the fixed-capacity KV cache: {"k","v"}: [L,B,S,Hkv,hd]."""
+    S = max_len or cfg.max_seq_len
+    shape = (cfg.n_layers, batch, S, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}

@@ -1,0 +1,159 @@
+"""Partition rules: param path → PartitionSpec over the named mesh axes.
+
+This is the TP/EP layout for the BASELINE ladder (Llama-3-8B TP on v5e-8,
+Mixtral EP on v5e-16). Megatron-style column/row split per block:
+
+- wq/wk/wv: columns (head dim) on `model` → attention heads are sharded,
+  no collective inside attention
+- wo: rows on `model` → XLA inserts one psum (all-reduce) per layer
+- w_up/w_gate: columns on `model`; w_down: rows on `model` → one psum
+- tok_embed: vocab dim on `model` (all-gather of the embedding row);
+  lm_head: vocab columns on `model` (logits computed sharded)
+- MoE experts: E dim on `expert` axis; router replicated
+- KV cache: kv-head dim on `model` (decode-time attention stays local)
+
+All specs are expressed over param PATHS (tuple of pytree keys), so the
+same rules drive (a) NamedSharding for jit, (b) the piece/shard manifest
+(pieces.build_shard_manifest), and (c) checkpoint resharding.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .config import ModelConfig
+
+# rules: suffix of the "/"-joined param path → PartitionSpec
+# (leading L dim on layer-stacked params is never sharded → spec starts None)
+_RULES: list[tuple[str, P]] = [
+    ("tok_embed", P("model", None)),
+    ("pos_embed", P(None, None)),
+    ("lm_head", P(None, "model")),
+    ("final_norm/scale", P(None)),
+    ("final_norm/bias", P(None)),
+    # attention (layer-stacked: [L, ...])
+    ("attn/wq", P(None, None, "model")),
+    ("attn/wk", P(None, None, "model")),
+    ("attn/wv", P(None, None, "model")),
+    ("attn/wo", P(None, "model", None)),
+    ("attn/bq", P(None, "model")),
+    ("attn/bk", P(None, "model")),
+    ("attn/bv", P(None, "model")),
+    ("attn/bo", P(None, None)),
+    # dense mlp
+    ("mlp/w_up", P(None, None, "model")),
+    ("mlp/w_gate", P(None, None, "model")),
+    ("mlp/w_down", P(None, "model", None)),
+    ("mlp/b_up", P(None, "model")),
+    ("mlp/b_down", P(None, None)),
+    # moe: experts on `expert`, inner dims on `model`
+    ("moe/router", P(None, None, None)),
+    ("moe/w_up", P(None, "expert", None, "model")),
+    ("moe/w_gate", P(None, "expert", None, "model")),
+    ("moe/w_down", P(None, "expert", "model", None)),
+    # norms
+    ("ln1/scale", P(None, None)),
+    ("ln1/bias", P(None, None)),
+    ("ln2/scale", P(None, None)),
+    ("ln2/bias", P(None, None)),
+]
+
+
+def spec_for_path(path: str) -> P:
+    for suffix, spec in _RULES:
+        if path.endswith(suffix):
+            return spec
+    return P()  # replicate by default
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        parts.append(str(getattr(p, "key", getattr(p, "idx", p))))
+    return "/".join(parts)
+
+
+def partition_specs(params) -> dict:
+    """Pytree of PartitionSpec matching `params`' structure."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: spec_for_path(_path_str(path)), params
+    )
+
+
+def _fits(leaf, spec: P, mesh: Mesh) -> bool:
+    for dim, entry in zip(leaf.shape, spec):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        n = 1
+        for a in axes:
+            n *= mesh.shape.get(a, 1)
+        if dim % n:
+            return False
+    return True
+
+
+def shard_params(params, mesh: Mesh):
+    """Place params onto the mesh per the rules (host → device transfer).
+    Params whose sharded dim doesn't divide the mesh axis (e.g. gpt2's prime
+    vocab on tok_embed/lm_head) are replicated instead."""
+    specs = partition_specs(params)
+    return jax.tree.map(
+        lambda leaf, spec: jax.device_put(
+            leaf, NamedSharding(mesh, spec if _fits(leaf, spec, mesh) else P())
+        ),
+        params,
+        specs,
+    )
+
+
+def cache_spec() -> P:
+    """KV cache [L, B, S, Hkv, hd]: batch on `data`, kv heads on `model`."""
+    return P(None, "data", None, "model", None)
+
+
+def flat_partition_specs(params, mesh_axes: dict[str, int] | None = None) -> dict[str, tuple]:
+    """{path_str: spec-as-tuple} for pieces.build_shard_manifest, which
+    wants mesh-axis names per tensor axis. With `mesh_axes` given, specs
+    whose dims don't divide the axis size degrade to replicated — mirroring
+    shard_params' fallback."""
+    out = {}
+
+    def visit(path, leaf):
+        ps = _path_str(path)
+        spec = tuple(spec_for_path(ps))
+        if mesh_axes:
+            ok = all(
+                e is None or leaf.shape[i] % mesh_axes.get(e, 1) == 0
+                for i, e in enumerate(spec)
+            )
+            if not ok:
+                spec = ()
+        out[ps] = spec
+        return leaf
+
+    jax.tree_util.tree_map_with_path(visit, params)
+    return out
+
+
+def validate_divisibility(cfg: ModelConfig, mesh: Mesh) -> None:
+    """Fail fast when the model's dims don't divide the mesh axes."""
+    tp = mesh.shape.get("model", 1)
+    ep = mesh.shape.get("expert", 1)
+    problems = []
+    # the KV cache shards kv heads on `model` (cache_spec), so tp must
+    # divide n_kv_heads exactly (KV replication for tp > Hkv is future work)
+    if cfg.n_kv_heads % tp:
+        problems.append(f"n_kv_heads={cfg.n_kv_heads} vs model axis {tp}")
+    if (cfg.n_heads * cfg.head_dim) % tp:
+        problems.append(f"attn width {cfg.n_heads * cfg.head_dim} vs model axis {tp}")
+    if cfg.d_ff % tp:
+        problems.append(f"d_ff={cfg.d_ff} vs model axis {tp}")
+    # note: vocab (tok_embed/lm_head) indivisibility is NOT fatal —
+    # shard_params falls back to replicating those params (gpt2's 50257
+    # vocab is prime, yet gpt2 must still run TP on its other dims)
+    if cfg.is_moe and cfg.n_experts % ep:
+        problems.append(f"n_experts={cfg.n_experts} vs expert axis {ep}")
+    if problems:
+        raise ValueError(f"model {cfg.name} does not fit mesh {dict(mesh.shape)}: " + "; ".join(problems))

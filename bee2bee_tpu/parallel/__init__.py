@@ -1,0 +1,6 @@
+"""Parallelism layer: device meshes, sharding rules, and cross-peer parallel
+serving (TP/PP/EP/SP). The reference has no analogue — its only parallelism
+is layer-range pipeline hops over WebSocket (reference node.py:236-277); here
+parallelism is jax.sharding over a Mesh with XLA-inserted collectives."""
+
+from .mesh import MeshSpec, build_mesh, local_mesh  # noqa: F401

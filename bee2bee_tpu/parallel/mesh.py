@@ -1,0 +1,77 @@
+"""Device mesh construction: the single place axis names are defined.
+
+Axes (SURVEY §7 step 2: "mesh axes declared once so single-chip is the
+degenerate 1x1 mesh"):
+
+- ``data``   — batch/data parallel replicas
+- ``model``  — tensor-parallel shards (attention heads / MLP columns)
+- ``expert`` — MoE expert-parallel shards
+- ``seq``    — sequence/context parallel (ring attention)
+
+Every axis defaults to 1, so any program written against these names runs
+unchanged from one chip to a v5e-16 slice — only the mesh shape changes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("data", "seq", "expert", "model")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape over the named axes."""
+
+    data: int = 1
+    seq: int = 1
+    expert: int = 1
+    model: int = 1
+    # axis order in the physical device grid; innermost (last) axis gets
+    # devices that are closest in ICI topology, so keep `model` last: TP
+    # collectives are the most latency-sensitive.
+    order: tuple[str, ...] = field(default=AXES)
+
+    @property
+    def shape(self) -> dict[str, int]:
+        return {"data": self.data, "seq": self.seq, "expert": self.expert, "model": self.model}
+
+    @property
+    def size(self) -> int:
+        return self.data * self.seq * self.expert * self.model
+
+    @classmethod
+    def from_dict(cls, d: dict[str, int]) -> "MeshSpec":
+        unknown = set(d) - set(AXES)
+        if unknown:
+            raise ValueError(f"unknown mesh axes {sorted(unknown)}; valid: {AXES}")
+        return cls(**{k: int(v) for k, v in d.items()})
+
+
+def build_mesh(spec: MeshSpec | dict | None = None, devices=None) -> Mesh:
+    """Build a Mesh from a spec. With no spec, all local devices go on the
+    `model` axis (the right default for single-host TP serving)."""
+    if isinstance(spec, dict):
+        spec = MeshSpec.from_dict(spec)
+    devices = list(devices if devices is not None else jax.devices())
+    if spec is None:
+        spec = MeshSpec(model=len(devices))
+    if spec.size > len(devices):
+        raise ValueError(f"mesh needs {spec.size} devices, have {len(devices)}")
+    devices = devices[: spec.size]
+    dims = [spec.shape[a] for a in spec.order]
+    grid = np.array(devices, dtype=object).reshape(dims)
+    return Mesh(grid, spec.order)
+
+
+def local_mesh() -> Mesh:
+    """Degenerate all-axes-1 mesh on the first local device."""
+    return build_mesh(MeshSpec(), devices=jax.devices()[:1])
+
+
+def axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape.get(axis, 1)

@@ -1,0 +1,179 @@
+"""InferenceEngine tests: streaming, determinism, sampling, truncation,
+metrics — the contract the service layer builds on."""
+
+import jax
+import numpy as np
+import pytest
+
+from bee2bee_tpu.engine import EngineConfig, InferenceEngine
+from bee2bee_tpu.engine.sampling import sample
+from bee2bee_tpu.engine.tokenizer import ByteTokenizer
+import jax.numpy as jnp
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return InferenceEngine(
+        "tiny-llama",
+        engine_config=EngineConfig(max_seq_len=128, prefill_buckets=(16, 32, 64), dtype="float32", cache_dtype="float32"),
+    )
+
+
+def test_generate_stream_yields_tokens_then_result(engine):
+    events = list(engine.generate_stream("hello mesh", max_new_tokens=8))
+    # streaming is chunked: each event carries one or more tokens
+    streamed = []
+    for e in events:
+        if "token" in e:
+            streamed.extend(e.get("tokens", [e["token"]]))
+    assert 0 < len(streamed) <= 8
+    done = events[-1]
+    assert done["done"] is True
+    r = done["result"]
+    assert r.new_tokens == len(streamed)
+    assert r.token_ids == streamed
+    assert r.prompt_tokens > 0
+    assert r.ttft_s > 0 and r.latency_s >= r.ttft_s
+    assert r.finish_reason in ("length", "eos", "stop")
+
+
+def test_greedy_is_deterministic(engine):
+    a = engine.generate("determinism", max_new_tokens=6)
+    b = engine.generate("determinism", max_new_tokens=6)
+    assert a.token_ids == b.token_ids
+
+
+def test_cache_isolation_between_requests(engine):
+    """A second request must not see the first request's KV state."""
+    base = engine.generate("aaaa", max_new_tokens=5).token_ids
+    engine.generate("completely different context", max_new_tokens=5)
+    again = engine.generate("aaaa", max_new_tokens=5).token_ids
+    assert base == again
+
+
+def test_long_prompt_left_truncates(engine):
+    long_prompt = "x" * 5000
+    r = engine.generate(long_prompt, max_new_tokens=16)
+    assert r.prompt_tokens <= engine.max_seq_len - 16 - 1
+    assert r.new_tokens > 0
+
+
+def test_max_new_tokens_too_large_raises(engine):
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        engine.generate("hi", max_new_tokens=10_000)
+
+
+def test_stop_tokens_halt_generation(engine):
+    free = engine.generate("stop test", max_new_tokens=8)
+    assert len(free.token_ids) >= 2
+    stop_at = free.token_ids[1]
+    r = engine.generate("stop test", max_new_tokens=8, stop_tokens=[stop_at])
+    assert r.token_ids == free.token_ids[:1]
+    assert r.finish_reason == "stop"
+
+
+def test_metrics_recorded(engine):
+    before = engine.metrics.snapshot()["total_requests"]
+    engine.generate("metrics", max_new_tokens=4)
+    after = engine.metrics.snapshot()
+    assert after["total_requests"] == before + 1
+    assert after["total_tokens"] > 0
+
+
+def test_temperature_sampling_varies(engine):
+    outs = {
+        tuple(engine.generate("sampling seed test", max_new_tokens=8, temperature=1.5).token_ids)
+        for _ in range(4)
+    }
+    assert len(outs) > 1  # rng advances between requests
+
+
+def test_score_logprobs(engine):
+    ids = engine.tokenizer.encode("score me")
+    lp = engine.score(ids)
+    assert lp.shape == (len(ids) - 1,)
+    assert np.all(lp <= 0)
+
+
+def test_info_schema(engine):
+    info = engine.info
+    assert info["model"] == "tiny-llama"
+    assert info["n_params"] > 0
+    assert info["mesh"]["model"] >= 1
+
+
+# ---- sampling unit behavior -------------------------------------------------
+
+
+def test_sample_greedy_argmax():
+    logits = jnp.asarray([[0.1, 2.0, -1.0, 0.5]])
+    assert int(sample(logits, jax.random.key(0), temperature=0.0)[0]) == 1
+
+
+def test_sample_topk_restricts_support():
+    logits = jnp.asarray([[5.0, 4.0, -10.0, -10.0]])
+    toks = {
+        int(sample(logits, jax.random.key(s), temperature=1.0, top_k=2)[0])
+        for s in range(50)
+    }
+    assert toks <= {0, 1}
+
+
+def test_sample_topp_keeps_nucleus():
+    # one dominant token (p>0.99): top_p=0.5 must always pick it
+    logits = jnp.asarray([[10.0, 0.0, 0.0, 0.0]])
+    toks = {
+        int(sample(logits, jax.random.key(s), temperature=1.0, top_p=0.5)[0])
+        for s in range(20)
+    }
+    assert toks == {0}
+
+
+def test_byte_tokenizer_roundtrip():
+    tok = ByteTokenizer(50257)
+    text = "hello wörld — bee2bee"
+    assert tok.decode(tok.encode(text)) == text
+    ids = tok.encode(text)
+    assert ids[0] == tok.bos_id
+    assert all(0 <= i < tok.vocab_size for i in ids)
+
+
+def test_top_p_zero_degrades_to_greedy():
+    logits = jnp.asarray([[0.1, 2.0, -1.0, 0.5]])
+    for s in range(10):
+        assert int(sample(logits, jax.random.key(s), temperature=1.0, top_p=0.0)[0]) == 1
+
+
+def test_random_init_finite_at_depth():
+    # fan-in must come from the true input dim, not the stacked layer dim:
+    # a deep-ish random model must produce finite logits
+    from bee2bee_tpu.models import core, get_config
+    from dataclasses import replace
+    cfg = replace(get_config("tiny-llama"), n_layers=16)
+    params = core.init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    logits, _ = core.forward(params, cfg, jnp.ones((1, 8), jnp.int32), None, 0)
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_real_model_names_never_resolve_to_tiny_configs():
+    from bee2bee_tpu.models import get_config
+    assert get_config("openai-community/gpt2").name == "gpt2"
+    assert get_config("gpt2").name == "gpt2"
+    assert get_config("tiny-gpt2").name == "tiny-gpt2"
+
+
+def test_max_new_tokens_zero_streams_nothing(engine):
+    evs = list(engine.generate_stream("hi", max_new_tokens=0))
+    assert len(evs) == 1 and evs[0]["done"]
+    assert evs[0]["result"].new_tokens == 0
+    assert engine.generate("hi", max_new_tokens=0).new_tokens == 0
+
+
+def test_engine_on_data_axis_mesh_does_not_crash():
+    from bee2bee_tpu.parallel import MeshSpec, build_mesh
+    mesh = build_mesh(MeshSpec(data=2, model=2))
+    eng = InferenceEngine(
+        "tiny-llama", mesh=mesh,
+        engine_config=EngineConfig(max_seq_len=64, prefill_buckets=(16,), dtype="float32", cache_dtype="float32"),
+    )
+    assert eng.generate("data axis", max_new_tokens=4).new_tokens > 0
