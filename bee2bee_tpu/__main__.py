@@ -1,0 +1,145 @@
+"""CLI: `python -m bee2bee_tpu <command>` (reference __main__.py:30-123's
+click group, with `serve-tpu` as the flagship alongside the reference's
+backends and `register` for one-shot registry upserts)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+
+import click
+
+from . import __version__
+from .config import load_config, save_config
+
+
+def _setup_logging():
+    logging.basicConfig(
+        level=os.environ.get("LOG_LEVEL", "INFO"),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+
+
+def _serve(backend: str, model: str, **kw):
+    from .meshnet.runtime import run_p2p_node
+
+    _setup_logging()
+    cfg = load_config()
+    if kw.get("port") is not None:
+        cfg.port = kw["port"]
+    if kw.get("api_port") is not None:
+        cfg.api_port = kw["api_port"]
+    if kw.get("price") is not None:
+        cfg.price_per_token = kw["price"]
+    if kw.get("mesh_shape"):
+        cfg.mesh_shape = kw["mesh_shape"]
+    try:
+        asyncio.run(
+            run_p2p_node(
+                backend=backend,
+                model=model,
+                cfg=cfg,
+                bootstrap=kw.get("bootstrap"),
+                checkpoint_path=kw.get("checkpoint"),
+                ollama_host=kw.get("ollama_host"),
+            )
+        )
+    except KeyboardInterrupt:
+        click.echo("shutting down")
+
+
+def _common_opts(f):
+    f = click.option("--port", type=int, default=None, help="WS mesh port")(f)
+    f = click.option("--api-port", type=int, default=None, help="HTTP gateway port")(f)
+    f = click.option("--bootstrap", default=None, help="bootstrap ws:// addr or join link")(f)
+    f = click.option("--price", type=float, default=None, help="price per token")(f)
+    return f
+
+
+@click.group()
+@click.version_option(__version__)
+def cli():
+    """bee2bee-tpu: TPU-native decentralized inference mesh."""
+
+
+@cli.command("serve-tpu")
+@click.option("--model", default="distilgpt2", help="model name or config key")
+@click.option("--checkpoint", default=None, help="local checkpoint dir (HF or native)")
+@click.option("--mesh-shape", default=None, help='e.g. "data:1,model:8"')
+@_common_opts
+def serve_tpu(model, checkpoint, mesh_shape, **kw):
+    """Serve a model on TPU via the jit engine (the flagship entrypoint)."""
+    _serve("tpu", model, checkpoint=checkpoint, mesh_shape=mesh_shape, **kw)
+
+
+@cli.command("serve-ollama")
+@click.option("--model", required=True)
+@click.option("--ollama-host", default=None, envvar="OLLAMA_HOST")
+@_common_opts
+def serve_ollama(model, ollama_host, **kw):
+    """Proxy a local Ollama daemon into the mesh."""
+    _serve("ollama", model, ollama_host=ollama_host, **kw)
+
+
+@cli.command("serve-hf-remote")
+@click.option("--model", required=True)
+@_common_opts
+def serve_hf_remote(model, **kw):
+    """Proxy the HF serverless Inference API into the mesh."""
+    _serve("hf_remote", model, **kw)
+
+
+@cli.command("serve-fake")
+@click.option("--model", default="fake-model")
+@_common_opts
+def serve_fake(model, **kw):
+    """Serve a deterministic fake backend (testing/demo)."""
+    _serve("fake", model, **kw)
+
+
+@cli.command()
+@click.option("--bootstrap", default=None, help="set the default bootstrap url")
+def register(bootstrap):
+    """One-shot registry upsert + config update (reference __main__.py:78-123)."""
+    _setup_logging()
+    cfg = load_config()
+    if bootstrap:
+        cfg.bootstrap_url = bootstrap
+        save_config(cfg)
+        click.echo(f"bootstrap set to {bootstrap}")
+
+    from .registry import RegistryClient
+
+    client = RegistryClient()
+    if not client.enabled:
+        click.echo("registry disabled (no SUPABASE_URL/ANON_KEY or BEE2BEE_ENTRYPOINT)")
+        return
+
+    async def one_shot():
+        from .meshnet.node import P2PNode
+
+        node = P2PNode(host="127.0.0.1", port=0)
+        await node.start()
+        try:
+            ok = await client.sync_node(node)
+            click.echo(f"registry sync: {'ok' if ok else 'failed'}")
+        finally:
+            await node.stop()
+
+    asyncio.run(one_shot())
+
+
+@cli.command()
+def info():
+    """Show devices, mesh defaults, and config."""
+    import jax
+
+    cfg = load_config()
+    click.echo(f"version: {__version__}")
+    click.echo(f"devices: {jax.devices()}")
+    click.echo(f"config: {cfg.to_dict()}")
+
+
+if __name__ == "__main__":
+    cli()

@@ -1,0 +1,250 @@
+"""HTTP gateway: per-node REST API over the mesh runtime.
+
+Same surface as the reference's FastAPI app (api.py:113-267): `GET /` status,
+`GET /peers`, `GET /providers`, `POST /connect`, `POST /chat` + `/generate`
+(alias) with local-first fuzzy model match, streaming via chunked responses,
+and P2P fallback; `X-API-KEY` auth — but DENIED BY DEFAULT when no key is
+configured locally-only (the reference leaves the API wide open with no key,
+api.py:24-26; here an unset key only allows loopback callers). Built on
+aiohttp (fastapi/uvicorn are not in this image).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any
+
+from aiohttp import web
+
+from . import __version__
+from .meshnet.node import P2PNode
+
+logger = logging.getLogger("bee2bee_tpu.api")
+
+CORS_HEADERS = {
+    "Access-Control-Allow-Origin": "*",
+    "Access-Control-Allow-Methods": "GET, POST, OPTIONS",
+    "Access-Control-Allow-Headers": "Content-Type, X-API-KEY",
+}
+
+
+def _auth_ok(request: web.Request, api_key: str | None) -> bool:
+    if api_key:
+        return request.headers.get("X-API-KEY") == api_key
+    # no key configured: loopback only (safer than the reference's open
+    # default, per SURVEY §7 "what NOT to carry over")
+    peer = request.remote or ""
+    return peer in ("127.0.0.1", "::1", "localhost", "")
+
+
+# local service resolution lives on the node (_local_service_for) so the
+# HTTP gateway and the P2P gen_request path share one matching rule
+
+
+def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
+    app = web.Application(client_max_size=32 * 1024 * 1024)
+    app["node"] = node
+
+    @web.middleware
+    async def middleware(request: web.Request, handler):
+        if request.method == "OPTIONS":
+            return web.Response(headers=CORS_HEADERS)
+        if not _auth_ok(request, api_key):
+            return web.json_response(
+                {"detail": "invalid or missing X-API-KEY"}, status=401, headers=CORS_HEADERS
+            )
+        try:
+            resp = await handler(request)
+        except web.HTTPException:
+            raise
+        except Exception as e:
+            logger.exception("handler error")
+            return web.json_response({"detail": str(e)}, status=500, headers=CORS_HEADERS)
+        for k, v in CORS_HEADERS.items():
+            resp.headers.setdefault(k, v)
+        return resp
+
+    app.middlewares.append(middleware)
+
+    async def home(request):
+        st = node.status()
+        st.update({"status": "ok", "version": __version__})
+        return web.json_response(st)
+
+    async def peers(request):
+        out = []
+        for pid, info in node.peers.items():
+            out.append(
+                {
+                    "peer_id": pid,
+                    "addr": info.get("addr"),
+                    "region": info.get("region"),
+                    "health": info.get("health"),
+                    "rtt_ms": info.get("rtt_ms"),
+                    "metrics": info.get("metrics"),
+                    "api_port": info.get("api_port"),
+                }
+            )
+        return web.json_response({"peers": out})
+
+    async def providers(request):
+        return web.json_response({"providers": node.list_providers(request.query.get("model"))})
+
+    async def connect(request):
+        body = await _json_body(request)
+        target = body.get("addr") or body.get("link")
+        if not target:
+            return web.json_response({"detail": "addr or link required"}, status=400)
+        ok = await node.connect_bootstrap(target)
+        return web.json_response({"connected": ok})
+
+    async def chat(request):
+        body = await _json_body(request)
+        prompt = body.get("prompt") or _prompt_from_messages(body.get("messages"))
+        if not prompt:
+            return web.json_response({"detail": "prompt or messages required"}, status=400)
+        model = body.get("model")
+        params = {
+            "prompt": prompt,
+            "max_new_tokens": int(body.get("max_new_tokens") or body.get("max_tokens") or 2048),
+            "temperature": float(body.get("temperature", 0.7)),
+        }
+        svc = node.local_service_for(model)
+        stream = bool(body.get("stream"))
+
+        if svc is not None:
+            if stream:
+                return await _stream_service(request, node, svc, params)
+            import asyncio
+
+            result = await asyncio.get_running_loop().run_in_executor(
+                None, svc.execute, params
+            )
+            return web.json_response(result)
+
+        # P2P fallback (reference api.py:247-264)
+        provider = node.pick_provider(model)
+        if provider is None or provider["local"]:
+            return web.json_response(
+                {"detail": f"no provider for model {model!r}"}, status=404
+            )
+        if stream:
+            return await _stream_p2p(request, node, provider, params, model)
+        result = await node.request_generation(
+            provider["provider_id"],
+            prompt,
+            model=model,
+            max_new_tokens=params["max_new_tokens"],
+            temperature=params["temperature"],
+        )
+        return web.json_response(result)
+
+    app.router.add_get("/", home)
+    app.router.add_get("/peers", peers)
+    app.router.add_get("/providers", providers)
+    app.router.add_post("/connect", connect)
+    app.router.add_post("/chat", chat)
+    app.router.add_post("/generate", chat)  # alias (reference api.py:190-191)
+    app.router.add_route("OPTIONS", "/{tail:.*}", lambda r: web.Response(headers=CORS_HEADERS))
+    return app
+
+
+async def _json_body(request: web.Request) -> dict[str, Any]:
+    try:
+        return await request.json()
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        raise web.HTTPBadRequest(reason="invalid JSON body")
+
+
+def _prompt_from_messages(messages) -> str | None:
+    """OpenAI-style messages → user:/assistant: transcript (the format the
+    reference UI sends, App.jsx:994-998)."""
+    if not messages:
+        return None
+    return "\n".join(f"{m.get('role', 'user')}: {m.get('content', '')}" for m in messages)
+
+
+async def _stream_service(request, node: P2PNode, svc, params) -> web.StreamResponse:
+    """JSON-lines streaming from a local service (chunked response)."""
+    import asyncio
+
+    resp = web.StreamResponse(
+        headers={"Content-Type": "application/x-ndjson", **CORS_HEADERS}
+    )
+    await resp.prepare(request)
+    loop = asyncio.get_running_loop()
+    q: asyncio.Queue = asyncio.Queue()
+    DONE = object()
+
+    def pump():
+        try:
+            for line in svc.execute_stream(params):
+                loop.call_soon_threadsafe(q.put_nowait, line)
+        finally:
+            loop.call_soon_threadsafe(q.put_nowait, DONE)
+
+    task = loop.run_in_executor(None, pump)
+    while True:
+        item = await q.get()
+        if item is DONE:
+            break
+        await resp.write(item.encode("utf-8"))
+    await task
+    await resp.write_eof()
+    return resp
+
+
+async def _stream_p2p(request, node: P2PNode, provider, params, model) -> web.StreamResponse:
+    import asyncio
+
+    resp = web.StreamResponse(
+        headers={"Content-Type": "application/x-ndjson", **CORS_HEADERS}
+    )
+    await resp.prepare(request)
+    q: asyncio.Queue = asyncio.Queue()
+    loop = asyncio.get_running_loop()
+
+    def on_chunk(text):
+        q.put_nowait(json.dumps({"text": text}) + "\n")
+
+    gen_task = asyncio.create_task(
+        node.request_generation(
+            provider["provider_id"],
+            params["prompt"],
+            model=model,
+            max_new_tokens=params["max_new_tokens"],
+            temperature=params["temperature"],
+            stream=True,
+            on_chunk=on_chunk,
+        )
+    )
+    while True:
+        getter = asyncio.create_task(q.get())
+        done, _ = await asyncio.wait({getter, gen_task}, return_when=asyncio.FIRST_COMPLETED)
+        if getter in done:
+            await resp.write(getter.result().encode("utf-8"))
+            continue
+        getter.cancel()
+        try:
+            await gen_task
+            while not q.empty():
+                await resp.write(q.get_nowait().encode("utf-8"))
+            await resp.write((json.dumps({"done": True}) + "\n").encode("utf-8"))
+        except Exception as e:
+            await resp.write(
+                (json.dumps({"status": "error", "message": str(e)}) + "\n").encode("utf-8")
+            )
+        break
+    await resp.write_eof()
+    return resp
+
+
+async def start_api_server(node: P2PNode, host: str, port: int, api_key: str | None = None):
+    """Start the gateway; returns the aiohttp AppRunner (await .cleanup())."""
+    runner = web.AppRunner(build_app(node, api_key=api_key))
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    logger.info("api gateway on http://%s:%s", host, port)
+    return runner

@@ -23,6 +23,7 @@ callback) minus the transcript parsing, which lives in the service layer.
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from functools import partial
@@ -108,6 +109,9 @@ class InferenceEngine:
         self._prefill = jax.jit(self._prefill_fn, donate_argnums=(2,))
         self._decode_compiled: dict[tuple, Callable] = {}
         self._rng = jax.random.key(self.engine_cfg.rng_seed)
+        # gateways run execute() on a thread pool: guard the rng stream and
+        # the compiled-fn cache (jax itself is safe for concurrent dispatch)
+        self._mutex = threading.Lock()
 
     # ------------------------------------------------------------ compiled fns
 
@@ -144,14 +148,15 @@ class InferenceEngine:
             int(top_k or 0),
             round(float(top_p if top_p is not None else 1.0), 4),
         )
-        fn = self._decode_compiled.get(sig)
-        if fn is None:
-            fn = jax.jit(
-                partial(self._decode_chunk_fn, sig[0], sig[1], sig[2]),
-                donate_argnums=(2,),  # donate the cache for in-place HBM update
-            )
-            self._decode_compiled[sig] = fn
-        return fn
+        with self._mutex:
+            fn = self._decode_compiled.get(sig)
+            if fn is None:
+                fn = jax.jit(
+                    partial(self._decode_chunk_fn, sig[0], sig[1], sig[2]),
+                    donate_argnums=(2,),  # donate the cache for in-place HBM update
+                )
+                self._decode_compiled[sig] = fn
+            return fn
 
     # ------------------------------------------------------------ helpers
 
@@ -176,8 +181,9 @@ class InferenceEngine:
         return jax.device_put(cache, NamedSharding(self.mesh, fitted))
 
     def _next_key(self):
-        self._rng, sub = jax.random.split(self._rng)
-        return sub
+        with self._mutex:
+            self._rng, sub = jax.random.split(self._rng)
+            return sub
 
     # ------------------------------------------------------------ public API
 
@@ -186,20 +192,29 @@ class InferenceEngine:
 
         Chunks chain on-device through (cur, cache); dispatch is ~free, so
         all compute is enqueued before anything is read back. Returns
-        (first_token_dev [B], chunk_devs list of [B, K], n_prompt, bucket).
+        (first_token_dev [B], chunk_devs list of [B, K], n_prompt, bucket,
+        clamped_max_new_tokens).
         """
         if isinstance(prompt, str):
             ids = self.tokenizer.encode(prompt)
         else:
             ids = list(prompt)
         K = self.engine_cfg.decode_chunk
-        chunks = max(0, -(-(max_new_tokens - 1) // K))  # ceil
-        gen_capacity = 1 + chunks * K
-        budget = self.max_seq_len - gen_capacity - 1
-        if budget < 1:
+        # clamp generation to what the cache can hold while keeping at least
+        # a small prompt window (callers may pass max_new_tokens == cache
+        # size; clamping, not erroring, is the serving behavior)
+        min_prompt = max(1, min(len(ids), 16))
+        max_gen = self.max_seq_len - 1 - min_prompt
+        if max_gen < 1:
             raise ValueError(
                 f"max_new_tokens={max_new_tokens} leaves no room in max_seq_len={self.max_seq_len}"
             )
+        max_new_tokens = max(0, min(max_new_tokens, max_gen))
+        chunks = max(0, -(-(max_new_tokens - 1) // K))  # ceil
+        chunks = min(chunks, (max_gen - 1) // K) if K else 0
+        max_new_tokens = min(max_new_tokens, 1 + chunks * K)
+        gen_capacity = 1 + chunks * K
+        budget = self.max_seq_len - gen_capacity - 1
         # left-truncate so prompt + generation fits the cache (the reference
         # simply OOMs/errors here; we keep the most recent context)
         if len(ids) > budget:
@@ -224,7 +239,7 @@ class InferenceEngine:
             cur = toks_dev[:, -1]
             offset += K
             pending.append(toks_dev)
-        return first, pending, n, bucket
+        return first, pending, n, bucket, max_new_tokens
 
     def _stop_set(self, stop_tokens):
         stop = set(stop_tokens or [])
@@ -264,7 +279,7 @@ class InferenceEngine:
         granularity is engine_cfg.decode_chunk tokens (each read through a
         tunneled TPU costs ~100 ms — see _dispatch)."""
         t_start = time.perf_counter()
-        first, pending, n, bucket = self._dispatch(
+        first, pending, n, bucket, max_new_tokens = self._dispatch(
             prompt, max_new_tokens, temperature, top_k, top_p
         )
         stop, eos = self._stop_set(stop_tokens)
@@ -275,6 +290,7 @@ class InferenceEngine:
 
         out_ids: list[int] = []
         fin: str | None = None
+        flushed_text = ""  # cumulative decode → UTF-8-safe incremental text
 
         def emit(t: int) -> str | None:
             if t in stop:
@@ -282,9 +298,21 @@ class InferenceEngine:
             out_ids.append(t)
             return None
 
+        def text_delta(final: bool = False) -> str:
+            # decode the cumulative ids and emit the new suffix; hold back
+            # trailing replacement chars (a multi-byte char split across
+            # chunks) until the next chunk completes it
+            nonlocal flushed_text
+            full = self.tokenizer.decode(out_ids)
+            if not final:
+                full = full.rstrip("�")
+            delta = full[len(flushed_text):]
+            flushed_text = full
+            return delta
+
         fin = emit(tok) if max_new_tokens > 0 else None
         if fin is None and max_new_tokens > 0:
-            yield {"token": tok, "tokens": [tok], "text": self.tokenizer.decode([tok])}
+            yield {"token": tok, "tokens": [tok], "text": text_delta()}
             for toks_dev in pending:
                 if fin is not None or len(out_ids) >= max_new_tokens:
                     break
@@ -298,10 +326,11 @@ class InferenceEngine:
                         break
                     emitted.append(t)
                 if emitted:
+                    last = len(out_ids) >= max_new_tokens or fin is not None
                     yield {
                         "token": emitted[-1],
                         "tokens": emitted,
-                        "text": self.tokenizer.decode(emitted),
+                        "text": text_delta(final=last),
                     }
         yield {
             "done": True,
@@ -317,7 +346,7 @@ class InferenceEngine:
         stop_tokens = kw.pop("stop_tokens", None)
         max_new_tokens = kw.get("max_new_tokens", 128)
         t_start = time.perf_counter()
-        first, pending, n, bucket = self._dispatch(
+        first, pending, n, bucket, max_new_tokens = self._dispatch(
             prompt,
             max_new_tokens,
             kw.get("temperature", 0.0),

@@ -1,0 +1,701 @@
+"""P2PNode: one WebSocket mesh node.
+
+Wire-compatible with the reference's message set (p2p_runtime.py:460-470 and
+the JS bridge's subset, bridge.js:163-223): hello / peer_list / ping / pong /
+service_announce / gen_request / gen_chunk / gen_success / gen_error /
+gen_result / piece_request / piece_data. Reference defects deliberately fixed
+(SURVEY §7 step 4):
+
+- **gen_success vs gen_result asymmetry** (reference only resolves futures on
+  gen_result, p2p_runtime.py:467,660): here the result handler accepts all of
+  gen_success/gen_result/gen_error.
+- **blocking execute in the event loop** (reference calls svc.execute inline,
+  p2p_runtime.py:624): service execution runs in a thread executor.
+- **unlocked _pending_requests** (p2p_runtime.py:794-796): guarded.
+- **piece transfer stubs** (p2p_runtime.py:675-683): fully implemented, with
+  binary tensor frames instead of JSON for piece payloads.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import time
+from pathlib import Path
+from typing import Any, Callable
+
+import websockets
+
+from .. import protocol
+from ..joinlink import generate_join_link, parse_join_link
+from ..pieces import ShardManifest
+from ..utils import MetricsAggregator, get_lan_ip, get_system_metrics, new_id, sha256_hex
+
+logger = logging.getLogger("bee2bee_tpu.mesh")
+
+REQUEST_TIMEOUT_S = 300.0  # reference p2p_runtime.py:831
+PING_INTERVAL_S = 15.0
+
+
+class P2PNode:
+    def __init__(
+        self,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        region: str = "default",
+        node_id: str | None = None,
+        announce_host: str | None = None,
+        announce_port: int | None = None,
+        api_port: int | None = None,
+        piece_dir: str | Path | None = None,
+    ):
+        self.host = host
+        self.port = port
+        self.region = region
+        self.peer_id = node_id or new_id("node")
+        self.announce_host = announce_host
+        self.announce_port = announce_port
+        self.api_port = api_port
+
+        self.peers: dict[str, dict] = {}  # peer_id -> {ws, addr, metrics, ...}
+        self.providers: dict[str, dict] = {}  # peer_id -> {svc_name: meta}
+        self.local_services: dict[str, Any] = {}
+        self.throughput = MetricsAggregator()
+
+        # piece store: hash -> bytes (optionally spilled to piece_dir)
+        self.piece_store: dict[str, bytes] = {}
+        self.piece_dir = Path(piece_dir) if piece_dir else None
+        self.manifests: dict[str, ShardManifest] = {}
+
+        self._server = None
+        self._lock = asyncio.Lock()  # guards peers/providers
+        self._pending_lock = asyncio.Lock()  # guards _pending/_chunk_cbs
+        self._pending: dict[str, asyncio.Future] = {}
+        self._chunk_cbs: dict[str, Callable[[str], None]] = {}
+        self._tasks: list[asyncio.Task] = []
+        self._stopped = False
+        self.started_at: float | None = None
+
+    def _spawn(self, coro) -> asyncio.Task:
+        """Track a background task, self-pruning on completion (a churny
+        mesh would otherwise grow _tasks without bound)."""
+        task = asyncio.create_task(coro)
+        self._tasks.append(task)
+        task.add_done_callback(lambda t: self._tasks.remove(t) if t in self._tasks else None)
+        return task
+
+    # ------------------------------------------------------------ lifecycle
+
+    @property
+    def addr(self) -> str:
+        host = self.announce_host or (get_lan_ip() if self.host in ("0.0.0.0", "::") else self.host)
+        port = self.announce_port or self.port
+        return f"ws://{host}:{port}"
+
+    def join_link(self) -> str:
+        return generate_join_link(self.peer_id, [self.addr])
+
+    async def start(self):
+        self._server = await websockets.serve(
+            self._handle_connection,
+            self.host,
+            self.port,
+            max_size=protocol.MAX_FRAME,  # reference's 32 MiB cap
+        )
+        if self.port == 0:  # resolve ephemeral port
+            self.port = next(iter(self._server.sockets)).getsockname()[1]
+        self.started_at = time.time()
+        self._tasks.append(asyncio.create_task(self._monitor_loop()))
+        logger.info("node %s listening on %s", self.peer_id, self.addr)
+        return self
+
+    async def stop(self):
+        self._stopped = True
+        # say goodbye and close sockets FIRST — cancelling reader tasks
+        # first would purge the peer table before anything gets closed,
+        # leaving outbound connections dangling on the remote side
+        async with self._lock:
+            peers = list(self.peers.values())
+            self.peers.clear()
+            self.providers.clear()
+        for info in peers:
+            with contextlib.suppress(Exception):
+                await info["ws"].send(protocol.encode(protocol.msg(protocol.GOODBYE, peer_id=self.peer_id)))
+                await info["ws"].close()
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            with contextlib.suppress(asyncio.CancelledError):
+                await t
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        async with self._pending_lock:
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(RuntimeError("node_stopped"))
+            self._pending.clear()
+            self._chunk_cbs.clear()
+
+    # ------------------------------------------------------------ connections
+
+    async def _handle_connection(self, ws):
+        """Inbound connection: read messages until close."""
+        try:
+            await self._reader(ws)
+        finally:
+            await self._drop_peer(ws)
+
+    async def _connect_peer(self, addr: str) -> bool:
+        async with self._lock:
+            if any(p.get("addr") == addr for p in self.peers.values()):
+                return True
+        if addr == self.addr:
+            return False
+        try:
+            ws = await websockets.connect(addr, max_size=protocol.MAX_FRAME, open_timeout=10)
+        except Exception as e:
+            # wss→ws fallback mirrors the reference (p2p_runtime.py:353-361)
+            if addr.startswith("wss://"):
+                return await self._connect_peer("ws://" + addr[6:])
+            logger.warning("connect %s failed: %s", addr, e)
+            return False
+        await self._send(ws, self._hello_msg())
+
+        async def run_reader():
+            try:
+                await self._reader(ws)
+            finally:
+                await self._drop_peer(ws)
+
+        self._spawn(run_reader())
+        return True
+
+    async def connect_bootstrap(self, link_or_addr: str) -> bool:
+        """Join the mesh via a ws:// addr or a join link."""
+        if "://" in link_or_addr and link_or_addr.split("://")[0] not in ("ws", "wss"):
+            info = parse_join_link(link_or_addr)
+            for addr in info["bootstrap_addrs"]:
+                if await self._connect_peer(addr):
+                    return True
+            return False
+        return await self._connect_peer(link_or_addr)
+
+    async def _reader(self, ws):
+        async for raw in ws:
+            try:
+                if isinstance(raw, bytes):
+                    data, tensors = protocol.decode_binary(raw)
+                    data["_tensors"] = tensors
+                else:
+                    data = protocol.decode(raw)
+            except ValueError as e:
+                logger.warning("bad frame from peer: %s", e)
+                continue
+            try:
+                await self._on_message(ws, data)
+            except Exception:
+                logger.exception("handler error for %s", data.get("type"))
+
+    async def _drop_peer(self, ws):
+        async with self._lock:
+            dead = [pid for pid, info in self.peers.items() if info["ws"] is ws]
+            for pid in dead:
+                self.peers.pop(pid, None)
+                self.providers.pop(pid, None)
+        for pid in dead:
+            logger.info("peer %s disconnected", pid)
+
+    # ------------------------------------------------------------ sending
+
+    async def _send(self, ws, message: dict | bytes):
+        raw = message if isinstance(message, bytes) else protocol.encode(message)
+        await ws.send(raw)
+
+    async def broadcast(self, message: dict):
+        async with self._lock:
+            targets = [info["ws"] for info in self.peers.values()]
+        results = await asyncio.gather(
+            *(self._send(ws, message) for ws in targets), return_exceptions=True
+        )
+        return sum(1 for r in results if not isinstance(r, Exception))
+
+    # ------------------------------------------------------------ hello/gossip
+
+    def _hello_msg(self) -> dict:
+        return protocol.msg(
+            protocol.HELLO,
+            peer_id=self.peer_id,
+            addr=self.addr,
+            region=self.region,
+            metrics=get_system_metrics(self.throughput),
+            services={n: s.get_metadata() for n, s in self.local_services.items()},
+            api_port=self.api_port,
+            api_host=self.announce_host or get_lan_ip(),
+        )
+
+    async def _on_message(self, ws, data: dict):
+        handlers = {
+            protocol.HELLO: self._handle_hello,
+            protocol.PEER_LIST: self._handle_peer_list,
+            protocol.PING: self._handle_ping,
+            protocol.PONG: self._handle_pong,
+            protocol.SERVICE_ANNOUNCE: self._handle_service_announce,
+            protocol.GEN_REQUEST: self._handle_gen_request,
+            protocol.GEN_CHUNK: self._handle_gen_chunk,
+            protocol.GEN_SUCCESS: self._handle_gen_result,
+            protocol.GEN_RESULT: self._handle_gen_result,
+            protocol.GEN_ERROR: self._handle_gen_result,
+            protocol.PIECE_REQUEST: self._handle_piece_request,
+            protocol.PIECE_DATA: self._handle_piece_data,
+            protocol.PIECE_HAVE: self._handle_piece_have,
+            protocol.GOODBYE: self._handle_goodbye,
+        }
+        handler = handlers.get(data.get("type"))
+        if handler is None:
+            logger.debug("unknown message type %r", data.get("type"))
+            return
+        await handler(ws, data)
+
+    async def _handle_hello(self, ws, data):
+        pid = data.get("peer_id")
+        if not pid or pid == self.peer_id:
+            return
+        known = False
+        async with self._lock:
+            known = pid in self.peers
+            self.peers[pid] = {
+                "ws": ws,
+                "addr": data.get("addr"),
+                "region": data.get("region"),
+                "metrics": data.get("metrics") or {},
+                "api_port": data.get("api_port"),
+                "api_host": data.get("api_host"),
+                "health": "online",
+                "last_seen": time.time(),
+                "rtt_ms": self.peers.get(pid, {}).get("rtt_ms"),
+            }
+            services = data.get("services") or {}
+            if services:
+                self.providers.setdefault(pid, {}).update(services)
+            peer_addrs = [p["addr"] for p in self.peers.values() if p.get("addr")]
+        if not known:
+            await self._send(ws, self._hello_msg())
+            await self._send(ws, protocol.msg(protocol.PEER_LIST, peers=peer_addrs))
+
+    async def _handle_peer_list(self, ws, data):
+        for addr in data.get("peers") or []:
+            if addr and addr != self.addr:
+                with contextlib.suppress(Exception):
+                    await self._connect_peer(addr)
+
+    async def _handle_ping(self, ws, data):
+        pid = await self._peer_for(ws)
+        if pid and data.get("metrics"):
+            async with self._lock:
+                if pid in self.peers:
+                    self.peers[pid]["metrics"] = data["metrics"]
+                    self.peers[pid]["last_seen"] = time.time()
+        await self._send(ws, protocol.msg(protocol.PONG, ts=data.get("ts")))
+
+    async def _handle_pong(self, ws, data):
+        pid = await self._peer_for(ws)
+        ts = data.get("ts")
+        if pid and isinstance(ts, (int, float)):
+            rtt = (time.time() - ts) * 1000.0
+            async with self._lock:
+                if pid in self.peers:
+                    self.peers[pid]["rtt_ms"] = round(rtt, 2)
+                    self.peers[pid]["health"] = "online"
+                    self.peers[pid]["last_seen"] = time.time()
+
+    async def _handle_service_announce(self, ws, data):
+        svc, meta = data.get("service"), data.get("meta") or {}
+        pid = await self._peer_for(ws)
+        if pid and svc:
+            async with self._lock:
+                self.providers.setdefault(pid, {})[svc] = meta
+
+    async def _handle_goodbye(self, ws, data):
+        await self._drop_peer(ws)
+
+    async def _peer_for(self, ws) -> str | None:
+        async with self._lock:
+            for pid, info in self.peers.items():
+                if info["ws"] is ws:
+                    return pid
+        return None
+
+    # ------------------------------------------------------------ services
+
+    def add_service(self, svc) -> None:
+        self.local_services[svc.name] = svc
+
+    async def announce_service(self, svc) -> int:
+        self.add_service(svc)
+        return await self.broadcast(
+            protocol.msg(protocol.SERVICE_ANNOUNCE, service=svc.name, meta=svc.get_metadata())
+        )
+
+    def list_providers(self, model: str | None = None) -> list[dict]:
+        """Flatten local + remote providers (reference p2p_runtime.py:687-721)."""
+        out = []
+        for name, svc in self.local_services.items():
+            meta = svc.get_metadata()
+            out.append({"provider_id": self.peer_id, "service": name, "local": True, **meta})
+        for pid, svcs in self.providers.items():
+            peer = self.peers.get(pid, {})
+            for name, meta in svcs.items():
+                out.append(
+                    {
+                        "provider_id": pid,
+                        "service": name,
+                        "local": False,
+                        "_latency": peer.get("rtt_ms"),
+                        "health": peer.get("health"),
+                        **meta,
+                    }
+                )
+        if model:
+            out = [
+                p for p in out
+                if any(model.lower() in m.lower() or m.lower() in model.lower() for m in p.get("models", []))
+            ]
+        return out
+
+    def pick_provider(self, model: str | None = None) -> dict | None:
+        """Cheapest, then lowest-latency (reference p2p_runtime.py:744-746);
+        local services count as zero latency."""
+        cands = self.list_providers(model)
+        if not cands:
+            return None
+        return sorted(
+            cands,
+            key=lambda p: (
+                p.get("price_per_token") or 0.0,
+                0.0 if p["local"] else (p.get("_latency") or 1e9),
+            ),
+        )[0]
+
+    # ------------------------------------------------------------ generation
+
+    async def request_generation(
+        self,
+        provider_id: str,
+        prompt: str,
+        model: str | None = None,
+        max_new_tokens: int = 2048,
+        temperature: float = 0.7,
+        stream: bool = False,
+        on_chunk: Callable[[str], None] | None = None,
+        timeout: float = REQUEST_TIMEOUT_S,
+    ) -> dict:
+        params = {
+            "prompt": prompt,
+            "max_new_tokens": max_new_tokens,
+            "temperature": temperature,
+        }
+        # self-request shortcut (reference p2p_runtime.py:761-787)
+        if provider_id == self.peer_id:
+            svc = self.local_service_for(model)
+            if svc is None:
+                raise RuntimeError(f"no local service for model {model!r}")
+            return await self._execute_local(svc, params, stream, on_chunk)
+
+        async with self._lock:
+            info = self.peers.get(provider_id)
+            svc_name = self._remote_service_name(provider_id, model)
+        if info is None:
+            raise RuntimeError(f"unknown provider {provider_id!r}")
+
+        rid = new_id("req")
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        async with self._pending_lock:
+            self._pending[rid] = fut
+            if on_chunk:
+                self._chunk_cbs[rid] = on_chunk
+        try:
+            await self._send(
+                info["ws"],
+                protocol.msg(
+                    protocol.GEN_REQUEST,
+                    rid=rid,
+                    prompt=prompt,
+                    model=model,
+                    svc=svc_name,
+                    max_new_tokens=max_new_tokens,
+                    max_tokens=max_new_tokens,  # reference reads this key
+                    temperature=temperature,
+                    stream=bool(stream or on_chunk),
+                ),
+            )
+            result = await asyncio.wait_for(fut, timeout=timeout)
+        except asyncio.TimeoutError:
+            raise RuntimeError("request_timed_out")
+        finally:
+            async with self._pending_lock:
+                self._pending.pop(rid, None)
+                self._chunk_cbs.pop(rid, None)
+        if isinstance(result, dict) and result.get("error"):
+            raise RuntimeError(result["error"])
+        return result
+
+    def local_service_for(self, model: str | None):
+        """Fuzzy-match a local service for `model`; None when a specific
+        model was asked for and nothing matches (the caller then falls back
+        to the mesh — answering for the wrong model would be worse)."""
+        if not model:
+            return next(iter(self.local_services.values()), None)
+        for svc in self.local_services.values():
+            models = svc.get_metadata().get("models", [])
+            if any(model.lower() in m.lower() or m.lower() in model.lower() for m in models):
+                return svc
+        return None
+
+    def _remote_service_name(self, provider_id: str, model: str | None) -> str:
+        svcs = self.providers.get(provider_id, {})
+        if model:
+            for name, meta in svcs.items():
+                if model in meta.get("models", []):
+                    return name
+        return next(iter(svcs), "tpu")
+
+    async def _execute_local(self, svc, params, stream, on_chunk) -> dict:
+        loop = asyncio.get_running_loop()
+        if stream or on_chunk:
+            import json as _json
+
+            text_parts: list[str] = []
+
+            def run_stream():
+                for line in svc.execute_stream(params):
+                    obj = _json.loads(line)
+                    if obj.get("text"):
+                        text_parts.append(obj["text"])
+                        if on_chunk:
+                            loop.call_soon_threadsafe(on_chunk, obj["text"])
+                    if obj.get("status") == "error":
+                        raise RuntimeError(obj.get("message", "stream error"))
+
+            await loop.run_in_executor(None, run_stream)
+            return {"text": "".join(text_parts), "tokens": None, "streamed": True}
+        return await loop.run_in_executor(None, svc.execute, params)
+
+    async def _handle_gen_request(self, ws, data):
+        rid = data.get("rid") or data.get("task_id")
+        model = data.get("model")
+        svc = self.local_services.get(data.get("svc", "")) or self.local_service_for(model)
+        params = {
+            "prompt": data.get("prompt", ""),
+            "max_new_tokens": data.get("max_new_tokens") or data.get("max_tokens") or 2048,
+            "temperature": data.get("temperature", 0.7),
+        }
+        if svc is not None:
+            try:
+                if data.get("stream"):
+                    send_q: asyncio.Queue = asyncio.Queue()
+
+                    def on_chunk(text):
+                        send_q.put_nowait(text)
+
+                    task = asyncio.create_task(
+                        self._execute_local(svc, params, True, on_chunk)
+                    )
+                    while True:
+                        getter = asyncio.create_task(send_q.get())
+                        done, _ = await asyncio.wait(
+                            {getter, task}, return_when=asyncio.FIRST_COMPLETED
+                        )
+                        if getter in done:
+                            await self._send(
+                                ws, protocol.msg(protocol.GEN_CHUNK, rid=rid, text=getter.result())
+                            )
+                            continue
+                        getter.cancel()
+                        result = await task
+                        # drain anything queued after task finished
+                        while not send_q.empty():
+                            await self._send(
+                                ws,
+                                protocol.msg(protocol.GEN_CHUNK, rid=rid, text=send_q.get_nowait()),
+                            )
+                        break
+                    await self._send(ws, protocol.msg(protocol.GEN_SUCCESS, rid=rid, **result))
+                else:
+                    result = await self._execute_local(svc, params, False, None)
+                    await self._send(ws, protocol.msg(protocol.GEN_SUCCESS, rid=rid, **result))
+            except Exception as e:
+                await self._send(
+                    ws, protocol.msg(protocol.GEN_ERROR, rid=rid, error=f"local_error: {e}")
+                )
+            return
+        # swarm relay: one extra hop through another provider
+        # (reference p2p_runtime.py:634-655)
+        requester = await self._peer_for(ws)
+        cand = None
+        for p in self.list_providers(model):
+            if not p["local"] and p["provider_id"] != requester:
+                cand = p
+                break
+        if cand is None:
+            await self._send(
+                ws,
+                protocol.msg(
+                    protocol.GEN_RESULT, rid=rid, error="consensus_deadlock: no_node_available"
+                ),
+            )
+            return
+        try:
+            result = await self.request_generation(
+                cand["provider_id"],
+                params["prompt"],
+                model=model,
+                max_new_tokens=params["max_new_tokens"],
+                temperature=params["temperature"],
+            )
+            # the inner result carries its own rid — replace it with ours
+            fwd = {k: v for k, v in result.items() if k not in ("rid", "task_id", "type")}
+            await self._send(ws, protocol.msg(protocol.GEN_RESULT, rid=rid, **fwd))
+        except Exception as e:
+            await self._send(
+                ws, protocol.msg(protocol.GEN_RESULT, rid=rid, error=f"relay_link_failure: {e}")
+            )
+
+    async def _handle_gen_chunk(self, ws, data):
+        rid = data.get("rid") or data.get("task_id")
+        async with self._pending_lock:
+            cb = self._chunk_cbs.get(rid)
+        if cb and data.get("text"):
+            cb(data["text"])
+
+    async def _handle_gen_result(self, ws, data):
+        rid = data.get("rid") or data.get("task_id")
+        async with self._pending_lock:
+            fut = self._pending.get(rid)
+        if fut and not fut.done():
+            payload = {k: v for k, v in data.items() if k not in ("type",)}
+            fut.set_result(payload)
+
+    # ------------------------------------------------------------ pieces
+
+    def store_piece(self, data: bytes) -> str:
+        digest = sha256_hex(data)
+        self.piece_store[digest] = data
+        if self.piece_dir:
+            from ..pieces import save_pieces
+
+            save_pieces([data], self.piece_dir)
+        return digest
+
+    def get_piece(self, digest: str) -> bytes | None:
+        data = self.piece_store.get(digest)
+        if data is None and self.piece_dir:
+            try:
+                from ..pieces import load_piece
+
+                data = load_piece(self.piece_dir, digest)
+            except (OSError, ValueError):
+                return None
+        return data
+
+    async def request_piece(self, peer_id: str, digest: str, timeout: float = 60.0) -> bytes:
+        """Fetch a piece from a peer; hash-verified before returning."""
+        async with self._lock:
+            info = self.peers.get(peer_id)
+        if info is None:
+            raise RuntimeError(f"unknown peer {peer_id!r}")
+        rid = new_id("piece")
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        async with self._pending_lock:
+            self._pending[rid] = fut
+        try:
+            await self._send(
+                info["ws"], protocol.msg(protocol.PIECE_REQUEST, rid=rid, hash=digest)
+            )
+            result = await asyncio.wait_for(fut, timeout=timeout)
+        finally:
+            async with self._pending_lock:
+                self._pending.pop(rid, None)
+        if result.get("error"):
+            raise RuntimeError(result["error"])
+        data = bytes(result["_tensors"]["data"].tobytes())
+        if sha256_hex(data) != digest:
+            raise ValueError(f"piece {digest[:12]} failed hash verification")
+        return data
+
+    async def _handle_piece_request(self, ws, data):
+        import numpy as np
+
+        rid, digest = data.get("rid"), data.get("hash")
+        blob = self.get_piece(digest) if digest else None
+        if blob is None:
+            await self._send(
+                ws, protocol.msg(protocol.PIECE_DATA, rid=rid, hash=digest, error="piece_not_found")
+            )
+            return
+        frame = protocol.encode_binary(
+            protocol.msg(protocol.PIECE_DATA, rid=rid, hash=digest),
+            {"data": np.frombuffer(blob, dtype=np.uint8)},
+        )
+        await self._send(ws, frame)
+
+    async def _handle_piece_data(self, ws, data):
+        rid = data.get("rid")
+        async with self._pending_lock:
+            fut = self._pending.get(rid)
+        if fut and not fut.done():
+            fut.set_result(data)
+
+    async def _handle_piece_have(self, ws, data):
+        pid = await self._peer_for(ws)
+        if pid:
+            async with self._lock:
+                self.peers.get(pid, {}).setdefault("pieces", set()).update(
+                    data.get("hashes") or []
+                )
+
+    # ------------------------------------------------------------ monitoring
+
+    async def _monitor_loop(self):
+        while not self._stopped:
+            try:
+                await asyncio.sleep(PING_INTERVAL_S)
+                async with self._lock:
+                    targets = list(self.peers.items())
+                now = time.time()
+                for pid, info in targets:
+                    try:
+                        await self._send(
+                            info["ws"],
+                            protocol.msg(
+                                protocol.PING,
+                                ts=now,
+                                metrics=get_system_metrics(self.throughput),
+                            ),
+                        )
+                    except Exception:
+                        await self._drop_peer(info["ws"])
+                async with self._lock:
+                    for pid, info in self.peers.items():
+                        if now - info.get("last_seen", now) > 3 * PING_INTERVAL_S:
+                            info["health"] = "unreachable"
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("monitor loop error")
+
+    # ------------------------------------------------------------ status
+
+    def status(self) -> dict:
+        return {
+            "peer_id": self.peer_id,
+            "addr": self.addr,
+            "region": self.region,
+            "uptime_s": round(time.time() - self.started_at, 1) if self.started_at else 0,
+            "peers": len(self.peers),
+            "local_services": list(self.local_services),
+            "providers": sum(len(v) for v in self.providers.values()),
+            "pieces": len(self.piece_store),
+            "metrics": get_system_metrics(self.throughput),
+        }

@@ -1,0 +1,126 @@
+"""run_p2p_node: the node orchestrator (reference p2p_runtime.py:843-954).
+
+Boot order mirrors the reference's serve() stack (SURVEY §3.1): start the WS
+node → start the HTTP gateway → connect bootstrap → load the service in an
+executor (announce when ready) → sync with the registry → run forever.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+
+from ..config import NodeConfig, load_config, parse_mesh_shape
+from .node import P2PNode
+
+logger = logging.getLogger("bee2bee_tpu.runtime")
+
+
+def build_service(backend: str, model: str, cfg: NodeConfig, **kw):
+    """Service factory for the CLI/runtime (reference run_p2p_node's backend
+    switch, p2p_runtime.py:891-902)."""
+    if backend == "tpu":
+        from ..engine.engine import EngineConfig
+        from ..parallel import MeshSpec, build_mesh
+        from ..services.tpu import TPUService
+
+        shape = parse_mesh_shape(cfg.mesh_shape)
+        mesh = build_mesh(MeshSpec.from_dict(shape)) if shape else None
+        return TPUService(
+            model,
+            price_per_token=cfg.price_per_token,
+            max_new_tokens=cfg.max_new_tokens,
+            mesh=mesh,
+            checkpoint_path=kw.get("checkpoint_path"),
+            engine_config=EngineConfig(max_seq_len=cfg.max_seq_len, dtype=cfg.dtype),
+        )
+    if backend == "ollama":
+        from ..services.ollama import OllamaService
+
+        return OllamaService(
+            model,
+            price_per_token=cfg.price_per_token,
+            host=kw.get("ollama_host") or "http://127.0.0.1:11434",
+            max_new_tokens=cfg.max_new_tokens,
+        )
+    if backend in ("hf_remote", "remote"):
+        from ..services.remote import RemoteService
+
+        return RemoteService(
+            model, price_per_token=cfg.price_per_token, max_new_tokens=cfg.max_new_tokens
+        )
+    if backend == "fake":
+        from ..services.fake import FakeService
+
+        return FakeService(model, price_per_token=cfg.price_per_token)
+    raise ValueError(f"unknown backend {backend!r} (tpu | ollama | hf_remote | fake)")
+
+
+async def run_p2p_node(
+    backend: str = "tpu",
+    model: str = "distilgpt2",
+    cfg: NodeConfig | None = None,
+    bootstrap: str | None = None,
+    serve_api: bool = True,
+    registry_sync: bool = True,
+    checkpoint_path: str | None = None,
+    ollama_host: str | None = None,
+    ready_event: asyncio.Event | None = None,
+    shutdown_event: asyncio.Event | None = None,
+):
+    """Boot a full serving node; runs until shutdown_event (or forever)."""
+    cfg = cfg or load_config()
+    node = P2PNode(
+        host=cfg.host,
+        port=cfg.port,
+        announce_host=cfg.announce_host,
+        announce_port=cfg.announce_port,
+        api_port=cfg.api_port if serve_api else None,
+    )
+    await node.start()
+
+    api_runner = None
+    if serve_api:
+        from ..api import start_api_server
+
+        api_runner = await start_api_server(node, cfg.host, cfg.api_port, api_key=cfg.api_key)
+
+    if bootstrap or cfg.bootstrap_url:
+        with contextlib.suppress(Exception):
+            await node.connect_bootstrap(bootstrap or cfg.bootstrap_url)
+
+    svc = build_service(
+        backend, model, cfg, checkpoint_path=checkpoint_path, ollama_host=ollama_host
+    )
+    loop = asyncio.get_running_loop()
+    if hasattr(svc, "load_sync"):
+        await loop.run_in_executor(None, svc.load_sync)
+    await node.announce_service(svc)
+    logger.info("serving %s via %s; join link: %s", model, backend, node.join_link())
+
+    registry_task = None
+    if registry_sync:
+        from ..registry import RegistryClient
+
+        client = RegistryClient()
+        if client.enabled:
+            registry_task = asyncio.create_task(client.sync_loop(node))
+
+    if ready_event is not None:
+        ready_event.set()
+    try:
+        if shutdown_event is not None:
+            await shutdown_event.wait()
+        else:
+            while True:
+                await asyncio.sleep(3600)
+    finally:
+        if registry_task:
+            registry_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await registry_task
+        if api_runner is not None:
+            await api_runner.cleanup()
+        await node.stop()
+    return node

@@ -1,0 +1,111 @@
+"""Global registry client: node liveness/models/metrics upserts to a Supabase
+REST `active_nodes` table, or a cluster entrypoint relay (reference
+registry.py:10-69 + SUPABASE_SCHEMA.sql:66-76). Enabled iff env creds are
+present; all failures are soft (the mesh works without a registry)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+
+logger = logging.getLogger("bee2bee_tpu.registry")
+
+SYNC_INTERVAL_S = 30.0
+
+
+class RegistryClient:
+    def __init__(
+        self,
+        supabase_url: str | None = None,
+        supabase_key: str | None = None,
+        entrypoint: str | None = None,
+    ):
+        self.supabase_url = supabase_url or os.environ.get("SUPABASE_URL") or os.environ.get(
+            "VITE_SUPABASE_URL"
+        )
+        self.supabase_key = supabase_key or os.environ.get("SUPABASE_ANON_KEY") or os.environ.get(
+            "VITE_SUPABASE_ANON_KEY"
+        )
+        self.entrypoint = entrypoint or os.environ.get("BEE2BEE_ENTRYPOINT")
+        self.mode = (
+            "supabase"
+            if (self.supabase_url and self.supabase_key)
+            else ("entrypoint" if self.entrypoint else None)
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return self.mode is not None
+
+    def _node_record(self, node) -> dict:
+        models = []
+        for svc in node.local_services.values():
+            models.extend(svc.get_metadata().get("models", []))
+        return {
+            "node_id": node.peer_id,
+            "address": node.addr,
+            "region": node.region,
+            "models": models,
+            "metrics": node.status()["metrics"],
+            "api_port": node.api_port,
+            "last_seen": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }
+
+    async def sync_node(self, node) -> bool:
+        """One upsert; returns success. Soft-fails on any network error."""
+        if not self.enabled:
+            return False
+        import httpx
+
+        record = self._node_record(node)
+        try:
+            async with httpx.AsyncClient(timeout=10) as client:
+                if self.mode == "supabase":
+                    r = await client.post(
+                        f"{self.supabase_url.rstrip('/')}/rest/v1/active_nodes",
+                        json=record,
+                        headers={
+                            "apikey": self.supabase_key,
+                            "Authorization": f"Bearer {self.supabase_key}",
+                            "Content-Type": "application/json",
+                            # upsert-on-conflict (reference registry.py:62-66)
+                            "Prefer": "resolution=merge-duplicates",
+                        },
+                    )
+                else:
+                    r = await client.post(
+                        f"{self.entrypoint.rstrip('/')}/register", json=record
+                    )
+                return r.status_code < 300
+        except Exception as e:
+            logger.debug("registry sync failed: %s", e)
+            return False
+
+    async def fetch_nodes(self) -> list[dict]:
+        """Read the global mesh (bridge.js syncGlobalMesh equivalent)."""
+        if self.mode != "supabase":
+            return []
+        import httpx
+
+        try:
+            async with httpx.AsyncClient(timeout=10) as client:
+                r = await client.get(
+                    f"{self.supabase_url.rstrip('/')}/rest/v1/active_nodes",
+                    params={"select": "*"},
+                    headers={
+                        "apikey": self.supabase_key,
+                        "Authorization": f"Bearer {self.supabase_key}",
+                    },
+                )
+                if r.status_code < 300:
+                    return r.json()
+        except Exception as e:
+            logger.debug("registry fetch failed: %s", e)
+        return []
+
+    async def sync_loop(self, node, interval_s: float = SYNC_INTERVAL_S):
+        while True:
+            await self.sync_node(node)
+            await asyncio.sleep(interval_s)

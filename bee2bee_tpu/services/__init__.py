@@ -1,0 +1,26 @@
+"""Service layer: the `BaseService` contract (metadata / execute /
+execute_stream) with four backends — TPU engine, Ollama proxy, remote HF
+Inference API, and a fake for tests (reference services.py:13-25 defines the
+contract; the fake is the test backend SURVEY §4 says the reference lacks).
+"""
+
+from .base import BaseService, ServiceError  # noqa: F401
+from .fake import FakeService  # noqa: F401
+
+
+def __getattr__(name):
+    # TPUService pulls in jax; OllamaService/RemoteService pull in requests.
+    # Lazy so `import bee2bee_tpu.services` works in minimal contexts.
+    if name == "TPUService":
+        from .tpu import TPUService
+
+        return TPUService
+    if name == "OllamaService":
+        from .ollama import OllamaService
+
+        return OllamaService
+    if name == "RemoteService":
+        from .remote import RemoteService
+
+        return RemoteService
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

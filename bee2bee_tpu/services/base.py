@@ -1,0 +1,95 @@
+"""The service contract every backend implements.
+
+Wire-compatible with the reference (`services.py:13-25`): `get_metadata()`
+feeds hello/service_announce messages; `execute(params) -> result dict` with
+keys text/tokens/latency_ms/price_per_token/cost (reference services.py:
+101-113); `execute_stream(params)` yields JSON-lines `{"text": chunk}` then
+`{"done": true}` (reference services.py:74-80).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Iterator
+
+
+class ServiceError(Exception):
+    pass
+
+
+class BaseService:
+    """A hostable inference backend."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def get_metadata(self) -> dict[str, Any]:
+        return {}
+
+    def execute(self, params: dict[str, Any]) -> dict[str, Any]:
+        raise NotImplementedError
+
+    def execute_stream(self, params: dict[str, Any]) -> Iterator[str]:
+        raise NotImplementedError
+
+    # -- shared helpers -------------------------------------------------------
+
+    @staticmethod
+    def _require_prompt(params: dict) -> str:
+        prompt = params.get("prompt")
+        if not prompt:
+            raise ServiceError("Missing prompt")
+        return prompt
+
+    @staticmethod
+    def result_dict(text: str, new_tokens: int, t0: float, price_per_token: float) -> dict:
+        """The reference's result schema (services.py:101-113)."""
+        latency_ms = int((time.time() - t0) * 1000.0)
+        return {
+            "text": text,
+            "tokens": int(new_tokens),
+            "latency_ms": latency_ms,
+            "price_per_token": price_per_token,
+            "cost": price_per_token * int(new_tokens),
+        }
+
+    @staticmethod
+    def stream_line(obj: dict) -> str:
+        return json.dumps(obj) + "\n"
+
+
+def parse_transcript(prompt: str) -> tuple[list[dict], bool]:
+    """Parse a `user:`/`assistant:` transcript into chat messages (the
+    reference does this inside generation, hf.py:54-81; we keep it at the
+    service boundary). Returns (messages, was_transcript)."""
+    lines = prompt.splitlines()
+    roles = ("user:", "assistant:", "system:")
+    if not any(ln.strip().lower().startswith(roles) for ln in lines):
+        return [{"role": "user", "content": prompt}], False
+    messages: list[dict] = []
+    cur_role, cur = None, []
+    for ln in lines:
+        low = ln.strip().lower()
+        matched = next((r for r in roles if low.startswith(r)), None)
+        if matched:
+            if cur_role is not None:
+                messages.append({"role": cur_role, "content": "\n".join(cur).strip()})
+            cur_role = matched[:-1]
+            cur = [ln.strip()[len(matched):].lstrip()]
+        elif cur_role is not None:
+            cur.append(ln)
+    if cur_role is not None:
+        messages.append({"role": cur_role, "content": "\n".join(cur).strip()})
+    return messages, True
+
+
+def scrub_stop_words(text: str) -> str:
+    """Cut generation at a role-marker the model hallucinated (the
+    reference's stop-word scan, hf.py:111-136)."""
+    cut = len(text)
+    for marker in ("\nuser:", "\nassistant:", "\nsystem:", "user:", "assistant:"):
+        idx = text.find(marker)
+        if idx > 0:
+            cut = min(cut, idx)
+    return text[:cut]

@@ -1,0 +1,63 @@
+"""FakeService: deterministic in-memory backend for tests.
+
+SURVEY §4: "no fake model backend (tests simply skip model paths)" is a
+reference gap we close — the whole mesh/gateway stack is testable without
+loading a model.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Iterator
+
+from .base import BaseService
+
+
+class FakeService(BaseService):
+    def __init__(
+        self,
+        model_name: str = "fake-model",
+        price_per_token: float = 0.0,
+        reply: str | None = None,
+        chunk_size: int = 4,
+        fail_with: str | None = None,
+    ):
+        super().__init__("fake")
+        self.model_name = model_name
+        self.price_per_token = price_per_token
+        self.reply = reply
+        self.chunk_size = chunk_size
+        self.fail_with = fail_with
+        self.calls: list[dict] = []
+
+    def get_metadata(self) -> dict[str, Any]:
+        return {
+            "models": [self.model_name],
+            "price_per_token": self.price_per_token,
+            "max_new_tokens": 2048,
+        }
+
+    def _reply_for(self, params: dict) -> str:
+        if self.reply is not None:
+            return self.reply
+        return f"echo({self._require_prompt(params)})"
+
+    def execute(self, params: dict[str, Any]) -> dict[str, Any]:
+        self.calls.append(dict(params))
+        if self.fail_with:
+            from .base import ServiceError
+
+            raise ServiceError(self.fail_with)
+        t0 = time.time()
+        text = self._reply_for(params)
+        return self.result_dict(text, len(text.split()), t0, self.price_per_token)
+
+    def execute_stream(self, params: dict[str, Any]) -> Iterator[str]:
+        self.calls.append(dict(params))
+        if self.fail_with:
+            yield self.stream_line({"status": "error", "message": self.fail_with})
+            return
+        text = self._reply_for(params)
+        for i in range(0, len(text), self.chunk_size):
+            yield self.stream_line({"text": text[i : i + self.chunk_size]})
+        yield self.stream_line({"done": True})

@@ -1,0 +1,115 @@
+"""TPUService: the serving backend — wraps InferenceEngine behind the
+BaseService contract (the role HFService plays in the reference,
+services.py:27-116, with torch generate swapped for the jit engine).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Iterator
+
+from .base import BaseService, ServiceError, parse_transcript, scrub_stop_words
+
+
+class TPUService(BaseService):
+    def __init__(
+        self,
+        model_name: str,
+        price_per_token: float = 0.0,
+        max_new_tokens: int = 2048,
+        engine=None,
+        mesh=None,
+        checkpoint_path: str | None = None,
+        engine_config=None,
+    ):
+        super().__init__("tpu")
+        self.model_name = model_name
+        self.price_per_token = price_per_token
+        self.max_new_tokens = max_new_tokens
+        self.engine = engine
+        self._mesh = mesh
+        self._checkpoint_path = checkpoint_path
+        self._engine_config = engine_config
+
+    # loading is split from construction so nodes can announce before the
+    # (slow) compile finishes — same shape as the reference's load_sync/
+    # load_async split (services.py:36-41)
+    def load_sync(self):
+        if self.engine is None:
+            from ..engine.engine import InferenceEngine
+
+            self.engine = InferenceEngine(
+                self.model_name,
+                mesh=self._mesh,
+                checkpoint_path=self._checkpoint_path,
+                engine_config=self._engine_config,
+            )
+        return self
+
+    def get_metadata(self) -> dict[str, Any]:
+        meta = {
+            "models": [self.model_name],
+            "price_per_token": self.price_per_token,
+            "max_new_tokens": self.max_new_tokens,
+            "backend": "tpu",
+        }
+        if self.engine is not None:
+            meta["engine"] = self.engine.info
+            meta["measured"] = self.engine.metrics.snapshot()
+        return meta
+
+    def _gen_args(self, params: dict) -> dict:
+        prompt = self._require_prompt(params)
+        messages, was_transcript = parse_transcript(prompt)
+        if was_transcript:
+            # flatten back to a plain prompt ending with the assistant cue;
+            # a real chat template would need a real tokenizer, which a
+            # zero-egress node may not have
+            prompt = "\n".join(f"{m['role']}: {m['content']}" for m in messages)
+            prompt += "\nassistant:"
+        return {
+            "prompt": prompt,
+            "max_new_tokens": min(
+                int(params.get("max_new_tokens", self.max_new_tokens)), self.max_new_tokens
+            ),
+            "temperature": float(params.get("temperature", 0.7)),
+            "top_k": int(params.get("top_k", 0)),
+            "top_p": float(params.get("top_p", 1.0)),
+        }
+
+    def execute(self, params: dict[str, Any]) -> dict[str, Any]:
+        if self.engine is None:
+            raise ServiceError("Model not loaded")
+        t0 = time.time()
+        args = self._gen_args(params)
+        result = self.engine.generate(**args)
+        text = scrub_stop_words(result.text)
+        out = self.result_dict(text, result.new_tokens, t0, self.price_per_token)
+        out["tokens_per_sec"] = result.tokens_per_sec
+        out["ttft_ms"] = int(result.ttft_s * 1000)
+        out["finish_reason"] = result.finish_reason
+        return out
+
+    def execute_stream(self, params: dict[str, Any]) -> Iterator[str]:
+        if self.engine is None:
+            raise ServiceError("Model not loaded")
+        args = self._gen_args(params)
+        try:
+            emitted = ""
+            for ev in self.engine.generate_stream(**args):
+                if ev.get("done"):
+                    break
+                piece = ev.get("text", "")
+                if not piece:
+                    continue
+                prev = emitted
+                scrubbed = scrub_stop_words(prev + piece)
+                delta = scrubbed[len(prev):]
+                if delta:
+                    emitted = scrubbed
+                    yield self.stream_line({"text": delta})
+                if len(scrubbed) < len(prev) + len(piece):
+                    break  # a stop marker started inside this chunk
+            yield self.stream_line({"done": True})
+        except Exception as e:  # match reference stream-error contract
+            yield self.stream_line({"status": "error", "message": f"Stream error: {e}"})

@@ -6,7 +6,25 @@ import os
 
 os.environ.setdefault("BEE2BEE_TPU_HOME", "/tmp/bee2bee_tpu_test_home")
 
+import asyncio  # noqa: E402
+import inspect  # noqa: E402
+
 import pytest  # noqa: E402
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Run `async def` tests via asyncio.run (pytest-asyncio isn't in this
+    image). Sync fixtures work normally; use async context managers instead
+    of async fixtures."""
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(fn(**kwargs))
+        return True
+    return None
 
 
 @pytest.fixture(scope="session", autouse=True)

@@ -1,0 +1,170 @@
+"""Gateway tests (model: reference tests/test_api.py — real node behind the
+app, auth paths — plus streaming and P2P fallback, which it never covered)."""
+
+import json
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from bee2bee_tpu.api import build_app
+from bee2bee_tpu.meshnet.node import P2PNode
+from bee2bee_tpu.services.fake import FakeService
+from tests.test_meshnet import _settle, mesh
+
+
+async def _client(node, api_key=None):
+    client = TestClient(TestServer(build_app(node, api_key=api_key)))
+    await client.start_server()
+    return client
+
+
+async def test_home_status():
+    async with mesh(1) as (node,):
+        node.add_service(FakeService("m"))
+        client = await _client(node)
+        try:
+            r = await client.get("/")
+            assert r.status == 200
+            body = await r.json()
+            assert body["status"] == "ok"
+            assert body["peer_id"] == node.peer_id
+            assert "tpu" in body["version"] or body["version"]
+        finally:
+            await client.close()
+
+
+async def test_auth_rejects_bad_key_and_accepts_good():
+    async with mesh(1) as (node,):
+        client = await _client(node, api_key="sekrit")
+        try:
+            r = await client.get("/peers")
+            assert r.status == 401
+            r = await client.get("/peers", headers={"X-API-KEY": "wrong"})
+            assert r.status == 401
+            r = await client.get("/peers", headers={"X-API-KEY": "sekrit"})
+            assert r.status == 200
+        finally:
+            await client.close()
+
+
+async def test_chat_local_service():
+    async with mesh(1) as (node,):
+        node.add_service(FakeService("my-model", reply="gateway says hi"))
+        client = await _client(node)
+        try:
+            r = await client.post("/chat", json={"prompt": "hi", "model": "my-model"})
+            assert r.status == 200
+            body = await r.json()
+            assert body["text"] == "gateway says hi"
+            assert "cost" in body
+        finally:
+            await client.close()
+
+
+async def test_generate_alias_and_messages_format():
+    async with mesh(1) as (node,):
+        svc = FakeService("m", reply="ok")
+        node.add_service(svc)
+        client = await _client(node)
+        try:
+            r = await client.post(
+                "/generate",
+                json={"messages": [{"role": "user", "content": "hello"}], "model": "m"},
+            )
+            assert r.status == 200
+            assert svc.calls[-1]["prompt"] == "user: hello"
+        finally:
+            await client.close()
+
+
+async def test_chat_streaming_ndjson():
+    async with mesh(1) as (node,):
+        node.add_service(FakeService("m", reply="streaming!", chunk_size=3))
+        client = await _client(node)
+        try:
+            r = await client.post("/chat", json={"prompt": "x", "model": "m", "stream": True})
+            assert r.status == 200
+            raw = (await r.read()).decode()
+            lines = [json.loads(ln) for ln in raw.strip().splitlines()]
+            text = "".join(ln.get("text", "") for ln in lines)
+            assert text == "streaming!"
+            assert lines[-1] == {"done": True}
+        finally:
+            await client.close()
+
+
+async def test_chat_p2p_fallback():
+    """Gateway node has no local service; request falls through the mesh."""
+    async with mesh(2) as (gateway, provider):
+        provider.add_service(FakeService("remote-model", reply="from the mesh"))
+        await gateway.connect_bootstrap(provider.addr)
+        assert await _settle(lambda: gateway.providers)
+        client = await _client(gateway)
+        try:
+            r = await client.post("/chat", json={"prompt": "q", "model": "remote-model"})
+            assert r.status == 200
+            assert (await r.json())["text"] == "from the mesh"
+        finally:
+            await client.close()
+
+
+async def test_chat_no_provider_404():
+    async with mesh(1) as (node,):
+        client = await _client(node)
+        try:
+            r = await client.post("/chat", json={"prompt": "q", "model": "ghost"})
+            assert r.status == 404
+        finally:
+            await client.close()
+
+
+async def test_chat_missing_prompt_400():
+    async with mesh(1) as (node,):
+        node.add_service(FakeService("m"))
+        client = await _client(node)
+        try:
+            r = await client.post("/chat", json={"model": "m"})
+            assert r.status == 400
+            r = await client.post("/chat", data=b"{not json", headers={"Content-Type": "application/json"})
+            assert r.status == 400
+        finally:
+            await client.close()
+
+
+async def test_connect_endpoint():
+    async with mesh(2) as (a, b):
+        client = await _client(a)
+        try:
+            r = await client.post("/connect", json={"addr": b.addr})
+            assert r.status == 200
+            assert (await r.json())["connected"] is True
+            assert await _settle(lambda: a.peers)
+            r = await client.post("/connect", json={})
+            assert r.status == 400
+        finally:
+            await client.close()
+
+
+async def test_providers_endpoint():
+    async with mesh(1) as (node,):
+        node.add_service(FakeService("modelx", price_per_token=0.25))
+        client = await _client(node)
+        try:
+            body = await (await client.get("/providers")).json()
+            assert body["providers"][0]["models"] == ["modelx"]
+            body = await (await client.get("/providers?model=nope")).json()
+            assert body["providers"] == []
+        finally:
+            await client.close()
+
+
+async def test_unknown_model_not_served_by_wrong_local_service():
+    """A request for a model this node doesn't have must NOT be answered by
+    whatever local service exists (found by live-gateway probing)."""
+    async with mesh(1) as (node,):
+        node.add_service(FakeService("actual-model", reply="wrong answer"))
+        client = await _client(node)
+        try:
+            r = await client.post("/chat", json={"prompt": "x", "model": "ghost-model"})
+            assert r.status == 404
+        finally:
+            await client.close()

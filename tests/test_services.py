@@ -1,0 +1,133 @@
+"""Service-layer tests: contract shape, transcript parsing, stop-word
+scrubbing, and TPUService over a real (tiny) engine."""
+
+import json
+
+import pytest
+
+from bee2bee_tpu.services import BaseService, FakeService, ServiceError
+from bee2bee_tpu.services.base import parse_transcript, scrub_stop_words
+from bee2bee_tpu.services.tpu import TPUService
+from bee2bee_tpu.engine import EngineConfig
+
+
+def test_result_dict_schema():
+    out = BaseService.result_dict("hi", 10, 0, price_per_token=0.5)
+    assert out["text"] == "hi"
+    assert out["tokens"] == 10
+    assert out["cost"] == 5.0
+    assert out["latency_ms"] >= 0
+    assert out["price_per_token"] == 0.5
+
+
+def test_fake_service_execute_and_stream():
+    svc = FakeService("m", reply="hello world")
+    out = svc.execute({"prompt": "x"})
+    assert out["text"] == "hello world"
+    lines = [json.loads(ln) for ln in svc.execute_stream({"prompt": "x"})]
+    assert "".join(ln.get("text", "") for ln in lines) == "hello world"
+    assert lines[-1] == {"done": True}
+
+
+def test_fake_service_missing_prompt():
+    with pytest.raises(ServiceError, match="Missing prompt"):
+        FakeService("m").execute({})
+
+
+def test_parse_transcript_plain_prompt():
+    msgs, was = parse_transcript("just a question")
+    assert not was
+    assert msgs == [{"role": "user", "content": "just a question"}]
+
+
+def test_parse_transcript_chat():
+    msgs, was = parse_transcript(
+        "user: hi there\nassistant: hello!\nuser: second question\nwith a second line"
+    )
+    assert was
+    assert [m["role"] for m in msgs] == ["user", "assistant", "user"]
+    assert msgs[2]["content"] == "second question\nwith a second line"
+
+
+def test_scrub_stop_words():
+    assert scrub_stop_words("a fine answer\nuser: next?") == "a fine answer"
+    assert scrub_stop_words("clean text stays") == "clean text stays"
+    # marker at position 0 is NOT scrubbed (reference keeps leading role text)
+    assert scrub_stop_words("assistant: x")
+
+
+@pytest.fixture(scope="module")
+def tpu_service():
+    svc = TPUService(
+        "tiny-llama",
+        price_per_token=0.001,
+        max_new_tokens=16,
+        engine_config=EngineConfig(
+            max_seq_len=128, prefill_buckets=(16, 32), dtype="float32",
+            cache_dtype="float32", decode_chunk=8,
+        ),
+    )
+    return svc.load_sync()
+
+
+def test_tpu_service_execute(tpu_service):
+    out = tpu_service.execute({"prompt": "hello", "max_new_tokens": 8, "temperature": 0})
+    assert set(out) >= {"text", "tokens", "latency_ms", "price_per_token", "cost"}
+    assert out["tokens"] > 0
+    assert out["cost"] == pytest.approx(out["tokens"] * 0.001)
+    assert out["tokens_per_sec"] >= 0
+
+
+def test_tpu_service_stream_matches_contract(tpu_service):
+    lines = [json.loads(ln) for ln in tpu_service.execute_stream({"prompt": "hi", "temperature": 0})]
+    assert lines[-1] == {"done": True}
+    assert all("text" in ln or "done" in ln for ln in lines)
+
+
+def test_tpu_service_caps_max_new_tokens(tpu_service):
+    # service max is 16; a request for 10k must be capped, not crash
+    out = tpu_service.execute({"prompt": "x", "max_new_tokens": 10_000, "temperature": 0})
+    assert out["tokens"] <= 16
+
+
+def test_tpu_service_metadata(tpu_service):
+    meta = tpu_service.get_metadata()
+    assert meta["models"] == ["tiny-llama"]
+    assert meta["backend"] == "tpu"
+    assert meta["engine"]["model"] == "tiny-llama"
+
+
+def test_tpu_service_unloaded_raises():
+    svc = TPUService("tiny-llama")
+    with pytest.raises(ServiceError, match="not loaded"):
+        svc.execute({"prompt": "x"})
+
+
+def test_ollama_service_unreachable_is_clean_error():
+    from bee2bee_tpu.services.ollama import OllamaService
+
+    svc = OllamaService("some-model", host="http://127.0.0.1:1")  # nothing there
+    with pytest.raises(ServiceError, match="unreachable"):
+        svc.execute({"prompt": "x"})
+    meta = svc.get_metadata()
+    assert meta["backend"] == "ollama"
+
+
+def test_tpu_service_stream_not_truncated(tpu_service):
+    """Streamed text must equal non-streamed text (the stream once broke
+    after the first chunk)."""
+    out = tpu_service.execute({"prompt": "count with me", "max_new_tokens": 16, "temperature": 0})
+    lines = [
+        json.loads(ln)
+        for ln in tpu_service.execute_stream(
+            {"prompt": "count with me", "max_new_tokens": 16, "temperature": 0}
+        )
+    ]
+    streamed = "".join(ln.get("text", "") for ln in lines)
+    assert streamed == out["text"]
+
+
+def test_default_2048_request_does_not_crash(tpu_service):
+    # the reference default (max_new_tokens=2048) against a 128-token cache
+    out = tpu_service.execute({"prompt": "defaults", "max_new_tokens": 2048, "temperature": 0})
+    assert out["tokens"] > 0
