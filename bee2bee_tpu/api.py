@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 from typing import Any
 
 from aiohttp import web
@@ -22,11 +23,30 @@ from .meshnet.node import P2PNode
 
 logger = logging.getLogger("bee2bee_tpu.api")
 
-CORS_HEADERS = {
-    "Access-Control-Allow-Origin": "*",
-    "Access-Control-Allow-Methods": "GET, POST, OPTIONS",
-    "Access-Control-Allow-Headers": "Content-Type, X-API-KEY",
-}
+
+def _cors_headers(api_key: str | None) -> dict[str, str]:
+    """CORS policy. The reference always sends `*` (api.py:92-98) — but
+    combined with our loopback-only keyless auth that would let any page in
+    the operator's browser drive the node. So: browsers are only allowed
+    when an origin list is configured explicitly, or when requests must
+    carry an API key anyway (which a drive-by page doesn't have)."""
+    origin = os.environ.get("BEE2BEE_CORS_ORIGINS") or ("*" if api_key else None)
+    if not origin:
+        return {}
+    return {
+        "Access-Control-Allow-Origin": origin,
+        "Access-Control-Allow-Methods": "GET, POST, OPTIONS",
+        "Access-Control-Allow-Headers": "Content-Type, X-API-KEY",
+    }
+
+
+def _int_param(body: dict, keys: tuple[str, ...], default: int) -> int:
+    """First present-and-not-None key wins; an explicit 0 stays 0."""
+    for k in keys:
+        v = body.get(k)
+        if v is not None:
+            return int(v)
+    return default
 
 
 def _auth_ok(request: web.Request, api_key: str | None) -> bool:
@@ -45,23 +65,28 @@ def _auth_ok(request: web.Request, api_key: str | None) -> bool:
 def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
     app = web.Application(client_max_size=32 * 1024 * 1024)
     app["node"] = node
+    cors = _cors_headers(api_key)
 
     @web.middleware
     async def middleware(request: web.Request, handler):
         if request.method == "OPTIONS":
-            return web.Response(headers=CORS_HEADERS)
+            return web.Response(headers=cors)
         if not _auth_ok(request, api_key):
             return web.json_response(
-                {"detail": "invalid or missing X-API-KEY"}, status=401, headers=CORS_HEADERS
+                {"detail": "invalid or missing X-API-KEY"}, status=401, headers=cors
             )
         try:
             resp = await handler(request)
         except web.HTTPException:
             raise
+        except ConnectionResetError:
+            raise  # client went away mid-stream; nothing to respond to
         except Exception as e:
+            if request.transport is None:
+                raise  # response already started and connection is gone
             logger.exception("handler error")
-            return web.json_response({"detail": str(e)}, status=500, headers=CORS_HEADERS)
-        for k, v in CORS_HEADERS.items():
+            return web.json_response({"detail": str(e)}, status=500, headers=cors)
+        for k, v in cors.items():
             resp.headers.setdefault(k, v)
         return resp
 
@@ -107,7 +132,7 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
         model = body.get("model")
         params = {
             "prompt": prompt,
-            "max_new_tokens": int(body.get("max_new_tokens") or body.get("max_tokens") or 2048),
+            "max_new_tokens": _int_param(body, ("max_new_tokens", "max_tokens"), 2048),
             "temperature": float(body.get("temperature", 0.7)),
         }
         svc = node.local_service_for(model)
@@ -115,7 +140,7 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
 
         if svc is not None:
             if stream:
-                return await _stream_service(request, node, svc, params)
+                return await _stream_service(request, node, svc, params, cors)
             import asyncio
 
             result = await asyncio.get_running_loop().run_in_executor(
@@ -130,7 +155,7 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
                 {"detail": f"no provider for model {model!r}"}, status=404
             )
         if stream:
-            return await _stream_p2p(request, node, provider, params, model)
+            return await _stream_p2p(request, node, provider, params, model, cors)
         result = await node.request_generation(
             provider["provider_id"],
             prompt,
@@ -146,7 +171,7 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
     app.router.add_post("/connect", connect)
     app.router.add_post("/chat", chat)
     app.router.add_post("/generate", chat)  # alias (reference api.py:190-191)
-    app.router.add_route("OPTIONS", "/{tail:.*}", lambda r: web.Response(headers=CORS_HEADERS))
+    app.router.add_route("OPTIONS", "/{tail:.*}", lambda r: web.Response(headers=cors))
     return app
 
 
@@ -165,41 +190,51 @@ def _prompt_from_messages(messages) -> str | None:
     return "\n".join(f"{m.get('role', 'user')}: {m.get('content', '')}" for m in messages)
 
 
-async def _stream_service(request, node: P2PNode, svc, params) -> web.StreamResponse:
+async def _stream_service(request, node: P2PNode, svc, params, cors=()) -> web.StreamResponse:
     """JSON-lines streaming from a local service (chunked response)."""
     import asyncio
+    import threading
 
     resp = web.StreamResponse(
-        headers={"Content-Type": "application/x-ndjson", **CORS_HEADERS}
+        headers={"Content-Type": "application/x-ndjson", **dict(cors)}
     )
     await resp.prepare(request)
     loop = asyncio.get_running_loop()
     q: asyncio.Queue = asyncio.Queue()
     DONE = object()
+    cancelled = threading.Event()
 
     def pump():
         try:
             for line in svc.execute_stream(params):
+                if cancelled.is_set():
+                    break  # client went away: stop pulling from the engine
                 loop.call_soon_threadsafe(q.put_nowait, line)
         finally:
             loop.call_soon_threadsafe(q.put_nowait, DONE)
 
     task = loop.run_in_executor(None, pump)
-    while True:
-        item = await q.get()
-        if item is DONE:
-            break
-        await resp.write(item.encode("utf-8"))
-    await task
-    await resp.write_eof()
+    try:
+        while True:
+            item = await q.get()
+            if item is DONE:
+                break
+            await resp.write(item.encode("utf-8"))
+        await resp.write_eof()
+    except (ConnectionResetError, asyncio.CancelledError):
+        logger.info("stream client disconnected; aborting generation pump")
+        raise
+    finally:
+        cancelled.set()
+        await task
     return resp
 
 
-async def _stream_p2p(request, node: P2PNode, provider, params, model) -> web.StreamResponse:
+async def _stream_p2p(request, node: P2PNode, provider, params, model, cors=()) -> web.StreamResponse:
     import asyncio
 
     resp = web.StreamResponse(
-        headers={"Content-Type": "application/x-ndjson", **CORS_HEADERS}
+        headers={"Content-Type": "application/x-ndjson", **dict(cors)}
     )
     await resp.prepare(request)
     q: asyncio.Queue = asyncio.Queue()

@@ -123,9 +123,11 @@ class P2PNode:
             with contextlib.suppress(Exception):
                 await info["ws"].send(protocol.encode(protocol.msg(protocol.GOODBYE, peer_id=self.peer_id)))
                 await info["ws"].close()
-        for t in self._tasks:
+        # iterate copies: _spawn's done-callbacks remove finished tasks from
+        # self._tasks, which would skip entries mid-iteration
+        for t in list(self._tasks):
             t.cancel()
-        for t in self._tasks:
+        for t in list(self._tasks):
             with contextlib.suppress(asyncio.CancelledError):
                 await t
         if self._server is not None:
@@ -285,10 +287,16 @@ class P2PNode:
             await self._send(ws, protocol.msg(protocol.PEER_LIST, peers=peer_addrs))
 
     async def _handle_peer_list(self, ws, data):
+        # connect concurrently off the reader task: a serial await here would
+        # stall all message processing on this connection for up to
+        # open_timeout per dead address in a churned peer list
         for addr in data.get("peers") or []:
             if addr and addr != self.addr:
-                with contextlib.suppress(Exception):
-                    await self._connect_peer(addr)
+                self._spawn(self._connect_peer_quiet(addr))
+
+    async def _connect_peer_quiet(self, addr: str):
+        with contextlib.suppress(Exception):
+            await self._connect_peer(addr)
 
     async def _handle_ping(self, ws, data):
         pid = await self._peer_for(ws)
@@ -486,9 +494,12 @@ class P2PNode:
         rid = data.get("rid") or data.get("task_id")
         model = data.get("model")
         svc = self.local_services.get(data.get("svc", "")) or self.local_service_for(model)
+        mnt = data.get("max_new_tokens")
+        if mnt is None:  # explicit 0 must stay 0 ("or" would turn it into 2048)
+            mnt = data.get("max_tokens")
         params = {
             "prompt": data.get("prompt", ""),
-            "max_new_tokens": data.get("max_new_tokens") or data.get("max_tokens") or 2048,
+            "max_new_tokens": 2048 if mnt is None else int(mnt),
             "temperature": data.get("temperature", 0.7),
         }
         if svc is not None:

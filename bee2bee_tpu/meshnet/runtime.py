@@ -80,36 +80,38 @@ async def run_p2p_node(
     )
     await node.start()
 
+    # everything after start() runs under the teardown guard: a failed
+    # service build/load must not leak the listening node/gateway/monitor
     api_runner = None
-    if serve_api:
-        from ..api import start_api_server
-
-        api_runner = await start_api_server(node, cfg.host, cfg.api_port, api_key=cfg.api_key)
-
-    if bootstrap or cfg.bootstrap_url:
-        with contextlib.suppress(Exception):
-            await node.connect_bootstrap(bootstrap or cfg.bootstrap_url)
-
-    svc = build_service(
-        backend, model, cfg, checkpoint_path=checkpoint_path, ollama_host=ollama_host
-    )
-    loop = asyncio.get_running_loop()
-    if hasattr(svc, "load_sync"):
-        await loop.run_in_executor(None, svc.load_sync)
-    await node.announce_service(svc)
-    logger.info("serving %s via %s; join link: %s", model, backend, node.join_link())
-
     registry_task = None
-    if registry_sync:
-        from ..registry import RegistryClient
-
-        client = RegistryClient()
-        if client.enabled:
-            registry_task = asyncio.create_task(client.sync_loop(node))
-
-    if ready_event is not None:
-        ready_event.set()
     try:
+        if serve_api:
+            from ..api import start_api_server
+
+            api_runner = await start_api_server(node, cfg.host, cfg.api_port, api_key=cfg.api_key)
+
+        if bootstrap or cfg.bootstrap_url:
+            with contextlib.suppress(Exception):
+                await node.connect_bootstrap(bootstrap or cfg.bootstrap_url)
+
+        svc = build_service(
+            backend, model, cfg, checkpoint_path=checkpoint_path, ollama_host=ollama_host
+        )
+        loop = asyncio.get_running_loop()
+        if hasattr(svc, "load_sync"):
+            await loop.run_in_executor(None, svc.load_sync)
+        await node.announce_service(svc)
+        logger.info("serving %s via %s; join link: %s", model, backend, node.join_link())
+
+        if registry_sync:
+            from ..registry import RegistryClient
+
+            client = RegistryClient()
+            if client.enabled:
+                registry_task = asyncio.create_task(client.sync_loop(node))
+
+        if ready_event is not None:
+            ready_event.set()
         if shutdown_event is not None:
             await shutdown_event.wait()
         else:

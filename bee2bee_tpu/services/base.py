@@ -84,11 +84,16 @@ def parse_transcript(prompt: str) -> tuple[list[dict], bool]:
     return messages, True
 
 
+STOP_MARKERS = ("\nuser:", "\nassistant:", "\nsystem:", "user:", "assistant:")
+# streaming must hold back this many chars: a marker may still complete
+STOP_HOLDBACK = max(len(m) for m in STOP_MARKERS) - 1
+
+
 def scrub_stop_words(text: str) -> str:
     """Cut generation at a role-marker the model hallucinated (the
     reference's stop-word scan, hf.py:111-136)."""
     cut = len(text)
-    for marker in ("\nuser:", "\nassistant:", "\nsystem:", "user:", "assistant:"):
+    for marker in STOP_MARKERS:
         idx = text.find(marker)
         if idx > 0:
             cut = min(cut, idx)

@@ -8,7 +8,13 @@ from __future__ import annotations
 import time
 from typing import Any, Iterator
 
-from .base import BaseService, ServiceError, parse_transcript, scrub_stop_words
+from .base import (
+    STOP_HOLDBACK,
+    BaseService,
+    ServiceError,
+    parse_transcript,
+    scrub_stop_words,
+)
 
 
 class TPUService(BaseService):
@@ -95,21 +101,27 @@ class TPUService(BaseService):
             raise ServiceError("Model not loaded")
         args = self._gen_args(params)
         try:
-            emitted = ""
+            # hold back STOP_HOLDBACK chars so a stop marker split across
+            # chunk boundaries never leaks its prefix to the client (execute()
+            # scrubs the full text; streaming must match it byte-for-byte)
+            acc = ""  # full raw accumulation
+            emitted = 0  # chars of scrub(acc) already yielded
             for ev in self.engine.generate_stream(**args):
-                if ev.get("done"):
+                if ev.get("done"):  # flush the held-back tail
+                    tail = scrub_stop_words(acc)
+                    if tail[emitted:]:
+                        yield self.stream_line({"text": tail[emitted:]})
                     break
-                piece = ev.get("text", "")
-                if not piece:
-                    continue
-                prev = emitted
-                scrubbed = scrub_stop_words(prev + piece)
-                delta = scrubbed[len(prev):]
-                if delta:
-                    emitted = scrubbed
-                    yield self.stream_line({"text": delta})
-                if len(scrubbed) < len(prev) + len(piece):
-                    break  # a stop marker started inside this chunk
+                acc += ev.get("text", "")
+                scrubbed = scrub_stop_words(acc)
+                if len(scrubbed) < len(acc):  # a marker completed: flush & stop
+                    if scrubbed[emitted:]:
+                        yield self.stream_line({"text": scrubbed[emitted:]})
+                    break
+                safe = max(emitted, len(scrubbed) - STOP_HOLDBACK)
+                if scrubbed[emitted:safe]:
+                    yield self.stream_line({"text": scrubbed[emitted:safe]})
+                    emitted = safe
             yield self.stream_line({"done": True})
         except Exception as e:  # match reference stream-error contract
             yield self.stream_line({"status": "error", "message": f"Stream error: {e}"})
