@@ -214,6 +214,72 @@ def _moe(x, p, cfg: ModelConfig):
     return jnp.einsum("bted,bte->btd", out, weights.astype(out.dtype))
 
 
+# ------------------------------------------------------- reusable blocks
+
+
+def embed_tokens(params: Params, cfg: ModelConfig, input_ids, positions):
+    """Token (+learned-pos) embedding. input_ids [B,T], positions [B,T]."""
+    x = jnp.take(params["tok_embed"], input_ids, axis=0)
+    if cfg.embedding_scale:
+        x = x * jnp.asarray(math.sqrt(cfg.d_model), x.dtype)
+    if cfg.pos_embedding == "learned":
+        x = x + jnp.take(params["pos_embed"], positions, axis=0)
+    return x
+
+
+def transformer_block(lp: Params, cfg: ModelConfig, x, positions, mask, kv_hook=None):
+    """One block. lp: a single layer's params (no leading L dim). x [B,T,D].
+
+    kv_hook(k, v) -> (k_eff, v_eff), when given, intercepts the freshly
+    projected K/V — the cached decode path uses it to write the chunk into
+    the KV cache and attend over the cache instead. No hook = plain causal
+    self-attention over the chunk (training/scoring/pipeline-stage path).
+    """
+    B, T, _ = x.shape
+    H, Hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    h = _norm(x, lp["ln1"], cfg)
+    q = h @ lp["attn"]["wq"]
+    k = h @ lp["attn"]["wk"]
+    v = h @ lp["attn"]["wv"]
+    if "bq" in lp["attn"]:
+        q = q + lp["attn"]["bq"]
+        k = k + lp["attn"]["bk"]
+        v = v + lp["attn"]["bv"]
+    q = q.reshape(B, T, H, hd)
+    k = k.reshape(B, T, Hkv, hd)
+    v = v.reshape(B, T, Hkv, hd)
+    if cfg.pos_embedding == "rope":
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+    if kv_hook is not None:
+        k, v = kv_hook(k, v)
+    attn_out = _attention(q, k, v, mask, cfg)
+    attn_out = attn_out @ lp["attn"]["wo"]
+    if "bo" in lp["attn"]:
+        attn_out = attn_out + lp["attn"]["bo"]
+    x = x + attn_out
+
+    h2 = _norm(x, lp["ln2"], cfg)
+    if cfg.is_moe:
+        return x + _moe(h2, lp["moe"], cfg)
+    return x + _mlp(h2, lp["mlp"], cfg)
+
+
+def final_logits(params: Params, cfg: ModelConfig, x):
+    """Final norm + LM head (+softcap), f32 logits."""
+    x = _norm(x, params["final_norm"], cfg)
+    if cfg.tie_embeddings:
+        logits = x @ params["tok_embed"].T
+    else:
+        logits = x @ params["lm_head"]
+    logits = logits.astype(jnp.float32)
+    if cfg.logits_softcap:
+        c = cfg.logits_softcap
+        logits = jnp.tanh(logits / c) * c
+    return logits
+
+
 # ---------------------------------------------------------------- forward
 
 
@@ -223,6 +289,7 @@ def forward(
     input_ids,  # [B, T] int32
     cache,  # {"k": [L,B,S,Hkv,hd], "v": ...} or None (no-cache full forward)
     offset,  # [] or [B] int32: write position of input_ids[:, 0] in the cache
+    remat: bool = False,  # jax.checkpoint each layer (training: HBM for FLOPs)
 ):
     """Run a [B, T] token chunk. Returns (logits [B, T, V], new_cache).
 
@@ -232,18 +299,12 @@ def forward(
     the chunk — the training/scoring path.
     """
     B, T = input_ids.shape
-    D = cfg.d_model
-    H, Hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
     off = jnp.asarray(offset, jnp.int32)
     off_b = jnp.broadcast_to(off.reshape(-1), (B,))  # [B]
     positions = off_b[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]  # [B, T]
 
-    x = jnp.take(params["tok_embed"], input_ids, axis=0)
-    if cfg.embedding_scale:
-        x = x * jnp.asarray(math.sqrt(D), x.dtype)
-    if cfg.pos_embedding == "learned":
-        x = x + jnp.take(params["pos_embed"], positions, axis=0)
+    x = embed_tokens(params, cfg, input_ids, positions)
 
     if cache is not None:
         S = cache["k"].shape[2]
@@ -259,23 +320,14 @@ def forward(
         x, cache_k, cache_v = carry
         lp, layer_idx = xs
 
-        h = _norm(x, lp["ln1"], cfg)
-        q = h @ lp["attn"]["wq"]
-        k = h @ lp["attn"]["wk"]
-        v = h @ lp["attn"]["wv"]
-        if "bq" in lp["attn"]:
-            q = q + lp["attn"]["bq"]
-            k = k + lp["attn"]["bk"]
-            v = v + lp["attn"]["bv"]
-        q = q.reshape(B, T, H, hd)
-        k = k.reshape(B, T, Hkv, hd)
-        v = v.reshape(B, T, Hkv, hd)
-        if cfg.pos_embedding == "rope":
-            q = _rope(q, positions, cfg.rope_theta)
-            k = _rope(k, positions, cfg.rope_theta)
+        if cache_k is None:  # training/scoring path: plain block
+            return (transformer_block(lp, cfg, x, positions, mask), None, None), None
 
-        if cache_k is not None:
-            # write this chunk's K/V at [offset, offset+T) per batch row
+        def kv_hook(k, v):
+            # write this chunk's K/V at [offset, offset+T) per batch row,
+            # then attend over the whole cache row
+            nonlocal cache_k, cache_v
+
             def write(cache_row, new_row, start):
                 return lax.dynamic_update_slice(
                     cache_row, new_row.astype(cache_row.dtype), (start, 0, 0)
@@ -285,49 +337,33 @@ def forward(
             cv = jax.vmap(write)(cache_v[layer_idx], v, off_b)
             cache_k = cache_k.at[layer_idx].set(ck)
             cache_v = cache_v.at[layer_idx].set(cv)
-            attn_out = _attention(q, ck, cv, mask, cfg)
-        else:
-            attn_out = _attention(q, k, v, mask, cfg)
+            return ck, cv
 
-        attn_out = attn_out @ lp["attn"]["wo"]
-        if "bo" in lp["attn"]:
-            attn_out = attn_out + lp["attn"]["bo"]
-        x = x + attn_out
-
-        h2 = _norm(x, lp["ln2"], cfg)
-        if cfg.is_moe:
-            x = x + _moe(h2, lp["moe"], cfg)
-        else:
-            x = x + _mlp(h2, lp["mlp"], cfg)
+        x = transformer_block(lp, cfg, x, positions, mask, kv_hook=kv_hook)
         return (x, cache_k, cache_v), None
 
     layer_params = params["layers"]
     n_layers = cfg.n_layers
+    # prevent_cse=False: checkpoint inside lax.scan doesn't need the CSE
+    # barrier (scan's loop structure already prevents it) and the barrier
+    # blocks XLA fusion otherwise
+    layer_body = jax.checkpoint(layer, prevent_cse=False) if remat else layer
     if cache is not None:
         (x, ck, cv), _ = lax.scan(
-            layer,
+            layer_body,
             (x, cache["k"], cache["v"]),
             (layer_params, jnp.arange(n_layers)),
         )
         new_cache = {"k": ck, "v": cv}
     else:
         (x, _, _), _ = lax.scan(
-            layer,
+            layer_body,
             (x, None, None),
             (layer_params, jnp.arange(n_layers)),
         )
         new_cache = None
 
-    x = _norm(x, params["final_norm"], cfg)
-    if cfg.tie_embeddings:
-        logits = x @ params["tok_embed"].T
-    else:
-        logits = x @ params["lm_head"]
-    logits = logits.astype(jnp.float32)
-    if cfg.logits_softcap:
-        c = cfg.logits_softcap
-        logits = jnp.tanh(logits / c) * c
-    return logits, new_cache
+    return final_logits(params, cfg, x), new_cache
 
 
 def init_cache(cfg: ModelConfig, batch: int, max_len: int | None = None, dtype=jnp.bfloat16):

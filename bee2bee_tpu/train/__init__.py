@@ -1,0 +1,9 @@
+from .trainer import (  # noqa: F401
+    TrainConfig,
+    Trainer,
+    TrainState,
+    loss_fn,
+    make_optimizer,
+    make_train_state,
+    make_train_step,
+)

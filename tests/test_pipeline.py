@@ -1,0 +1,79 @@
+"""Pipeline-parallel tests on the virtual CPU mesh: the GPipe schedule must
+be numerically identical to the plain forward, and the whole pp program must
+differentiate (train step decreases loss)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bee2bee_tpu.models import core, get_config
+from bee2bee_tpu.parallel.pipeline import (
+    make_pp_train_step,
+    pipeline_forward,
+    split_pp_params,
+)
+
+
+def _setup(model="tiny-llama", pipe=2, data=2):
+    cfg = get_config(model)
+    # mesh with a pipe axis (not one of the serving axes): build directly
+    import numpy as onp
+    from jax.sharding import Mesh
+
+    devs = onp.array(jax.devices()[: pipe * data]).reshape(pipe, data)
+    mesh = Mesh(devs, ("pipe", "data"))
+    params = core.init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    return cfg, mesh, params
+
+
+def test_pipeline_forward_matches_plain():
+    cfg, mesh, params = _setup(pipe=2, data=2)
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(3, cfg.vocab_size, (4, 12)), jnp.int32
+    )
+    ref, _ = core.forward(params, cfg, ids, None, 0)
+    head, staged = split_pp_params(params, 2, mesh)
+    got = pipeline_forward(head, staged, cfg, mesh, ids, n_microbatches=2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_four_stages_one_mb_each():
+    cfg, mesh, params = _setup(pipe=4, data=2)
+    # 4 stages needs n_layers % 4 == 0: tiny-llama has 2 → use stacked double
+    from dataclasses import replace
+
+    cfg4 = replace(cfg, n_layers=4)
+    params = core.init_params(cfg4, jax.random.key(1), dtype=jnp.float32)
+    ids = jnp.asarray(
+        np.random.default_rng(1).integers(3, cfg4.vocab_size, (8, 8)), jnp.int32
+    )
+    ref, _ = core.forward(params, cfg4, ids, None, 0)
+    head, staged = split_pp_params(params, 4, mesh)
+    got = pipeline_forward(head, staged, cfg4, mesh, ids, n_microbatches=4)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_pp_train_step_decreases_loss():
+    cfg, mesh, params = _setup(pipe=2, data=2)
+    head, staged = split_pp_params(params, 2, mesh)
+    step = make_pp_train_step(cfg, mesh, n_microbatches=2, lr=1e-2)
+    ids = jnp.asarray(
+        np.random.default_rng(2).integers(3, cfg.vocab_size, (4, 12)), jnp.int32
+    )
+    batch = {"input_ids": ids}
+    losses = []
+    for _ in range(4):
+        head, staged, l = step(head, staged, batch)
+        losses.append(float(l))
+    assert losses[-1] < losses[0]
+
+
+def test_moe_pipeline_matches_plain():
+    cfg, mesh, params = _setup(model="tiny-mixtral", pipe=2, data=1)
+    ids = jnp.asarray(
+        np.random.default_rng(3).integers(3, cfg.vocab_size, (2, 8)), jnp.int32
+    )
+    ref, _ = core.forward(params, cfg, ids, None, 0)
+    head, staged = split_pp_params(params, 2, mesh)
+    got = pipeline_forward(head, staged, cfg, mesh, ids, n_microbatches=2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-4)

@@ -1,0 +1,92 @@
+"""Trainer tests on the 8-device virtual CPU mesh: loss decreases, remat
+matches non-remat, and sharded (dp+tp+sp) training matches single-device —
+the distributed-training correctness the reference's WS toy (node.py:99-182)
+never verified."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bee2bee_tpu.models import core, get_config
+from bee2bee_tpu.parallel import MeshSpec, build_mesh
+from bee2bee_tpu.train import TrainConfig, Trainer, loss_fn, make_train_state, make_train_step
+
+
+def _batch(cfg, B=4, T=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": jnp.asarray(rng.integers(3, cfg.vocab_size, (B, T)), jnp.int32)}
+
+
+def test_loss_decreases():
+    cfg = get_config("tiny-llama")
+    tr = Trainer(cfg, TrainConfig(learning_rate=1e-2))
+    batch = _batch(cfg)
+    first = tr.train_step(batch)["loss"]
+    for _ in range(10):
+        last = tr.train_step(batch)
+    assert last["loss"] < first
+    assert tr.step == 11
+    assert 0.0 <= last["accuracy"] <= 1.0
+
+
+def test_remat_matches_no_remat():
+    cfg = get_config("tiny-llama")
+    params = core.init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    batch = _batch(cfg)
+    l0, _ = loss_fn(params, cfg, batch, remat=False)
+    l1, _ = loss_fn(params, cfg, batch, remat=True)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    g0 = jax.grad(lambda p: loss_fn(p, cfg, batch, remat=False)[0])(params)
+    g1 = jax.grad(lambda p: loss_fn(p, cfg, batch, remat=True)[0])(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+        g0,
+        g1,
+    )
+
+
+def test_loss_mask_restricts_targets():
+    cfg = get_config("tiny-llama")
+    params = core.init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    batch = _batch(cfg, B=2, T=8)
+    mask = jnp.zeros_like(batch["input_ids"], jnp.float32).at[:, 4:].set(1.0)
+    lm, m = loss_fn(params, cfg, {**batch, "loss_mask": mask})
+    assert float(m["tokens"]) == 2 * 4  # positions 5..8 of the shifted targets
+    lf, _ = loss_fn(params, cfg, batch)
+    assert float(lm) != float(lf)
+
+
+def test_sharded_training_matches_single_device():
+    """dp=2, sp=2, tp=2 over 8 virtual devices: identical loss trajectory to
+    the unsharded step (f32, same init, same batch)."""
+    cfg = get_config("tiny-llama")
+    tcfg = TrainConfig(learning_rate=1e-2, param_dtype="float32")
+    params = core.init_params(cfg, jax.random.key(1), dtype=jnp.float32)
+
+    ref_state = make_train_state(cfg, tcfg, params=jax.tree.map(jnp.copy, params))
+    ref_step = make_train_step(cfg, tcfg)
+
+    mesh = build_mesh(MeshSpec(data=2, seq=2, model=2))
+    sh_state = make_train_state(cfg, tcfg, params=jax.tree.map(jnp.copy, params), mesh=mesh)
+    sh_step = make_train_step(cfg, tcfg, mesh=mesh)
+
+    batch = _batch(cfg, B=4, T=16)
+    losses_ref, losses_sh = [], []
+    for _ in range(3):
+        ref_state, m0 = ref_step(ref_state, batch)
+        sh_state, m1 = sh_step(sh_state, batch)
+        losses_ref.append(float(m0["loss"]))
+        losses_sh.append(float(m1["loss"]))
+    np.testing.assert_allclose(losses_sh, losses_ref, rtol=5e-5, atol=5e-6)
+    assert losses_sh[-1] < losses_sh[0]
+
+
+def test_moe_training_on_expert_mesh():
+    cfg = get_config("tiny-mixtral")
+    mesh = build_mesh(MeshSpec(data=2, expert=2, model=2))
+    tr = Trainer(cfg, TrainConfig(learning_rate=5e-3, param_dtype="float32"), mesh=mesh)
+    batch = _batch(cfg, B=4, T=8)
+    first = tr.train_step(batch)["loss"]
+    for _ in range(5):
+        last = tr.train_step(batch)
+    assert last["loss"] < first
