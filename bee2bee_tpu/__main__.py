@@ -130,6 +130,29 @@ def register(bootstrap):
     asyncio.run(one_shot())
 
 
+@cli.command("nat-status")
+@click.option("--port", default=4003, help="port to attempt forwarding for")
+@click.option("--forward/--no-forward", default=False,
+              help="actually create a mapping (touches the router)")
+def nat_status(port, forward):
+    """NAT diagnostics: gateway, public IP, NAT type, optional forward
+    (reference nat.py:493-561's status table)."""
+    _setup_logging()
+    from . import nat
+    from .stun import STUNClient
+
+    click.echo(f"lan ip:     {nat.get_lan_ip()}")
+    click.echo(f"gateway:    {nat.get_gateway_ip()}")
+    click.echo(f"public ip:  {nat.get_public_ip()}")
+    click.echo(f"nat type:   {STUNClient().detect_nat_type()}")
+    if forward:
+        mapping = nat.auto_forward_port(port)
+        click.echo(
+            f"forward:    ok={mapping.ok} method={mapping.method} "
+            f"external={mapping.public_ip}:{mapping.external_port} {mapping.detail}"
+        )
+
+
 @cli.command()
 def info():
     """Show devices, mesh defaults, and config."""

@@ -26,9 +26,11 @@ _ENV_MAP = {
     "BEE2BEE_API_KEY": "api_key",
     "BEE2BEE_MESH_SHAPE": "mesh_shape",
     "BEE2BEE_DTYPE": "dtype",
+    "BEE2BEE_AUTO_NAT": "auto_nat",
 }
 
 _INT_FIELDS = {"port", "api_port", "announce_port", "kv_page_size", "max_seq_len"}
+_BOOL_FIELDS = {"auto_nat"}
 
 
 @dataclass
@@ -43,6 +45,10 @@ class NodeConfig:
     announce_host: str | None = None
     announce_port: int | None = None
     api_key: str | None = None
+    # NAT auto-forwarding on startup (reference p2p_runtime.py:204-261);
+    # default off: datacenter TPU hosts don't need it, and it touches the
+    # router. Enable via config or BEE2BEE_AUTO_NAT=1.
+    auto_nat: bool = False
     # compute (TPU-native additions)
     mesh_shape: str = ""  # e.g. "data:1,model:8" — empty = all devices on model axis
     dtype: str = "bfloat16"
@@ -70,6 +76,8 @@ def load_config() -> NodeConfig:
                     val = int(val)
                 except ValueError:
                     continue
+            elif field_name in _BOOL_FIELDS:
+                val = val.lower() in ("1", "true", "yes", "on")
             setattr(cfg, field_name, val)
     return cfg
 

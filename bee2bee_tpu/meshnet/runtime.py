@@ -84,7 +84,30 @@ async def run_p2p_node(
     # service build/load must not leak the listening node/gateway/monitor
     api_runner = None
     registry_task = None
+    forwarder = None
     try:
+        # Announce-address resolution (reference p2p_runtime.py:195-274): when
+        # no explicit announce host was configured, try NAT auto-forward →
+        # STUN/echo public IP in an executor so router round-trips never block
+        # the loop.
+        if not cfg.announce_host and cfg.auto_nat:
+            from .. import nat
+
+            loop = asyncio.get_running_loop()
+            forwarder = nat.PortForwarder()
+            with contextlib.suppress(Exception):
+                mapping = await asyncio.wait_for(
+                    loop.run_in_executor(None, forwarder.auto_forward, node.port), 15.0
+                )
+                if mapping.ok and mapping.public_ip:
+                    node.announce_host = mapping.public_ip
+                    if mapping.external_port:
+                        node.announce_port = mapping.external_port
+                    logger.info(
+                        "NAT %s: announcing %s:%s", mapping.method,
+                        node.announce_host, node.announce_port,
+                    )
+
         if serve_api:
             from ..api import start_api_server
 
@@ -124,5 +147,11 @@ async def run_p2p_node(
                 await registry_task
         if api_runner is not None:
             await api_runner.cleanup()
+        if forwarder is not None and forwarder.mappings:
+            loop = asyncio.get_running_loop()
+            with contextlib.suppress(Exception):
+                await asyncio.wait_for(
+                    loop.run_in_executor(None, forwarder.cleanup), 10.0
+                )
         await node.stop()
     return node

@@ -1,0 +1,316 @@
+"""NAT/STUN tests against fake loopback servers — no real network.
+
+The reference's NAT tests hit the live router/Internet with vacuous
+asserts (reference tests/test_nat_optional.py); here every codec and the
+full client round-trip run against in-process UDP fakes.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+import pytest
+
+from bee2bee_tpu import nat, stun
+
+
+# ------------------------------------------------------------- STUN codec
+
+
+def test_binding_request_shape():
+    packet, txn = stun.build_binding_request()
+    assert len(packet) == 20
+    assert packet[4:8] == (stun.MAGIC_COOKIE).to_bytes(4, "big")
+    assert packet[8:20] == txn
+
+
+def test_binding_response_roundtrip_xor():
+    _, txn = stun.build_binding_request()
+    resp = stun.build_binding_response(txn, "203.0.113.7", 54321, xor=True)
+    assert stun.parse_binding_response(resp, txn) == ("203.0.113.7", 54321)
+
+
+def test_binding_response_roundtrip_plain():
+    _, txn = stun.build_binding_request()
+    resp = stun.build_binding_response(txn, "198.51.100.9", 4242, xor=False)
+    assert stun.parse_binding_response(resp, txn) == ("198.51.100.9", 4242)
+
+
+def test_binding_response_rejects_wrong_txn():
+    _, txn = stun.build_binding_request()
+    resp = stun.build_binding_response(txn, "203.0.113.7", 1000)
+    assert stun.parse_binding_response(resp, b"x" * 12) is None
+
+
+def test_binding_response_rejects_garbage():
+    _, txn = stun.build_binding_request()
+    assert stun.parse_binding_response(b"", txn) is None
+    assert stun.parse_binding_response(b"\x00" * 40, txn) is None
+
+
+# ----------------------------------------------------- fake STUN server
+
+
+class FakeStunServer(threading.Thread):
+    """Loopback UDP server answering binding requests with a fixed
+    mapped endpoint (or per-request source port if `echo_port=True`)."""
+
+    def __init__(self, ip: str = "203.0.113.50", port: int = 7777, echo_port=False):
+        super().__init__(daemon=True)
+        self.mapped = (ip, port)
+        self.echo_port = echo_port
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind(("127.0.0.1", 0))
+        self.addr = self.sock.getsockname()
+        self.sock.settimeout(5.0)
+        self._stop = threading.Event()
+
+    def run(self):
+        while not self._stop.is_set():
+            try:
+                data, src = self.sock.recvfrom(2048)
+            except OSError:
+                break
+            if len(data) < 20:
+                continue
+            txn = data[8:20]
+            ip, port = self.mapped
+            if self.echo_port:
+                port = src[1]
+            self.sock.sendto(stun.build_binding_response(txn, ip, port), src)
+
+    def stop(self):
+        self._stop.set()
+        self.sock.close()
+
+
+@pytest.fixture
+def stun_server():
+    srv = FakeStunServer()
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_stun_client_query(stun_server):
+    client = stun.STUNClient(servers=(stun_server.addr,), timeout=2.0)
+    res = client.query_server(*stun_server.addr)
+    assert res is not None
+    assert (res.ip, res.port) == ("203.0.113.50", 7777)
+
+
+def test_stun_parallel_endpoint(stun_server):
+    dead = ("127.0.0.1", 1)  # nothing listening
+    client = stun.STUNClient(servers=(dead, stun_server.addr), timeout=1.0)
+    res = client.get_public_endpoint()
+    assert res is not None and res.ip == "203.0.113.50"
+
+
+def test_nat_type_cone():
+    a, b = FakeStunServer(), FakeStunServer()
+    a.start(), b.start()
+    try:
+        client = stun.STUNClient(servers=(a.addr, b.addr), timeout=1.0)
+        assert client.detect_nat_type() == "cone"
+    finally:
+        a.stop(), b.stop()
+
+
+def test_nat_type_symmetric():
+    a = FakeStunServer(port=1111)
+    b = FakeStunServer(port=2222)
+    a.start(), b.start()
+    try:
+        client = stun.STUNClient(servers=(a.addr, b.addr), timeout=1.0)
+        assert client.detect_nat_type() == "symmetric"
+    finally:
+        a.stop(), b.stop()
+
+
+def test_nat_type_blocked():
+    client = stun.STUNClient(servers=(("127.0.0.1", 1),), timeout=0.3)
+    assert client.detect_nat_type() == "blocked"
+
+
+def test_nat_type_open():
+    srv = FakeStunServer(ip="127.0.0.1", port=9)
+    srv.start()
+    try:
+        client = stun.STUNClient(servers=(srv.addr, srv.addr), timeout=1.0)
+        assert client.detect_nat_type() == "open"
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------- NAT-PMP codec
+
+
+def test_natpmp_map_codec():
+    req = nat.build_natpmp_map_request(4334, 4334, lifetime=7200, tcp=True)
+    assert len(req) == 12
+    version, opcode = req[0], req[1]
+    assert version == 0 and opcode == nat.NATPMP_OP_MAP_TCP
+
+    # craft the gateway's success response
+    import struct
+
+    resp = struct.pack("!BBHIHHI", 0, nat.NATPMP_OP_MAP_TCP + 128, 0, 1234, 4334, 40000, 7200)
+    assert nat.parse_natpmp_map_response(resp) == (4334, 40000, 7200)
+
+
+def test_natpmp_rejects_error_result():
+    import struct
+
+    resp = struct.pack("!BBHIHHI", 0, nat.NATPMP_OP_MAP_TCP + 128, 2, 0, 1, 1, 0)
+    assert nat.parse_natpmp_map_response(resp) is None
+
+
+def test_natpmp_public_addr_codec():
+    import struct
+
+    resp = struct.pack("!BBHI", 0, 128, 0, 99) + socket.inet_aton("198.51.100.1")
+    assert nat.parse_natpmp_public_addr_response(resp) == "198.51.100.1"
+
+
+# -------------------------------------------------------------- PCP codec
+
+
+def test_pcp_map_roundtrip():
+    packet, nonce = nat.build_pcp_map_request("192.168.1.10", 4334, 4334)
+    assert len(packet) == 24 + 36
+    assert packet[0] == nat.PCP_VERSION
+
+    # synthesize the router's response: header(24) + nonce + proto + ports + ip
+    import struct
+
+    header = struct.pack("!BBBBI", 2, nat.PCP_OP_MAP | 0x80, 0, 0, 600) + b"\x00" * 16
+    payload = (
+        nonce
+        + struct.pack("!B3xHH", nat.PCP_PROTO_TCP, 4334, 40001)
+        + b"\x00" * 10 + b"\xff\xff" + socket.inet_aton("203.0.113.99")
+    )
+    parsed = nat.parse_pcp_map_response(header + payload, nonce)
+    assert parsed == (40001, 600, "203.0.113.99")
+
+
+def test_pcp_rejects_wrong_nonce():
+    packet, nonce = nat.build_pcp_map_request("192.168.1.10", 1, 1)
+    import struct
+
+    header = struct.pack("!BBBBI", 2, 0x81, 0, 0, 600) + b"\x00" * 16
+    payload = b"y" * 12 + struct.pack("!B3xHH", 6, 1, 2) + b"\x00" * 16
+    assert nat.parse_pcp_map_response(header + payload, nonce) is None
+
+
+# ------------------------------------------------- forwarder w/ fake GW
+
+
+class FakeNatpmpGateway(threading.Thread):
+    """Loopback NAT-PMP 'router': grants every map at external+1000."""
+
+    def __init__(self):
+        super().__init__(daemon=True)
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind(("127.0.0.1", 0))
+        self.port = self.sock.getsockname()[1]
+        self.sock.settimeout(5.0)
+        self._stop = threading.Event()
+        self.zero_lifetime_seen = threading.Event()
+
+    def run(self):
+        import struct
+
+        while not self._stop.is_set():
+            try:
+                data, src = self.sock.recvfrom(64)
+            except OSError:
+                break
+            if len(data) == 2 and data[1] == nat.NATPMP_OP_PUBLIC_ADDR:
+                resp = struct.pack("!BBHI", 0, 128, 0, 1) + socket.inet_aton("203.0.113.1")
+                self.sock.sendto(resp, src)
+            elif len(data) == 12:
+                _, opcode, _, internal, external, lifetime = struct.unpack(
+                    "!BBHHHI", data
+                )
+                if lifetime == 0:
+                    self.zero_lifetime_seen.set()
+                resp = struct.pack(
+                    "!BBHIHHI", 0, opcode + 128, 0, 42, internal, internal + 1000, lifetime
+                )
+                self.sock.sendto(resp, src)
+
+    def stop(self):
+        self._stop.set()
+        self.sock.close()
+
+
+def test_forwarder_natpmp_path_and_cleanup():
+    gw = FakeNatpmpGateway()
+    gw.start()
+    try:
+        fwd = nat.PortForwarder(gateway="127.0.0.1", timeout=2.0,
+                                natpmp_port=gw.port, pcp_port=1)
+        mapping = fwd.auto_forward(4334)
+        assert mapping.ok and mapping.method == "natpmp"
+        assert mapping.external_port == 5334
+        assert mapping.public_ip == "203.0.113.1"
+        assert fwd.cleanup() == 1
+        assert gw.zero_lifetime_seen.wait(2.0)
+        assert fwd.mappings == []
+    finally:
+        gw.stop()
+
+
+def test_forwarder_all_fail_returns_failed_mapping(monkeypatch):
+    monkeypatch.setattr(nat.STUNClient, "get_public_endpoint", lambda self: None)
+    fwd = nat.PortForwarder(gateway=None, timeout=0.2)
+    fwd.gateway = None  # defeat __post_init__ discovery
+    mapping = fwd.auto_forward(4334)
+    assert not mapping.ok and mapping.method == "none"
+
+
+def test_auto_forward_env_disable(monkeypatch):
+    monkeypatch.setenv("BEE2BEE_DISABLE_NAT", "1")
+    mapping = nat.auto_forward_port(4334)
+    assert not mapping.ok and mapping.detail == "disabled by env"
+
+
+# ----------------------------------------------------------- public IP
+
+
+def test_public_ip_cache(monkeypatch):
+    nat._PUBLIC_IP_CACHE.clear()
+    calls = []
+
+    class FakeResp:
+        status_code = 200
+        text = "203.0.113.77\n"
+
+    import httpx
+
+    def fake_get(url, timeout):
+        calls.append(url)
+        return FakeResp()
+
+    monkeypatch.setattr(httpx, "get", fake_get)
+    assert nat.get_public_ip() == "203.0.113.77"
+    assert nat.get_public_ip() == "203.0.113.77"
+    assert len(calls) == 1  # second hit served from cache
+    nat._PUBLIC_IP_CACHE.clear()
+
+
+def test_gateway_ip_parse(tmp_path, monkeypatch):
+    # emulate /proc/net/route content: default route via 192.168.1.254
+    route = (
+        "Iface Destination Gateway Flags RefCnt Use Metric Mask MTU Window IRTT\n"
+        "eth0 00000000 FE01A8C0 0003 0 0 100 00000000 0 0 0\n"
+    )
+    p = tmp_path / "route"
+    p.write_text(route)
+    real_open = open
+    monkeypatch.setattr(
+        "builtins.open",
+        lambda f, *a, **k: real_open(p if f == "/proc/net/route" else f, *a, **k),
+    )
+    assert nat.get_gateway_ip() == "192.168.1.254"
