@@ -19,6 +19,9 @@ def _setup_logging():
         level=os.environ.get("LOG_LEVEL", "INFO"),
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
+    # orbax/absl emit per-save INFO floods; keep them at WARNING unless asked
+    if os.environ.get("LOG_LEVEL", "INFO").upper() != "DEBUG":
+        logging.getLogger("absl").setLevel(logging.WARNING)
 
 
 def _serve(backend: str, model: str, **kw):
@@ -128,6 +131,72 @@ def register(bootstrap):
             await node.stop()
 
     asyncio.run(one_shot())
+
+
+@cli.command()
+@click.option("--model", default="tiny-gpt2", help="model config name")
+@click.option("--data", "data_path", required=True, type=click.Path(exists=True),
+              help="text file (blank-line-separated documents)")
+@click.option("--steps", default=100, help="training steps")
+@click.option("--batch-size", default=8)
+@click.option("--seq-len", default=128)
+@click.option("--lr", default=3e-4)
+@click.option("--ckpt-dir", default=None, help="checkpoint directory (resume if present)")
+@click.option("--ckpt-every", default=50,
+              help="steps between checkpoints (0 = only at the end)")
+@click.option("--mesh-shape", default="", help='e.g. "data:2,model:4"')
+def train(model, data_path, steps, batch_size, seq_len, lr, ckpt_dir, ckpt_every, mesh_shape):
+    """Train a causal LM on a local text corpus (checkpoint/resume-able).
+
+    The SPMD realization of the reference's per-layer WS training protocol
+    (reference node.py:94-182)."""
+    _setup_logging()
+    from .datasets import PreprocessConfig, from_text_file
+    from .engine.tokenizer import ByteTokenizer
+    from .models.config import get_config
+    from .train.trainer import TrainConfig, Trainer
+
+    cfg = get_config(model)
+    tcfg = TrainConfig(learning_rate=lr, total_steps=steps)
+    mesh = None
+    if mesh_shape:
+        from .config import parse_mesh_shape
+        from .parallel import MeshSpec, build_mesh
+
+        mesh = build_mesh(MeshSpec.from_dict(parse_mesh_shape(mesh_shape)))
+
+    data = from_text_file(
+        data_path, ByteTokenizer(cfg.vocab_size),
+        PreprocessConfig(seq_len=seq_len, batch_size=batch_size, shuffle_seed=0),
+    )
+    if data.n_batches == 0:
+        raise click.ClickException("corpus too small for one batch")
+
+    ckpt = None
+    trainer = Trainer(cfg, tcfg, mesh=mesh)
+    if ckpt_dir:
+        from .train.checkpoint import TrainCheckpointer
+
+        ckpt = TrainCheckpointer(ckpt_dir)
+        if ckpt.latest_step() is not None:
+            trainer.state = ckpt.restore(cfg, tcfg, mesh=mesh)
+            click.echo(f"resumed from step {trainer.step}")
+
+    it = data.repeat()
+    while trainer.step < steps:
+        metrics = trainer.train_step(next(it))
+        if trainer.step % 10 == 0 or trainer.step == steps:
+            click.echo(
+                f"step {trainer.step:5d} loss {metrics['loss']:.4f} "
+                f"acc {metrics['accuracy']:.3f}"
+            )
+        if ckpt and (
+            (ckpt_every > 0 and trainer.step % ckpt_every == 0)
+            or trainer.step == steps
+        ):
+            ckpt.save(trainer.state, cfg, tcfg)
+    if ckpt:
+        ckpt.close()
 
 
 @cli.command("nat-status")

@@ -1,0 +1,162 @@
+"""Dataset preprocessing: tokenize + pack into static-shape batches.
+
+Capability parity with the reference's thin wrapper (reference
+datasets.py:5-22: build_preprocess_config + load_and_preprocess over HF
+datasets, truncating to max_length=128 — reference hf.py:161-176), made
+TPU-idiomatic: XLA wants STATIC shapes, so instead of per-example ragged
+truncation this packs token streams into dense ``[batch, seq_len]``
+blocks with loss masks, yielding numpy batches ready for
+``jax.device_put`` onto a ('data','seq')-sharded mesh.
+
+Sources: an in-memory list of texts (tests/offline), a local text file,
+or — when the `datasets` package and a local/cached dataset are
+available — an HF dataset. Nothing here touches the network unless the
+caller passes an HF dataset name that isn't cached (gated the same way
+the reference gates transformers, reference hf.py:7-20).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Iterator
+
+import numpy as np
+
+
+def has_datasets() -> bool:
+    try:
+        import datasets  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+@dataclass(frozen=True)
+class PreprocessConfig:
+    """Mirrors reference build_preprocess_config (datasets.py:5-16), with
+    packing controls added."""
+
+    text_field: str = "text"
+    seq_len: int = 128
+    batch_size: int = 8
+    append_eos: bool = True
+    drop_remainder: bool = True  # ragged tails would force a recompile
+    shuffle_seed: int | None = None
+
+
+def tokenize_texts(
+    texts: Iterable[str], tokenizer, cfg: PreprocessConfig
+) -> np.ndarray:
+    """Concatenate token ids of all texts into one flat int32 stream."""
+    stream: list[int] = []
+    eos = getattr(tokenizer, "eos_token_id", None)
+    for t in texts:
+        ids = tokenizer.encode(t)
+        stream.extend(int(i) for i in ids)
+        if cfg.append_eos and eos is not None:
+            stream.append(int(eos))
+    return np.asarray(stream, dtype=np.int32)
+
+
+def pack_stream(stream: np.ndarray, cfg: PreprocessConfig) -> np.ndarray:
+    """Flat stream → [n_blocks, seq_len] dense blocks (static shapes)."""
+    n_blocks = len(stream) // cfg.seq_len
+    if n_blocks == 0:
+        if not cfg.drop_remainder and len(stream):
+            pad = np.zeros(cfg.seq_len, np.int32)
+            pad[: len(stream)] = stream
+            return pad[None, :]
+        return np.zeros((0, cfg.seq_len), np.int32)
+    used = stream[: n_blocks * cfg.seq_len].reshape(n_blocks, cfg.seq_len)
+    if not cfg.drop_remainder and len(stream) > n_blocks * cfg.seq_len:
+        tail = np.zeros(cfg.seq_len, np.int32)
+        rest = stream[n_blocks * cfg.seq_len :]
+        tail[: len(rest)] = rest
+        used = np.concatenate([used, tail[None, :]], axis=0)
+    return used
+
+
+@dataclass
+class PackedDataset:
+    """Dense token blocks + batch iteration with loss masks.
+
+    Batches are dicts {"input_ids": [B,T] int32, "loss_mask": [B,T] f32}
+    — exactly what train.loss_fn consumes.
+    """
+
+    blocks: np.ndarray  # [N, T]
+    batch_size: int = 8
+    _rng: np.random.Generator | None = field(default=None, repr=False)
+
+    @property
+    def n_batches(self) -> int:
+        return len(self.blocks) // self.batch_size
+
+    def shuffle(self, seed: int) -> "PackedDataset":
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(len(self.blocks))
+        return PackedDataset(self.blocks[perm], self.batch_size, rng)
+
+    def __iter__(self) -> Iterator[dict]:
+        for i in range(self.n_batches):
+            chunk = self.blocks[i * self.batch_size : (i + 1) * self.batch_size]
+            yield {
+                "input_ids": chunk,
+                "loss_mask": (chunk != 0).astype(np.float32),
+            }
+
+    def __len__(self) -> int:
+        return self.n_batches
+
+    def repeat(self) -> Iterator[dict]:
+        """Infinite epoch loop, reshuffling each pass when seeded."""
+        epoch = 0
+        while True:
+            ds = self.shuffle(epoch) if self._rng is not None else self
+            yield from ds
+            epoch += 1
+
+
+def from_texts(
+    texts: Iterable[str], tokenizer, cfg: PreprocessConfig | None = None
+) -> PackedDataset:
+    cfg = cfg or PreprocessConfig()
+    stream = tokenize_texts(texts, tokenizer, cfg)
+    blocks = pack_stream(stream, cfg)
+    ds = PackedDataset(blocks, cfg.batch_size)
+    if cfg.shuffle_seed is not None:
+        ds = ds.shuffle(cfg.shuffle_seed)
+    return ds
+
+
+def from_text_file(
+    path: str | Path, tokenizer, cfg: PreprocessConfig | None = None
+) -> PackedDataset:
+    text = Path(path).read_text()
+    # blank-line-separated documents, like HF text datasets
+    docs = [d for d in text.split("\n\n") if d.strip()]
+    return from_texts(docs, tokenizer, cfg)
+
+
+def load_and_preprocess(
+    dataset_name: str,
+    tokenizer,
+    cfg: PreprocessConfig | None = None,
+    split: str = "train",
+    limit: int | None = None,
+) -> PackedDataset:
+    """HF-datasets path (reference load_and_preprocess, datasets.py:19-22).
+
+    Requires the `datasets` package and a cached/local dataset (no egress
+    in the build environment).
+    """
+    if not has_datasets():
+        raise RuntimeError("the `datasets` package is not installed")
+    import datasets as hfds
+
+    cfg = cfg or PreprocessConfig()
+    ds = hfds.load_dataset(dataset_name, split=split)
+    texts = (ex[cfg.text_field] for ex in (ds.select(range(limit)) if limit else ds))
+    return from_texts(texts, tokenizer, cfg)

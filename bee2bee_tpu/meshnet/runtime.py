@@ -101,7 +101,10 @@ async def run_p2p_node(
                 )
                 if mapping.ok and mapping.public_ip:
                     node.announce_host = mapping.public_ip
-                    if mapping.external_port:
+                    # "stun" is observe-only: its external_port is the NAT
+                    # mapping of a throwaway UDP socket, not our listener —
+                    # only real mappings may override the announce port
+                    if mapping.external_port and mapping.method != "stun":
                         node.announce_port = mapping.external_port
                     logger.info(
                         "NAT %s: announcing %s:%s", mapping.method,

@@ -51,6 +51,8 @@ class PortMapping:
     public_ip: str | None = None
     lifetime: int = 0
     detail: str = ""
+    tcp: bool = True  # protocol the mapping was created for (cleanup needs it)
+    nonce: bytes | None = None  # PCP: delete must reuse the creating nonce (RFC 6887)
 
 
 # ----------------------------------------------------------------- NAT-PMP
@@ -164,15 +166,10 @@ def get_gateway_ip() -> str | None:
 
 
 def get_lan_ip() -> str | None:
-    try:
-        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        s.settimeout(1.0)
-        s.connect(("10.255.255.255", 1))  # no packets sent; routes only
-        ip = s.getsockname()[0]
-        s.close()
-        return ip
-    except OSError:
-        return None
+    """None when no route exists (delegates to utils.get_lan_ip)."""
+    from .utils import get_lan_ip as _lan
+
+    return _lan(default=None)
 
 
 _PUBLIC_IP_CACHE: dict[str, tuple[float, str]] = {}
@@ -268,7 +265,7 @@ class PortForwarder:
             if u.addportmapping(port, proto, u.lanaddr, port, "bee2bee_tpu", ""):
                 return PortMapping(
                     True, "upnp", port, external_port=port,
-                    public_ip=u.externalipaddress(), lifetime=0,
+                    public_ip=u.externalipaddress(), lifetime=0, tcp=tcp,
                 )
             return PortMapping(False, "upnp", port, detail="addportmapping refused")
         except Exception as exc:  # miniupnpc raises bare Exception
@@ -299,7 +296,7 @@ class PortForwarder:
         public_ip = parse_natpmp_public_addr_response(addr_data) if addr_data else None
         return PortMapping(
             True, "natpmp", port, external_port=external,
-            public_ip=public_ip, lifetime=lifetime,
+            public_ip=public_ip, lifetime=lifetime, tcp=tcp,
         )
 
     def _try_pcp(self, port: int, tcp: bool) -> PortMapping:
@@ -314,7 +311,7 @@ class PortForwarder:
         external_port, lifetime, external_ip = parsed
         return PortMapping(
             True, "pcp", port, external_port=external_port,
-            public_ip=external_ip, lifetime=lifetime,
+            public_ip=external_ip, lifetime=lifetime, tcp=tcp, nonce=nonce,
         )
 
     def _try_stun(self, port: int) -> PortMapping:
@@ -341,17 +338,20 @@ class PortForwarder:
                     u.discoverdelay = int(self.timeout * 1000)
                     if u.discover() > 0:
                         u.selectigd()
-                        u.deleteportmapping(m.external_port, "TCP")
+                        u.deleteportmapping(m.external_port, "TCP" if m.tcp else "UDP")
                         removed += 1
                 elif m.method == "natpmp" and self.gateway:
                     self._udp_round_trip(
-                        build_natpmp_map_request(m.internal_port, 0, lifetime=0),
+                        build_natpmp_map_request(
+                            m.internal_port, 0, lifetime=0, tcp=m.tcp
+                        ),
                         (self.gateway, self.natpmp_port),
                     )
                     removed += 1
                 elif m.method == "pcp" and self.gateway:
                     packet, _ = build_pcp_map_request(
-                        get_lan_ip() or "0.0.0.0", m.internal_port, 0, lifetime=0
+                        get_lan_ip() or "0.0.0.0", m.internal_port, 0,
+                        lifetime=0, tcp=m.tcp, nonce=m.nonce,
                     )
                     self._udp_round_trip(packet, (self.gateway, self.pcp_port))
                     removed += 1

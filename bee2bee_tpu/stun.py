@@ -19,6 +19,7 @@ import os
 import secrets
 import socket
 import struct
+import time as _time
 from concurrent.futures import ThreadPoolExecutor, as_completed
 from dataclasses import dataclass
 
@@ -135,54 +136,84 @@ class STUNClient:
         self.timeout = timeout
         self.source_port = source_port
 
-    def query_server(self, host: str, port: int) -> StunResult | None:
-        """One binding round-trip against a single server."""
+    def query_server(
+        self, host: str, port: int, sock: socket.socket | None = None
+    ) -> StunResult | None:
+        """One binding round-trip against a single server.
+
+        Pass an existing bound socket to reuse one local port across
+        queries — required for NAT-type comparison, where the NAT mapping
+        is keyed by the source port.
+        """
         packet, txn_id = build_binding_request()
-        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        own_sock = sock is None
+        if own_sock:
+            sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            sock.bind(("0.0.0.0", self.source_port))
         try:
             sock.settimeout(self.timeout)
-            sock.bind(("0.0.0.0", self.source_port))
             sock.sendto(packet, (host, port))
-            data, _ = sock.recvfrom(2048)
+            # drain until our transaction id answers (a reused socket may
+            # still hold late replies from a previous query)
+            deadline = _time.monotonic() + self.timeout
+            while True:
+                data, _ = sock.recvfrom(2048)
+                decoded = parse_binding_response(data, txn_id)
+                if decoded is not None:
+                    return StunResult(
+                        ip=decoded[0], port=decoded[1], server=f"{host}:{port}"
+                    )
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    return None
+                sock.settimeout(remaining)
         except OSError:
             return None
         finally:
-            sock.close()
-        decoded = parse_binding_response(data, txn_id)
-        if decoded is None:
-            return None
-        return StunResult(ip=decoded[0], port=decoded[1], server=f"{host}:{port}")
+            if own_sock:
+                sock.close()
 
     def get_public_endpoint(self, max_servers: int = 4) -> StunResult | None:
-        """Query several servers in parallel; first success wins."""
+        """Query several servers in parallel; first success returns without
+        waiting for the slow/unreachable ones (their threads die on their
+        own socket timeouts)."""
         targets = list(self.servers[:max_servers])
         if not targets:
             return None
-        with ThreadPoolExecutor(max_workers=len(targets)) as pool:
+        pool = ThreadPoolExecutor(max_workers=len(targets))
+        try:
             futures = [pool.submit(self.query_server, h, p) for h, p in targets]
             for fut in as_completed(futures):
                 res = fut.result()
                 if res is not None:
-                    for other in futures:
-                        other.cancel()
                     return res
-        return None
+            return None
+        finally:
+            pool.shutdown(wait=False, cancel_futures=True)
 
     def detect_nat_type(self) -> str:
         """Classify NAT by consistency of mappings across two servers.
 
         Returns one of: "blocked", "open", "cone", "symmetric", "unknown".
-        Same (ip, port) from two distinct servers → endpoint-independent
-        mapping ("cone"); differing ports → "symmetric"; mapping equals a
-        local interface address → "open" (no NAT).
+        Both binding requests leave from ONE local socket, so the NAT holds
+        a single mapping for them: same (ip, port) seen by two distinct
+        servers → endpoint-independent mapping ("cone"); differing ports →
+        "symmetric"; mapping equals a local interface address → "open".
         """
         results: list[StunResult] = []
-        for host, port in self.servers:
-            res = self.query_server(host, port)
-            if res is not None and all(r.server != res.server for r in results):
-                results.append(res)
-            if len(results) >= 2:
-                break
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            sock.bind(("0.0.0.0", self.source_port))
+            for host, port in self.servers:
+                res = self.query_server(host, port, sock=sock)
+                if res is not None and all(r.server != res.server for r in results):
+                    results.append(res)
+                if len(results) >= 2:
+                    break
+        except OSError:
+            pass
+        finally:
+            sock.close()
         if not results:
             return "blocked"
         local_ips = _local_addresses()

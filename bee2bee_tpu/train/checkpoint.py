@@ -1,0 +1,195 @@
+"""Checkpoint/resume for training state (orbax-backed).
+
+The reference has NO model-state checkpointing — only config JSON and
+content-addressed weight pieces on disk (reference utils.py:37-40,
+pieces.py:24-32); training activation caches live in process memory and
+die with it (reference node.py:60,123-129). This module is the capability
+*add* SURVEY §5 calls for: full TrainState (step/params/opt_state)
+save/restore with orbax, sharding-aware restore onto a live Mesh so a
+resumed run lands parameters directly at their mesh coordinates without a
+host-memory detour.
+
+Serving-side param checkpoints use the piece/manifest native format
+(models/loader.py save_native) — the two interoperate via
+``export_params``.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.config import ModelConfig
+from ..models.partition import partition_specs
+from .trainer import TrainConfig, TrainState, make_optimizer
+
+
+class TrainCheckpointer:
+    """Numbered step checkpoints under one directory, orbax-managed.
+
+    Layout: ``<dir>/<step>/state`` (orbax PyTree) + ``<dir>/meta.json``
+    (model/train configs, written once).
+    """
+
+    def __init__(self, directory: str | Path, max_to_keep: int = 3):
+        self.directory = Path(directory).absolute()
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    # ------------------------------------------------------------------ save
+
+    def save(
+        self,
+        state: TrainState,
+        model_cfg: ModelConfig | None = None,
+        train_cfg: TrainConfig | None = None,
+        force: bool = False,
+    ) -> int:
+        step = int(state.step)
+        if model_cfg is not None:
+            meta = {
+                "model": dict(model_cfg.__dict__),
+                "train": dict(train_cfg.__dict__) if train_cfg else {},
+            }
+            (self.directory / "meta.json").write_text(
+                json.dumps(meta, default=str, indent=1)
+            )
+        self._mgr.save(
+            step, args=ocp.args.StandardSave(_to_saveable(state)), force=force
+        )
+        self._mgr.wait_until_finished()
+        return step
+
+    # --------------------------------------------------------------- restore
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return list(self._mgr.all_steps())
+
+    def restore(
+        self,
+        model_cfg: ModelConfig,
+        train_cfg: TrainConfig | None = None,
+        mesh: Mesh | None = None,
+        step: int | None = None,
+    ) -> TrainState:
+        """Restore a TrainState; with a mesh, leaves are produced directly
+        at their partition_specs placements (no full-replica staging)."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        train_cfg = train_cfg or TrainConfig()
+        template = _abstract_state(model_cfg, train_cfg, mesh)
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(template)
+        )
+        # template already carries the optimizer-state tree structure, so the
+        # restored pytree drops straight into TrainState
+        return TrainState(
+            step=restored["step"],
+            params=restored["params"],
+            opt_state=restored["opt_state"],
+        )
+
+    def close(self):
+        self._mgr.close()
+
+    # ------------------------------------------------------------- interop
+
+    def export_params(self, state: TrainState, model_cfg: ModelConfig, path: str | Path):
+        """Write serving-format weights (piece manifest, loader.save_native)
+        from a training state — train → serve handoff."""
+        from ..models.loader import save_native
+
+        return save_native(state.params, model_cfg, path)
+
+
+def load_meta(directory: str | Path) -> dict:
+    p = Path(directory) / "meta.json"
+    return json.loads(p.read_text()) if p.exists() else {}
+
+
+# -------------------------------------------------------------------- helpers
+
+
+def _to_saveable(state: TrainState) -> dict[str, Any]:
+    # orbax StandardSave wants a pytree of arrays; dict container keeps the
+    # on-disk layout stable across TrainState refactors
+    return {
+        "step": state.step,
+        "params": state.params,
+        "opt_state": state.opt_state,
+    }
+
+
+def _abstract_state(
+    model_cfg: ModelConfig, train_cfg: TrainConfig, mesh: Mesh | None
+) -> dict[str, Any]:
+    """ShapeDtypeStructs (with shardings when a mesh is given) matching
+    _to_saveable's layout, without materializing parameters."""
+    from ..models import core
+
+    dtype = jax.numpy.dtype(train_cfg.param_dtype)
+    params_shape = jax.eval_shape(
+        lambda: core.init_params(model_cfg, jax.random.key(0), dtype=dtype)
+    )
+    opt_shape = jax.eval_shape(
+        lambda: make_optimizer(train_cfg).init(params_shape)
+    )
+
+    if mesh is not None:
+        specs = partition_specs(params_shape)
+
+        def with_sharding(leaf, spec):
+            return jax.ShapeDtypeStruct(
+                leaf.shape, leaf.dtype, sharding=NamedSharding(mesh, spec)
+            )
+
+        # optimizer moments (adam mu/nu) are param-tree-shaped subtrees, so
+        # their leaf KEYPATHS end with the corresponding param's keypath —
+        # match on that, never on shape (same-shaped params can carry
+        # opposite TP axes, e.g. attn wq vs wo)
+        from jax.tree_util import keystr, tree_flatten_with_path, tree_map_with_path
+
+        param_paths = {
+            keystr(path): spec
+            for (path, _), spec in zip(
+                tree_flatten_with_path(params_shape)[0],
+                jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P)),
+            )
+        }
+        params_shape = jax.tree.map(with_sharding, params_shape, specs)
+
+        def opt_sharding(path, leaf):
+            ps = keystr(path)
+            spec = next(
+                (s for pp, s in param_paths.items() if ps.endswith(pp)), P()
+            )
+            return jax.ShapeDtypeStruct(
+                leaf.shape, leaf.dtype, sharding=NamedSharding(mesh, spec)
+            )
+
+        opt_shape = tree_map_with_path(opt_sharding, opt_shape)
+
+    return {
+        "step": jax.ShapeDtypeStruct((), jax.numpy.int32)
+        if mesh is None
+        else jax.ShapeDtypeStruct(
+            (), jax.numpy.int32, sharding=NamedSharding(mesh, P())
+        ),
+        "params": params_shape,
+        "opt_state": opt_shape,
+    }

@@ -74,8 +74,9 @@ def sha256_hex(data: bytes | str) -> str:
     return hashlib.sha256(data).hexdigest()
 
 
-def get_lan_ip() -> str:
-    """Best-effort LAN IP via the UDP-connect trick (reference utils.py:68-80)."""
+def get_lan_ip(default: str | None = "127.0.0.1") -> str | None:
+    """Best-effort LAN IP via the UDP-connect trick (reference utils.py:68-80).
+    Returns `default` (pass None to detect failure) when no route exists."""
     try:
         s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         try:
@@ -85,7 +86,7 @@ def get_lan_ip() -> str:
         finally:
             s.close()
     except OSError:
-        return "127.0.0.1"
+        return default
 
 
 def now_ms() -> int:

@@ -128,6 +128,22 @@ def test_nat_type_symmetric():
         a.stop(), b.stop()
 
 
+def test_nat_type_cone_uses_single_source_socket():
+    """Cone vs symmetric must be judged from ONE local socket: servers that
+    echo the observed source port report the same port only when both
+    queries share a socket."""
+    a = FakeStunServer(echo_port=True)
+    b = FakeStunServer(echo_port=True)
+    a.start(), b.start()
+    try:
+        client = stun.STUNClient(servers=(a.addr, b.addr), timeout=1.0)
+        # loopback "mapping" is consistent per source port → cone, and 'open'
+        # short-circuit doesn't trigger because ip is 203.0.113.50
+        assert client.detect_nat_type() == "cone"
+    finally:
+        a.stop(), b.stop()
+
+
 def test_nat_type_blocked():
     client = stun.STUNClient(servers=(("127.0.0.1", 1),), timeout=0.3)
     assert client.detect_nat_type() == "blocked"
