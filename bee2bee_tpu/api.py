@@ -20,6 +20,7 @@ from aiohttp import web
 
 from . import __version__
 from .meshnet.node import P2PNode
+from .tracing import get_tracer
 
 logger = logging.getLogger("bee2bee_tpu.api")
 
@@ -130,6 +131,12 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
         if not prompt:
             return web.json_response({"detail": "prompt or messages required"}, status=400)
         model = body.get("model")
+        with get_tracer().span(
+            "api.chat", model=model, stream=bool(body.get("stream"))
+        ):
+            return await _chat_inner(request, body, prompt, model)
+
+    async def _chat_inner(request, body, prompt, model):
         params = {
             "prompt": prompt,
             "max_new_tokens": _int_param(body, ("max_new_tokens", "max_tokens"), 2048),
@@ -165,9 +172,25 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
         )
         return web.json_response(result)
 
+    async def trace(request):
+        """Observability surface the reference lacks (SURVEY §5): per-span
+        percentiles + recent spans from the process-global tracer."""
+        tracer = get_tracer()
+        try:
+            limit = min(1000, max(1, int(request.query.get("limit", 50))))
+        except ValueError:
+            return web.json_response({"detail": "limit must be an int"}, status=400)
+        return web.json_response(
+            {
+                "stats": tracer.stats(),
+                "recent": tracer.recent(limit, name=request.query.get("name")),
+            }
+        )
+
     app.router.add_get("/", home)
     app.router.add_get("/peers", peers)
     app.router.add_get("/providers", providers)
+    app.router.add_get("/trace", trace)
     app.router.add_post("/connect", connect)
     app.router.add_post("/chat", chat)
     app.router.add_post("/generate", chat)  # alias (reference api.py:190-191)

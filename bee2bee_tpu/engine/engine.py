@@ -37,6 +37,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..models import config as model_config
 from ..models import core, partition
 from ..parallel.mesh import local_mesh
+from ..tracing import get_tracer
 from ..utils import MetricsAggregator
 from .sampling import sample
 from .tokenizer import load_tokenizer
@@ -225,20 +226,24 @@ class InferenceEngine:
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :n] = ids
         cache = self.new_cache(1)
-        cache, last_logits = self._prefill(
-            self.params, jnp.asarray(tokens), cache, jnp.asarray([n], jnp.int32)
-        )
-        first = sample(last_logits, self._next_key(), temperature, top_k, top_p)
-
-        decode = self._get_decode(temperature, top_k, top_p)
-        cur, offset, pending = first, n, []
-        for _ in range(chunks):
-            toks_dev, cache = decode(
-                self.params, cur, cache, jnp.asarray([offset], jnp.int32), self._next_key()
+        with get_tracer().span("engine.prefill", prompt_tokens=n, bucket=bucket):
+            cache, last_logits = self._prefill(
+                self.params, jnp.asarray(tokens), cache, jnp.asarray([n], jnp.int32)
             )
-            cur = toks_dev[:, -1]
-            offset += K
-            pending.append(toks_dev)
+            first = sample(last_logits, self._next_key(), temperature, top_k, top_p)
+
+        # dispatch-only: decode chunks are enqueued async, so this span
+        # measures queueing, not device time (that shows in device_profile)
+        with get_tracer().span("engine.decode_dispatch", chunks=chunks):
+            decode = self._get_decode(temperature, top_k, top_p)
+            cur, offset, pending = first, n, []
+            for _ in range(chunks):
+                toks_dev, cache = decode(
+                    self.params, cur, cache, jnp.asarray([offset], jnp.int32), self._next_key()
+                )
+                cur = toks_dev[:, -1]
+                offset += K
+                pending.append(toks_dev)
         return first, pending, n, bucket, max_new_tokens
 
     def _stop_set(self, stop_tokens):

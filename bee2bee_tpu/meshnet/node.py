@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import contextvars
 import logging
 import time
 from pathlib import Path
@@ -30,6 +31,7 @@ import websockets
 from .. import protocol
 from ..joinlink import generate_join_link, parse_join_link
 from ..pieces import ShardManifest
+from ..tracing import get_tracer
 from ..utils import MetricsAggregator, get_lan_ip, get_system_metrics, new_id, sha256_hex
 
 logger = logging.getLogger("bee2bee_tpu.mesh")
@@ -424,29 +426,34 @@ class P2PNode:
             if on_chunk:
                 self._chunk_cbs[rid] = on_chunk
         try:
-            await self._send(
-                info["ws"],
-                protocol.msg(
-                    protocol.GEN_REQUEST,
-                    rid=rid,
-                    prompt=prompt,
-                    model=model,
-                    svc=svc_name,
-                    max_new_tokens=max_new_tokens,
-                    max_tokens=max_new_tokens,  # reference reads this key
-                    temperature=temperature,
-                    stream=bool(stream or on_chunk),
-                ),
-            )
-            result = await asyncio.wait_for(fut, timeout=timeout)
+            with get_tracer().span(
+                "gen.p2p", provider=provider_id, model=model, rid=rid
+            ):
+                await self._send(
+                    info["ws"],
+                    protocol.msg(
+                        protocol.GEN_REQUEST,
+                        rid=rid,
+                        prompt=prompt,
+                        model=model,
+                        svc=svc_name,
+                        max_new_tokens=max_new_tokens,
+                        max_tokens=max_new_tokens,  # reference reads this key
+                        temperature=temperature,
+                        stream=bool(stream or on_chunk),
+                    ),
+                )
+                result = await asyncio.wait_for(fut, timeout=timeout)
+                # raise inside the span so remote-error results count as
+                # span errors in /trace, same as timeouts do
+                if isinstance(result, dict) and result.get("error"):
+                    raise RuntimeError(result["error"])
         except asyncio.TimeoutError:
             raise RuntimeError("request_timed_out")
         finally:
             async with self._pending_lock:
                 self._pending.pop(rid, None)
                 self._chunk_cbs.pop(rid, None)
-        if isinstance(result, dict) and result.get("error"):
-            raise RuntimeError(result["error"])
         return result
 
     def local_service_for(self, model: str | None):
@@ -471,24 +478,34 @@ class P2PNode:
 
     async def _execute_local(self, svc, params, stream, on_chunk) -> dict:
         loop = asyncio.get_running_loop()
-        if stream or on_chunk:
-            import json as _json
+        with get_tracer().span(
+            "gen.local", service=svc.name, stream=bool(stream or on_chunk)
+        ) as span:
+            # copy_context so engine spans emitted inside the worker thread
+            # keep this span as their parent (run_in_executor alone drops
+            # contextvars)
+            ctx = contextvars.copy_context()
+            if stream or on_chunk:
+                import json as _json
 
-            text_parts: list[str] = []
+                text_parts: list[str] = []
 
-            def run_stream():
-                for line in svc.execute_stream(params):
-                    obj = _json.loads(line)
-                    if obj.get("text"):
-                        text_parts.append(obj["text"])
-                        if on_chunk:
-                            loop.call_soon_threadsafe(on_chunk, obj["text"])
-                    if obj.get("status") == "error":
-                        raise RuntimeError(obj.get("message", "stream error"))
+                def run_stream():
+                    for line in svc.execute_stream(params):
+                        obj = _json.loads(line)
+                        if obj.get("text"):
+                            text_parts.append(obj["text"])
+                            if on_chunk:
+                                loop.call_soon_threadsafe(on_chunk, obj["text"])
+                        if obj.get("status") == "error":
+                            raise RuntimeError(obj.get("message", "stream error"))
 
-            await loop.run_in_executor(None, run_stream)
-            return {"text": "".join(text_parts), "tokens": None, "streamed": True}
-        return await loop.run_in_executor(None, svc.execute, params)
+                await loop.run_in_executor(None, ctx.run, run_stream)
+                span.attrs["chunks"] = len(text_parts)
+                return {"text": "".join(text_parts), "tokens": None, "streamed": True}
+            result = await loop.run_in_executor(None, ctx.run, svc.execute, params)
+            span.attrs["tokens"] = result.get("tokens")
+            return result
 
     async def _handle_gen_request(self, ws, data):
         rid = data.get("rid") or data.get("task_id")

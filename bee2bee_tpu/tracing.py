@@ -1,0 +1,168 @@
+"""Tracing and observability: request spans + on-demand device profiles.
+
+The reference has NO tracing (SURVEY §5) — the closest artifacts are
+per-request latency_ms (reference services.py:97-105) and ping RTTs
+(reference p2p_runtime.py:544-557). This module is the required upgrade:
+
+- `Tracer`: a lock-guarded ring buffer of completed `Span`s with nested
+  span support (contextvar parent), percentile aggregation per span name,
+  and zero dependencies. One process-global instance via `get_tracer()`.
+- `Span` context manager works in sync and async code and never throws:
+  tracing must not take down the serving path.
+- `device_profile()`: wraps `jax.profiler.trace` so one call captures an
+  XLA device trace viewable in TensorBoard/Perfetto.
+
+Spans are cheap (monotonic clock + dict append) and bounded (ring
+buffer), so they stay on in production; mesh nodes surface them at the
+gateway's `/trace` route.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Iterator
+
+from .utils import new_id
+
+_current_span: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "bee2bee_current_span", default=None
+)
+
+
+@dataclass
+class Span:
+    name: str
+    span_id: str = field(default_factory=lambda: new_id("span"))
+    parent_id: str | None = None
+    start_ms: float = 0.0
+    duration_ms: float = -1.0  # -1 while open
+    attrs: dict[str, Any] = field(default_factory=dict)
+    error: str | None = None
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start_ms": round(self.start_ms, 3),
+            "duration_ms": round(self.duration_ms, 3),
+            "attrs": self.attrs,
+            "error": self.error,
+        }
+
+
+class Tracer:
+    """Bounded in-memory span collector; thread-safe; never raises."""
+
+    def __init__(self, capacity: int = 2048):
+        self._spans: deque[Span] = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._epoch = time.time() * 1000.0 - time.monotonic() * 1000.0
+        self.counters: dict[str, int] = {}
+
+    @contextmanager
+    def span(self, name: str, **attrs) -> Iterator[Span]:
+        s = Span(
+            name=name,
+            parent_id=_current_span.get(),
+            start_ms=self._epoch + time.monotonic() * 1000.0,
+            attrs=dict(attrs),
+        )
+        token = _current_span.set(s.span_id)
+        t0 = time.monotonic()
+        try:
+            yield s
+        except BaseException as exc:
+            s.error = f"{type(exc).__name__}: {exc}"
+            raise
+        finally:
+            s.duration_ms = (time.monotonic() - t0) * 1000.0
+            _current_span.reset(token)
+            with self._lock:
+                self._spans.append(s)
+
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def recent(self, limit: int = 100, name: str | None = None) -> list[dict]:
+        with self._lock:
+            spans = list(self._spans)
+        if name is not None:
+            spans = [s for s in spans if s.name == name]
+        return [s.to_dict() for s in spans[-limit:]]
+
+    def stats(self) -> dict[str, dict]:
+        """Per-span-name aggregates: count, p50/p95/max duration, errors."""
+        with self._lock:
+            spans = list(self._spans)
+            counters = dict(self.counters)
+        by_name: dict[str, list[Span]] = {}
+        for s in spans:
+            by_name.setdefault(s.name, []).append(s)
+        out: dict[str, dict] = {}
+        for name, group in by_name.items():
+            durs = sorted(s.duration_ms for s in group)
+            out[name] = {
+                "count": len(durs),
+                "errors": sum(1 for s in group if s.error),
+                "p50_ms": round(_pct(durs, 0.50), 3),
+                "p95_ms": round(_pct(durs, 0.95), 3),
+                "max_ms": round(durs[-1], 3),
+            }
+        if counters:
+            out["_counters"] = counters
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self.counters.clear()
+
+
+def _pct(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+_GLOBAL = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _GLOBAL
+
+
+@contextmanager
+def device_profile(log_dir: str = "/tmp/bee2bee_trace"):
+    """Capture an XLA device trace (TensorBoard `trace_viewer` readable).
+
+    The TPU-native answer to "how do I see where the time goes": wraps
+    jax.profiler.trace around any block — jit compiles, collectives, HBM
+    transfers all appear in the timeline.
+    """
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        with get_tracer().span("device_profile", log_dir=log_dir):
+            yield log_dir
+
+
+def annotate(name: str, **attrs):
+    """jax.profiler.TraceAnnotation + host span in one: shows up both in
+    the device timeline and in /trace output."""
+    import jax
+
+    @contextmanager
+    def _cm():
+        with jax.profiler.TraceAnnotation(name):
+            with get_tracer().span(name, **attrs):
+                yield
+
+    return _cm()
