@@ -148,11 +148,9 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
         if svc is not None:
             if stream:
                 return await _stream_service(request, node, svc, params, cors)
-            import asyncio
-
-            result = await asyncio.get_running_loop().run_in_executor(
-                None, svc.execute, params
-            )
+            # node._execute_local = executor dispatch + gen.local span with
+            # contextvar parenting (engine spans nest under it)
+            result = await node._execute_local(svc, params, stream=False, on_chunk=None)
             return web.json_response(result)
 
         # P2P fallback (reference api.py:247-264)
@@ -216,6 +214,7 @@ def _prompt_from_messages(messages) -> str | None:
 async def _stream_service(request, node: P2PNode, svc, params, cors=()) -> web.StreamResponse:
     """JSON-lines streaming from a local service (chunked response)."""
     import asyncio
+    import contextvars
     import threading
 
     resp = web.StreamResponse(
@@ -236,20 +235,27 @@ async def _stream_service(request, node: P2PNode, svc, params, cors=()) -> web.S
         finally:
             loop.call_soon_threadsafe(q.put_nowait, DONE)
 
-    task = loop.run_in_executor(None, pump)
-    try:
-        while True:
-            item = await q.get()
-            if item is DONE:
-                break
-            await resp.write(item.encode("utf-8"))
-        await resp.write_eof()
-    except (ConnectionResetError, asyncio.CancelledError):
-        logger.info("stream client disconnected; aborting generation pump")
-        raise
-    finally:
-        cancelled.set()
-        await task
+    # span + copy_context mirror node._execute_local (the service lines pass
+    # through verbatim here, so we can't reuse it directly)
+    with get_tracer().span("gen.local", service=svc.name, stream=True) as span:
+        ctx = contextvars.copy_context()
+        task = loop.run_in_executor(None, ctx.run, pump)
+        chunks = 0
+        try:
+            while True:
+                item = await q.get()
+                if item is DONE:
+                    break
+                chunks += 1
+                await resp.write(item.encode("utf-8"))
+            await resp.write_eof()
+        except (ConnectionResetError, asyncio.CancelledError):
+            logger.info("stream client disconnected; aborting generation pump")
+            raise
+        finally:
+            span.attrs["chunks"] = chunks
+            cancelled.set()
+            await task
     return resp
 
 

@@ -226,7 +226,9 @@ class InferenceEngine:
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :n] = ids
         cache = self.new_cache(1)
-        with get_tracer().span("engine.prefill", prompt_tokens=n, bucket=bucket):
+        # dispatch-only (prefill is jit'd + async): wall time here is enqueue
+        # + any compile, NOT device time — that shows in device_profile
+        with get_tracer().span("engine.prefill_dispatch", prompt_tokens=n, bucket=bucket):
             cache, last_logits = self._prefill(
                 self.params, jnp.asarray(tokens), cache, jnp.asarray([n], jnp.int32)
             )

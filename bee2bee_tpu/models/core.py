@@ -227,13 +227,18 @@ def embed_tokens(params: Params, cfg: ModelConfig, input_ids, positions):
     return x
 
 
-def transformer_block(lp: Params, cfg: ModelConfig, x, positions, mask, kv_hook=None):
+def transformer_block(
+    lp: Params, cfg: ModelConfig, x, positions, mask, kv_hook=None, attn_fn=None
+):
     """One block. lp: a single layer's params (no leading L dim). x [B,T,D].
 
     kv_hook(k, v) -> (k_eff, v_eff), when given, intercepts the freshly
     projected K/V — the cached decode path uses it to write the chunk into
     the KV cache and attend over the cache instead. No hook = plain causal
     self-attention over the chunk (training/scoring/pipeline-stage path).
+
+    attn_fn(q, k, v, mask, cfg) -> [B,T,H*hd] replaces the dense softmax
+    attention — the sequence-parallel path passes ring attention here.
     """
     B, T, _ = x.shape
     H, Hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -254,7 +259,7 @@ def transformer_block(lp: Params, cfg: ModelConfig, x, positions, mask, kv_hook=
         k = _rope(k, positions, cfg.rope_theta)
     if kv_hook is not None:
         k, v = kv_hook(k, v)
-    attn_out = _attention(q, k, v, mask, cfg)
+    attn_out = (attn_fn or _attention)(q, k, v, mask, cfg)
     attn_out = attn_out @ lp["attn"]["wo"]
     if "bo" in lp["attn"]:
         attn_out = attn_out + lp["attn"]["bo"]

@@ -4,3 +4,17 @@ is layer-range pipeline hops over WebSocket (reference node.py:236-277); here
 parallelism is jax.sharding over a Mesh with XLA-inserted collectives."""
 
 from .mesh import MeshSpec, build_mesh, local_mesh  # noqa: F401
+
+
+def __getattr__(name):
+    # ring/pipeline pull in the model core; keep `import bee2bee_tpu.parallel`
+    # light for mesh-only users
+    if name in ("ring_attention", "make_sp_forward", "make_sp_train_step"):
+        from . import ring
+
+        return getattr(ring, name)
+    if name in ("pipeline_forward", "make_pp_train_step", "split_pp_params"):
+        from . import pipeline
+
+        return getattr(pipeline, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

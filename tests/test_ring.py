@@ -1,0 +1,151 @@
+"""Ring attention / sequence parallelism tests (8-device CPU mesh).
+
+Correctness bar: ring results must match the dense reference attention
+(models/core._attention) to float tolerance, including GQA and the full
+model forward; the trainer path must produce finite loss and identical
+metrics to the dense DP trainer on the same batch.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bee2bee_tpu.models import core
+from bee2bee_tpu.models.config import get_config
+from bee2bee_tpu.parallel import MeshSpec, build_mesh
+from bee2bee_tpu.parallel.ring import (
+    make_sp_forward,
+    make_sp_train_step,
+    ring_attention,
+)
+
+
+def dense_causal(q, k, v):
+    """Reference: core._attention with a causal mask."""
+    T = q.shape[1]
+    mask = jnp.tril(jnp.ones((T, T), bool))[None, None, :, :]
+    cfg = get_config("tiny-gpt2")  # only used for shape-free code path
+    return core._attention(q, k, v, mask, cfg)
+
+
+def _qkv(B, T, H, Hkv, hd, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((B, T, H, hd)), dtype)
+    k = jnp.asarray(rng.standard_normal((B, T, Hkv, hd)), dtype)
+    v = jnp.asarray(rng.standard_normal((B, T, Hkv, hd)), dtype)
+    return q, k, v
+
+
+@pytest.fixture(scope="module")
+def mesh_ds():
+    return build_mesh(MeshSpec(data=2, seq=4))
+
+
+@pytest.fixture(scope="module")
+def mesh_seq8():
+    return build_mesh(MeshSpec(seq=8))
+
+
+def test_ring_matches_dense_mha(mesh_ds):
+    q, k, v = _qkv(B=2, T=32, H=4, Hkv=4, hd=8)
+    out = ring_attention(q, k, v, mesh_ds)
+    ref = dense_causal(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_matches_dense_gqa(mesh_ds):
+    q, k, v = _qkv(B=2, T=32, H=8, Hkv=2, hd=4, seed=1)
+    out = ring_attention(q, k, v, mesh_ds)
+    ref = dense_causal(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_full_seq_axis(mesh_seq8):
+    q, k, v = _qkv(B=1, T=64, H=4, Hkv=4, hd=8, seed=2)
+    out = ring_attention(q, k, v, mesh_seq8, axis_name="seq")
+    ref = dense_causal(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_causality(mesh_ds):
+    """Future tokens must not influence earlier outputs: perturbing the
+    last quarter of the sequence leaves the first quarter unchanged."""
+    q, k, v = _qkv(B=1, T=32, H=4, Hkv=4, hd=8, seed=3)
+    out1 = np.asarray(ring_attention(q, k, v, mesh_ds))
+    k2 = k.at[:, 24:].add(7.0)
+    v2 = v.at[:, 24:].add(-3.0)
+    out2 = np.asarray(ring_attention(q, k2, v2, mesh_ds))
+    np.testing.assert_allclose(out1[:, :8], out2[:, :8], atol=1e-6)
+    assert not np.allclose(out1[:, 24:], out2[:, 24:])
+
+
+def test_ring_bf16_inputs(mesh_ds):
+    q, k, v = _qkv(B=1, T=32, H=4, Hkv=4, hd=8, seed=4, dtype=jnp.bfloat16)
+    out = ring_attention(q, k, v, mesh_ds)
+    assert out.dtype == jnp.bfloat16
+    ref = dense_causal(q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32))
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), atol=0.08, rtol=0.08
+    )
+
+
+def test_sp_forward_matches_dense(mesh_ds):
+    cfg = get_config("tiny-llama")
+    params = core.init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(3, cfg.vocab_size, (2, 32)), jnp.int32
+    )
+    sp = make_sp_forward(cfg, mesh_ds)
+    got = sp(params, ids)
+    ref, _ = core.forward(params, cfg, ids, None, jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=3e-4, rtol=1e-4)
+
+
+def test_sp_forward_rejects_tp_mesh():
+    mesh = build_mesh(MeshSpec(seq=2, model=4))
+    with pytest.raises(ValueError, match="model=1"):
+        make_sp_forward(get_config("tiny-llama"), mesh)
+
+
+def test_sp_train_step_matches_dense_trainer(mesh_ds):
+    from bee2bee_tpu.train.trainer import TrainConfig, make_train_state, make_train_step
+
+    cfg = get_config("tiny-llama")
+    tcfg = TrainConfig(learning_rate=1e-3, param_dtype="float32")
+    batch = {
+        "input_ids": jnp.asarray(
+            np.random.default_rng(1).integers(3, cfg.vocab_size, (4, 32)), jnp.int32
+        )
+    }
+
+    state_sp = make_train_state(cfg, tcfg, jax.random.key(0))
+    sp_step = make_sp_train_step(cfg, tcfg, mesh_ds, donate=False)
+    _, m_sp = sp_step(state_sp, batch)
+
+    state_d = make_train_state(cfg, tcfg, jax.random.key(0))
+    d_step = make_train_step(cfg, tcfg)
+    _, m_d = d_step(state_d, batch)
+
+    assert float(m_sp["loss"]) == pytest.approx(float(m_d["loss"]), rel=2e-4)
+    assert float(m_sp["grad_norm"]) == pytest.approx(float(m_d["grad_norm"]), rel=2e-3)
+
+
+def test_sp_long_context_scales(mesh_seq8):
+    """The point of ring attention: a sequence 8x the per-device chunk runs
+    with per-device K/V of T/8 — here just correctness at T=128 on tiny."""
+    cfg = get_config("tiny-llama")
+    params = core.init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    ids = jnp.asarray(
+        np.random.default_rng(2).integers(3, cfg.vocab_size, (1, 128)), jnp.int32
+    )
+    sp = make_sp_forward(cfg, mesh_seq8)
+    got = sp(params, ids)
+    ref, _ = core.forward(params, cfg, ids, None, jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=3e-4, rtol=1e-4)
