@@ -56,6 +56,10 @@ class EngineConfig:
     # sync costs ~100 ms through a tunneled TPU; chunking amortizes it to
     # sync/chunk_len per token. Streaming granularity == chunk_len.
     decode_chunk: int = 16
+    # "dense": einsum attention (models/core._attention, XLA-fused);
+    # "flash": pallas tiled kernel (ops/flash.py) — no [T,S] score
+    # materialization, VMEM-resident online softmax
+    attention: str = "dense"
 
 
 @dataclass
@@ -89,6 +93,7 @@ class InferenceEngine:
         # an explicit mesh (the model must divide its axes — validated below)
         self.mesh = mesh if mesh is not None else local_mesh()
         partition.validate_divisibility(self.model_cfg, self.mesh)
+        self._validate_attention_impl()
         self.dtype = jnp.dtype(self.engine_cfg.dtype)
         self.max_seq_len = min(self.engine_cfg.max_seq_len, self.model_cfg.max_seq_len)
         self.metrics = MetricsAggregator()
@@ -116,9 +121,34 @@ class InferenceEngine:
 
     # ------------------------------------------------------------ compiled fns
 
+    def _attn_fn(self):
+        """attn_fn for core.forward per the engine's attention setting."""
+        if self.engine_cfg.attention != "flash":
+            return None
+        from ..ops.flash import flash_attention
+
+        def attn(q, k, v, mask, cfg, positions=None):
+            return flash_attention(q, k, v, offset=positions[:, 0])
+
+        return attn
+
+    def _validate_attention_impl(self):
+        # pallas_call has no SPMD partitioning rule: under TP the
+        # model-sharded KV cache would be all-gathered into the kernel.
+        # Same stance as parallel/ring.make_sp_forward's mesh guard.
+        if self.engine_cfg.attention == "flash" and (
+            self.mesh.shape.get("model", 1) > 1 or self.mesh.shape.get("expert", 1) > 1
+        ):
+            raise ValueError(
+                "attention='flash' requires model=expert=1 in the mesh "
+                f"(got {dict(self.mesh.shape)}); use attention='dense' for TP/EP"
+            )
+
     def _prefill_fn(self, params, tokens, cache, true_len):
         """tokens [B, Tb] padded; returns (cache, last_logits [B, V])."""
-        logits, cache = core.forward(params, self.model_cfg, tokens, cache, jnp.int32(0))
+        logits, cache = core.forward(
+            params, self.model_cfg, tokens, cache, jnp.int32(0), attn_fn=self._attn_fn()
+        )
         idx = (true_len - 1).reshape(-1, 1, 1)  # [B,1,1]
         last = jnp.take_along_axis(logits, jnp.broadcast_to(idx, (logits.shape[0], 1, logits.shape[2])), axis=1)
         return cache, last[:, 0, :]
@@ -134,7 +164,7 @@ class InferenceEngine:
         def step(carry, key_t):
             cur, cache, off = carry
             logits, cache = core.forward(
-                params, self.model_cfg, cur[:, None], cache, off
+                params, self.model_cfg, cur[:, None], cache, off, attn_fn=self._attn_fn()
             )
             nxt = sample(logits[:, -1, :], key_t, temperature, top_k, top_p)
             return (nxt, cache, off + 1), nxt

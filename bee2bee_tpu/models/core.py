@@ -237,8 +237,10 @@ def transformer_block(
     the KV cache and attend over the cache instead. No hook = plain causal
     self-attention over the chunk (training/scoring/pipeline-stage path).
 
-    attn_fn(q, k, v, mask, cfg) -> [B,T,H*hd] replaces the dense softmax
-    attention — the sequence-parallel path passes ring attention here.
+    attn_fn(q, k, v, mask, cfg, positions=positions) -> [B,T,H*hd] replaces
+    the dense softmax attention — the sequence-parallel path passes ring
+    attention here, the engine's flash path passes the pallas kernel
+    (which derives per-batch cache offsets from `positions`).
     """
     B, T, _ = x.shape
     H, Hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -259,7 +261,10 @@ def transformer_block(
         k = _rope(k, positions, cfg.rope_theta)
     if kv_hook is not None:
         k, v = kv_hook(k, v)
-    attn_out = (attn_fn or _attention)(q, k, v, mask, cfg)
+    if attn_fn is None:
+        attn_out = _attention(q, k, v, mask, cfg)
+    else:
+        attn_out = attn_fn(q, k, v, mask, cfg, positions=positions)
     attn_out = attn_out @ lp["attn"]["wo"]
     if "bo" in lp["attn"]:
         attn_out = attn_out + lp["attn"]["bo"]
@@ -295,6 +300,7 @@ def forward(
     cache,  # {"k": [L,B,S,Hkv,hd], "v": ...} or None (no-cache full forward)
     offset,  # [] or [B] int32: write position of input_ids[:, 0] in the cache
     remat: bool = False,  # jax.checkpoint each layer (training: HBM for FLOPs)
+    attn_fn=None,  # custom attention (ops.flash / parallel.ring); None = dense
 ):
     """Run a [B, T] token chunk. Returns (logits [B, T, V], new_cache).
 
@@ -326,7 +332,11 @@ def forward(
         lp, layer_idx = xs
 
         if cache_k is None:  # training/scoring path: plain block
-            return (transformer_block(lp, cfg, x, positions, mask), None, None), None
+            return (
+                transformer_block(lp, cfg, x, positions, mask, attn_fn=attn_fn),
+                None,
+                None,
+            ), None
 
         def kv_hook(k, v):
             # write this chunk's K/V at [offset, offset+T) per batch row,
@@ -344,7 +354,9 @@ def forward(
             cache_v = cache_v.at[layer_idx].set(cv)
             return ck, cv
 
-        x = transformer_block(lp, cfg, x, positions, mask, kv_hook=kv_hook)
+        x = transformer_block(
+            lp, cfg, x, positions, mask, kv_hook=kv_hook, attn_fn=attn_fn
+        )
         return (x, cache_k, cache_v), None
 
     layer_params = params["layers"]

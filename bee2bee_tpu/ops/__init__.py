@@ -1,0 +1,12 @@
+"""Hand-written TPU kernels (pallas/Mosaic).
+
+The reference has zero native/kernel code (SURVEY §2 native inventory:
+"none"); on TPU the kernel obligations come from the target itself —
+flash attention tiles that keep the MXU fed from VMEM instead of
+materializing [T, S] score matrices in HBM.
+
+Kernels auto-fall back to interpret mode off-TPU, so the whole test
+suite exercises them on the CPU mesh.
+"""
+
+from .flash import decode_attention, flash_attention  # noqa: F401

@@ -1,0 +1,228 @@
+"""Flash attention pallas kernels (prefill + KV-cache decode).
+
+Design notes (pallas_guide.md patterns):
+- Online softmax: grid's innermost dim walks K/V blocks sequentially on
+  one core; m/l/acc scratch in VMEM persists across those iterations and
+  the output block is written on the last one.
+- Accumulation in f32 (MXU `preferred_element_type`), storage dtype of
+  the inputs.
+- GQA: the kv-head index for a q-head h is h // (H // Hkv), computed in
+  the BlockSpec index_map so each q-head grid step DMAs only its own KV
+  block.
+- `offset` rides SMEM as a [1,1] scalar so the SAME compiled kernel
+  serves prefill (offset=0 mask within the chunk) and cached decode
+  (queries live at positions offset..offset+T).
+- Off-TPU the kernels run in pallas interpret mode — the CPU test suite
+  exercises the exact kernel code path.
+
+Replaces the dense [B,H,T,S] score materialization of models/core
+._attention on the hot path (engine flag attention="flash").
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+_LANES = 128  # m/l scratch lane padding (min f32 tile is (8, 128))
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+# ------------------------------------------------------------- prefill
+
+
+def _flash_kernel(
+    off_ref,  # SMEM [1,1] int32: global position of q[:, 0]
+    q_ref,  # [1, BQ, 1, hd]
+    k_ref,  # [1, BK, 1, hd]
+    v_ref,  # [1, BK, 1, hd]
+    o_ref,  # [1, BQ, 1, hd]
+    m_ref,  # VMEM [BQ, 128] f32 running max
+    l_ref,  # VMEM [BQ, 128] f32 running sum
+    acc_ref,  # VMEM [BQ, hd] f32
+    *,
+    sm_scale: float,
+    block_q: int,
+    block_k: int,
+    causal: bool,
+):
+    qi = pl.program_id(2)
+    kj = pl.program_id(3)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # skip K blocks entirely above the diagonal (offset is dynamic, so the
+    # grid can't be pruned statically — predicate out the wasted MXU work)
+    last_qpos = off_ref[0, 0] + (qi + 1) * block_q - 1
+    visible = (kj * block_k <= last_qpos) if causal else jnp.bool_(True)
+
+    @pl.when(visible)
+    def _attend():
+        q = q_ref[0, :, 0, :]
+        k = k_ref[0, :, 0, :]
+        s = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * sm_scale
+        )  # [BQ, BK]
+
+        if causal:
+            qpos = off_ref[0, 0] + qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            mask = kpos <= qpos
+            s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[:, 0]
+        l_prev = l_ref[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        if causal:
+            # a fully-masked ROW would otherwise contribute exp(-1e30+1e30)=1
+            p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+
+        v = v_ref[0, :, 0, :]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + pv
+        m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    @pl.when(kj == pl.num_programs(3) - 1)
+    def _finalize():
+        # causal rows always see their own position, so l >= exp(0) > 0
+        o_ref[0, :, 0, :] = (
+            acc_ref[:] / l_ref[:, 0][:, None]
+        ).astype(o_ref.dtype)
+
+
+def flash_attention(
+    q,  # [B, T, H, hd]
+    k,  # [B, S, Hkv, hd]
+    v,  # [B, S, Hkv, hd]
+    offset=None,  # [] or [B] int32: global position of q[:, 0] (None -> 0)
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    sm_scale: float | None = None,
+    interpret: bool | None = None,
+):
+    """Tiled causal attention; returns [B, T, H*hd] (core._attention ABI).
+
+    T and S are padded to the block sizes internally; with a KV cache pass
+    S = cache capacity and `offset` = write position (future cache slots
+    are masked by causality exactly like models/core.forward's mask).
+    """
+    B, T, H, hd = q.shape
+    S, Hkv = k.shape[1], k.shape[2]
+    group = H // Hkv
+    sm_scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(hd)
+    interpret = (not _on_tpu()) if interpret is None else interpret
+
+    block_q = min(block_q, max(T, 8))
+    block_k = min(block_k, max(S, 8))
+    Tp = -(-T // block_q) * block_q
+    Sp = -(-S // block_k) * block_k
+    if Tp != T:
+        q = jnp.pad(q, ((0, 0), (0, Tp - T), (0, 0), (0, 0)))
+    if Sp != S:
+        k = jnp.pad(k, ((0, 0), (0, Sp - S), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, Sp - S), (0, 0), (0, 0)))
+    if not causal and Sp != S:
+        raise ValueError("non-causal flash requires S divisible by block_k")
+
+    # per-batch offsets in SMEM: [B, 1], one (1,1) block per batch step
+    off = jnp.broadcast_to(
+        jnp.asarray(offset if offset is not None else 0, jnp.int32).reshape(-1),
+        (B,),
+    ).reshape(B, 1)
+
+    grid = (B, H, Tp // block_q, Sp // block_k)
+    kernel = functools.partial(
+        _flash_kernel,
+        sm_scale=sm_scale,
+        block_q=block_q,
+        block_k=block_k,
+        causal=causal,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1), lambda b, h, i, j: (b, 0), memory_space=pltpu.SMEM
+            ),
+            pl.BlockSpec((1, block_q, 1, hd), lambda b, h, i, j: (b, i, h, 0)),
+            pl.BlockSpec(
+                (1, block_k, 1, hd), lambda b, h, i, j: (b, j, h // group, 0)
+            ),
+            pl.BlockSpec(
+                (1, block_k, 1, hd), lambda b, h, i, j: (b, j, h // group, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, 1, hd), lambda b, h, i, j: (b, i, h, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, hd), jnp.float32),
+        ],
+        out_shape=jax.ShapeDtypeStruct((B, Tp, H, hd), q.dtype),
+        interpret=interpret,
+    )(off, q, k, v)
+    return out[:, :T].reshape(B, T, H * hd)
+
+
+# -------------------------------------------------------------- decode
+
+
+def decode_attention(
+    q,  # [B, H, hd] one query token per row
+    k,  # [B, S, Hkv, hd] KV cache
+    v,  # [B, S, Hkv, hd]
+    lengths,  # [B] int32 valid prefix length INCLUDING the current token
+    block_k: int = 256,
+    sm_scale: float | None = None,
+    interpret: bool | None = None,
+):
+    """Single-token cached attention; returns [B, H*hd].
+
+    Bandwidth-bound: each kv-head group streams its cache once through
+    VMEM. The query sits at position lengths[b]-1, so causal masking
+    covers exactly the written prefix — unwritten slots never score.
+    """
+    out = flash_attention(
+        q[:, None],  # [B, 1, H, hd]
+        k,
+        v,
+        offset=jnp.asarray(lengths, jnp.int32) - 1,
+        causal=True,
+        block_q=8,
+        block_k=block_k,
+        sm_scale=sm_scale,
+        interpret=interpret,
+    )
+    return out[:, 0]

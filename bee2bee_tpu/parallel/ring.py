@@ -116,7 +116,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "seq"):
 # ------------------------------------------------- sequence-parallel model
 
 
-def make_sp_forward(cfg: ModelConfig, mesh: Mesh):
+def make_sp_forward(cfg: ModelConfig, mesh: Mesh, remat: bool = False):
     """Full-model forward with every attention as a ring over `seq`.
 
     Requires model/expert axes of size 1 (TP/EP compose via the pjit path
@@ -135,7 +135,7 @@ def make_sp_forward(cfg: ModelConfig, mesh: Mesh):
     n_seq = mesh.shape["seq"]
     attn = partial(ring_attention_local, axis_name="seq", axis_size=n_seq)
 
-    def attn_fn(q, k, v, mask, _cfg):
+    def attn_fn(q, k, v, mask, _cfg, positions=None):
         return attn(q, k, v)
 
     def local_fn(params, ids):
@@ -155,7 +155,11 @@ def make_sp_forward(cfg: ModelConfig, mesh: Mesh):
                 None,
             )
 
-        x, _ = lax.scan(layer, x, params["layers"])
+        # long context is exactly where activation memory peaks — honor the
+        # trainer's remat flag like core.forward does (prevent_cse=False:
+        # scan's loop structure already blocks CSE)
+        body = jax.checkpoint(layer, prevent_cse=False) if remat else layer
+        x, _ = lax.scan(body, x, params["layers"])
         return core.final_logits(params, cfg, x)
 
     param_specs = jax.tree.map(lambda _: P(), jax.eval_shape(
@@ -175,50 +179,18 @@ def make_sp_train_step(cfg: ModelConfig, tcfg, mesh: Mesh, donate: bool = True):
     """DP×SP train step: ring attention inside, psum-mean loss/grads.
 
     Mirrors trainer.make_train_step's contract: (state, batch) ->
-    (state, metrics). Gradients are averaged over data×seq implicitly by
-    the sharded loss mean (XLA inserts the psum through shard_map's
-    replicated-params reverse rule).
+    (state, metrics) — same loss/step machinery (trainer.xent_loss_metrics
+    / make_step_from_loss), only the forward differs.
     """
-    import optax
+    from ..train.trainer import make_step_from_loss, xent_loss_metrics
 
-    from ..train.trainer import TrainState, make_optimizer
+    sp_forward = make_sp_forward(cfg, mesh, remat=tcfg.remat)
 
-    opt = make_optimizer(tcfg)
-    sp_forward = make_sp_forward(cfg, mesh)
-    batch_spec = NamedSharding(mesh, P("data", "seq"))
-
-    def loss_fn(params, batch):
+    def loss(params, batch):
         ids = batch["input_ids"]
         logits = sp_forward(params, ids)
-        logits = logits[:, :-1, :]
-        targets = ids[:, 1:]
-        mask = batch.get("loss_mask")
-        mask = (
-            jnp.ones_like(targets, jnp.float32)
-            if mask is None
-            else mask[:, 1:].astype(jnp.float32)
-        )
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        denom = jnp.maximum(mask.sum(), 1.0)
-        loss = (nll * mask).sum() / denom
-        acc = ((jnp.argmax(logits, axis=-1) == targets) * mask).sum() / denom
-        return loss, {"loss": loss, "accuracy": acc, "tokens": denom}
+        return xent_loss_metrics(logits, ids, batch.get("loss_mask"))
 
-    def step(state: TrainState, batch: dict):
-        batch = {
-            k: lax.with_sharding_constraint(v, batch_spec) for k, v in batch.items()
-        }
-        (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, batch
-        )
-        updates, opt_state = opt.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-        metrics = dict(metrics)
-        metrics["grad_norm"] = optax.global_norm(grads)
-        return (
-            TrainState(step=state.step + 1, params=params, opt_state=opt_state),
-            metrics,
-        )
-
-    return jax.jit(step, donate_argnums=(0,) if donate else ())
+    return make_step_from_loss(
+        loss, tcfg, NamedSharding(mesh, P("data", "seq")), donate=donate
+    )

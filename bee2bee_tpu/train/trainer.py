@@ -70,21 +70,30 @@ def make_optimizer(tcfg: TrainConfig) -> optax.GradientTransformation:
     )
 
 
-def loss_fn(params, cfg: ModelConfig, batch: dict, remat: bool = False):
-    """Next-token cross entropy. batch: input_ids [B, T] (+ optional
-    loss_mask [B, T] over the *target* positions)."""
-    ids = batch["input_ids"]
-    logits, _ = core.forward(params, cfg, ids, None, jnp.int32(0), remat=remat)
+def xent_loss_metrics(logits, ids, loss_mask=None):
+    """Shifted next-token cross entropy + metrics — the ONE place the
+    loss/metrics contract lives (the dense and ring-SP steps both call it)."""
     logits = logits[:, :-1, :]
     targets = ids[:, 1:]
-    mask = batch.get("loss_mask")
-    mask = jnp.ones_like(targets, jnp.float32) if mask is None else mask[:, 1:].astype(jnp.float32)
+    mask = (
+        jnp.ones_like(targets, jnp.float32)
+        if loss_mask is None
+        else loss_mask[:, 1:].astype(jnp.float32)
+    )
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     denom = jnp.maximum(mask.sum(), 1.0)
     loss = (nll * mask).sum() / denom
     acc = ((jnp.argmax(logits, axis=-1) == targets) * mask).sum() / denom
     return loss, {"loss": loss, "accuracy": acc, "tokens": denom}
+
+
+def loss_fn(params, cfg: ModelConfig, batch: dict, remat: bool = False):
+    """Next-token cross entropy. batch: input_ids [B, T] (+ optional
+    loss_mask [B, T] over the *target* positions)."""
+    ids = batch["input_ids"]
+    logits, _ = core.forward(params, cfg, ids, None, jnp.int32(0), remat=remat)
+    return xent_loss_metrics(logits, ids, batch.get("loss_mask"))
 
 
 def make_train_state(
@@ -106,23 +115,20 @@ def make_train_state(
     return TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state)
 
 
-def make_train_step(cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh | None = None):
-    """Returns jitted (state, batch) -> (state, metrics).
-
-    With a mesh: the batch is constrained to ('data','seq') over (B, T) so
-    DP/SP are explicit, and donation keeps params/opt state in place in HBM.
-    """
+def make_step_from_loss(loss, tcfg: TrainConfig, batch_sharding=None, donate=True):
+    """Shared step body: loss(params, batch) -> (loss, metrics) becomes a
+    jitted (state, batch) -> (state, metrics) with optimizer update,
+    grad_norm, optional batch sharding constraint, and state donation."""
     opt = make_optimizer(tcfg)
-    batch_spec = P("data", "seq")
 
     def step(state: TrainState, batch: dict):
-        if mesh is not None:
+        if batch_sharding is not None:
             batch = {
-                k: jax.lax.with_sharding_constraint(v, NamedSharding(mesh, batch_spec))
+                k: jax.lax.with_sharding_constraint(v, batch_sharding)
                 for k, v in batch.items()
             }
-        (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, cfg, batch, tcfg.remat
+        (loss_val, metrics), grads = jax.value_and_grad(loss, has_aux=True)(
+            state.params, batch
         )
         updates, opt_state = opt.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
@@ -133,7 +139,23 @@ def make_train_step(cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh | None = Non
             metrics,
         )
 
-    return jax.jit(step, donate_argnums=(0,))
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def make_train_step(cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh | None = None):
+    """Returns jitted (state, batch) -> (state, metrics).
+
+    With a mesh: the batch is constrained to ('data','seq') over (B, T) so
+    DP/SP are explicit, and donation keeps params/opt state in place in HBM.
+    """
+    batch_sharding = (
+        NamedSharding(mesh, P("data", "seq")) if mesh is not None else None
+    )
+    return make_step_from_loss(
+        lambda params, batch: loss_fn(params, cfg, batch, tcfg.remat),
+        tcfg,
+        batch_sharding,
+    )
 
 
 class Trainer:

@@ -1,0 +1,161 @@
+"""Pallas flash attention kernel tests (interpret mode on the CPU mesh).
+
+Correctness bar: kernel outputs must match models/core._attention (the
+dense einsum reference) across causal prefill, GQA, cache offsets,
+non-divisible shapes, bf16, and full engine generation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bee2bee_tpu.models import core
+from bee2bee_tpu.models.config import get_config
+from bee2bee_tpu.ops import decode_attention, flash_attention
+
+CFG = get_config("tiny-gpt2")  # only shape-free code paths used
+
+
+def _qkv(B, T, H, Hkv, hd, S=None, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    S = S or T
+    q = jnp.asarray(rng.standard_normal((B, T, H, hd)), dtype)
+    k = jnp.asarray(rng.standard_normal((B, S, Hkv, hd)), dtype)
+    v = jnp.asarray(rng.standard_normal((B, S, Hkv, hd)), dtype)
+    return q, k, v
+
+
+def dense_causal(q, k, v):
+    T = q.shape[1]
+    mask = jnp.tril(jnp.ones((T, T), bool))[None, None]
+    return core._attention(q, k, v, mask, CFG)
+
+
+def test_flash_matches_dense_mha():
+    q, k, v = _qkv(2, 64, 4, 4, 16)
+    out = flash_attention(q, k, v, block_q=16, block_k=16)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(dense_causal(q, k, v)), atol=2e-5
+    )
+
+
+def test_flash_matches_dense_gqa():
+    q, k, v = _qkv(2, 32, 8, 2, 8, seed=1)
+    out = flash_attention(q, k, v, block_q=16, block_k=8)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(dense_causal(q, k, v)), atol=2e-5
+    )
+
+
+def test_flash_nondivisible_lengths_padded():
+    q, k, v = _qkv(1, 33, 4, 4, 8, seed=2)  # 33 % 16 != 0
+    out = flash_attention(q, k, v, block_q=16, block_k=16)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(dense_causal(q, k, v)), atol=2e-5
+    )
+
+
+def test_flash_cache_offset():
+    """Chunk of queries at offset against a bigger cache == core.forward's
+    cache mask (s <= off + t)."""
+    B, T, S, H, hd = 1, 8, 64, 4, 8
+    q, _, _ = _qkv(B, T, H, H, hd, seed=3)
+    _, k, v = _qkv(B, T, H, H, hd, S=S, seed=4)
+    off = 20
+    out = flash_attention(q, k, v, offset=off, block_q=8, block_k=16)
+    s_idx = jnp.arange(S)[None, None, None, :]
+    q_pos = (off + jnp.arange(T))[None, None, :, None]
+    mask = s_idx <= q_pos
+    ref = core._attention(q, k, v, mask, CFG)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_per_batch_offsets():
+    B, T, S, H, hd = 2, 4, 32, 2, 8
+    q, _, _ = _qkv(B, T, H, H, hd, seed=5)
+    _, k, v = _qkv(B, T, H, H, hd, S=S, seed=6)
+    offs = jnp.asarray([3, 17], jnp.int32)
+    out = flash_attention(q, k, v, offset=offs, block_q=8, block_k=8)
+    for b in range(B):
+        s_idx = jnp.arange(S)[None, None, None, :]
+        q_pos = (int(offs[b]) + jnp.arange(T))[None, None, :, None]
+        ref = core._attention(
+            q[b : b + 1], k[b : b + 1], v[b : b + 1], s_idx <= q_pos, CFG
+        )
+        np.testing.assert_allclose(np.asarray(out[b]), np.asarray(ref[0]), atol=2e-5)
+
+
+def test_flash_bf16():
+    q, k, v = _qkv(1, 32, 4, 4, 16, seed=7, dtype=jnp.bfloat16)
+    out = flash_attention(q, k, v, block_q=16, block_k=16)
+    assert out.dtype == jnp.bfloat16
+    ref = dense_causal(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32)
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), atol=0.08, rtol=0.08
+    )
+
+
+def test_decode_attention_lengths():
+    B, S, H, Hkv, hd = 2, 64, 8, 2, 8
+    rng = np.random.default_rng(8)
+    q = jnp.asarray(rng.standard_normal((B, H, hd)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, S, Hkv, hd)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, S, Hkv, hd)), jnp.float32)
+    lengths = jnp.asarray([40, 9], jnp.int32)
+    out = decode_attention(q, k, v, lengths, block_k=16)
+    for b in range(B):
+        L = int(lengths[b])
+        mask = jnp.zeros((1, 1, 1, S), bool).at[:, :, :, :L].set(True)
+        ref = core._attention(q[b : b + 1, None], k[b : b + 1], v[b : b + 1], mask, CFG)
+        np.testing.assert_allclose(np.asarray(out[b]), np.asarray(ref[0, 0]), atol=2e-5)
+
+
+def test_flash_under_jit():
+    """The kernel must trace/compile under jit (inference path; no custom
+    VJP is defined, so it is NOT differentiable — training uses the dense
+    or ring paths)."""
+    q, k, v = _qkv(1, 16, 2, 2, 8, seed=9)
+    f = jax.jit(lambda q, k, v: flash_attention(q, k, v, block_q=8, block_k=8))
+    np.testing.assert_allclose(
+        np.asarray(f(q, k, v)), np.asarray(dense_causal(q, k, v)), atol=2e-5
+    )
+
+
+def test_engine_flash_rejects_tp_mesh():
+    from bee2bee_tpu.engine.engine import EngineConfig, InferenceEngine
+    from bee2bee_tpu.parallel import MeshSpec, build_mesh
+
+    mesh = build_mesh(MeshSpec(data=2, model=4))
+    cfg = get_config("tiny-gpt2")
+    params = core.init_params(cfg, jax.random.key(0))
+    with pytest.raises(ValueError, match="flash"):
+        InferenceEngine(
+            cfg, params, mesh=mesh,
+            engine_config=EngineConfig(max_seq_len=128, attention="flash"),
+        )
+
+
+def test_engine_flash_matches_dense_generation():
+    """Greedy generation with attention='flash' must produce the same
+    tokens as the dense engine."""
+    from bee2bee_tpu.engine.engine import EngineConfig, InferenceEngine
+
+    cfg = get_config("tiny-gpt2")
+    params = core.init_params(cfg, jax.random.key(0))
+    dense = InferenceEngine(
+        cfg, params,
+        engine_config=EngineConfig(max_seq_len=128, decode_chunk=4, attention="dense"),
+    )
+    flash = InferenceEngine(
+        cfg, params,
+        engine_config=EngineConfig(max_seq_len=128, decode_chunk=4, attention="flash"),
+    )
+    out_d = dense.generate("hello flash world", max_new_tokens=12, temperature=0.0)
+    out_f = flash.generate("hello flash world", max_new_tokens=12, temperature=0.0)
+    assert out_d.token_ids == out_f.token_ids
