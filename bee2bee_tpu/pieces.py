@@ -17,6 +17,7 @@ import os
 from dataclasses import asdict, dataclass, field
 from pathlib import Path
 
+from . import native
 from .joinlink import chunk_bytes
 from .utils import sha256_hex
 
@@ -29,18 +30,19 @@ def split_pieces(data: bytes, piece_size: int = DEFAULT_PIECE_SIZE) -> list[byte
 
 
 def piece_hashes(pieces: list[bytes]) -> list[str]:
-    """(reference pieces.py:11-12)"""
-    return [sha256_hex(p) for p in pieces]
+    """(reference pieces.py:11-12) — hashed across cores by the C++ codec
+    (native.py), hashlib fallback."""
+    return native.hash_many(pieces)
 
 
 def verify_and_reassemble(pieces: list[bytes], hashes: list[str]) -> bytes:
     """Verify each piece hash then concatenate (reference pieces.py:15-21)."""
     if len(pieces) != len(hashes):
         raise ValueError(f"piece/hash count mismatch: {len(pieces)} vs {len(hashes)}")
-    for i, (p, h) in enumerate(zip(pieces, hashes)):
-        got = sha256_hex(p)
-        if got != h:
-            raise ValueError(f"piece {i} hash mismatch: {got[:12]} != {h[:12]}")
+    bad = native.verify_many(pieces, hashes)
+    if bad >= 0:
+        got = sha256_hex(pieces[bad])
+        raise ValueError(f"piece {bad} hash mismatch: {got[:12]} != {hashes[bad][:12]}")
     return b"".join(pieces)
 
 
@@ -166,6 +168,7 @@ def build_shard_manifest(model: str, params: dict, partition_specs: dict, mesh_a
 
     manifest = ShardManifest(model=model)
     blobs: dict[str, bytes] = {}
+    pending: list[tuple] = []
 
     for path in sorted(params):
         arr = np.asarray(params[path])
@@ -188,22 +191,28 @@ def build_shard_manifest(model: str, params: dict, partition_specs: dict, mesh_a
             shards = np.split(arr, n, axis=axis)
         for idx, shard in enumerate(shards):
             data = np.ascontiguousarray(shard).tobytes()
-            digest = sha256_hex(data)
-            blobs[digest] = data
-            manifest.pieces.append(
-                ShardPiece(
-                    param=path,
-                    shard_index=idx,
-                    shard_count=len(shards),
-                    axis=axis,
-                    mesh_axis=mesh_axis,
-                    shape=list(shard.shape),
-                    dtype=str(shard.dtype),
-                    nbytes=len(data),
-                    sha256=digest,
-                )
+            pending.append((path, idx, len(shards), axis, mesh_axis, shard, data))
+
+    # one parallel native hashing pass over every shard blob
+    digests = native.hash_many([p[-1] for p in pending])
+    for (path, idx, count, axis, mesh_axis, shard, data), digest in zip(
+        pending, digests
+    ):
+        blobs[digest] = data
+        manifest.pieces.append(
+            ShardPiece(
+                param=path,
+                shard_index=idx,
+                shard_count=count,
+                axis=axis,
+                mesh_axis=mesh_axis,
+                shape=list(shard.shape),
+                dtype=str(shard.dtype),
+                nbytes=len(data),
+                sha256=digest,
             )
-            manifest.total_bytes += len(data)
+        )
+        manifest.total_bytes += len(data)
     return manifest, blobs
 
 

@@ -1,0 +1,132 @@
+// Piece codec: multithreaded content hashing/verification for model-weight
+// distribution. The Python layer (bee2bee_tpu/native.py) binds these via
+// ctypes; calls release the GIL, so hashing a multi-GB checkpoint scales
+// across cores instead of serializing behind Python's loop.
+//
+// C ABI only — no C++ symbols cross the boundary.
+
+#include <dlfcn.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include "sha256.h"
+
+namespace {
+
+// Prefer libcrypto's SHA256 (SHA-NI / AVX2 accelerated, ~10x our portable
+// implementation) when the runtime library is present; we declare the
+// prototype ourselves so no OpenSSL headers are needed at build time.
+using sha256_fn_t = unsigned char* (*)(const unsigned char*, size_t, unsigned char*);
+
+sha256_fn_t resolve_sha256() {
+  for (const char* name : {"libcrypto.so.3", "libcrypto.so.1.1", "libcrypto.so"}) {
+    if (void* handle = dlopen(name, RTLD_NOW | RTLD_GLOBAL)) {
+      if (void* sym = dlsym(handle, "SHA256")) {
+        return reinterpret_cast<sha256_fn_t>(sym);
+      }
+      dlclose(handle);
+    }
+  }
+  return nullptr;
+}
+
+sha256_fn_t g_crypto_sha256 = resolve_sha256();
+
+inline void do_sha256(const uint8_t* data, size_t len, uint8_t out[32]) {
+  if (g_crypto_sha256 != nullptr) {
+    g_crypto_sha256(data, len, out);
+  } else {
+    b2b::sha256(data, len, out);
+  }
+}
+
+int resolve_threads(int n_threads, uint64_t n_items) {
+  unsigned hw = std::thread::hardware_concurrency();
+  if (hw == 0) hw = 4;
+  uint64_t n = (n_threads > 0) ? uint64_t(n_threads) : uint64_t(hw);
+  n = std::min<uint64_t>(n, n_items);
+  return int(std::max<uint64_t>(n, 1));
+}
+
+// Run fn(i) for i in [0, n) across up to n_threads workers.
+template <typename F>
+void parallel_for(uint64_t n, int n_threads, F fn) {
+  int workers = resolve_threads(n_threads, n);
+  if (workers <= 1) {
+    for (uint64_t i = 0; i < n; ++i) fn(i);
+    return;
+  }
+  std::atomic<uint64_t> next(0);
+  std::vector<std::thread> pool;
+  pool.reserve(workers);
+  for (int w = 0; w < workers; ++w) {
+    pool.emplace_back([&] {
+      for (;;) {
+        uint64_t i = next.fetch_add(1);
+        if (i >= n) return;
+        fn(i);
+      }
+    });
+  }
+  for (auto& t : pool) t.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* b2b_version() { return "bee2bee-native 0.1.0"; }
+
+// One-shot SHA-256.
+void b2b_sha256(const uint8_t* data, uint64_t len, uint8_t out[32]) {
+  b2b::sha256(data, size_t(len), out);
+}
+
+// Hash n separate buffers (datas[i], lens[i]) -> out[i*32..]; parallel.
+void b2b_hash_many(const uint8_t* const* datas, const uint64_t* lens,
+                   uint64_t n, uint8_t* out, int n_threads) {
+  parallel_for(n, n_threads, [&](uint64_t i) {
+    b2b::sha256(datas[i], size_t(lens[i]), out + i * 32);
+  });
+}
+
+// Hash consecutive piece_size chunks of one contiguous buffer (the last
+// chunk may be short) -> out[i*32..]. Returns the number of chunks.
+uint64_t b2b_hash_chunks(const uint8_t* data, uint64_t len, uint64_t piece_size,
+                         uint8_t* out, int n_threads) {
+  if (piece_size == 0) return 0;
+  uint64_t n = (len + piece_size - 1) / piece_size;
+  if (len == 0) n = 0;
+  parallel_for(n, n_threads, [&](uint64_t i) {
+    uint64_t off = i * piece_size;
+    uint64_t sz = std::min(piece_size, len - off);
+    b2b::sha256(data + off, size_t(sz), out + i * 32);
+  });
+  return n;
+}
+
+// Verify n buffers against expected digests (32 bytes each).
+// Returns -1 when all match, else the lowest mismatching index.
+int64_t b2b_verify_many(const uint8_t* const* datas, const uint64_t* lens,
+                        uint64_t n, const uint8_t* expected, int n_threads) {
+  std::atomic<int64_t> bad(-1);
+  parallel_for(n, n_threads, [&](uint64_t i) {
+    uint8_t digest[32];
+    b2b::sha256(datas[i], size_t(lens[i]), digest);
+    if (std::memcmp(digest, expected + i * 32, 32) != 0) {
+      int64_t prev = bad.load();
+      // keep the LOWEST bad index for deterministic error reporting
+      while ((prev == -1 || int64_t(i) < prev) &&
+             !bad.compare_exchange_weak(prev, int64_t(i))) {
+      }
+    }
+  });
+  return bad.load();
+}
+
+}  // extern "C"

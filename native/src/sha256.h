@@ -1,0 +1,21 @@
+// Minimal SHA-256 (FIPS 180-4), dependency-free, for the piece codec.
+#pragma once
+#include <cstddef>
+#include <cstdint>
+
+namespace b2b {
+
+struct Sha256 {
+  uint32_t state[8];
+  uint64_t bitlen;
+  uint8_t buffer[64];
+  size_t buflen;
+
+  Sha256();
+  void update(const uint8_t* data, size_t len);
+  void final(uint8_t out[32]);
+};
+
+void sha256(const uint8_t* data, size_t len, uint8_t out[32]);
+
+}  // namespace b2b

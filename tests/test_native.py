@@ -1,0 +1,121 @@
+"""Native C++ piece codec tests: parity with hashlib, fallback, pieces
+integration. The .so builds from native/ via make on first use."""
+
+from __future__ import annotations
+
+import hashlib
+
+import pytest
+
+from bee2bee_tpu import native, pieces
+
+
+@pytest.fixture(scope="module", autouse=True)
+def require_native():
+    if not native.available():
+        pytest.skip("native codec did not build (g++ unavailable?)")
+
+
+def test_version():
+    assert "bee2bee-native" in native.version()
+
+
+def test_sha256_matches_hashlib():
+    for blob in (b"", b"x", b"hello world", bytes(range(256)) * 999):
+        assert native.sha256_hex(blob) == hashlib.sha256(blob).hexdigest()
+
+
+def test_sha256_nul_bytes_and_large():
+    blob = b"\x00" * 100_000 + b"tail\x00\x00"
+    assert native.sha256_hex(blob) == hashlib.sha256(blob).hexdigest()
+
+
+def test_hash_many_parity():
+    blobs = [bytes([i]) * (i * 997 + 1) for i in range(50)]
+    got = native.hash_many(blobs)
+    want = [hashlib.sha256(b).hexdigest() for b in blobs]
+    assert got == want
+
+
+def test_hash_many_empty():
+    assert native.hash_many([]) == []
+
+
+def test_hash_chunks_parity():
+    data = bytes(range(256)) * 4096  # 1 MiB
+    piece = 100_000  # non-divisible: last chunk short
+    got = native.hash_chunks(data, piece)
+    want = [
+        hashlib.sha256(data[i : i + piece]).hexdigest()
+        for i in range(0, len(data), piece)
+    ]
+    assert got == want
+
+
+def test_verify_many_ok_and_mismatch():
+    blobs = [b"aaa", b"bbb", b"ccc", b"ddd"]
+    hashes = [hashlib.sha256(b).hexdigest() for b in blobs]
+    assert native.verify_many(blobs, hashes) == -1
+    # corrupt two; the LOWEST bad index is reported
+    bad = list(blobs)
+    bad[1] = b"xxx"
+    bad[3] = b"yyy"
+    assert native.verify_many(bad, hashes) == 1
+
+
+def test_verify_many_count_mismatch_raises():
+    with pytest.raises(ValueError, match="count mismatch"):
+        native.verify_many([b"a"], [])
+
+
+def test_fallback_parity(monkeypatch):
+    """With the native lib disabled, every wrapper gives identical results."""
+    blobs = [b"one", b"two", b"three" * 1000]
+    hashes = native.hash_many(blobs)
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_load", lambda: None)
+    assert native.hash_many(blobs) == hashes
+    assert native.sha256_hex(blobs[2]) == hashes[2]
+    assert native.verify_many(blobs, hashes) == -1
+    assert native.hash_chunks(b"abcdef", 4) == [
+        hashlib.sha256(b"abcd").hexdigest(),
+        hashlib.sha256(b"ef").hexdigest(),
+    ]
+
+
+def test_pieces_use_native_codec():
+    data = bytes(range(256)) * 2048  # 512 KiB
+    ps = pieces.split_pieces(data, piece_size=65536)
+    hashes = pieces.piece_hashes(ps)
+    assert hashes == [hashlib.sha256(p).hexdigest() for p in ps]
+    assert pieces.verify_and_reassemble(ps, hashes) == data
+    corrupted = list(ps)
+    corrupted[3] = b"junk"
+    with pytest.raises(ValueError, match="piece 3"):
+        pieces.verify_and_reassemble(corrupted, hashes)
+
+
+def test_manifest_build_native_parity():
+    import numpy as np
+
+    params = {
+        "wq": np.arange(64, dtype=np.float32).reshape(8, 8),
+        "wo": np.ones((8, 8), np.float32),
+    }
+    specs = {"wq": (None, "model"), "wo": ("model", None)}
+    manifest, blobs = pieces.build_shard_manifest("m", params, specs, {"model": 2})
+    for p in manifest.pieces:
+        assert hashlib.sha256(blobs[p.sha256]).hexdigest() == p.sha256
+    back = pieces.assemble_params_from_pieces(manifest, blobs, {"model": 0})
+    assert back["wq"].shape == (8, 4)
+    assert back["wo"].shape == (4, 8)
+
+
+def test_parallel_hashing_is_consistent():
+    """Same digests regardless of thread count (scheduling-independence)."""
+    blobs = [bytes([i % 251]) * 10_000 for i in range(64)]
+    assert (
+        native.hash_many(blobs, n_threads=1)
+        == native.hash_many(blobs, n_threads=8)
+        == native.hash_many(blobs, n_threads=0)
+    )
