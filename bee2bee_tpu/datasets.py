@@ -60,22 +60,44 @@ def tokenize_texts(
     return np.asarray(stream, dtype=np.int32)
 
 
-def pack_stream(stream: np.ndarray, cfg: PreprocessConfig) -> np.ndarray:
-    """Flat stream → [n_blocks, seq_len] dense blocks (static shapes)."""
+def pack_stream_masked(
+    stream: np.ndarray, cfg: PreprocessConfig
+) -> tuple[np.ndarray, np.ndarray]:
+    """Flat stream → ([n_blocks, seq_len] blocks, [n_blocks, seq_len] mask).
+
+    The mask marks real stream positions with 1.0 and padded-tail zeros
+    with 0.0. Padding only ever exists in the final block (and only when
+    ``drop_remainder=False``); full blocks are entirely valid regardless
+    of which token ids they contain — including id 0, which is a real
+    vocabulary token in GPT-2-family tokenizers and must stay in the loss.
+    """
     n_blocks = len(stream) // cfg.seq_len
     if n_blocks == 0:
         if not cfg.drop_remainder and len(stream):
             pad = np.zeros(cfg.seq_len, np.int32)
             pad[: len(stream)] = stream
-            return pad[None, :]
-        return np.zeros((0, cfg.seq_len), np.int32)
+            mask = np.zeros(cfg.seq_len, np.float32)
+            mask[: len(stream)] = 1.0
+            return pad[None, :], mask[None, :]
+        return np.zeros((0, cfg.seq_len), np.int32), np.zeros(
+            (0, cfg.seq_len), np.float32
+        )
     used = stream[: n_blocks * cfg.seq_len].reshape(n_blocks, cfg.seq_len)
+    masks = np.ones((n_blocks, cfg.seq_len), np.float32)
     if not cfg.drop_remainder and len(stream) > n_blocks * cfg.seq_len:
         tail = np.zeros(cfg.seq_len, np.int32)
         rest = stream[n_blocks * cfg.seq_len :]
         tail[: len(rest)] = rest
+        tmask = np.zeros(cfg.seq_len, np.float32)
+        tmask[: len(rest)] = 1.0
         used = np.concatenate([used, tail[None, :]], axis=0)
-    return used
+        masks = np.concatenate([masks, tmask[None, :]], axis=0)
+    return used, masks
+
+
+def pack_stream(stream: np.ndarray, cfg: PreprocessConfig) -> np.ndarray:
+    """Flat stream → [n_blocks, seq_len] dense blocks (static shapes)."""
+    return pack_stream_masked(stream, cfg)[0]
 
 
 @dataclass
@@ -89,6 +111,7 @@ class PackedDataset:
     blocks: np.ndarray  # [N, T]
     batch_size: int = 8
     _rng: np.random.Generator | None = field(default=None, repr=False)
+    masks: np.ndarray | None = None  # [N, T] f32; None ⇒ every position valid
 
     @property
     def n_batches(self) -> int:
@@ -97,15 +120,19 @@ class PackedDataset:
     def shuffle(self, seed: int) -> "PackedDataset":
         rng = np.random.default_rng(seed)
         perm = rng.permutation(len(self.blocks))
-        return PackedDataset(self.blocks[perm], self.batch_size, rng)
+        masks = self.masks[perm] if self.masks is not None else None
+        return PackedDataset(self.blocks[perm], self.batch_size, rng, masks)
 
     def __iter__(self) -> Iterator[dict]:
         for i in range(self.n_batches):
-            chunk = self.blocks[i * self.batch_size : (i + 1) * self.batch_size]
-            yield {
-                "input_ids": chunk,
-                "loss_mask": (chunk != 0).astype(np.float32),
-            }
+            sl = slice(i * self.batch_size, (i + 1) * self.batch_size)
+            chunk = self.blocks[sl]
+            mask = (
+                self.masks[sl]
+                if self.masks is not None
+                else np.ones(chunk.shape, np.float32)
+            )
+            yield {"input_ids": chunk, "loss_mask": mask}
 
     def __len__(self) -> int:
         return self.n_batches
@@ -124,8 +151,8 @@ def from_texts(
 ) -> PackedDataset:
     cfg = cfg or PreprocessConfig()
     stream = tokenize_texts(texts, tokenizer, cfg)
-    blocks = pack_stream(stream, cfg)
-    ds = PackedDataset(blocks, cfg.batch_size)
+    blocks, masks = pack_stream_masked(stream, cfg)
+    ds = PackedDataset(blocks, cfg.batch_size, masks=masks)
     if cfg.shuffle_seed is not None:
         ds = ds.shuffle(cfg.shuffle_seed)
     return ds

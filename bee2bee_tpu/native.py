@@ -81,6 +81,7 @@ def _load():
             ctypes.c_int,
         ]
         lib.b2b_verify_many.restype = ctypes.c_int64
+        lib.b2b_sha256_accelerated.restype = ctypes.c_int
         _lib = lib
     except OSError as e:
         logger.warning("failed to load native codec: %s", e)
@@ -95,6 +96,14 @@ def available() -> bool:
 def version() -> str | None:
     lib = _load()
     return lib.b2b_version().decode() if lib else None
+
+
+def accelerated() -> bool:
+    """True when the codec resolved libcrypto's SHA256 (SHA-NI/AVX2) —
+    the fast path that makes multi-GB checkpoint hashing ~10x quicker
+    than the portable fallback."""
+    lib = _load()
+    return bool(lib and lib.b2b_sha256_accelerated())
 
 
 def _ptr_arrays(blobs: list[bytes]):

@@ -114,9 +114,11 @@ def _flash_kernel(
 
     @pl.when(kj == pl.num_programs(3) - 1)
     def _finalize():
-        # causal rows always see their own position, so l >= exp(0) > 0
+        # l == 0 only for rows with no visible keys (e.g. a decode row whose
+        # lengths[b] == 0, offset -1): emit 0, not 0/0 = NaN
+        l = l_ref[:, 0][:, None]
         o_ref[0, :, 0, :] = (
-            acc_ref[:] / l_ref[:, 0][:, None]
+            acc_ref[:] / jnp.where(l == 0.0, 1.0, l)
         ).astype(o_ref.dtype)
 
 

@@ -84,14 +84,17 @@ const char* b2b_version() { return "bee2bee-native 0.1.0"; }
 
 // One-shot SHA-256.
 void b2b_sha256(const uint8_t* data, uint64_t len, uint8_t out[32]) {
-  b2b::sha256(data, size_t(len), out);
+  do_sha256(data, size_t(len), out);
 }
+
+// 1 when the accelerated libcrypto SHA256 resolved, else 0 (portable path).
+int b2b_sha256_accelerated() { return g_crypto_sha256 != nullptr ? 1 : 0; }
 
 // Hash n separate buffers (datas[i], lens[i]) -> out[i*32..]; parallel.
 void b2b_hash_many(const uint8_t* const* datas, const uint64_t* lens,
                    uint64_t n, uint8_t* out, int n_threads) {
   parallel_for(n, n_threads, [&](uint64_t i) {
-    b2b::sha256(datas[i], size_t(lens[i]), out + i * 32);
+    do_sha256(datas[i], size_t(lens[i]), out + i * 32);
   });
 }
 
@@ -105,7 +108,7 @@ uint64_t b2b_hash_chunks(const uint8_t* data, uint64_t len, uint64_t piece_size,
   parallel_for(n, n_threads, [&](uint64_t i) {
     uint64_t off = i * piece_size;
     uint64_t sz = std::min(piece_size, len - off);
-    b2b::sha256(data + off, size_t(sz), out + i * 32);
+    do_sha256(data + off, size_t(sz), out + i * 32);
   });
   return n;
 }
@@ -117,7 +120,7 @@ int64_t b2b_verify_many(const uint8_t* const* datas, const uint64_t* lens,
   std::atomic<int64_t> bad(-1);
   parallel_for(n, n_threads, [&](uint64_t i) {
     uint8_t digest[32];
-    b2b::sha256(datas[i], size_t(lens[i]), digest);
+    do_sha256(datas[i], size_t(lens[i]), digest);
     if (std::memcmp(digest, expected + i * 32, 32) != 0) {
       int64_t prev = bad.load();
       // keep the LOWEST bad index for deterministic error reporting

@@ -43,8 +43,37 @@ def test_from_texts_batches_and_masks():
     b = batches[0]
     assert b["input_ids"].shape == (2, 16)
     assert b["loss_mask"].shape == (2, 16)
-    # mask is zero exactly on padding
-    assert ((b["input_ids"] != 0) == (b["loss_mask"] > 0)).all()
+    # padding exists only in the stream's final block; full blocks all-valid
+    assert (b["loss_mask"][0] == 1.0).all()
+    # each row's mask is a prefix of ones (monotone non-increasing)
+    assert (np.diff(b["loss_mask"], axis=1) <= 0).all()
+
+
+def test_loss_mask_keeps_real_token_id_zero():
+    """Regression (ADVICE r1): token id 0 is a REAL vocab id in GPT-2-family
+    tokenizers; full packed blocks must keep it in the training loss."""
+
+    class ZeroishTok:
+        eos_token_id = 0  # eos IS id 0, like some byte-level vocabs
+
+        def encode(self, t):
+            return [0, 5, 0, 7]
+
+    cfg = ds.PreprocessConfig(seq_len=5, batch_size=1, drop_remainder=False)
+    data = ds.from_texts(["a", "b"], ZeroishTok(), cfg)
+    batches = list(data)
+    # stream = [0,5,0,7,0, 0,5,0,7,0] → block0 full, block1 full
+    assert (batches[0]["loss_mask"] == 1.0).all()
+    assert (batches[1]["loss_mask"] == 1.0).all()
+    assert (batches[0]["input_ids"][0] == np.array([0, 5, 0, 7, 0])).all()
+
+
+def test_pack_stream_masked_tail():
+    cfg = ds.PreprocessConfig(seq_len=8, drop_remainder=False)
+    blocks, masks = ds.pack_stream_masked(np.arange(1, 12, dtype=np.int32), cfg)
+    assert blocks.shape == masks.shape == (2, 8)
+    assert masks[0].tolist() == [1.0] * 8
+    assert masks[1].tolist() == [1.0, 1.0, 1.0, 0.0, 0.0, 0.0, 0.0, 0.0]
 
 
 def test_from_text_file(tmp_path):

@@ -119,3 +119,16 @@ def test_parallel_hashing_is_consistent():
         == native.hash_many(blobs, n_threads=8)
         == native.hash_many(blobs, n_threads=0)
     )
+
+
+def test_accelerated_path_matches_hashlib():
+    """Regression (ADVICE r1): the libcrypto SHA-NI fast path used to be
+    dead code — do_sha256 was defined but never called. When it resolves,
+    every entry point must still agree with hashlib."""
+    blobs = [b"", b"x", b"hello world" * 1000]
+    want = [hashlib.sha256(b).hexdigest() for b in blobs]
+    assert [native.sha256_hex(b) for b in blobs] == want
+    assert native.hash_many(blobs) == want
+    assert native.verify_many(blobs, want) == -1
+    # accelerated() reports a bool either way; on this image libcrypto exists
+    assert isinstance(native.accelerated(), bool)

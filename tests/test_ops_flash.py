@@ -159,3 +159,20 @@ def test_engine_flash_matches_dense_generation():
     out_d = dense.generate("hello flash world", max_new_tokens=12, temperature=0.0)
     out_f = flash.generate("hello flash world", max_new_tokens=12, temperature=0.0)
     assert out_d.token_ids == out_f.token_ids
+
+
+def test_decode_attention_zero_length_is_finite():
+    """Regression (ADVICE r1): lengths==0 rows (empty/padding slots) used
+    to divide 0/0 in the kernel finalize and emit NaN."""
+    B, S, H, Hkv, hd = 2, 32, 4, 2, 8
+    rng = np.random.default_rng(11)
+    q = jnp.asarray(rng.standard_normal((B, H, hd)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, S, Hkv, hd)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, S, Hkv, hd)), jnp.float32)
+    lengths = jnp.asarray([0, 5], jnp.int32)
+    out = decode_attention(q, k, v, lengths, block_k=16)
+    assert np.isfinite(np.asarray(out)).all()
+    # the live row still matches dense
+    mask = jnp.zeros((1, 1, 1, S), bool).at[:, :, :, :5].set(True)
+    ref = core._attention(q[1:2, None], k[1:2], v[1:2], mask, CFG)
+    np.testing.assert_allclose(np.asarray(out[1]), np.asarray(ref[0, 0]), atol=2e-5)
