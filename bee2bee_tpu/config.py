@@ -3,7 +3,7 @@
 Capability parity with reference config (/root/reference/bee2bee/config.py:11-47):
 persisted `~/.bee2bee_tpu/config.json`, env > file > defaults precedence
 (reference config.py:35-42). Extended with TPU-specific knobs (mesh shape,
-dtype, KV page size) that the reference has no analogue for.
+dtype, batch size) that the reference has no analogue for.
 """
 
 from __future__ import annotations
@@ -26,10 +26,11 @@ _ENV_MAP = {
     "BEE2BEE_API_KEY": "api_key",
     "BEE2BEE_MESH_SHAPE": "mesh_shape",
     "BEE2BEE_DTYPE": "dtype",
+    "BEE2BEE_MAX_BATCH": "max_batch_size",
     "BEE2BEE_AUTO_NAT": "auto_nat",
 }
 
-_INT_FIELDS = {"port", "api_port", "announce_port", "kv_page_size", "max_seq_len"}
+_INT_FIELDS = {"port", "api_port", "announce_port", "max_batch_size", "max_seq_len"}
 _BOOL_FIELDS = {"auto_nat"}
 
 
@@ -52,7 +53,7 @@ class NodeConfig:
     # compute (TPU-native additions)
     mesh_shape: str = ""  # e.g. "data:1,model:8" — empty = all devices on model axis
     dtype: str = "bfloat16"
-    kv_page_size: int = 128
+    max_batch_size: int = 8  # continuous-batching rows (EngineConfig.max_batch)
     max_seq_len: int = 2048
     max_new_tokens: int = 2048  # reference default (services.py:28)
     price_per_token: float = 0.0

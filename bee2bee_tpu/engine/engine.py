@@ -6,12 +6,13 @@ TPU-first structure (SURVEY §7 step 2, hard part 1):
   shape compiles once, bounding the recompile space. Pad K/V written past the
   true length is overwritten by decode exactly when it would enter the
   causal window, so no separate validity mask is needed.
-- **Fixed-capacity KV cache** allocated once per request batch at
-  max_seq_len, donated through every decode step so XLA updates it in place
-  in HBM.
+- **Continuous batching** (engine/scheduler.py): concurrent requests share
+  one fixed-capacity [max_batch, max_seq_len] KV cache, donated through
+  every decode step so XLA updates it in place in HBM; rows admit/retire
+  between chunks and a request stops paying compute at EOS.
 - **On-device sampling** inside the jit'd step: one fused
   forward+sample+cache-update program per token; the only host transfer per
-  step is the sampled token id (needed for streaming/stop anyway).
+  chunk is the sampled token ids (needed for streaming/stop anyway).
 - **Mesh-agnostic**: params and cache carry NamedShardings from
   models.partition; the same engine serves a 1-chip node or a v5e-8 TP
   group — jit inserts the collectives.
@@ -26,8 +27,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
-from functools import partial
-from typing import Callable, Iterator
+from typing import Iterator
 
 import jax
 import jax.numpy as jnp
@@ -37,9 +37,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..models import config as model_config
 from ..models import core, partition
 from ..parallel.mesh import local_mesh
-from ..tracing import get_tracer
 from ..utils import MetricsAggregator
-from .sampling import sample
 from .tokenizer import load_tokenizer
 
 DEFAULT_BUCKETS = (64, 128, 256, 512, 1024, 2048, 4096, 8192)
@@ -54,8 +52,23 @@ class EngineConfig:
     rng_seed: int = 0
     # tokens decoded per jit call (lax.scan on device). Each host<->device
     # sync costs ~100 ms through a tunneled TPU; chunking amortizes it to
-    # sync/chunk_len per token. Streaming granularity == chunk_len.
-    decode_chunk: int = 16
+    # sync/chunk_len per token. Streaming granularity == chunk_len, and so
+    # is the EOS early-exit granularity (a request stopping mid-chunk pays
+    # the rest of that chunk, never the rest of max_new_tokens). 32 measured
+    # best on the tunneled v5e chip (16: +1 sync; 64: coarser early exit
+    # for no gain).
+    decode_chunk: int = 32
+    # continuous-batching rows: concurrent requests share one [max_batch]
+    # KV cache and decode together (engine/scheduler.py). Decode is
+    # HBM-bound on the weights, so extra rows are nearly free throughput.
+    max_batch: int = 8
+    # readback window: up to this many chunks are dispatched per host sync
+    # when no active request is streaming (a sync costs ~75-100 ms through
+    # a tunneled TPU — measured; dispatch is ~10 us). The window is also
+    # capped by the tightest active row budget, so worst-case post-EOS
+    # waste is max_inflight_chunks * decode_chunk tokens, never the rest
+    # of max_new_tokens like the round-1 engine.
+    max_inflight_chunks: int = 8
     # "dense": einsum attention (models/core._attention, XLA-fused);
     # "flash": pallas tiled kernel (ops/flash.py) — no [T,S] score
     # materialization, VMEM-resident online softmax
@@ -113,11 +126,13 @@ class InferenceEngine:
         self._replicated = NamedSharding(self.mesh, P())
         # one jit object; it specializes per tokens shape (= per bucket)
         self._prefill = jax.jit(self._prefill_fn, donate_argnums=(2,))
-        self._decode_compiled: dict[tuple, Callable] = {}
         self._rng = jax.random.key(self.engine_cfg.rng_seed)
         # gateways run execute() on a thread pool: guard the rng stream and
-        # the compiled-fn cache (jax itself is safe for concurrent dispatch)
+        # lazy scheduler creation (jax itself is safe for concurrent dispatch)
         self._mutex = threading.Lock()
+        self._scheduler = None  # created on first generate (allocates the
+        # shared [max_batch] cache — engines built only for score()/info
+        # never pay for it)
 
     # ------------------------------------------------------------ compiled fns
 
@@ -153,42 +168,6 @@ class InferenceEngine:
         last = jnp.take_along_axis(logits, jnp.broadcast_to(idx, (logits.shape[0], 1, logits.shape[2])), axis=1)
         return cache, last[:, 0, :]
 
-    def _decode_chunk_fn(self, temperature, top_k, top_p, params, token, cache, offset, key):
-        """Decode `decode_chunk` tokens in one on-device scan.
-
-        token [B]: the current token (to be written at `offset`). Returns
-        (tokens [B, K] — the K tokens sampled after `token` — and the cache).
-        One host sync per K tokens instead of per token.
-        """
-
-        def step(carry, key_t):
-            cur, cache, off = carry
-            logits, cache = core.forward(
-                params, self.model_cfg, cur[:, None], cache, off, attn_fn=self._attn_fn()
-            )
-            nxt = sample(logits[:, -1, :], key_t, temperature, top_k, top_p)
-            return (nxt, cache, off + 1), nxt
-
-        keys = jax.random.split(key, self.engine_cfg.decode_chunk)
-        (_, cache, _), toks = jax.lax.scan(step, (token, cache, offset), keys)
-        return jnp.moveaxis(toks, 0, 1), cache  # [B, K]
-
-    def _get_decode(self, temperature, top_k, top_p):
-        sig = (
-            round(float(temperature if temperature is not None else 0.0), 4),
-            int(top_k or 0),
-            round(float(top_p if top_p is not None else 1.0), 4),
-        )
-        with self._mutex:
-            fn = self._decode_compiled.get(sig)
-            if fn is None:
-                fn = jax.jit(
-                    partial(self._decode_chunk_fn, sig[0], sig[1], sig[2]),
-                    donate_argnums=(2,),  # donate the cache for in-place HBM update
-                )
-                self._decode_compiled[sig] = fn
-            return fn
-
     # ------------------------------------------------------------ helpers
 
     def _bucket_for(self, n: int) -> int:
@@ -218,19 +197,40 @@ class InferenceEngine:
 
     # ------------------------------------------------------------ public API
 
-    def _dispatch(self, prompt, max_new_tokens, temperature, top_k, top_p):
-        """Tokenize, prefill, and asynchronously dispatch every decode chunk.
+    @property
+    def scheduler(self):
+        """The continuous-batching scheduler (lazy: allocates the shared
+        [max_batch] KV cache on first use)."""
+        if self._scheduler is None:
+            from .scheduler import BatchScheduler
 
-        Chunks chain on-device through (cur, cache); dispatch is ~free, so
-        all compute is enqueued before anything is read back. Returns
-        (first_token_dev [B], chunk_devs list of [B, K], n_prompt, bucket,
-        clamped_max_new_tokens).
-        """
-        if isinstance(prompt, str):
-            ids = self.tokenizer.encode(prompt)
-        else:
-            ids = list(prompt)
-        K = self.engine_cfg.decode_chunk
+            with self._mutex:
+                if self._scheduler is None:
+                    self._scheduler = BatchScheduler(
+                        self, max_batch=self.engine_cfg.max_batch
+                    )
+        return self._scheduler
+
+    def close(self):
+        """Stop the scheduler thread (idempotent)."""
+        if self._scheduler is not None:
+            self._scheduler.shutdown()
+            self._scheduler = None
+
+    def _stop_set(self, stop_tokens):
+        stop = set(int(t) for t in (stop_tokens or []))
+        eos = self.tokenizer.eos_token_id
+        if eos is not None and eos >= 0:
+            stop.add(int(eos))
+        return stop, eos
+
+    def _make_request(
+        self, prompt, max_new_tokens, temperature, top_k, top_p, stop_tokens,
+        stream: bool = False,
+    ):
+        from .scheduler import Request
+
+        ids = self.tokenizer.encode(prompt) if isinstance(prompt, str) else list(prompt)
         # clamp generation to what the cache can hold while keeping at least
         # a small prompt window (callers may pass max_new_tokens == cache
         # size; clamping, not erroring, is the serving behavior)
@@ -238,68 +238,43 @@ class InferenceEngine:
         max_gen = self.max_seq_len - 1 - min_prompt
         if max_gen < 1:
             raise ValueError(
-                f"max_new_tokens={max_new_tokens} leaves no room in max_seq_len={self.max_seq_len}"
+                f"max_new_tokens={max_new_tokens} leaves no room in "
+                f"max_seq_len={self.max_seq_len}"
             )
         max_new_tokens = max(0, min(max_new_tokens, max_gen))
-        chunks = max(0, -(-(max_new_tokens - 1) // K))  # ceil
-        chunks = min(chunks, (max_gen - 1) // K) if K else 0
-        max_new_tokens = min(max_new_tokens, 1 + chunks * K)
-        gen_capacity = 1 + chunks * K
-        budget = self.max_seq_len - gen_capacity - 1
         # left-truncate so prompt + generation fits the cache (the reference
         # simply OOMs/errors here; we keep the most recent context)
+        budget = self.max_seq_len - 1 - max(max_new_tokens, 1)
         if len(ids) > budget:
             ids = ids[-budget:]
-        n = len(ids)
-        bucket = self._bucket_for(n)
+        stop, eos = self._stop_set(stop_tokens)
+        return Request(
+            ids, max_new_tokens, temperature, top_k, top_p, stop, eos,
+            self.tokenizer, stream=stream,
+        )
 
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :n] = ids
-        cache = self.new_cache(1)
-        # dispatch-only (prefill is jit'd + async): wall time here is enqueue
-        # + any compile, NOT device time — that shows in device_profile
-        with get_tracer().span("engine.prefill_dispatch", prompt_tokens=n, bucket=bucket):
-            cache, last_logits = self._prefill(
-                self.params, jnp.asarray(tokens), cache, jnp.asarray([n], jnp.int32)
-            )
-            first = sample(last_logits, self._next_key(), temperature, top_k, top_p)
-
-        # dispatch-only: decode chunks are enqueued async, so this span
-        # measures queueing, not device time (that shows in device_profile)
-        with get_tracer().span("engine.decode_dispatch", chunks=chunks):
-            decode = self._get_decode(temperature, top_k, top_p)
-            cur, offset, pending = first, n, []
-            for _ in range(chunks):
-                toks_dev, cache = decode(
-                    self.params, cur, cache, jnp.asarray([offset], jnp.int32), self._next_key()
-                )
-                cur = toks_dev[:, -1]
-                offset += K
-                pending.append(toks_dev)
-        return first, pending, n, bucket, max_new_tokens
-
-    def _stop_set(self, stop_tokens):
-        stop = set(stop_tokens or [])
-        eos = self.tokenizer.eos_token_id
-        if eos is not None and eos >= 0:
-            stop.add(int(eos))
-        return stop, eos
-
-    def _result(self, out_ids, n, bucket, finish, t_start, ttft, t_decode0):
-        latency = time.perf_counter() - t_start
-        decode_time = time.perf_counter() - t_decode0
-        tps = len(out_ids) / decode_time if decode_time > 0 and out_ids else 0.0
-        self.metrics.record(len(out_ids), latency)
+    def _build_result(self, req) -> GenerationResult:
+        t = req.timing
+        t_first = t.t_first or t.t_done
+        latency = t.t_done - t.t_submit
+        decode_time = t.t_done - t_first
+        n_out = len(req.out_ids)
+        tps = n_out / decode_time if decode_time > 0 and n_out else 0.0
+        self.metrics.record(n_out, latency)
         return GenerationResult(
-            text=self.tokenizer.decode(out_ids),
-            token_ids=out_ids,
-            prompt_tokens=n,
-            new_tokens=len(out_ids),
-            ttft_s=round(ttft, 4),
+            text=self.tokenizer.decode(req.out_ids),
+            token_ids=list(req.out_ids),
+            prompt_tokens=req.prompt_tokens,
+            new_tokens=n_out,
+            ttft_s=round(t_first - t.t_submit, 4),
             latency_s=round(latency, 4),
             tokens_per_sec=round(tps, 2),
-            finish_reason=finish,
-            timings={"prefill_bucket": bucket, "decode_s": round(decode_time, 4)},
+            finish_reason=req.finish or "length",
+            timings={
+                "prefill_bucket": req.bucket,
+                "decode_s": round(decode_time, 4),
+                "chunks": req.chunks_decoded,
+            },
         )
 
     def generate_stream(
@@ -313,98 +288,55 @@ class InferenceEngine:
     ) -> Iterator[dict]:
         """Yield {"token": last_id, "tokens": ids, "text": piece} per decode
         chunk, then {"done": True, "result": GenerationResult}. Streaming
-        granularity is engine_cfg.decode_chunk tokens (each read through a
-        tunneled TPU costs ~100 ms — see _dispatch)."""
-        t_start = time.perf_counter()
-        first, pending, n, bucket, max_new_tokens = self._dispatch(
-            prompt, max_new_tokens, temperature, top_k, top_p
+        granularity is engine_cfg.decode_chunk tokens. Requests from
+        concurrent callers share the scheduler's batch — submission order
+        is admission order; rows decode together."""
+        req = self._make_request(
+            prompt, max_new_tokens, temperature, top_k, top_p, stop_tokens,
+            stream=True,
         )
-        stop, eos = self._stop_set(stop_tokens)
-
-        tok = int(jax.device_get(first)[0])
-        ttft = time.perf_counter() - t_start
-        t_decode0 = time.perf_counter()
-
-        out_ids: list[int] = []
-        fin: str | None = None
-        flushed_text = ""  # cumulative decode → UTF-8-safe incremental text
-
-        def emit(t: int) -> str | None:
-            if t in stop:
-                return "eos" if t == eos else "stop"
-            out_ids.append(t)
-            return None
-
-        def text_delta(final: bool = False) -> str:
-            # decode the cumulative ids and emit the new suffix; hold back
-            # trailing replacement chars (a multi-byte char split across
-            # chunks) until the next chunk completes it
-            nonlocal flushed_text
-            full = self.tokenizer.decode(out_ids)
-            if not final:
-                full = full.rstrip("�")
-            delta = full[len(flushed_text):]
-            flushed_text = full
-            return delta
-
-        fin = emit(tok) if max_new_tokens > 0 else None
-        if fin is None and max_new_tokens > 0:
-            yield {"token": tok, "tokens": [tok], "text": text_delta()}
-            for toks_dev in pending:
-                if fin is not None or len(out_ids) >= max_new_tokens:
-                    break
-                chunk_toks = [int(t) for t in jax.device_get(toks_dev)[0]]
-                emitted = []
-                for t in chunk_toks:
-                    if len(out_ids) >= max_new_tokens:
-                        break
-                    fin = emit(t)
-                    if fin is not None:
-                        break
-                    emitted.append(t)
-                if emitted:
-                    last = len(out_ids) >= max_new_tokens or fin is not None
-                    yield {
-                        "token": emitted[-1],
-                        "tokens": emitted,
-                        "text": text_delta(final=last),
-                    }
-        yield {
-            "done": True,
-            "result": self._result(
-                out_ids, n, bucket, fin or "length", t_start, ttft, t_decode0
-            ),
-        }
+        if req.max_new_tokens <= 0:
+            req.timing.t_first = req.timing.t_done = time.perf_counter()
+            yield {"done": True, "result": self._build_result(req)}
+            return
+        self.scheduler.submit(req)
+        try:
+            while True:
+                ev = req.events.get()
+                if ev.get("done") and ev.get("result") is None:
+                    raise RuntimeError(ev.get("error", "generation failed"))
+                yield ev
+                if ev.get("done"):
+                    return
+        finally:
+            # consumer closed the generator early (e.g. a stop marker
+            # completed in the service layer): release the batch row
+            # instead of decoding to the token budget for nobody
+            if req.finish is None:
+                req.cancelled = True
 
     def generate(self, prompt, **kw) -> GenerationResult:
-        """Non-streaming generation: exactly ONE device→host read for the
-        whole request (all chunks are concatenated on device first), so
-        throughput is compute-bound even over a high-latency TPU tunnel."""
+        """Non-streaming generation via the same scheduler path; blocks
+        until the request retires (EOS / stop / budget)."""
         stop_tokens = kw.pop("stop_tokens", None)
-        max_new_tokens = kw.get("max_new_tokens", 128)
-        t_start = time.perf_counter()
-        first, pending, n, bucket, max_new_tokens = self._dispatch(
+        req = self._make_request(
             prompt,
-            max_new_tokens,
+            kw.get("max_new_tokens", 128),
             kw.get("temperature", 0.0),
             kw.get("top_k", 0),
             kw.get("top_p", 1.0),
+            stop_tokens,
         )
-        stop, eos = self._stop_set(stop_tokens)
-        all_dev = jnp.concatenate([first[:, None]] + pending, axis=1) if pending else first[:, None]
-        t_decode0 = time.perf_counter()
-        toks = [int(t) for t in jax.device_get(all_dev)[0]]
-        ttft = time.perf_counter() - t_start  # single read: ttft == full latency
-
-        out_ids, fin = [], None
-        for t in toks:
-            if len(out_ids) >= max_new_tokens:
-                break
-            if t in stop:
-                fin = "eos" if t == eos else "stop"
-                break
-            out_ids.append(t)
-        return self._result(out_ids, n, bucket, fin or "length", t_start, ttft, t_decode0)
+        if req.max_new_tokens <= 0:
+            req.timing.t_first = req.timing.t_done = time.perf_counter()
+            return self._build_result(req)
+        self.scheduler.submit(req)
+        while True:
+            ev = req.events.get()
+            if ev.get("done"):
+                if ev.get("result") is None:
+                    raise RuntimeError(ev.get("error", "generation failed"))
+                return ev["result"]
 
     def score(self, token_ids: list[int]):
         """Per-token logprobs of a sequence (no cache, full forward) — the

@@ -45,3 +45,50 @@ def sample(
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
 
     return jax.random.categorical(key, logits, axis=-1)
+
+
+def sample_batched(
+    logits,  # [B, V] float32
+    key,
+    temperature,  # [B] float32; <= 0 → greedy for that row
+    top_k,  # [B] int32; <= 0 → no top-k restriction
+    top_p,  # [B] float32; >= 1 → no nucleus restriction
+):
+    """Per-row sampling for continuous batching: every knob is a traced
+    [B] array, so ONE compiled decode step serves any mix of concurrent
+    requests' sampling settings (the scalar `sample` compiles one variant
+    per signature — fine for a single stream, wrong for a shared batch).
+
+    Semantics per row match `sample`: temperature scale → top-k mask →
+    nucleus mask over the already-masked logits → categorical; greedy rows
+    short-circuit to argmax via a final where.
+    """
+    V = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1)
+
+    def sampled_path(_):
+        l = logits / jnp.maximum(temperature, 1e-6)[:, None]
+
+        sorted_l = jnp.sort(l, axis=-1)[:, ::-1]
+        k_eff = jnp.clip(jnp.where(top_k > 0, top_k, V), 1, V)
+        kth = jnp.take_along_axis(sorted_l, (k_eff - 1)[:, None], axis=-1)
+        l = jnp.where(l < kth, -jnp.inf, l)
+
+        sorted_m = jnp.sort(l, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_m, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = (cum - probs < top_p[:, None]).at[:, 0].set(True)
+        cutoff = jnp.min(
+            jnp.where(keep, sorted_m, jnp.inf), axis=-1, keepdims=True
+        )
+        l = jnp.where(l < cutoff, -jnp.inf, l)
+
+        sampled = jax.random.categorical(key, l, axis=-1)
+        return jnp.where(temperature <= 0.0, greedy, sampled)
+
+    # the sorts/cumsum above cost real time at vocab scale (two bitonic
+    # sorts of [B, V] per token on TPU); an all-greedy batch — the common
+    # serving default — must pay argmax only. lax.cond executes one branch.
+    return jax.lax.cond(
+        jnp.any(temperature > 0.0), sampled_path, lambda _: greedy, None
+    )

@@ -33,7 +33,11 @@ def build_service(backend: str, model: str, cfg: NodeConfig, **kw):
             max_new_tokens=cfg.max_new_tokens,
             mesh=mesh,
             checkpoint_path=kw.get("checkpoint_path"),
-            engine_config=EngineConfig(max_seq_len=cfg.max_seq_len, dtype=cfg.dtype),
+            engine_config=EngineConfig(
+                max_seq_len=cfg.max_seq_len,
+                dtype=cfg.dtype,
+                max_batch=cfg.max_batch_size,
+            ),
         )
     if backend == "ollama":
         from ..services.ollama import OllamaService

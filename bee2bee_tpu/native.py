@@ -83,8 +83,14 @@ def _load():
         lib.b2b_verify_many.restype = ctypes.c_int64
         lib.b2b_sha256_accelerated.restype = ctypes.c_int
         _lib = lib
-    except OSError as e:
-        logger.warning("failed to load native codec: %s", e)
+    except (OSError, AttributeError) as e:
+        # AttributeError = a stale prebuilt .so missing a newer symbol
+        # (the file is gitignored, so it survives source updates); degrade
+        # to hashlib rather than crashing every entry point
+        logger.warning(
+            "failed to load native codec (%s); falling back to hashlib — "
+            "run `make -C native clean all` to rebuild", e
+        )
         _lib = None
     return _lib
 

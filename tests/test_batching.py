@@ -1,0 +1,265 @@
+"""Continuous-batching scheduler tests (VERDICT r2 task #2 acceptance):
+concurrent mixed-length requests share decode chunks, EOS/stop retires a
+row immediately (early-exit), and retired rows re-admit queued work.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bee2bee_tpu.engine import EngineConfig, InferenceEngine
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = InferenceEngine(
+        "tiny-llama",
+        engine_config=EngineConfig(
+            max_seq_len=128,
+            prefill_buckets=(16, 32, 64),
+            dtype="float32",
+            cache_dtype="float32",
+            decode_chunk=4,
+            max_batch=8,
+        ),
+    )
+    yield eng
+    eng.close()
+
+
+def test_eos_early_exit_stops_decode(engine):
+    """A request stopping after ~2 tokens must pay at most one readback
+    window of decode, not ceil(max_new_tokens / chunk) — the round-1
+    engine paid all of them."""
+    free = engine.generate("early exit probe", max_new_tokens=12)
+    assert len(free.token_ids) >= 3
+    stop_at = free.token_ids[2]
+    r = engine.generate("early exit probe", max_new_tokens=100, stop_tokens=[stop_at])
+    assert r.token_ids == free.token_ids[:2]
+    assert r.finish_reason == "stop"
+    cap = engine.engine_cfg.max_inflight_chunks
+    serial = -(-100 // engine.engine_cfg.decode_chunk)  # 25 chunks if no exit
+    assert r.timings["chunks"] <= cap < serial, (
+        f"paid {r.timings['chunks']} chunks for 2 tokens (cap {cap})"
+    )
+
+
+def test_eos_early_exit_streaming_is_chunk_tight(engine):
+    """Streaming pins the readback window to one chunk, so a stopping
+    stream pays ~1 chunk — the tightest early exit."""
+    free = engine.generate("stream exit probe", max_new_tokens=12)
+    stop_at = free.token_ids[2]
+    events = list(
+        engine.generate_stream(
+            "stream exit probe", max_new_tokens=100, stop_tokens=[stop_at]
+        )
+    )
+    r = events[-1]["result"]
+    assert r.finish_reason == "stop"
+    assert r.timings["chunks"] <= 2, (
+        f"streaming paid {r.timings['chunks']} chunks for {r.new_tokens} tokens"
+    )
+
+
+def test_concurrent_requests_share_decode_chunks(engine):
+    """8 concurrent mixed-length requests must decode as a shared batch:
+    total chunks dispatched ~= the longest request's chunks (plus admission
+    skew), nowhere near the serial sum."""
+    prompts = [f"concurrent request number {i} says" for i in range(8)]
+    budgets = [8, 12, 16, 20, 24, 28, 32, 36]
+    K = engine.engine_cfg.decode_chunk
+
+    # sequential ground truth (greedy) + serial chunk cost
+    sequential = [
+        engine.generate(p, max_new_tokens=m).token_ids
+        for p, m in zip(prompts, budgets)
+    ]
+    chunks_before = engine.scheduler.stats.chunks
+
+    results: list = [None] * 8
+    def run(i):
+        results[i] = engine.generate(prompts[i], max_new_tokens=budgets[i])
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    # correctness under concurrency: greedy rows are independent
+    for i in range(8):
+        assert results[i].token_ids == sequential[i], f"request {i} diverged"
+
+    batched_chunks = engine.scheduler.stats.chunks - chunks_before
+    serial_chunks = sum(-(-m // K) for m in budgets)  # 54 for these budgets
+    assert engine.scheduler.stats.peak_active >= 2
+    assert batched_chunks < serial_chunks * 0.7, (
+        f"batched run used {batched_chunks} chunks vs serial {serial_chunks} — "
+        "requests are not sharing decode"
+    )
+
+
+def test_more_requests_than_rows_queue_and_complete():
+    eng = InferenceEngine(
+        "tiny-llama",
+        engine_config=EngineConfig(
+            max_seq_len=64,
+            prefill_buckets=(16,),
+            dtype="float32",
+            cache_dtype="float32",
+            decode_chunk=4,
+            max_batch=2,  # force queueing: 5 requests, 2 rows
+        ),
+    )
+    try:
+        results: list = [None] * 5
+
+        def run(i):
+            results[i] = eng.generate(f"queued {i}", max_new_tokens=6)
+
+        threads = [threading.Thread(target=run, args=(i,)) for i in range(5)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(r is not None and r.new_tokens > 0 for r in results)
+        assert eng.scheduler.stats.admitted >= 5
+        assert eng.scheduler.stats.retired >= 5
+    finally:
+        eng.close()
+
+
+def test_mixed_sampling_params_single_compile(engine):
+    """Greedy and temperature rows share the one compiled step; greedy rows
+    must stay deterministic even next to sampling rows."""
+    base = engine.generate("mixed sampling", max_new_tokens=8).token_ids
+
+    out: dict = {}
+    def greedy():
+        out["greedy"] = engine.generate("mixed sampling", max_new_tokens=8)
+    def hot():
+        out["hot"] = engine.generate(
+            "mixed sampling", max_new_tokens=8, temperature=1.2, top_k=7, top_p=0.9
+        )
+
+    t1, t2 = threading.Thread(target=greedy), threading.Thread(target=hot)
+    t1.start(); t2.start(); t1.join(); t2.join()
+    assert out["greedy"].token_ids == base
+    assert out["hot"].new_tokens > 0
+    assert all(0 <= t < engine.model_cfg.vocab_size for t in out["hot"].token_ids)
+
+
+def test_row_reuse_does_not_leak_kv(engine):
+    """A retired row's stale KV must never influence the next occupant
+    (isolation comes from the causal mask + full-row prefill insert)."""
+    a = engine.generate("row reuse probe A", max_new_tokens=10).token_ids
+    engine.generate("x" * 400, max_new_tokens=10)  # long occupant, all rows cycled
+    b = engine.generate("row reuse probe A", max_new_tokens=10).token_ids
+    assert a == b
+
+
+def test_sample_batched_matches_scalar_greedy():
+    import jax
+    import jax.numpy as jnp
+
+    from bee2bee_tpu.engine.sampling import sample, sample_batched
+
+    logits = jnp.asarray(np.random.default_rng(0).standard_normal((4, 50)), jnp.float32)
+    key = jax.random.key(0)
+    greedy = sample_batched(
+        logits, key, jnp.zeros(4), jnp.zeros(4, jnp.int32), jnp.ones(4)
+    )
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(sample(logits, key)))
+
+
+def test_sample_batched_respects_topk_per_row():
+    import jax
+    import jax.numpy as jnp
+
+    from bee2bee_tpu.engine.sampling import sample_batched
+
+    # row 0: top_k=1 → must pick argmax even at high temperature;
+    # row 1: unrestricted
+    logits = jnp.asarray(np.random.default_rng(1).standard_normal((2, 64)), jnp.float32)
+    for seed in range(8):
+        toks = sample_batched(
+            logits,
+            jax.random.key(seed),
+            jnp.asarray([5.0, 5.0]),
+            jnp.asarray([1, 0], jnp.int32),
+            jnp.asarray([1.0, 1.0]),
+        )
+        assert int(toks[0]) == int(jnp.argmax(logits[0]))
+
+
+def test_abandoned_stream_releases_row(engine):
+    """Closing a generate_stream early must retire the row instead of
+    decoding to the full token budget for nobody (code-review finding)."""
+    gen = engine.generate_stream("abandoned stream", max_new_tokens=100)
+    next(gen)  # consume the first event only
+    gen.close()  # GeneratorExit → cancel
+    deadline = time.time() + 30
+    while engine.scheduler.active and time.time() < deadline:
+        time.sleep(0.05)
+    assert engine.scheduler.active == 0, "cancelled row never retired"
+    last = engine.scheduler.stats.history[-1]
+    assert last["chunks"] < -(-100 // engine.engine_cfg.decode_chunk)
+
+
+def test_scheduler_error_fails_request_and_recovers(engine):
+    """A device-side failure must error the blocked caller (not hang it)
+    and leave the scheduler serving subsequent requests."""
+    sch = engine.scheduler
+    orig = sch._decode
+
+    def boom(*a, **k):
+        raise RuntimeError("injected device failure")
+
+    sch._decode = boom
+    try:
+        with pytest.raises(RuntimeError, match="scheduler error"):
+            engine.generate("this one dies", max_new_tokens=16)
+    finally:
+        sch._decode = orig
+    r = engine.generate("this one lives", max_new_tokens=8)
+    assert r.new_tokens > 0
+
+
+def test_engine_close_fails_inflight_requests():
+    eng = InferenceEngine(
+        "tiny-llama",
+        engine_config=EngineConfig(
+            max_seq_len=64, prefill_buckets=(16,), dtype="float32",
+            cache_dtype="float32", decode_chunk=4, max_batch=2,
+        ),
+    )
+    err: list = []
+    # no natural EOS: the request must run to budget, not stop early
+    eng._stop_set = lambda stop_tokens: (set(), None)
+    sch = eng.scheduler  # force creation so we can slow decode down
+    orig = sch._decode
+
+    def slow(*a, **k):
+        time.sleep(0.3)  # keep the request in flight while close() lands
+        return orig(*a, **k)
+
+    sch._decode = slow
+
+    def run():
+        try:
+            eng.generate("shutdown victim", max_new_tokens=40)
+        except RuntimeError as e:
+            err.append(e)
+
+    t = threading.Thread(target=run)
+    t.start()
+    while not sch.active and t.is_alive():
+        time.sleep(0.02)
+    eng.close()
+    t.join(timeout=30)
+    assert not t.is_alive(), "caller hung after close()"
+    assert err and "shut down" in str(err[0])

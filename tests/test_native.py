@@ -132,3 +132,22 @@ def test_accelerated_path_matches_hashlib():
     assert native.verify_many(blobs, want) == -1
     # accelerated() reports a bool either way; on this image libcrypto exists
     assert isinstance(native.accelerated(), bool)
+
+
+def test_stale_so_missing_symbol_degrades_to_hashlib(tmp_path, monkeypatch):
+    """Regression: a stale prebuilt .so lacking a newer symbol must fall
+    back to hashlib, not raise AttributeError from every entry point."""
+    import subprocess
+
+    src = tmp_path / "stub.cpp"
+    src.write_text('extern "C" const char* b2b_version() { return "stale"; }\n')
+    so = tmp_path / "libstale.so"
+    subprocess.run(
+        ["g++", "-shared", "-fPIC", "-o", str(so), str(src)], check=True
+    )
+    monkeypatch.setattr(native, "_SO_PATH", so)
+    monkeypatch.setattr(native, "_NATIVE_DIR", tmp_path)  # no Makefile: no rebuild
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_load_attempted", False)
+    assert native.available() is False
+    assert native.sha256_hex(b"abc") == hashlib.sha256(b"abc").hexdigest()
