@@ -119,10 +119,12 @@ class InferenceEngine:
             params = core.init_params(
                 self.model_cfg, jax.random.key(self.engine_cfg.rng_seed), dtype=self.dtype
             )
-        self.params = partition.shard_params(params, self.mesh)
+        self.params = partition.shard_params(params, self.mesh, cfg=self.model_cfg)
         self.tokenizer = tokenizer or load_tokenizer(checkpoint_path, self.model_cfg.vocab_size)
 
-        self._cache_sharding = NamedSharding(self.mesh, partition.cache_spec())
+        self._cache_sharding = NamedSharding(
+            self.mesh, partition.cache_spec(self.model_cfg, self.mesh)
+        )
         self._replicated = NamedSharding(self.mesh, P())
         # one jit object; it specializes per tokens shape (= per bucket)
         self._prefill = jax.jit(self._prefill_fn, donate_argnums=(2,))
@@ -137,27 +139,21 @@ class InferenceEngine:
     # ------------------------------------------------------------ compiled fns
 
     def _attn_fn(self):
-        """attn_fn for core.forward per the engine's attention setting."""
+        """attn_fn for core.forward per the engine's attention setting.
+        Under a non-trivial mesh the pallas kernel runs per-shard via
+        shard_map (ops.flash.make_flash_attn_fn) — pallas_call has no SPMD
+        partitioning rule, so sharding propagation would all-gather it."""
         if self.engine_cfg.attention != "flash":
             return None
-        from ..ops.flash import flash_attention
+        from ..ops.flash import make_flash_attn_fn
 
-        def attn(q, k, v, mask, cfg, positions=None):
-            return flash_attention(q, k, v, offset=positions[:, 0])
-
-        return attn
+        return make_flash_attn_fn(self.mesh)
 
     def _validate_attention_impl(self):
-        # pallas_call has no SPMD partitioning rule: under TP the
-        # model-sharded KV cache would be all-gathered into the kernel.
-        # Same stance as parallel/ring.make_sp_forward's mesh guard.
-        if self.engine_cfg.attention == "flash" and (
-            self.mesh.shape.get("model", 1) > 1 or self.mesh.shape.get("expert", 1) > 1
-        ):
-            raise ValueError(
-                "attention='flash' requires model=expert=1 in the mesh "
-                f"(got {dict(self.mesh.shape)}); use attention='dense' for TP/EP"
-            )
+        if self.engine_cfg.attention == "flash":
+            from ..ops.flash import validate_flash_mesh
+
+            validate_flash_mesh(self.model_cfg, self.mesh)
 
     def _prefill_fn(self, params, tokens, cache, true_len):
         """tokens [B, Tb] padded; returns (cache, last_logits [B, V])."""
@@ -182,7 +178,7 @@ class InferenceEngine:
         )
         # fall back axis-by-axis when a cache dim doesn't divide its mesh
         # axis (e.g. batch=1 on a data=2 mesh) instead of crashing device_put
-        spec = partition.cache_spec()
+        spec = partition.cache_spec(self.model_cfg, self.mesh)
         k = cache["k"]
         fitted = P(*[
             e if e is None or k.shape[i] % self.mesh.shape.get(e, 1) == 0 else None
@@ -212,10 +208,14 @@ class InferenceEngine:
         return self._scheduler
 
     def close(self):
-        """Stop the scheduler thread (idempotent)."""
-        if self._scheduler is not None:
-            self._scheduler.shutdown()
-            self._scheduler = None
+        """Stop the scheduler thread (idempotent). The swap happens under
+        _mutex (so a concurrent lazy creation can't be missed) but
+        shutdown() runs outside it — the scheduler thread takes _mutex in
+        _next_key, so joining while holding it would stall."""
+        with self._mutex:
+            sch, self._scheduler = self._scheduler, None
+        if sch is not None:
+            sch.shutdown()
 
     def _stop_set(self, stop_tokens):
         stop = set(int(t) for t in (stop_tokens or []))

@@ -350,33 +350,32 @@ class BatchScheduler:
         A = self.active
         if A == 0 and self._bsz > 1:
             # idle: fresh bucket-1 cache, nothing to carry over
-            self._bsz = 1
-            self._cache = self.engine.new_cache(1)
-            self._cur = jnp.zeros((1,), jnp.int32)
-            self._offsets = jnp.zeros((1,), jnp.int32)
-            self._rows = [None]
-            self._row_params_dirty = True
+            self._reset_device_state()
         elif self._bsz > 1 and A * 2 <= self._bsz // 2:
             # quarter-occupancy hysteresis: halve without thrashing at the
             # boundary (A*2 <= bsz/2  ⇔  A <= bsz/4)
             self._resize(max(1, self._bsz // 2))
 
     def _admit(self):
-        """Prefill queued requests into free rows (one device sync each —
-        the first token is read back to report TTFT and catch instant-stop),
-        growing the batch bucket up to max_batch as needed."""
+        """Prefill queued requests into free rows, growing the batch bucket
+        up to max_batch. All prefills/inserts of an admission burst are
+        dispatched asynchronously; the first tokens come back in ONE device
+        sync (a sync costs ~75-100 ms through a tunneled chip — a burst of
+        8 must not pay it 8 times while active streams sit undecoded)."""
+        from .sampling import sample_batched
+
         e = self.engine
+        placed: list[tuple] = []  # (req, row, firsts_index)
+        firsts: list = []
         while True:
             with self._cond:
-                if not self._queue:
-                    return
-                if self.active >= self.max_batch:
-                    return
+                if not self._queue or self.active >= self.max_batch:
+                    break
                 req = self._queue.popleft()
             if req.cancelled:
                 req.finish = "cancelled"
                 req.timing.t_first = req.timing.t_done = time.perf_counter()
-                req.events.put({"done": True, "result": self.engine._build_result(req)})
+                req.events.put({"done": True, "result": e._build_result(req)})
                 continue
             if self.active == self._bsz:
                 self._resize(min(self._bsz * 2, self.max_batch))
@@ -396,8 +395,6 @@ class BatchScheduler:
                         e.params, jnp.asarray(tokens), row_cache,
                         jnp.asarray([n], jnp.int32),
                     )
-                    from .sampling import sample_batched
-
                     first = sample_batched(
                         last_logits,
                         e._next_key(),
@@ -406,17 +403,28 @@ class BatchScheduler:
                         jnp.asarray([req.top_p], jnp.float32),
                     )
                     self._cache = self._insert(self._cache, row_cache, jnp.int32(b))
-                    tok = int(jax.device_get(first)[0])
             except Exception as err:
                 # the popped request is in neither _queue nor _rows: fail it
                 # here or its caller hangs; then let _loop's handler recover
+                # (which errors the rest of this burst — they sit in _rows)
                 req.finish = "error"
                 req.events.put(
                     {"done": True, "result": None, "error": f"admission failed: {err!r}"}
                 )
                 raise
+            # reserve the row now (cur gets the real token after readback)
+            self._rows[b] = req
+            self._offsets = self._offsets.at[b].set(n)
+            placed.append((req, b, len(firsts)))
+            firsts.append(first)
 
-            req.timing.t_first = time.perf_counter()
+        if not placed:
+            return
+        toks = np.asarray(jax.device_get(jnp.concatenate(firsts)))  # ONE sync
+        now = time.perf_counter()
+        for req, b, i in placed:
+            tok = int(toks[i])
+            req.timing.t_first = now
             self.stats.admitted += 1
             if req.accept(tok) and req.stream:
                 # token events (and their cumulative re-decode) are only
@@ -424,15 +432,14 @@ class BatchScheduler:
                 req.events.put(
                     {"token": tok, "tokens": [tok], "text": req.text_delta(final=req.done)}
                 )
-            if req.done:
+            if req.done:  # instant stop/zero-budget: free the row again
+                self._rows[b] = None
                 self._retire(req)
                 continue
-
             self._cur = self._cur.at[b].set(tok)
-            self._offsets = self._offsets.at[b].set(n)
-            self._rows[b] = req
             self._row_params_dirty = True
             self.stats.peak_active = max(self.stats.peak_active, self.active)
+        self._compact_and_shrink()
 
     def _row_sampling_arrays(self):
         if self._row_params_dirty or self._temps is None:

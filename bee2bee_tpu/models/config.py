@@ -74,6 +74,11 @@ CONFIGS: dict[str, ModelConfig] = {
         name="tiny-mixtral", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
         n_kv_heads=2, d_ff=128, max_seq_len=256, n_experts=4, n_experts_per_tok=2,
     ),
+    "tiny-gemma": ModelConfig(  # MQA (one kv head): the KV-replication path
+        name="tiny-gemma", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+        n_kv_heads=1, d_ff=128, max_seq_len=256, activation="geglu",
+        embedding_scale=True, norm_plus_one=True, norm_eps=1e-6,
+    ),
     # -- BASELINE ladder --
     "distilgpt2": _gpt2("distilgpt2", d_model=768, n_layers=6, n_heads=12),
     "gpt2": _gpt2("gpt2", d_model=768, n_layers=12, n_heads=12),

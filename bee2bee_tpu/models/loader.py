@@ -197,7 +197,11 @@ def save_native(params, cfg: ModelConfig, path: str | Path, mesh_axes: dict[str,
     path = Path(path)
     path.mkdir(parents=True, exist_ok=True)
     flat = _flatten(params)
-    specs = flat_partition_specs(params, mesh_axes) if mesh_axes else {k: () for k in flat}
+    specs = (
+        flat_partition_specs(params, mesh_axes, cfg=cfg)
+        if mesh_axes
+        else {k: () for k in flat}
+    )
     manifest, blobs = build_shard_manifest(cfg.name, flat, specs, mesh_axes or {})
     save_pieces(list(blobs.values()), path / "pieces")
     (path / "bee2bee_manifest.json").write_text(manifest.to_json())

@@ -94,11 +94,33 @@ def _fits(leaf, spec: P, mesh: Mesh) -> bool:
     return True
 
 
-def shard_params(params, mesh: Mesh):
+def kv_replicated(cfg: ModelConfig, mesh: Mesh) -> bool:
+    """True when K/V heads must be replicated across the `model` axis:
+    MQA/GQA with tp > n_kv_heads (e.g. gemma-2b's single kv head on a
+    model=4 mesh). A width split of wk/wv would cut one kv head's hd dim
+    across devices and break per-shard attention locality; whole-head
+    replication keeps attention collective-free at the cost of duplicate
+    K/V compute (tiny: Hkv=1 projections are ~1/(H) of attention width)."""
+    tp = mesh.shape.get("model", 1)
+    return tp > 1 and cfg.n_kv_heads % tp != 0
+
+
+_KV_PARAM_SUFFIXES = ("attn/wk", "attn/wv", "attn/bk", "attn/bv")
+
+
+def shard_params(params, mesh: Mesh, cfg: ModelConfig | None = None):
     """Place params onto the mesh per the rules (host → device transfer).
     Params whose sharded dim doesn't divide the mesh axis (e.g. gpt2's prime
-    vocab on tok_embed/lm_head) are replicated instead."""
+    vocab on tok_embed/lm_head) are replicated instead. With `cfg` given,
+    MQA models replicate the K/V projections (see kv_replicated)."""
     specs = partition_specs(params)
+    if cfg is not None and kv_replicated(cfg, mesh):
+        specs = jax.tree_util.tree_map_with_path(
+            lambda path, s: (
+                P() if _path_str(path).endswith(_KV_PARAM_SUFFIXES) else s
+            ),
+            specs,
+        )
     return jax.tree.map(
         lambda leaf, spec: jax.device_put(
             leaf, NamedSharding(mesh, spec if _fits(leaf, spec, mesh) else P())
@@ -108,21 +130,35 @@ def shard_params(params, mesh: Mesh):
     )
 
 
-def cache_spec() -> P:
-    """KV cache [L, B, S, Hkv, hd]: batch on `data`, kv heads on `model`."""
+def cache_spec(cfg: ModelConfig | None = None, mesh: Mesh | None = None) -> P:
+    """KV cache [L, B, S, Hkv, hd]: batch on `data`, kv heads on `model`
+    — except MQA meshes (kv_replicated), where the kv-head dim stays
+    replicated to match the replicated wk/wv projections."""
+    if cfg is not None and mesh is not None and kv_replicated(cfg, mesh):
+        return P(None, "data", None, None, None)
     return P(None, "data", None, "model", None)
 
 
-def flat_partition_specs(params, mesh_axes: dict[str, int] | None = None) -> dict[str, tuple]:
+def flat_partition_specs(
+    params,
+    mesh_axes: dict[str, int] | None = None,
+    cfg: ModelConfig | None = None,
+) -> dict[str, tuple]:
     """{path_str: spec-as-tuple} for pieces.build_shard_manifest, which
     wants mesh-axis names per tensor axis. With `mesh_axes` given, specs
     whose dims don't divide the axis size degrade to replicated — mirroring
-    shard_params' fallback."""
+    shard_params' fallback. With `cfg` given, the MQA K/V replication
+    override matches shard_params too, keeping the manifest<->jit-sharding
+    invariant (a peer's assembled pieces must equal its jit shard)."""
     out = {}
+    tp = (mesh_axes or {}).get("model", 1)
+    kv_repl = cfg is not None and tp > 1 and cfg.n_kv_heads % tp != 0
 
     def visit(path, leaf):
         ps = _path_str(path)
         spec = tuple(spec_for_path(ps))
+        if kv_repl and ps.endswith(_KV_PARAM_SUFFIXES):
+            spec = ()
         if mesh_axes:
             ok = all(
                 e is None or leaf.shape[i] % mesh_axes.get(e, 1) == 0
@@ -142,10 +178,8 @@ def validate_divisibility(cfg: ModelConfig, mesh: Mesh) -> None:
     tp = mesh.shape.get("model", 1)
     ep = mesh.shape.get("expert", 1)
     problems = []
-    # the KV cache shards kv heads on `model` (cache_spec), so tp must
-    # divide n_kv_heads exactly (KV replication for tp > Hkv is future work)
-    if cfg.n_kv_heads % tp:
-        problems.append(f"n_kv_heads={cfg.n_kv_heads} vs model axis {tp}")
+    # n_kv_heads % tp != 0 is NOT fatal: kv_replicated() keeps K/V whole
+    # per shard (MQA replication), so gemma-2b (Hkv=1) serves at model=4
     if (cfg.n_heads * cfg.head_dim) % tp:
         problems.append(f"attn width {cfg.n_heads * cfg.head_dim} vs model axis {tp}")
     if cfg.d_ff % tp:

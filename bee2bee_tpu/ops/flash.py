@@ -198,6 +198,86 @@ def flash_attention(
     return out[:, :T].reshape(B, T, H * hd)
 
 
+# ----------------------------------------------------- TP/mesh wrapper
+
+
+def make_flash_attn_fn(mesh=None, interpret: bool | None = None):
+    """Build an attn_fn (core.transformer_block ABI) running the pallas
+    kernel per mesh shard.
+
+    pallas_call has no SPMD partitioning rule, so under a non-trivial mesh
+    the kernel must run inside shard_map with the engine's own layout
+    (models/partition.py): q heads sharded over `model`; K/V sharded over
+    `model` when n_kv_heads divides it, replicated otherwise (the MQA path
+    — partition.kv_replicated); batch over `data` when it divides. The
+    `expert` axis never shards attention tensors: every expert-group
+    device runs the same shard redundantly, matching the dense path's
+    effective layout.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    def attn(q, k, v, mask, cfg, positions=None):
+        offset = positions[:, 0] if positions is not None else None
+        if mesh is None or all(n == 1 for n in mesh.shape.values()):
+            return flash_attention(q, k, v, offset=offset, interpret=interpret)
+        B, _, H, _ = q.shape
+        Hkv = k.shape[2]
+        tp = mesh.shape.get("model", 1)
+        data = mesh.shape.get("data", 1)
+        batch_ax = "data" if data > 1 and B % data == 0 else None
+        head_ax = "model" if tp > 1 else None
+        kv_ax = "model" if tp > 1 and Hkv % tp == 0 else None
+        off = jnp.broadcast_to(
+            jnp.asarray(offset if offset is not None else 0, jnp.int32).reshape(-1),
+            (B,),
+        )
+        mapped = jax.shard_map(
+            lambda q_, k_, v_, o_: flash_attention(
+                q_, k_, v_, offset=o_, interpret=interpret
+            ),
+            mesh=mesh,
+            in_specs=(
+                P(batch_ax, None, head_ax, None),
+                P(batch_ax, None, kv_ax, None),
+                P(batch_ax, None, kv_ax, None),
+                P(batch_ax),
+            ),
+            out_specs=P(batch_ax, None, head_ax),
+            check_vma=False,
+        )
+        return mapped(q, k, v, off)
+
+    return attn
+
+
+def validate_flash_mesh(cfg, mesh) -> None:
+    """Fail fast when the head layout cannot run head-local flash:
+    q heads must divide the `model` axis, and each shard's q-head count
+    must cover its kv heads whole (GQA group stays integral)."""
+    tp = mesh.shape.get("model", 1)
+    if tp <= 1:
+        return
+    H, Hkv = cfg.n_heads, cfg.n_kv_heads
+    if H % tp:
+        raise ValueError(
+            f"attention='flash' needs n_heads={H} divisible by model axis "
+            f"{tp} (head-local kernel); use attention='dense'"
+        )
+    if Hkv % tp == 0:
+        return  # sharded KV: local h // G maps to the correct local kv head
+    if Hkv != 1:
+        # replicated KV with Hkv > 1: shard s's local q heads all belong to
+        # kv heads near s*H/tp/G globally, but the kernel's LOCAL
+        # h // (H_local/Hkv) mapping would spread them over all Hkv heads —
+        # silently wrong attention. Only MQA (Hkv == 1, every q head -> kv 0)
+        # is layout-invariant under replication.
+        raise ValueError(
+            f"attention='flash' cannot run GQA with n_kv_heads={Hkv} "
+            f"replicated across model axis {tp} (local kv-head mapping "
+            "would be wrong); use attention='dense'"
+        )
+
+
 # -------------------------------------------------------------- decode
 
 

@@ -127,11 +127,13 @@ def test_flash_under_jit():
     )
 
 
-def test_engine_flash_rejects_tp_mesh():
+def test_engine_flash_rejects_head_indivisible_mesh():
+    """Flash is head-local: n_heads must divide the model axis (tiny-gpt2
+    has 4 heads — model=8 cannot run the kernel per-shard)."""
     from bee2bee_tpu.engine.engine import EngineConfig, InferenceEngine
     from bee2bee_tpu.parallel import MeshSpec, build_mesh
 
-    mesh = build_mesh(MeshSpec(data=2, model=4))
+    mesh = build_mesh(MeshSpec(model=8))
     cfg = get_config("tiny-gpt2")
     params = core.init_params(cfg, jax.random.key(0))
     with pytest.raises(ValueError, match="flash"):
@@ -139,6 +141,51 @@ def test_engine_flash_rejects_tp_mesh():
             cfg, params, mesh=mesh,
             engine_config=EngineConfig(max_seq_len=128, attention="flash"),
         )
+
+
+def _tp_generation_match(model_name: str, mesh_spec: dict):
+    """Greedy generation: flash on a TP mesh must equal dense on the same
+    mesh AND dense on a single device."""
+    from bee2bee_tpu.engine.engine import EngineConfig, InferenceEngine
+    from bee2bee_tpu.parallel import MeshSpec, build_mesh
+
+    cfg = get_config(model_name)
+    params = core.init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    base_ecfg = dict(
+        max_seq_len=128, prefill_buckets=(16, 32), dtype="float32",
+        cache_dtype="float32", decode_chunk=4,
+    )
+    single = InferenceEngine(
+        cfg, params, engine_config=EngineConfig(**base_ecfg, attention="dense")
+    )
+    mesh = build_mesh(MeshSpec(**mesh_spec))
+    flash_tp = InferenceEngine(
+        cfg, params, mesh=mesh,
+        engine_config=EngineConfig(**base_ecfg, attention="flash"),
+    )
+    try:
+        want = single.generate("flash tensor parallel", max_new_tokens=10)
+        got = flash_tp.generate("flash tensor parallel", max_new_tokens=10)
+        assert got.token_ids == want.token_ids, (got.token_ids, want.token_ids)
+    finally:
+        single.close()
+        flash_tp.close()
+
+
+def test_engine_flash_on_tp_mesh_matches_single_device():
+    # tiny-llama: n_kv_heads=2 divides model=2 → KV sharded on `model`
+    _tp_generation_match("tiny-llama", {"data": 1, "model": 2})
+
+
+def test_engine_flash_on_tp_mesh_mqa_replicated():
+    # tiny-gemma: n_kv_heads=1, model=4 → KV replicated (partition.kv_replicated)
+    _tp_generation_match("tiny-gemma", {"model": 4})
+
+
+def test_engine_flash_on_ep_mesh():
+    # expert axis never shards attention: flash must run (redundantly per
+    # expert group) and match the dense engine
+    _tp_generation_match("tiny-mixtral", {"expert": 2, "model": 2})
 
 
 def test_engine_flash_matches_dense_generation():

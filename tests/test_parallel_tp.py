@@ -98,10 +98,35 @@ def test_engine_on_tp_mesh_generates():
 
 
 def test_validate_divisibility_rejects_bad_mesh():
-    cfg = get_config("tiny-llama")  # n_kv_heads=2
+    from dataclasses import replace
+
+    cfg = replace(get_config("tiny-llama"), d_ff=100)  # 100 % 8 != 0
     mesh = build_mesh(MeshSpec(model=8))
     with pytest.raises(ValueError, match="does not fit mesh"):
         partition.validate_divisibility(cfg, mesh)
+
+
+def test_validate_divisibility_allows_mqa_replication():
+    """VERDICT r2 weak #6: gemma-2b (n_kv_heads=1) must pass validation at
+    model=4 — K/V projections and cache replicate instead (kv_replicated)."""
+    cfg = get_config("gemma-2b")
+    mesh = build_mesh(MeshSpec(model=4))
+    partition.validate_divisibility(cfg, mesh)  # must not raise
+    assert partition.kv_replicated(cfg, mesh)
+    assert partition.cache_spec(cfg, mesh) == partition.P(
+        None, "data", None, None, None
+    )
+
+
+def test_mqa_shard_params_replicates_kv_projections():
+    cfg = get_config("tiny-gemma")  # n_kv_heads=1
+    mesh = build_mesh(MeshSpec(model=4))
+    params = core.init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    sharded = partition.shard_params(params, mesh, cfg=cfg)
+    wk = sharded["layers"]["attn"]["wk"]
+    assert {s.data.shape for s in wk.addressable_shards} == {wk.shape}  # replicated
+    wq = sharded["layers"]["attn"]["wq"]
+    assert {s.data.shape[2] for s in wq.addressable_shards} == {wq.shape[2] // 4}
 
 
 def test_manifest_specs_match_partition_rules():
@@ -137,3 +162,28 @@ def test_indivisible_vocab_replicates_instead_of_crashing():
     assert {s.data.shape for s in emb.addressable_shards} == {emb.shape}  # replicated
     wq = sharded["layers"]["attn"]["wq"]
     assert {s.data.shape[2] for s in wq.addressable_shards} == {wq.shape[2] // 2}
+
+
+def test_flat_specs_mqa_replication_matches_shard_params():
+    """Manifest<->jit invariant (code-review finding): the piece manifest
+    must replicate wk/wv exactly where shard_params(cfg=...) does."""
+    cfg = get_config("tiny-gemma")  # n_kv_heads=1
+    params = core.init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    specs = partition.flat_partition_specs(params, {"model": 4}, cfg=cfg)
+    assert specs["layers/attn/wk"] == ()
+    assert specs["layers/attn/wv"] == ()
+    assert specs["layers/attn/wq"] == (None, None, "model")
+
+
+def test_flash_rejects_replicated_gqa():
+    """Replicated-KV GQA (Hkv>1 not dividing tp) would silently mis-map kv
+    heads in the per-shard kernel — must be rejected, MQA (Hkv=1) allowed."""
+    from dataclasses import replace
+
+    from bee2bee_tpu.ops.flash import validate_flash_mesh
+
+    mesh = build_mesh(MeshSpec(model=4))
+    gqa = replace(get_config("tiny-llama"), n_heads=8, n_kv_heads=2, d_model=128)
+    with pytest.raises(ValueError, match="flash"):
+        validate_flash_mesh(gqa, mesh)
+    validate_flash_mesh(get_config("tiny-gemma"), mesh)  # MQA: fine
