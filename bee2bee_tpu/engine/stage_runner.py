@@ -1,0 +1,164 @@
+"""StageRunner: executes one pipeline stage of a model on this node's mesh.
+
+The worker-side half of cross-peer pipeline serving (BASELINE config 4).
+A node loads layers [a, b) of a model (models/stages.py) and answers
+part_forward requests: ids or hidden states in, hidden states or logits
+out, with a per-request KV cache held between calls — the TPU-native
+realization of the reference's partial-model worker (reference
+node.py:236-277: HF_PART_LOAD builds a layer range, HF_PART_FORWARD feeds
+text or received hidden states).
+
+Design:
+- One jit'd stage_forward per (T, cached?) shape — prefill (T=prompt
+  bucket) and decode (T=1) each compile once; the cache is donated so XLA
+  updates it in HBM.
+- Caches are per request_id, created lazily at first forward and dropped
+  on release() (or by the idle reaper when a coordinator vanishes).
+- Thread-safe: gateways/mesh handlers call from executor threads; a lock
+  guards the cache table only (jax dispatch is itself thread-safe).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import config as model_config
+from ..models import core, stages
+
+STALE_CACHE_S = 600.0  # drop request caches untouched this long
+
+
+class StageRunner:
+    def __init__(
+        self,
+        model: str | model_config.ModelConfig,
+        n_stages: int,
+        stage: int,
+        params=None,  # FULL param tree (sliced here) — or None to random-init
+        checkpoint_path: str | None = None,
+        max_seq_len: int = 2048,
+        dtype: str = "bfloat16",
+        rng_seed: int = 0,
+        max_batch: int = 8,
+    ):
+        self.model_cfg = (
+            model
+            if isinstance(model, model_config.ModelConfig)
+            else model_config.get_config(model)
+        )
+        self.spec = stages.StageSpec.build(self.model_cfg, n_stages, stage)
+        self.dtype = jnp.dtype(dtype)
+        self.max_seq_len = min(max_seq_len, self.model_cfg.max_seq_len)
+        self.max_batch = max_batch
+
+        if params is None and checkpoint_path:
+            from ..models.loader import load_checkpoint
+
+            params = load_checkpoint(checkpoint_path, self.model_cfg, dtype=self.dtype)
+        if params is None:
+            # deterministic random init: every stage of a pipeline derives
+            # the SAME full tree from the seed, then keeps its slice — so
+            # peers agree on weights without moving bytes (tests; real
+            # deployments load a checkpoint or fetch pieces)
+            params = core.init_params(
+                self.model_cfg, jax.random.key(rng_seed), dtype=self.dtype
+            )
+        self.params = stages.extract_stage_params(params, self.model_cfg, self.spec)
+
+        self._fwd = jax.jit(
+            lambda p, x, cache, off: stages.stage_forward(
+                p, self.model_cfg, self.spec, x, cache, off
+            ),
+            donate_argnums=(2,),
+        )
+        self._caches: dict[str, dict] = {}  # request_id -> {"cache", "touched"}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------ API
+
+    @property
+    def info(self) -> dict:
+        return {
+            "model": self.model_cfg.name,
+            "n_stages": self.spec.n_stages,
+            "stage": self.spec.stage,
+            "layers": [self.spec.start, self.spec.end],
+            "is_first": self.spec.is_first,
+            "is_last": self.spec.is_last,
+            "max_seq_len": self.max_seq_len,
+        }
+
+    def forward(self, request_id: str, x: np.ndarray, offset: int) -> np.ndarray:
+        """Run a chunk through this stage against the request's cache.
+
+        x: [B, T] int ids on the first stage, [B, T, D] hidden later.
+        Returns hidden [B, T, D] (f32) or logits [B, T, V] (f32, last)."""
+        if self.spec.is_first:
+            xj = jnp.asarray(x, jnp.int32)
+            B = xj.shape[0]
+        else:
+            xj = jnp.asarray(x, self.dtype)
+            B = xj.shape[0]
+        with self._lock:
+            self._reap_stale()
+            entry = self._caches.get(request_id)
+            if entry is None:
+                if len(self._caches) >= self.max_batch:
+                    raise RuntimeError(
+                        f"stage cache table full ({self.max_batch} requests)"
+                    )
+                entry = {
+                    "cache": stages.init_stage_cache(
+                        self.model_cfg, self.spec, B, self.max_seq_len, self.dtype
+                    ),
+                    "touched": time.time(),
+                }
+                self._caches[request_id] = entry
+            cache = entry["cache"]
+            if cache is None:
+                # a second in-flight forward for the same request would
+                # otherwise run uncached (None) and silently diverge
+                raise RuntimeError(f"concurrent forward for request {request_id!r}")
+            entry["cache"] = None  # donated below; never leave a stale ref
+        try:
+            out, cache = self._fwd(self.params, xj, cache, jnp.int32(offset))
+        except Exception:
+            # free the slot: leaving the None entry would burn a max_batch
+            # row for STALE_CACHE_S and turn retries into misleading
+            # "concurrent forward" errors
+            with self._lock:
+                self._caches.pop(request_id, None)
+            raise
+        with self._lock:
+            if request_id in self._caches:  # release() may have raced us
+                self._caches[request_id] = {"cache": cache, "touched": time.time()}
+        # logits stay f32 (sampling precision); hidden states cross the wire
+        # in the compute dtype (bf16 halves inter-peer bandwidth, the
+        # stages.py design point)
+        if self.spec.is_last:
+            return np.asarray(jax.device_get(out), np.float32)
+        return np.asarray(jax.device_get(out.astype(self.dtype)))
+
+    def release(self, request_id: str) -> None:
+        with self._lock:
+            self._caches.pop(request_id, None)
+
+    def _reap_stale(self) -> None:
+        now = time.time()
+        dead = [
+            rid
+            for rid, e in self._caches.items()
+            if now - e["touched"] > STALE_CACHE_S
+        ]
+        for rid in dead:
+            self._caches.pop(rid, None)
+
+    @property
+    def active_requests(self) -> int:
+        with self._lock:
+            return len(self._caches)

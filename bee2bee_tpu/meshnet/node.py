@@ -14,6 +14,10 @@ gen_result / piece_request / piece_data. Reference defects deliberately fixed
 - **unlocked _pending_requests** (p2p_runtime.py:794-796): guarded.
 - **piece transfer stubs** (p2p_runtime.py:675-683): fully implemented, with
   binary tensor frames instead of JSON for piece payloads.
+
+Cross-peer pipeline serving (task/result + part_load/part_forward, the
+reference's worker protocol node.py:48-294) lives in meshnet/pipeline.py
+(StageTaskMixin) and is wired into the dispatch table here.
 """
 
 from __future__ import annotations
@@ -33,6 +37,7 @@ from ..joinlink import generate_join_link, parse_join_link
 from ..pieces import ShardManifest
 from ..tracing import get_tracer
 from ..utils import MetricsAggregator, get_lan_ip, get_system_metrics, new_id, sha256_hex
+from .pipeline import StageTaskMixin
 
 logger = logging.getLogger("bee2bee_tpu.mesh")
 
@@ -40,7 +45,7 @@ REQUEST_TIMEOUT_S = 300.0  # reference p2p_runtime.py:831
 PING_INTERVAL_S = 15.0
 
 
-class P2PNode:
+class P2PNode(StageTaskMixin):
     def __init__(
         self,
         host: str = "0.0.0.0",
@@ -63,6 +68,7 @@ class P2PNode:
         self.peers: dict[str, dict] = {}  # peer_id -> {ws, addr, metrics, ...}
         self.providers: dict[str, dict] = {}  # peer_id -> {svc_name: meta}
         self.local_services: dict[str, Any] = {}
+        self.stage_runners: dict[str, Any] = {}  # model -> StageRunner (pipeline.py)
         self.throughput = MetricsAggregator()
 
         # piece store: hash -> bytes (optionally spilled to piece_dir)
@@ -255,6 +261,9 @@ class P2PNode:
             protocol.PIECE_DATA: self._handle_piece_data,
             protocol.PIECE_HAVE: self._handle_piece_have,
             protocol.GOODBYE: self._handle_goodbye,
+            protocol.TASK: self._handle_task,
+            protocol.RESULT: self._handle_result,
+            protocol.TASK_ERROR: self._handle_result,
         }
         handler = handlers.get(data.get("type"))
         if handler is None:

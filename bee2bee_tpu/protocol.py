@@ -110,6 +110,9 @@ _MAGIC = b"B2T1"
 def encode_binary(message: dict, tensors: dict[str, "Any"] | None = None) -> bytes:
     import numpy as np
 
+    import ml_dtypes  # noqa: F401 — registers bfloat16 with numpy: pipeline
+    # hidden states ship as bf16 (half the bytes of f32 at full exponent range)
+
     tensors = tensors or {}
     specs = []
     buffers = []
@@ -128,6 +131,8 @@ def encode_binary(message: dict, tensors: dict[str, "Any"] | None = None) -> byt
 def decode_binary(raw: bytes) -> tuple[dict, dict]:
     """Returns (message, tensors). `message` keeps non-tensor fields."""
     import numpy as np
+
+    import ml_dtypes  # noqa: F401 — bfloat16 dtype strings must resolve
 
     if raw[:4] != _MAGIC:
         raise ValueError("bad tensor-frame magic")
