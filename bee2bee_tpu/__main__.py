@@ -24,11 +24,8 @@ def _setup_logging():
         logging.getLogger("absl").setLevel(logging.WARNING)
 
 
-def _serve(backend: str, model: str, **kw):
-    from .meshnet.runtime import run_p2p_node
-
-    _setup_logging()
-    cfg = load_config()
+def _apply_common_cfg(cfg, kw):
+    """Fold _common_opts (and mesh shape) into the node config."""
     if kw.get("port") is not None:
         cfg.port = kw["port"]
     if kw.get("api_port") is not None:
@@ -37,6 +34,14 @@ def _serve(backend: str, model: str, **kw):
         cfg.price_per_token = kw["price"]
     if kw.get("mesh_shape"):
         cfg.mesh_shape = kw["mesh_shape"]
+    return cfg
+
+
+def _serve(backend: str, model: str, **kw):
+    from .meshnet.runtime import run_p2p_node
+
+    _setup_logging()
+    cfg = _apply_common_cfg(load_config(), kw)
     try:
         asyncio.run(
             run_p2p_node(
@@ -46,6 +51,8 @@ def _serve(backend: str, model: str, **kw):
                 bootstrap=kw.get("bootstrap"),
                 checkpoint_path=kw.get("checkpoint"),
                 ollama_host=kw.get("ollama_host"),
+                publish_weights=kw.get("publish_weights", False),
+                from_mesh=kw.get("from_mesh", False),
             )
         )
     except KeyboardInterrupt:
@@ -70,10 +77,18 @@ def cli():
 @click.option("--model", default="distilgpt2", help="model name or config key")
 @click.option("--checkpoint", default=None, help="local checkpoint dir (HF or native)")
 @click.option("--mesh-shape", default=None, help='e.g. "data:1,model:8"')
+@click.option("--publish-weights", is_flag=True,
+              help="announce this node's params as DHT pieces for joiners")
+@click.option("--from-mesh", is_flag=True,
+              help="fetch weights from mesh providers via the DHT "
+                   "(zero local checkpoint)")
 @_common_opts
-def serve_tpu(model, checkpoint, mesh_shape, **kw):
+def serve_tpu(model, checkpoint, mesh_shape, publish_weights, from_mesh, **kw):
     """Serve a model on TPU via the jit engine (the flagship entrypoint)."""
-    _serve("tpu", model, checkpoint=checkpoint, mesh_shape=mesh_shape, **kw)
+    _serve(
+        "tpu", model, checkpoint=checkpoint, mesh_shape=mesh_shape,
+        publish_weights=publish_weights, from_mesh=from_mesh, **kw
+    )
 
 
 @cli.command("serve-ollama")
@@ -91,6 +106,60 @@ def serve_ollama(model, ollama_host, **kw):
 def serve_hf_remote(model, **kw):
     """Proxy the HF serverless Inference API into the mesh."""
     _serve("hf_remote", model, **kw)
+
+
+@cli.command("serve-stage")
+@click.option("--model", required=True, help="model name or config key")
+@click.option("--n-stages", type=int, default=None,
+              help="preload this stage now (otherwise wait for part_load)")
+@click.option("--stage", type=int, default=0, help="0-based stage index")
+@click.option("--checkpoint", default=None, help="local checkpoint dir")
+@click.option("--max-seq-len", type=int, default=2048)
+@_common_opts
+def serve_stage(model, n_stages, stage, checkpoint, max_seq_len, **kw):
+    """Host a pipeline-stage worker (layers [a, b) of a model).
+
+    A coordinator peer drives generation across stage workers via the
+    task protocol (part_load / part_forward — meshnet/pipeline.py); with
+    --n-stages the stage loads immediately, otherwise the node waits for
+    a coordinator's part_load."""
+    from .meshnet.runtime import run_p2p_node
+
+    _setup_logging()
+    cfg = _apply_common_cfg(load_config(), kw)
+
+    async def main():
+        import functools
+
+        from .engine.stage_runner import StageRunner
+
+        preload = None
+        if n_stages is not None:
+            loop = asyncio.get_running_loop()
+            preload = await loop.run_in_executor(
+                None,
+                functools.partial(
+                    StageRunner,
+                    model,
+                    n_stages=n_stages,
+                    stage=stage,
+                    checkpoint_path=checkpoint,
+                    max_seq_len=max_seq_len,
+                    dtype=cfg.dtype,
+                ),
+            )
+        await run_p2p_node(
+            backend=None,
+            model=model,
+            cfg=cfg,
+            bootstrap=kw.get("bootstrap"),
+            stage_runner=preload,
+        )
+
+    try:
+        asyncio.run(main())
+    except KeyboardInterrupt:
+        click.echo("shutting down")
 
 
 @cli.command("serve-fake")

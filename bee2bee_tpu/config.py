@@ -28,9 +28,13 @@ _ENV_MAP = {
     "BEE2BEE_DTYPE": "dtype",
     "BEE2BEE_MAX_BATCH": "max_batch_size",
     "BEE2BEE_AUTO_NAT": "auto_nat",
+    "BEE2BEE_DHT_PORT": "dht_port",
+    "BEE2BEE_DHT_BOOTSTRAP": "dht_bootstrap",
 }
 
-_INT_FIELDS = {"port", "api_port", "announce_port", "max_batch_size", "max_seq_len"}
+_INT_FIELDS = {
+    "port", "api_port", "announce_port", "max_batch_size", "max_seq_len", "dht_port",
+}
 _BOOL_FIELDS = {"auto_nat"}
 
 
@@ -57,6 +61,10 @@ class NodeConfig:
     max_seq_len: int = 2048
     max_new_tokens: int = 2048  # reference default (services.py:28)
     price_per_token: float = 0.0
+    # DHT for weight distribution (kademlia UDP when installed; reference
+    # dht.py:25-38): listen port + comma-separated host:port bootstrap peers
+    dht_port: int = 8468
+    dht_bootstrap: str = ""
     extra: dict = field(default_factory=dict)
 
     def to_dict(self) -> dict:

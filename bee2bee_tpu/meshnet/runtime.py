@@ -62,7 +62,7 @@ def build_service(backend: str, model: str, cfg: NodeConfig, **kw):
 
 
 async def run_p2p_node(
-    backend: str = "tpu",
+    backend: str | None = "tpu",
     model: str = "distilgpt2",
     cfg: NodeConfig | None = None,
     bootstrap: str | None = None,
@@ -72,6 +72,10 @@ async def run_p2p_node(
     ollama_host: str | None = None,
     ready_event: asyncio.Event | None = None,
     shutdown_event: asyncio.Event | None = None,
+    stage_runner=None,  # host a preloaded pipeline stage (backend=None)
+    dht=None,  # DHTNode for weight distribution (created on demand)
+    publish_weights: bool = False,  # announce this node's params as pieces
+    from_mesh: bool = False,  # tpu backend: fetch weights from the mesh DHT
 ):
     """Boot a full serving node; runs until shutdown_event (or forever)."""
     cfg = cfg or load_config()
@@ -89,6 +93,7 @@ async def run_p2p_node(
     api_runner = None
     registry_task = None
     forwarder = None
+    own_dht = dht is None  # stop a DHT we created ourselves
     try:
         # Announce-address resolution (reference p2p_runtime.py:195-274): when
         # no explicit announce host was configured, try NAT auto-forward →
@@ -124,14 +129,76 @@ async def run_p2p_node(
             with contextlib.suppress(Exception):
                 await node.connect_bootstrap(bootstrap or cfg.bootstrap_url)
 
-        svc = build_service(
-            backend, model, cfg, checkpoint_path=checkpoint_path, ollama_host=ollama_host
-        )
-        loop = asyncio.get_running_loop()
-        if hasattr(svc, "load_sync"):
-            await loop.run_in_executor(None, svc.load_sync)
-        await node.announce_service(svc)
-        logger.info("serving %s via %s; join link: %s", model, backend, node.join_link())
+        if stage_runner is not None:
+            node.add_stage_runner(stage_runner)
+            logger.info(
+                "hosting stage %s/%s of %s (layers %s); join link: %s",
+                stage_runner.spec.stage + 1, stage_runner.spec.n_stages,
+                model, stage_runner.info["layers"], node.join_link(),
+            )
+        if (publish_weights or from_mesh) and dht is None:
+            from ..dht import DHTNode
+
+            dht = DHTNode(port=cfg.dht_port)
+            boot = [
+                (h, int(p))
+                for h, _, p in (
+                    x.strip().rpartition(":")
+                    for x in cfg.dht_bootstrap.split(",")
+                    if x.strip()
+                )
+            ]
+            await dht.start(boot or None)
+
+        if backend == "tpu" and from_mesh:
+            # the zero-local-checkpoint join: manifest + pieces come from
+            # mesh providers via the DHT (meshnet/weights.py)
+            from ..engine.engine import EngineConfig
+            from .weights import serve_model_from_mesh
+
+            shape = parse_mesh_shape(cfg.mesh_shape)
+            join_mesh = None
+            if shape:
+                from ..parallel import MeshSpec, build_mesh
+
+                join_mesh = build_mesh(MeshSpec.from_dict(shape))
+            svc = await serve_model_from_mesh(
+                node, dht, model,
+                mesh=join_mesh,
+                engine_config=EngineConfig(
+                    max_seq_len=cfg.max_seq_len, dtype=cfg.dtype,
+                    max_batch=cfg.max_batch_size,
+                ),
+                price_per_token=cfg.price_per_token,
+            )
+            logger.info("serving %s from mesh pieces; join link: %s", model, node.join_link())
+        elif backend is not None:
+            svc = build_service(
+                backend, model, cfg,
+                checkpoint_path=checkpoint_path, ollama_host=ollama_host,
+            )
+            loop = asyncio.get_running_loop()
+            if hasattr(svc, "load_sync"):
+                await loop.run_in_executor(None, svc.load_sync)
+            await node.announce_service(svc)
+            logger.info("serving %s via %s; join link: %s", model, backend, node.join_link())
+        elif stage_runner is None:
+            logger.info(
+                "stage worker awaiting part_load for %s; join link: %s",
+                model, node.join_link(),
+            )
+
+        if publish_weights and backend == "tpu":
+            # publishes after a --from-mesh join too: a joined peer reseeds
+            # the swarm as a new piece provider
+            from .weights import publish_model_weights
+
+            engine = getattr(svc, "engine", None)
+            if engine is not None:
+                await publish_model_weights(
+                    node, dht, engine.model_cfg, engine.params,
+                    parse_mesh_shape(cfg.mesh_shape),
+                )
 
         if registry_sync:
             from ..registry import RegistryClient
@@ -148,6 +215,9 @@ async def run_p2p_node(
             while True:
                 await asyncio.sleep(3600)
     finally:
+        if own_dht and dht is not None:
+            with contextlib.suppress(Exception):
+                await dht.stop()
         if registry_task:
             registry_task.cancel()
             with contextlib.suppress(asyncio.CancelledError):

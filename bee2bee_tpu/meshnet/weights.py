@@ -1,0 +1,186 @@
+"""Mesh weight distribution: DHT announce → piece fetch → serve.
+
+This wires the three previously separate mechanisms into the end-to-end
+flow the reference only sketched (reference dht.py:53-64 announce/lookup +
+pieces.py:7-32 chunking + p2p_runtime.py:675-683 stub handlers):
+
+- A serving node **publishes**: its params are sharded into content-
+  addressed pieces (pieces.build_shard_manifest, using the SAME partition
+  rules the engine's jit shardings use), the blobs enter the node's piece
+  store, and the manifest + per-piece provider records go onto the DHT.
+- A joining peer **fetches**: manifest from the DHT → the pieces its mesh
+  coordinates need (ShardManifest.pieces_for) → hash-verified transfers
+  from provider peers over the mesh's binary piece frames
+  (node.request_piece) → assemble → `jax.device_put` via the engine's
+  normal shard_params path → serve, with **zero local checkpoint**.
+
+The DHT is kademlia-backed when that optional package exists; otherwise
+records live in the in-process fallback (reference dht.py:25-38's same
+degradation) — fine for co-located tests, real deployments run kademlia
+or rely on the registry for manifest discovery.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+logger = logging.getLogger("bee2bee_tpu.weights")
+
+FETCH_CONCURRENCY = 8
+
+
+async def publish_model_weights(
+    node, dht, model_cfg, params, mesh_axes: dict[str, int] | None = None
+):
+    """Shard `params` into pieces, seed the node's piece store, announce
+    manifest + providers on the DHT. Returns the ShardManifest.
+
+    mesh_axes={} (or None) publishes whole-param pieces — what a
+    single-chip peer fetches; a TP group publishes with its axis sizes so
+    members fetch only their coordinates' slices."""
+    from ..models.loader import _flatten
+    from ..models.partition import flat_partition_specs
+    from ..pieces import build_shard_manifest
+
+    loop = asyncio.get_running_loop()
+
+    def build():
+        flat = _flatten(params)
+        specs = (
+            flat_partition_specs(params, mesh_axes, cfg=model_cfg)
+            if mesh_axes
+            else {k: () for k in flat}
+        )
+        return build_shard_manifest(model_cfg.name, flat, specs, mesh_axes or {})
+
+    manifest, blobs = await loop.run_in_executor(None, build)
+    for digest, blob in blobs.items():
+        node.piece_store[digest] = blob
+    node.manifests[model_cfg.name] = manifest
+
+    await dht.announce_manifest(model_cfg.name, manifest.to_json(), node.addr)
+    for piece in manifest.pieces:
+        await dht.announce_piece(
+            piece.sha256,
+            node.addr,
+            mesh_axis=piece.mesh_axis,
+            shard_index=piece.shard_index,
+        )
+    logger.info(
+        "published %s: %d pieces, %.1f MiB",
+        model_cfg.name, len(manifest.pieces), manifest.total_bytes / 2**20,
+    )
+    return manifest
+
+
+async def _peer_for_addr(node, addr: str) -> str | None:
+    """Resolve a DHT provider addr to a connected peer_id (dialing it if
+    new)."""
+    for pid, info in node.peers.items():
+        if info.get("addr") == addr:
+            return pid
+    if await node.connect_bootstrap(addr):
+        for _ in range(100):
+            for pid, info in node.peers.items():
+                if info.get("addr") == addr:
+                    return pid
+            await asyncio.sleep(0.05)
+    return None
+
+
+async def fetch_model_from_mesh(
+    node, dht, model: str, coords: dict[str, int] | None = None
+):
+    """Fetch manifest + pieces from mesh providers. With `coords`, only
+    that mesh coordinate's pieces come back (a TP-group member's share);
+    with coords=None, EVERY piece is fetched and sharded params are
+    re-concatenated to full tensors (a host that owns all coordinates —
+    it re-shards via the engine's own partition rules afterwards).
+    Returns (model_cfg, flat {path: np.ndarray}) — hash-verified."""
+    import numpy as np
+
+    from ..models.config import get_config
+    from ..pieces import ShardManifest, assemble_params_from_pieces
+
+    rec = await dht.get_manifest(model)
+    if rec is None:
+        raise RuntimeError(f"no manifest on the DHT for model {model!r}")
+    manifest = ShardManifest.from_json(rec["manifest"])
+    needed = manifest.pieces if coords is None else manifest.pieces_for(coords)
+
+    sem = asyncio.Semaphore(FETCH_CONCURRENCY)
+    blobs: dict[str, bytes] = {}
+
+    async def fetch(piece):
+        if node.get_piece(piece.sha256) is not None:  # already local
+            blobs[piece.sha256] = node.get_piece(piece.sha256)
+            return
+        providers = await dht.find_providers(piece.sha256, piece.shard_index)
+        addrs = [p["addr"] for p in providers] or [rec.get("addr")]
+        last_err: Exception | None = None
+        async with sem:
+            for addr in addrs:
+                if not addr:
+                    continue
+                try:
+                    pid = await _peer_for_addr(node, addr)
+                    if pid is None:
+                        continue
+                    blobs[piece.sha256] = await node.request_piece(pid, piece.sha256)
+                    return
+                except Exception as e:  # noqa: BLE001 — try the next provider
+                    last_err = e
+        raise RuntimeError(
+            f"no provider served piece {piece.sha256[:12]} for {piece.param}"
+        ) from last_err
+
+    await asyncio.gather(*(fetch(p) for p in needed))
+    if coords is not None:
+        return get_config(model), assemble_params_from_pieces(manifest, blobs, coords)
+    # full reassembly: verify + concat each param's shards (loader.load_native's
+    # on-disk logic, over the wire)
+    flat: dict[str, np.ndarray] = {}
+    parts: dict[str, list] = {}
+    for p in manifest.pieces:
+        from ..utils import sha256_hex
+
+        data = blobs[p.sha256]
+        if sha256_hex(data) != p.sha256:
+            raise ValueError(f"piece corrupt for {p.param}[{p.shard_index}]")
+        arr = np.frombuffer(data, dtype=p.dtype).reshape(p.shape)
+        if p.shard_count > 1:
+            parts.setdefault(p.param, [None] * p.shard_count)[p.shard_index] = arr
+        else:
+            flat[p.param] = arr
+    for name, shards in parts.items():
+        piece = next(p for p in manifest.pieces if p.param == name)
+        flat[name] = np.concatenate(shards, axis=piece.axis)
+    return get_config(model), flat
+
+
+async def serve_model_from_mesh(
+    node, dht, model: str, mesh=None, engine_config=None, price_per_token: float = 0.0
+):
+    """The full join flow: fetch pieces → engine → TPUService → announce.
+    The fresh peer serves with zero local checkpoint (VERDICT r2 task #5
+    acceptance)."""
+    from ..engine.engine import InferenceEngine
+    from ..models.loader import _unflatten
+    from ..services.tpu import TPUService
+
+    import jax.numpy as jnp
+
+    cfg, flat = await fetch_model_from_mesh(node, dht, model, coords=None)
+    loop = asyncio.get_running_loop()
+
+    def build_engine():
+        params = _unflatten(flat)
+        dtype = jnp.dtype(engine_config.dtype) if engine_config else jnp.bfloat16
+        params = __import__("jax").tree.map(lambda a: jnp.asarray(a, dtype), params)
+        return InferenceEngine(cfg, params, mesh=mesh, engine_config=engine_config)
+
+    engine = await loop.run_in_executor(None, build_engine)
+    svc = TPUService(cfg.name, price_per_token=price_per_token, engine=engine)
+    await node.announce_service(svc)
+    return svc
