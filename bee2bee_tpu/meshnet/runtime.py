@@ -140,14 +140,16 @@ async def run_p2p_node(
             from ..dht import DHTNode
 
             dht = DHTNode(port=cfg.dht_port)
-            boot = [
-                (h, int(p))
-                for h, _, p in (
-                    x.strip().rpartition(":")
-                    for x in cfg.dht_bootstrap.split(",")
-                    if x.strip()
-                )
-            ]
+            boot = []
+            for entry in cfg.dht_bootstrap.split(","):
+                entry = entry.strip()
+                if not entry:
+                    continue
+                host, _, port_s = entry.rpartition(":")
+                if host and port_s.isdigit():
+                    boot.append((host, int(port_s)))
+                else:  # bare hostname: default kademlia port
+                    boot.append((entry, 8468))
             await dht.start(boot or None)
 
         if backend == "tpu" and from_mesh:

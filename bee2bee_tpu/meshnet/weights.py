@@ -25,6 +25,8 @@ from __future__ import annotations
 import asyncio
 import logging
 
+from ..utils import sha256_hex
+
 logger = logging.getLogger("bee2bee_tpu.weights")
 
 FETCH_CONCURRENCY = 8
@@ -60,13 +62,19 @@ async def publish_model_weights(
     node.manifests[model_cfg.name] = manifest
 
     await dht.announce_manifest(model_cfg.name, manifest.to_json(), node.addr)
-    for piece in manifest.pieces:
-        await dht.announce_piece(
-            piece.sha256,
-            node.addr,
-            mesh_axis=piece.mesh_axis,
-            shard_index=piece.shard_index,
-        )
+    # announces are independent: batch them instead of one DHT RTT per piece
+    sem = asyncio.Semaphore(FETCH_CONCURRENCY)
+
+    async def announce(piece):
+        async with sem:
+            await dht.announce_piece(
+                piece.sha256,
+                node.addr,
+                mesh_axis=piece.mesh_axis,
+                shard_index=piece.shard_index,
+            )
+
+    await asyncio.gather(*(announce(p) for p in manifest.pieces))
     logger.info(
         "published %s: %d pieces, %.1f MiB",
         model_cfg.name, len(manifest.pieces), manifest.total_bytes / 2**20,
@@ -76,16 +84,21 @@ async def publish_model_weights(
 
 async def _peer_for_addr(node, addr: str) -> str | None:
     """Resolve a DHT provider addr to a connected peer_id (dialing it if
-    new)."""
-    for pid, info in node.peers.items():
-        if info.get("addr") == addr:
-            return pid
-    if await node.connect_bootstrap(addr):
-        for _ in range(100):
-            for pid, info in node.peers.items():
-                if info.get("addr") == addr:
-                    return pid
-            await asyncio.sleep(0.05)
+    new). Per-(node, addr) lock: concurrent piece fetches must not open N
+    parallel sockets to the same provider — the peer table only dedups
+    after the hello round-trip."""
+    locks = node.__dict__.setdefault("_weights_dial_locks", {})
+    lock = locks.setdefault(addr, asyncio.Lock())
+    async with lock:
+        for pid, info in node.peers.items():
+            if info.get("addr") == addr:
+                return pid
+        if await node.connect_bootstrap(addr):
+            for _ in range(100):
+                for pid, info in node.peers.items():
+                    if info.get("addr") == addr:
+                        return pid
+                await asyncio.sleep(0.05)
     return None
 
 
@@ -135,27 +148,31 @@ async def fetch_model_from_mesh(
             f"no provider served piece {piece.sha256[:12]} for {piece.param}"
         ) from last_err
 
-    await asyncio.gather(*(fetch(p) for p in needed))
+    results = await asyncio.gather(
+        *(fetch(p) for p in needed), return_exceptions=True
+    )
+    errors = [r for r in results if isinstance(r, BaseException)]
+    if errors:  # every sibling has finished — no orphaned transfers
+        raise errors[0]
     if coords is not None:
         return get_config(model), assemble_params_from_pieces(manifest, blobs, coords)
     # full reassembly: verify + concat each param's shards (loader.load_native's
     # on-disk logic, over the wire)
     flat: dict[str, np.ndarray] = {}
     parts: dict[str, list] = {}
+    concat_axis: dict[str, int] = {}
     for p in manifest.pieces:
-        from ..utils import sha256_hex
-
         data = blobs[p.sha256]
         if sha256_hex(data) != p.sha256:
             raise ValueError(f"piece corrupt for {p.param}[{p.shard_index}]")
         arr = np.frombuffer(data, dtype=p.dtype).reshape(p.shape)
         if p.shard_count > 1:
             parts.setdefault(p.param, [None] * p.shard_count)[p.shard_index] = arr
+            concat_axis[p.param] = p.axis
         else:
             flat[p.param] = arr
     for name, shards in parts.items():
-        piece = next(p for p in manifest.pieces if p.param == name)
-        flat[name] = np.concatenate(shards, axis=piece.axis)
+        flat[name] = np.concatenate(shards, axis=concat_axis[name])
     return get_config(model), flat
 
 

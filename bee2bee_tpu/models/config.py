@@ -35,6 +35,12 @@ class ModelConfig:
     # MoE
     n_experts: int = 0  # 0 = dense
     n_experts_per_tok: int = 2
+    # "dense": all experts on all tokens, weight-masked — the exact
+    # reference formulation (correctness baseline, 4x routed FLOPs at
+    # top-2-of-8). "routed": GShard-style capacity-grouped dispatch; only
+    # routed tokens hit each expert, tokens past capacity drop.
+    moe_impl: str = "dense"  # "dense" | "routed"
+    moe_capacity_factor: float = 1.25  # routed: C = ceil(N*k/E * factor)
 
     @property
     def head_dim(self) -> int:

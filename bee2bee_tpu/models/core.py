@@ -188,6 +188,49 @@ def _mlp(x, p, cfg: ModelConfig):
     return out
 
 
+def _moe_routed(x, p, cfg: ModelConfig):
+    """Top-k expert MLP, GShard-style routed dispatch (static shapes).
+
+    Tokens are grouped into per-expert capacity buffers [E, C, D] via a
+    dispatch one-hot, each expert runs its MLP on ONLY its buffer, and a
+    combine einsum scatters weighted outputs back — k/E of the dense
+    formulation's expert FLOPs. Capacity C = ceil(N*k/E * capacity
+    factor); assignments past an expert's capacity drop (their combine
+    weight is zero), token-index-major priority. Everything is einsum/
+    one_hot/cumsum — no gather/scatter, fully differentiable, and the
+    sharded-E einsums become all-to-alls over the `expert` mesh axis
+    under the partitioner.
+    """
+    B, T, D = x.shape
+    E, k = cfg.n_experts, cfg.n_experts_per_tok
+    N = B * T
+    C = min(N, int(math.ceil(N * k / E * cfg.moe_capacity_factor)))
+    xf = x.reshape(N, D)
+
+    logits = (xf @ p["router"]).astype(jnp.float32)  # [N, E]
+    topv, topi = lax.top_k(logits, k)
+    topp = jax.nn.softmax(topv, axis=-1)  # [N, k] renormalized
+
+    oh = jax.nn.one_hot(topi, E, dtype=jnp.float32)  # [N, k, E]
+    ohf = oh.reshape(N * k, E)  # token-major, slot-minor priority
+    pos_all = jnp.cumsum(ohf, axis=0) - ohf  # running count per expert
+    # exact small integers in f32; one_hot wants integer positions
+    pos = jnp.sum(pos_all * ohf, axis=-1).astype(jnp.int32)  # [N*k]
+    keep = (pos < C).astype(jnp.float32)
+    slot = jax.nn.one_hot(pos, C, dtype=jnp.float32) * keep[:, None]  # [N*k, C]
+    disp = (ohf[:, :, None] * slot[:, None, :]).reshape(N, k, E, C)
+    combine = jnp.sum(disp * topp[..., None, None], axis=1)  # [N, E, C]
+    disp_tok = jnp.sum(disp, axis=1)  # [N, E, C] 0/1
+
+    xe = jnp.einsum("nec,nd->ecd", disp_tok.astype(x.dtype), xf)  # [E, C, D]
+    up = jnp.einsum("ecd,edf->ecf", xe, p["w_up"])
+    gate = jnp.einsum("ecd,edf->ecf", xe, p["w_gate"]) if "w_gate" in p else None
+    h = _activate(up, gate, cfg)
+    ye = jnp.einsum("ecf,efd->ecd", h, p["w_down"])  # [E, C, D]
+    out = jnp.einsum("nec,ecd->nd", combine.astype(ye.dtype), ye)
+    return out.reshape(B, T, D)
+
+
 def _moe(x, p, cfg: ModelConfig):
     """Top-k expert MLP, dense-einsum formulation.
 
@@ -196,7 +239,12 @@ def _moe(x, p, cfg: ModelConfig):
     the XLA-friendly dense formulation (no gather/scatter, static shapes).
     Expert-parallel sharding splits the E dim across the `expert` mesh axis
     and XLA turns the weighted sum into a reduce over that axis.
+    cfg.moe_impl="routed" switches to the capacity-grouped dispatch that
+    only pays the routed FLOPs (_moe_routed); dense stays the reference
+    check.
     """
+    if cfg.moe_impl == "routed":
+        return _moe_routed(x, p, cfg)
     B, T, D = x.shape
     E, k = cfg.n_experts, cfg.n_experts_per_tok
     logits = (x @ p["router"]).astype(jnp.float32)  # [B, T, E]

@@ -132,3 +132,84 @@ def test_batched_rows_independent(model):
     la, _ = core.forward(params, cfg, jnp.asarray(a), None, 0)
     lb, _ = core.forward(params, cfg, jnp.asarray(b), None, 0)
     np.testing.assert_allclose(la[0], lb[0], rtol=1e-5, atol=1e-5)
+
+
+# --------------------------------------------------------------- routed MoE
+
+
+def test_routed_moe_matches_dense_at_full_capacity():
+    """With capacity >= N (no drops), the routed dispatch must equal the
+    dense all-experts formulation exactly (VERDICT r2 task #7 acceptance)."""
+    from bee2bee_tpu.models.config import get_config
+
+    dense_cfg = get_config("tiny-mixtral")
+    routed_cfg = get_config(
+        "tiny-mixtral", moe_impl="routed",
+        moe_capacity_factor=float(dense_cfg.n_experts),  # C = N: nothing drops
+    )
+    params = core.init_params(dense_cfg, jax.random.key(0), dtype=jnp.float32)
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(3, dense_cfg.vocab_size, (2, 12)), jnp.int32
+    )
+    want, _ = core.forward(params, dense_cfg, ids, None, jnp.int32(0))
+    got, _ = core.forward(params, routed_cfg, ids, None, jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_routed_moe_capacity_drops_are_finite():
+    """Tokens past expert capacity drop (combine weight 0) — outputs stay
+    finite and within range, never NaN."""
+    from bee2bee_tpu.models.config import get_config
+
+    cfg = get_config("tiny-mixtral", moe_impl="routed", moe_capacity_factor=0.25)
+    params = core.init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    ids = jnp.asarray(
+        np.random.default_rng(1).integers(3, cfg.vocab_size, (2, 16)), jnp.int32
+    )
+    logits, _ = core.forward(params, cfg, ids, None, jnp.int32(0))
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_routed_moe_on_expert_mesh_matches_single_device():
+    """Routed MoE under EP sharding: the dispatch/combine einsums become
+    collectives over the `expert` axis; numerics must not change."""
+    from bee2bee_tpu.models import partition
+    from bee2bee_tpu.models.config import get_config
+    from bee2bee_tpu.parallel import MeshSpec, build_mesh
+
+    cfg = get_config("tiny-mixtral", moe_impl="routed", moe_capacity_factor=4.0)
+    mesh = build_mesh(MeshSpec(expert=4, model=2))
+    params = core.init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    ids = jnp.asarray(
+        np.random.default_rng(2).integers(3, cfg.vocab_size, (1, 8)), jnp.int32
+    )
+    want, _ = core.forward(params, cfg, ids, None, jnp.int32(0))
+    sharded = partition.shard_params(params, mesh, cfg=cfg)
+    got = jax.jit(lambda p, x: core.forward(p, cfg, x, None, jnp.int32(0))[0])(
+        sharded, ids
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+
+
+def test_routed_moe_is_differentiable():
+    """The dispatch path (one_hot/cumsum/einsum) must carry gradients —
+    the dryrun trains a routed tiny-mixtral."""
+    from bee2bee_tpu.models.config import get_config
+
+    cfg = get_config("tiny-mixtral", moe_impl="routed", moe_capacity_factor=2.0)
+    params = core.init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    ids = jnp.asarray(
+        np.random.default_rng(3).integers(3, cfg.vocab_size, (2, 8)), jnp.int32
+    )
+
+    def loss(p):
+        logits, _ = core.forward(p, cfg, ids, None, jnp.int32(0))
+        tgt = ids[:, 1:]
+        lp = jax.nn.log_softmax(logits[:, :-1])
+        return -jnp.mean(jnp.take_along_axis(lp, tgt[..., None], axis=-1))
+
+    grads = jax.grad(loss)(params)
+    gnorms = [float(jnp.linalg.norm(g)) for g in jax.tree.leaves(grads)]
+    assert all(np.isfinite(g) for g in gnorms)
+    wup_g = grads["layers"]["moe"]["w_up"]
+    assert float(jnp.abs(wup_g).sum()) > 0  # experts actually received grads
