@@ -1,0 +1,19 @@
+# Serving node image (reference ships python:3.10-slim with a stale CMD,
+# /root/reference/Dockerfile:29; this one runs the real CLI).
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+    g++ make && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY pyproject.toml README.md ./
+COPY bee2bee_tpu ./bee2bee_tpu
+COPY native ./native
+RUN pip install --no-cache-dir -e ".[train]" && make -C native
+
+# WS mesh port + HTTP gateway port (NodeConfig defaults)
+EXPOSE 4003 4002
+
+# CPU by default; a TPU host provides its own jax[tpu] install or mounts
+# the plugin. Override the model/backend via args or BEE2BEE_* env.
+CMD ["bee2bee-tpu", "serve-tpu", "--model", "distilgpt2"]
