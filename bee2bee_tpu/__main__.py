@@ -170,6 +170,39 @@ def serve_fake(model, **kw):
     _serve("fake", model, **kw)
 
 
+@cli.command("serve-web")
+@click.option("--seeds", default="", help="comma-separated ws:// node addrs")
+@click.option("--port", type=int, default=4001, help="HTTP port for the web UI/API")
+@click.option("--host", default="0.0.0.0")
+def serve_web(seeds, port, host):
+    """Run the browser-facing web gateway (the reference's Express/React
+    tier, rebuilt on aiohttp + a static UI — bee2bee_tpu/web/)."""
+    _setup_logging()
+
+    async def main():
+        from .registry import RegistryClient
+        from .web import MeshBridge, start_web_gateway
+
+        bridge = MeshBridge([s.strip() for s in seeds.split(",") if s.strip()])
+        await bridge.start()
+        registry = RegistryClient()
+        runner = await start_web_gateway(
+            bridge, host, port, registry=registry if registry.enabled else None
+        )
+        click.echo(f"web gateway: http://{host}:{port} (seeds: {bridge.seeds or '-'})")
+        try:
+            while True:
+                await asyncio.sleep(3600)
+        finally:
+            await runner.cleanup()
+            await bridge.stop()
+
+    try:
+        asyncio.run(main())
+    except KeyboardInterrupt:
+        click.echo("shutting down")
+
+
 @cli.command()
 @click.option("--bootstrap", default=None, help="set the default bootstrap url")
 def register(bootstrap):

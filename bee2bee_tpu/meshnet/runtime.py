@@ -61,6 +61,33 @@ def build_service(backend: str, model: str, cfg: NodeConfig, **kw):
     raise ValueError(f"unknown backend {backend!r} (tpu | ollama | hf_remote | fake)")
 
 
+def _parse_dht_bootstrap(spec: str) -> list[tuple[str, int]]:
+    """"host:port,[v6::addr]:port,barehost" → [(host, port), ...].
+
+    Bare hosts (including bare IPv6 literals, which contain colons) get
+    the default kademlia port 8468; a malformed port raises rather than
+    silently mis-resolving far from the misconfiguration."""
+    out: list[tuple[str, int]] = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if entry.startswith("["):  # [v6]:port or [v6]
+            host, _, rest = entry[1:].partition("]")
+            port_s = rest.lstrip(":")
+        elif entry.count(":") == 1:
+            host, _, port_s = entry.partition(":")
+        else:  # zero colons = bare hostname; 2+ = bare IPv6 literal
+            host, port_s = entry, ""
+        if not port_s:
+            out.append((host, 8468))
+        elif port_s.isdigit():
+            out.append((host, int(port_s)))
+        else:
+            raise ValueError(f"bad dht bootstrap entry {entry!r}: invalid port")
+    return out
+
+
 async def run_p2p_node(
     backend: str | None = "tpu",
     model: str = "distilgpt2",
@@ -140,17 +167,7 @@ async def run_p2p_node(
             from ..dht import DHTNode
 
             dht = DHTNode(port=cfg.dht_port)
-            boot = []
-            for entry in cfg.dht_bootstrap.split(","):
-                entry = entry.strip()
-                if not entry:
-                    continue
-                host, _, port_s = entry.rpartition(":")
-                if host and port_s.isdigit():
-                    boot.append((host, int(port_s)))
-                else:  # bare hostname: default kademlia port
-                    boot.append((entry, 8468))
-            await dht.start(boot or None)
+            await dht.start(_parse_dht_bootstrap(cfg.dht_bootstrap) or None)
 
         if backend == "tpu" and from_mesh:
             # the zero-local-checkpoint join: manifest + pieces come from

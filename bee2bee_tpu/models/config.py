@@ -40,7 +40,19 @@ class ModelConfig:
     # top-2-of-8). "routed": GShard-style capacity-grouped dispatch; only
     # routed tokens hit each expert, tokens past capacity drop.
     moe_impl: str = "dense"  # "dense" | "routed"
-    moe_capacity_factor: float = 1.25  # routed: C = ceil(N*k/E * factor)
+    moe_capacity_factor: float = 1.25  # routed: C = ceil(g*k/E * factor)
+    # routed dispatch runs per GROUP of this many tokens (GShard grouping):
+    # capacity — and so the [*, g, E, C] dispatch tensor — stays O(group
+    # size), not O(batch*seq). Groups route independently.
+    moe_group_size: int = 512
+
+    def __post_init__(self):
+        if self.moe_impl not in ("dense", "routed"):
+            raise ValueError(
+                f"moe_impl={self.moe_impl!r} must be 'dense' or 'routed'"
+            )
+        if self.moe_group_size < 1:
+            raise ValueError(f"moe_group_size={self.moe_group_size} must be >= 1")
 
     @property
     def head_dim(self) -> int:

@@ -191,44 +191,59 @@ def _mlp(x, p, cfg: ModelConfig):
 def _moe_routed(x, p, cfg: ModelConfig):
     """Top-k expert MLP, GShard-style routed dispatch (static shapes).
 
-    Tokens are grouped into per-expert capacity buffers [E, C, D] via a
-    dispatch one-hot, each expert runs its MLP on ONLY its buffer, and a
+    Tokens are split into GROUPS of cfg.moe_group_size; each group routes
+    independently into per-expert capacity buffers [G, E, C, D] via a
+    dispatch one-hot, each expert runs its MLP on only its buffers, and a
     combine einsum scatters weighted outputs back — k/E of the dense
-    formulation's expert FLOPs. Capacity C = ceil(N*k/E * capacity
-    factor); assignments past an expert's capacity drop (their combine
-    weight is zero), token-index-major priority. Everything is einsum/
-    one_hot/cumsum — no gather/scatter, fully differentiable, and the
-    sharded-E einsums become all-to-alls over the `expert` mesh axis
-    under the partitioner.
+    formulation's expert FLOPs. Grouping keeps capacity — and the
+    [G, g, E, C] dispatch tensor — O(group size), not O(batch*seq): the
+    ungrouped formulation is quadratic in token count and OOMs real
+    sequence lengths. C = ceil(g*k/E * capacity factor); assignments past
+    an expert's per-group capacity drop (combine weight zero), token-
+    index-major priority; trailing pad tokens consume no capacity.
+    Everything is einsum/one_hot/cumsum — no gather/scatter, fully
+    differentiable, and the sharded-E einsums become all-to-alls over the
+    `expert` mesh axis under the partitioner.
     """
     B, T, D = x.shape
     E, k = cfg.n_experts, cfg.n_experts_per_tok
     N = B * T
-    C = min(N, int(math.ceil(N * k / E * cfg.moe_capacity_factor)))
+    g = min(cfg.moe_group_size, N)
+    G = -(-N // g)  # ceil: last group padded with dead tokens
+    Np = G * g
+    C = min(g, int(math.ceil(g * k / E * cfg.moe_capacity_factor)))
+
     xf = x.reshape(N, D)
+    valid = jnp.ones((N,), jnp.float32)
+    if Np != N:
+        xf = jnp.pad(xf, ((0, Np - N), (0, 0)))
+        valid = jnp.pad(valid, (0, Np - N))
+    xg = xf.reshape(G, g, D)
+    vg = valid.reshape(G, g)
 
-    logits = (xf @ p["router"]).astype(jnp.float32)  # [N, E]
+    logits = jnp.einsum("gnd,de->gne", xg, p["router"]).astype(jnp.float32)
     topv, topi = lax.top_k(logits, k)
-    topp = jax.nn.softmax(topv, axis=-1)  # [N, k] renormalized
+    topp = jax.nn.softmax(topv, axis=-1)  # [G, g, k] renormalized
 
-    oh = jax.nn.one_hot(topi, E, dtype=jnp.float32)  # [N, k, E]
-    ohf = oh.reshape(N * k, E)  # token-major, slot-minor priority
-    pos_all = jnp.cumsum(ohf, axis=0) - ohf  # running count per expert
+    oh = jax.nn.one_hot(topi, E, dtype=jnp.float32)  # [G, g, k, E]
+    oh = oh * vg[:, :, None, None]  # pad tokens take no capacity
+    ohf = oh.reshape(G, g * k, E)  # token-major, slot-minor priority
+    pos_all = jnp.cumsum(ohf, axis=1) - ohf  # per-group running count
     # exact small integers in f32; one_hot wants integer positions
-    pos = jnp.sum(pos_all * ohf, axis=-1).astype(jnp.int32)  # [N*k]
+    pos = jnp.sum(pos_all * ohf, axis=-1).astype(jnp.int32)  # [G, g*k]
     keep = (pos < C).astype(jnp.float32)
-    slot = jax.nn.one_hot(pos, C, dtype=jnp.float32) * keep[:, None]  # [N*k, C]
-    disp = (ohf[:, :, None] * slot[:, None, :]).reshape(N, k, E, C)
-    combine = jnp.sum(disp * topp[..., None, None], axis=1)  # [N, E, C]
-    disp_tok = jnp.sum(disp, axis=1)  # [N, E, C] 0/1
+    slot = jax.nn.one_hot(pos, C, dtype=jnp.float32) * keep[..., None]
+    disp = (ohf[..., None] * slot[:, :, None, :]).reshape(G, g, k, E, C)
+    combine = jnp.sum(disp * topp[..., None, None], axis=2)  # [G, g, E, C]
+    disp_tok = jnp.sum(disp, axis=2)  # [G, g, E, C] 0/1
 
-    xe = jnp.einsum("nec,nd->ecd", disp_tok.astype(x.dtype), xf)  # [E, C, D]
-    up = jnp.einsum("ecd,edf->ecf", xe, p["w_up"])
-    gate = jnp.einsum("ecd,edf->ecf", xe, p["w_gate"]) if "w_gate" in p else None
+    xe = jnp.einsum("gnec,gnd->gecd", disp_tok.astype(x.dtype), xg)
+    up = jnp.einsum("gecd,edf->gecf", xe, p["w_up"])
+    gate = jnp.einsum("gecd,edf->gecf", xe, p["w_gate"]) if "w_gate" in p else None
     h = _activate(up, gate, cfg)
-    ye = jnp.einsum("ecf,efd->ecd", h, p["w_down"])  # [E, C, D]
-    out = jnp.einsum("nec,ecd->nd", combine.astype(ye.dtype), ye)
-    return out.reshape(B, T, D)
+    ye = jnp.einsum("gecf,efd->gecd", h, p["w_down"])  # [G, E, C, D]
+    out = jnp.einsum("gnec,gecd->gnd", combine.astype(ye.dtype), ye)
+    return out.reshape(Np, D)[:N].reshape(B, T, D)
 
 
 def _moe(x, p, cfg: ModelConfig):

@@ -105,6 +105,45 @@ class RegistryClient:
             logger.debug("registry fetch failed: %s", e)
         return []
 
+    def _client(self):
+        """Long-lived AsyncClient for per-request paths (record_message
+        runs per generation — a fresh pool + TLS handshake each time would
+        sit on the serving hot path). Lazy; closed via aclose()."""
+        import httpx
+
+        if getattr(self, "_http", None) is None or self._http.is_closed:
+            self._http = httpx.AsyncClient(timeout=10)
+        return self._http
+
+    async def aclose(self):
+        if getattr(self, "_http", None) is not None and not self._http.is_closed:
+            await self._http.aclose()
+
+    async def record_message(self, node_id: str, tokens: int, role: str = "assistant") -> bool:
+        """Token-metrics insert into the `messages` table (the web
+        gateway's per-generation accounting — reference index.js:65-86)."""
+        if self.mode != "supabase":
+            return False
+        try:
+            r = await self._client().post(
+                f"{self.supabase_url.rstrip('/')}/rest/v1/messages",
+                json={
+                    "node_id": node_id,
+                    "content": "[metric log]",
+                    "role": role,
+                    "tokens": int(tokens),
+                },
+                headers={
+                    "apikey": self.supabase_key,
+                    "Authorization": f"Bearer {self.supabase_key}",
+                    "Content-Type": "application/json",
+                },
+            )
+            return r.status_code < 300
+        except Exception as e:
+            logger.debug("registry message write failed: %s", e)
+            return False
+
     async def sync_loop(self, node, interval_s: float = SYNC_INTERVAL_S):
         while True:
             await self.sync_node(node)

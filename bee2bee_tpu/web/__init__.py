@@ -1,0 +1,14 @@
+"""L5 web layer: the mesh-facing SaaS gateway + bridge.
+
+The reference ships this tier as Node.js (Express gateway
+/root/reference/app/api/index.js:16-216, WS bridge app/api/bridge.js:8-426,
+React SPA app/src/App.jsx) against a Supabase registry. This package is the
+same capability re-built in the framework's own stack — an aiohttp gateway
+and an asyncio bridge speaking the identical WebSocket dialect (task_id
+correlation, gen_chunk/gen_success accumulation, ping→pong, hello metadata
+capture, 5 s reconnect, 90 s timeout with partial salvage, direct-HTTP
+fast path) plus a static browser chat/register UI. Zero Node.js required.
+"""
+
+from .bridge import MeshBridge  # noqa: F401
+from .gateway import create_web_app, start_web_gateway  # noqa: F401

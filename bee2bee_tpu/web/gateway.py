@@ -1,0 +1,167 @@
+"""Web gateway: the SaaS HTTP facade over the mesh bridge.
+
+Route-for-route parity with the reference's Express gateway
+(/root/reference/app/api/index.js:16-216 — behavior studied, rebuilt on
+aiohttp):
+
+- ``POST /api/p2p/register``  — join-link registration → bridge retarget
+- ``POST /api/p2p/generate``  — streamed generation (chunked text body),
+  token metrics recorded after the stream (len/4 estimate, the
+  reference's accounting) to the in-memory counters and, when configured,
+  the Supabase registry's ``messages`` table via RegistryClient
+- ``GET|POST /api/p2p/status`` — bridge stats + known mesh peers +
+  optional direct node probe (``?node=http://host:port``)
+- ``GET|POST /api/p2p/global_metrics`` — read/accumulate token totals
+- ``GET /`` — the static browser UI (web/static/index.html): landing,
+  one-click register, chat — the React SPA's three views without a JS
+  build chain
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from pathlib import Path
+
+from aiohttp import web
+
+from .bridge import MeshBridge
+
+logger = logging.getLogger("bee2bee_tpu.web.gateway")
+
+STATIC_DIR = Path(__file__).parent / "static"
+
+
+def create_web_app(bridge: MeshBridge, registry=None) -> web.Application:
+    app = web.Application()
+    app["bridge"] = bridge
+    app["registry"] = registry
+    app["metrics"] = {"messages": 0, "tokens": 0}
+
+    async def register(request: web.Request):
+        body = await request.json()
+        link = body.get("link")
+        if not link:
+            return web.json_response({"error": "Missing join link"}, status=400)
+        try:
+            result = await bridge.register_join_link(link)
+        except Exception as e:  # noqa: BLE001 — surface as the reference does
+            return web.json_response({"error": str(e)}, status=500)
+        return web.json_response({**result, **bridge.stats(), "mode": "bee2bee-tpu"})
+
+    async def generate(request: web.Request):
+        body = await request.json()
+        task = body.get("task") or {}
+        prompt = task.get("prompt") or body.get("prompt")
+        model = task.get("model") or body.get("model") or "default"
+        target = task.get("targetNode") or body.get("targetNode")
+        if not prompt:
+            return web.json_response({"error": "Prompt is required"}, status=400)
+
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+            }
+        )
+        await resp.prepare(request)
+        loop = request.app["metrics"]
+        full: list[str] = []
+        queue: list[str] = []
+
+        def on_chunk(text: str):
+            full.append(text)
+            queue.append(text)
+
+        try:
+            result = await bridge.request(
+                {
+                    "prompt": prompt,
+                    "model": model,
+                    "max_new_tokens": body.get("max_new_tokens") or body.get("max_tokens"),
+                    "temperature": body.get("temperature"),
+                },
+                on_chunk=on_chunk,
+                target=target,
+            )
+            # flush whatever streamed plus any final remainder
+            streamed = "".join(full)
+            text = result.get("text") or streamed
+            await resp.write(streamed.encode())
+            if len(text) > len(streamed):
+                await resp.write(text[len(streamed):].encode())
+            tokens = max(1, len(text) // 4)
+            loop["messages"] += 1
+            loop["tokens"] += tokens
+            registry = request.app["registry"]
+            if registry is not None and getattr(registry, "enabled", False):
+                try:
+                    await registry.record_message(
+                        node_id=target or "GLOBAL_METRICS", tokens=tokens
+                    )
+                except Exception:  # noqa: BLE001 — metrics never break serving
+                    logger.debug("registry metrics write failed", exc_info=True)
+        except Exception as e:  # noqa: BLE001
+            await resp.write(f"\n\n[Error]: {e}".encode())
+        await resp.write_eof()
+        return resp
+
+    async def status(request: web.Request):
+        out = {
+            "bridge": bridge.stats(),
+            "mesh": [
+                {"addr": addr, **{k: v for k, v in meta.items() if k != "services"},
+                 "models": sorted(
+                     m for svc in (meta.get("services") or {}).values()
+                     for m in (svc.get("models") or [])
+                 )}
+                for addr, meta in bridge.peer_metadata.items()
+            ],
+            "metrics": request.app["metrics"],
+        }
+        node = request.query.get("node")
+        if node:  # optional direct probe of a node's own HTTP gateway
+            import aiohttp
+
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(
+                        f"{node.rstrip('/')}/", timeout=aiohttp.ClientTimeout(total=5)
+                    ) as r:
+                        out["probe"] = await r.json()
+            except Exception as e:  # noqa: BLE001
+                out["probe"] = {"error": str(e)}
+        return web.json_response(out)
+
+    async def global_metrics(request: web.Request):
+        metrics = request.app["metrics"]
+        if request.method == "POST":
+            body = await request.json()
+            metrics["tokens"] += int(body.get("tokens") or 0)
+            metrics["messages"] += 1
+        return web.json_response(
+            {**metrics, "total_requests": bridge.total_requests,
+             "bridge_tokens": bridge.total_tokens}
+        )
+
+    async def index(request: web.Request):
+        return web.FileResponse(STATIC_DIR / "index.html")
+
+    app.router.add_post("/api/p2p/register", register)
+    app.router.add_post("/api/p2p/generate", generate)
+    app.router.add_route("*", "/api/p2p/status", status)
+    app.router.add_route("*", "/api/p2p/global_metrics", global_metrics)
+    app.router.add_get("/", index)
+    return app
+
+
+async def start_web_gateway(
+    bridge: MeshBridge, host: str = "0.0.0.0", port: int = 4001, registry=None
+):
+    app = create_web_app(bridge, registry=registry)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    logger.info("web gateway on http://%s:%s", host, port)
+    return runner

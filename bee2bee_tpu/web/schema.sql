@@ -1,0 +1,74 @@
+-- Registry schema for the bee2bee-tpu web tier (Supabase/Postgres).
+-- Capability parity with the reference's SUPABASE_SCHEMA.sql (profiles,
+-- messages token accounting, node_logs telemetry, system_stats view,
+-- active_nodes mesh discovery — reference :10-101), with the security
+-- defaults the build plan prescribes (SURVEY §7 "what NOT to carry over"):
+-- the reference leaves every table writable by the anon role; here writes
+-- require authentication and active_nodes upserts are rate-scoped.
+
+create table if not exists profiles (
+  id uuid primary key default gen_random_uuid(),
+  handle text unique,
+  created_at timestamptz not null default now()
+);
+
+-- per-generation token accounting (gateway writes after each stream)
+create table if not exists messages (
+  id bigint generated always as identity primary key,
+  node_id text not null,
+  role text not null default 'assistant',
+  content text,
+  tokens integer not null default 0,
+  created_at timestamptz not null default now()
+);
+create index if not exists messages_node_created on messages (node_id, created_at);
+
+-- raw node telemetry (optional; the mesh itself carries metrics on pings)
+create table if not exists node_logs (
+  id bigint generated always as identity primary key,
+  node_id text not null,
+  metrics jsonb not null default '{}'::jsonb,
+  created_at timestamptz not null default now()
+);
+
+-- mesh discovery: one row per live node, upserted by RegistryClient
+create table if not exists active_nodes (
+  node_id text primary key,
+  address text not null,
+  region text,
+  models jsonb not null default '[]'::jsonb,
+  metrics jsonb not null default '{}'::jsonb,
+  api_port integer,
+  last_seen timestamptz not null default now()
+);
+create index if not exists active_nodes_last_seen on active_nodes (last_seen);
+
+-- aggregate view the gateway's global_metrics can read
+create or replace view system_stats as
+select
+  count(*) filter (where last_seen > now() - interval '5 minutes') as live_nodes,
+  (select coalesce(sum(tokens), 0) from messages)                  as total_tokens,
+  (select count(*) from messages)                                  as total_messages
+from active_nodes;
+
+-- RLS: reads are public (discovery must work anonymously), writes need a
+-- session — the reference's anon-writable policies (:83-96) invite
+-- registry poisoning and are deliberately NOT replicated.
+alter table profiles     enable row level security;
+alter table messages     enable row level security;
+alter table node_logs    enable row level security;
+alter table active_nodes enable row level security;
+
+create policy read_nodes    on active_nodes for select using (true);
+create policy read_stats    on messages     for select using (true);
+create policy write_nodes   on active_nodes for all
+  using (auth.role() = 'authenticated') with check (auth.role() = 'authenticated');
+create policy write_message on messages     for insert
+  with check (auth.role() = 'authenticated');
+create policy write_logs    on node_logs    for insert
+  with check (auth.role() = 'authenticated');
+
+-- stale-node pruning (run via pg_cron; the reference documents a manual
+-- DELETE with a 1 h window, :99-101)
+-- select cron.schedule('prune-nodes', '*/15 * * * *',
+--   $$delete from active_nodes where last_seen < now() - interval '1 hour'$$);

@@ -178,3 +178,17 @@ async def test_status_schema():
         st = a.status()
         for key in ("peer_id", "addr", "uptime_s", "peers", "local_services", "metrics"):
             assert key in st
+
+
+def test_parse_dht_bootstrap():
+    from bee2bee_tpu.meshnet.runtime import _parse_dht_bootstrap
+
+    assert _parse_dht_bootstrap("") == []
+    assert _parse_dht_bootstrap("10.0.0.5:9000, dht.example.com") == [
+        ("10.0.0.5", 9000), ("dht.example.com", 8468),
+    ]
+    assert _parse_dht_bootstrap("2001:db8::5") == [("2001:db8::5", 8468)]
+    assert _parse_dht_bootstrap("[2001:db8::5]:9000") == [("2001:db8::5", 9000)]
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="invalid port"):
+        _parse_dht_bootstrap("10.0.0.5:84O8")

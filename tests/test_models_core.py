@@ -213,3 +213,30 @@ def test_routed_moe_is_differentiable():
     assert all(np.isfinite(g) for g in gnorms)
     wup_g = grads["layers"]["moe"]["w_up"]
     assert float(jnp.abs(wup_g).sum()) > 0  # experts actually received grads
+
+
+def test_routed_moe_groups_match_ungrouped_at_full_capacity():
+    """Grouped dispatch with per-group full capacity still equals dense;
+    a group size that forces padding (g=5 over N=24) must not change
+    valid-token outputs."""
+    from bee2bee_tpu.models.config import get_config
+
+    dense_cfg = get_config("tiny-mixtral")
+    routed = get_config(
+        "tiny-mixtral", moe_impl="routed",
+        moe_capacity_factor=float(dense_cfg.n_experts), moe_group_size=5,
+    )
+    params = core.init_params(dense_cfg, jax.random.key(0), dtype=jnp.float32)
+    ids = jnp.asarray(
+        np.random.default_rng(4).integers(3, dense_cfg.vocab_size, (2, 12)), jnp.int32
+    )
+    want, _ = core.forward(params, dense_cfg, ids, None, jnp.int32(0))
+    got, _ = core.forward(params, routed, ids, None, jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_moe_impl_validated():
+    from bee2bee_tpu.models.config import get_config
+
+    with pytest.raises(ValueError, match="moe_impl"):
+        get_config("tiny-mixtral", moe_impl="Routed")
