@@ -38,7 +38,7 @@ logger = logging.getLogger("bee2bee_tpu.web.bridge")
 
 RECONNECT_S = 5.0
 REQUEST_TIMEOUT_S = 90.0
-MAX_FRAME = 32 * 1024 * 1024
+MAX_FRAME = protocol.MAX_FRAME  # one constant governs both ends
 
 
 class MeshBridge:
@@ -86,19 +86,25 @@ class MeshBridge:
             s for s in self.seeds if s != self.registered_node
         ]
         for url in candidates:
+            ws = None
             try:
                 ws = await asyncio.wait_for(
                     websockets.connect(url, max_size=MAX_FRAME), timeout=10
                 )
-            except Exception as e:  # noqa: BLE001 — try the next candidate
+                # announce ourselves so the node says hello back with metadata
+                await ws.send(protocol.encode(
+                    protocol.msg(protocol.HELLO, peer_id=new_id("bridge"),
+                                 region=self.region, services={})
+                ))
+            except Exception as e:  # noqa: BLE001 — try the next candidate;
+                # a half-open socket must not become active_ws (it would wedge
+                # every later request with no reader and no reconnect)
                 logger.debug("bridge dial %s failed: %s", url, e)
+                if ws is not None:
+                    with contextlib.suppress(Exception):
+                        await ws.close()
                 continue
             self.active_ws, self.active_url = ws, url
-            # announce ourselves so the node says hello back with metadata
-            await ws.send(protocol.encode(
-                protocol.msg(protocol.HELLO, peer_id=new_id("bridge"),
-                             region=self.region, services={})
-            ))
             if self._reader_task:
                 self._reader_task.cancel()
             self._reader_task = asyncio.create_task(self._reader(ws))
@@ -181,8 +187,12 @@ class MeshBridge:
                 )
             return
         if mtype == "ping":
+            # echo ts: the node's pong handler only refreshes rtt/health
+            # when the timestamp comes back (meshnet/node.py _handle_pong)
             with contextlib.suppress(Exception):
-                await ws.send(protocol.encode(protocol.msg(protocol.PONG)))
+                await ws.send(protocol.encode(
+                    protocol.msg(protocol.PONG, ts=msg.get("ts"))
+                ))
 
     # ------------------------------------------------------------ requests
 
@@ -272,15 +282,11 @@ class MeshBridge:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         req = {"fut": fut, "chunks": [], "on_chunk": on_chunk, "start": time.time()}
         self.pending[task_id] = req
-        await self.active_ws.send(protocol.encode({
-            "type": protocol.GEN_REQUEST,
-            "task_id": task_id,
-            "model": payload.get("model"),
-            "prompt": payload.get("prompt"),
-            "max_new_tokens": payload.get("max_new_tokens") or payload.get("max_tokens"),
-            "temperature": payload.get("temperature"),
-            "stream": True,
-        }))
+        try:
+            await self._send_gen_request(task_id, payload)
+        except Exception:
+            self.pending.pop(task_id, None)  # never leak the entry
+            raise
         try:
             result = await asyncio.wait_for(fut, timeout=timeout)
         except asyncio.TimeoutError:
@@ -291,6 +297,17 @@ class MeshBridge:
                 raise TimeoutError("node timeout: no output before deadline")
         self.total_tokens += max(1, len(result["text"]) // 4)
         return result
+
+    async def _send_gen_request(self, task_id: str, payload: dict):
+        await self.active_ws.send(protocol.encode({
+            "type": protocol.GEN_REQUEST,
+            "task_id": task_id,
+            "model": payload.get("model"),
+            "prompt": payload.get("prompt"),
+            "max_new_tokens": payload.get("max_new_tokens") or payload.get("max_tokens"),
+            "temperature": payload.get("temperature"),
+            "stream": True,
+        }))
 
     # ------------------------------------------------------------ status
 

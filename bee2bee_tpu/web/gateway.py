@@ -19,7 +19,7 @@ aiohttp):
 
 from __future__ import annotations
 
-import json
+import asyncio
 import logging
 from pathlib import Path
 
@@ -66,29 +66,46 @@ def create_web_app(bridge: MeshBridge, registry=None) -> web.Application:
         )
         await resp.prepare(request)
         loop = request.app["metrics"]
-        full: list[str] = []
-        queue: list[str] = []
+        chunk_q: asyncio.Queue = asyncio.Queue()
 
+        # on_chunk fires on this same event loop (bridge reader / direct
+        # HTTP stream), so put_nowait is safe; the pump below forwards each
+        # chunk to the client AS IT ARRIVES — real streaming, not buffer-
+        # then-flush
         def on_chunk(text: str):
-            full.append(text)
-            queue.append(text)
+            chunk_q.put_nowait(text)
 
+        req_task = asyncio.create_task(bridge.request(
+            {
+                "prompt": prompt,
+                "model": model,
+                "max_new_tokens": body.get("max_new_tokens") or body.get("max_tokens"),
+                "temperature": body.get("temperature"),
+            },
+            on_chunk=on_chunk,
+            target=target,
+        ))
+        streamed = ""
         try:
-            result = await bridge.request(
-                {
-                    "prompt": prompt,
-                    "model": model,
-                    "max_new_tokens": body.get("max_new_tokens") or body.get("max_tokens"),
-                    "temperature": body.get("temperature"),
-                },
-                on_chunk=on_chunk,
-                target=target,
-            )
-            # flush whatever streamed plus any final remainder
-            streamed = "".join(full)
+            while True:
+                getter = asyncio.create_task(chunk_q.get())
+                done, _ = await asyncio.wait(
+                    {getter, req_task}, return_when=asyncio.FIRST_COMPLETED
+                )
+                if getter in done:
+                    piece = getter.result()
+                    streamed += piece
+                    await resp.write(piece.encode())
+                    continue
+                getter.cancel()
+                break
+            result = await req_task
+            while not chunk_q.empty():  # chunks queued after completion
+                piece = chunk_q.get_nowait()
+                streamed += piece
+                await resp.write(piece.encode())
             text = result.get("text") or streamed
-            await resp.write(streamed.encode())
-            if len(text) > len(streamed):
+            if len(text) > len(streamed):  # non-streamed remainder
                 await resp.write(text[len(streamed):].encode())
             tokens = max(1, len(text) // 4)
             loop["messages"] += 1
@@ -102,6 +119,7 @@ def create_web_app(bridge: MeshBridge, registry=None) -> web.Application:
                 except Exception:  # noqa: BLE001 — metrics never break serving
                     logger.debug("registry metrics write failed", exc_info=True)
         except Exception as e:  # noqa: BLE001
+            req_task.cancel()
             await resp.write(f"\n\n[Error]: {e}".encode())
         await resp.write_eof()
         return resp

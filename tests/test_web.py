@@ -196,3 +196,52 @@ async def test_gateway_serves_ui():
             assert "bee2bee-tpu" in html and "/api/p2p/generate" in html
     finally:
         await bridge.stop()
+
+
+async def test_gateway_streams_incrementally():
+    """Chunks must reach the HTTP client AS generated, not buffered until
+    the request resolves (code-review finding: the first gateway version
+    flushed everything at completion)."""
+    import time as _time
+
+    from bee2bee_tpu.services.base import BaseService
+
+    class SlowService(BaseService):
+        def __init__(self):
+            super().__init__("slow")
+
+        def get_metadata(self):
+            return {"models": ["slow-model"], "price_per_token": 0.0}
+
+        def execute(self, params):
+            return {"text": "abc", "tokens": 3}
+
+        def execute_stream(self, params):
+            for piece in ("first|", "second|", "third"):
+                yield self.stream_line({"text": piece})
+                _time.sleep(0.4)
+            yield self.stream_line({"done": True})
+
+    node = P2PNode(host="127.0.0.1", port=0)
+    await node.start()
+    node.add_service(SlowService())
+    try:
+        async with bridge_for(node) as bridge:
+            await _settle(lambda: bridge.active_ws is not None)
+            async with gateway_client(bridge) as client:
+                resp = await client.post(
+                    "/api/p2p/generate",
+                    json={"prompt": "slow", "model": "slow-model"},
+                )
+                arrivals = []
+                t0 = _time.monotonic()
+                async for chunk in resp.content.iter_any():
+                    if chunk:
+                        arrivals.append((_time.monotonic() - t0, chunk.decode()))
+                text = "".join(c for _, c in arrivals)
+                assert "first|" in text and "third" in text
+                # the first piece must land well before the last (~0.8s gap)
+                assert len(arrivals) >= 2, arrivals
+                assert arrivals[-1][0] - arrivals[0][0] > 0.3, arrivals
+    finally:
+        await node.stop()
