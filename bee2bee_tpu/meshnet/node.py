@@ -36,13 +36,28 @@ from .. import protocol
 from ..joinlink import generate_join_link, parse_join_link
 from ..pieces import ShardManifest
 from ..tracing import get_tracer
-from ..utils import MetricsAggregator, get_lan_ip, get_system_metrics, new_id, sha256_hex
+from ..utils import (
+    MetricsAggregator,
+    get_lan_ip,
+    get_system_metrics,
+    new_id,
+    pump_queue_until,
+    sha256_hex,
+)
 from .pipeline import StageTaskMixin
 
 logger = logging.getLogger("bee2bee_tpu.mesh")
 
 REQUEST_TIMEOUT_S = 300.0  # reference p2p_runtime.py:831
 PING_INTERVAL_S = 15.0
+# dial-side redial of lost peers. The reference reconnects its worker every
+# 2 s forever (node.py:286-289) and its JS bridge every 5 s (bridge.js:83-95);
+# here: exponential backoff from 2 s capped at 30 s, giving up after 5 min for
+# ordinary peers (a departed peer is not coming back) while bootstrap addrs
+# retry forever (losing the bootstrap strands the node outside the mesh).
+RECONNECT_INITIAL_S = 2.0
+RECONNECT_MAX_S = 30.0
+RECONNECT_WINDOW_S = 300.0
 
 
 class P2PNode(StageTaskMixin):
@@ -84,6 +99,41 @@ class P2PNode(StageTaskMixin):
         self._tasks: list[asyncio.Task] = []
         self._stopped = False
         self.started_at: float | None = None
+
+        # auto-reconnect state (dial side only: the listener side of a lost
+        # connection waits for the dialer to come back, so exactly one end
+        # redials). Attributes, not module constants, so tests can shrink
+        # the backoff without monkeypatching the module.
+        self.reconnect_enabled = True
+        self.reconnect_initial_s = RECONNECT_INITIAL_S
+        self.reconnect_max_s = RECONNECT_MAX_S
+        self.reconnect_window_s = RECONNECT_WINDOW_S
+        self._dial_addr_by_ws: dict[Any, str] = {}  # outbound ws -> addr dialed
+        # scheme-less host:port — the wss→ws fallback changes the scheme of
+        # the addr actually dialed, and a bootstrap peer must keep its
+        # retry-forever status across that downgrade
+        self._bootstrap_addrs: set[str] = set()
+        # addr -> goodbye time. Entries expire after reconnect_window_s:
+        # suppression only needs to outlive any redial loop for that addr,
+        # and an unbounded set would leak on a churny public mesh
+        self._departed: dict[str, float] = {}
+        self._reconnecting: set[str] = set()
+
+    @staticmethod
+    def _addr_key(addr: str) -> str:
+        return addr.split("://", 1)[-1]
+
+    def _mark_departed(self, addr: str) -> None:
+        now = time.time()
+        self._departed = {
+            a: t for a, t in self._departed.items()
+            if now - t < self.reconnect_window_s
+        }
+        self._departed[addr] = now
+
+    def _is_departed(self, addr: str) -> bool:
+        t = self._departed.get(addr)
+        return t is not None and time.time() - t < self.reconnect_window_s
 
     def _spawn(self, coro) -> asyncio.Task:
         """Track a background task, self-pruning on completion (a churny
@@ -154,6 +204,8 @@ class P2PNode(StageTaskMixin):
         """Inbound connection: read messages until close."""
         try:
             await self._reader(ws)
+        except (websockets.exceptions.ConnectionClosed, OSError):
+            pass  # unclean peer death is normal mesh weather
         finally:
             await self._drop_peer(ws)
 
@@ -171,11 +223,25 @@ class P2PNode(StageTaskMixin):
                 return await self._connect_peer("ws://" + addr[6:])
             logger.warning("connect %s failed: %s", addr, e)
             return False
-        await self._send(ws, self._hello_msg())
+        self._dial_addr_by_ws[ws] = addr
+        self._departed.pop(addr, None)  # fresh dial resets a past goodbye
+        try:
+            await self._send(ws, self._hello_msg())
+        except Exception as e:
+            # peer accepted the socket but died before hello (mid-shutdown):
+            # treat as a failed dial, not a raise — _reconnect_loop must see
+            # False and keep backing off, and the dial record must not leak
+            self._dial_addr_by_ws.pop(ws, None)
+            with contextlib.suppress(Exception):
+                await ws.close()
+            logger.warning("hello to %s failed: %s", addr, e)
+            return False
 
         async def run_reader():
             try:
                 await self._reader(ws)
+            except (websockets.exceptions.ConnectionClosed, OSError):
+                pass  # unclean drop: _drop_peer schedules the redial
             finally:
                 await self._drop_peer(ws)
 
@@ -188,9 +254,13 @@ class P2PNode(StageTaskMixin):
             info = parse_join_link(link_or_addr)
             for addr in info["bootstrap_addrs"]:
                 if await self._connect_peer(addr):
+                    self._bootstrap_addrs.add(self._addr_key(addr))
                     return True
             return False
-        return await self._connect_peer(link_or_addr)
+        if await self._connect_peer(link_or_addr):
+            self._bootstrap_addrs.add(self._addr_key(link_or_addr))
+            return True
+        return False
 
     async def _reader(self, ws):
         async for raw in ws:
@@ -216,6 +286,45 @@ class P2PNode(StageTaskMixin):
                 self.providers.pop(pid, None)
         for pid in dead:
             logger.info("peer %s disconnected", pid)
+        # we dialed this connection: redial unless the peer said goodbye
+        # (or we are shutting down). Inbound connections are the remote
+        # dialer's job to restore.
+        dial_addr = self._dial_addr_by_ws.pop(ws, None)
+        if (
+            dial_addr
+            and self.reconnect_enabled
+            and not self._stopped
+            and not self._is_departed(dial_addr)
+            and dial_addr not in self._reconnecting
+        ):
+            self._spawn(self._reconnect_loop(dial_addr))
+
+    async def _reconnect_loop(self, addr: str):
+        """Redial `addr` with exponential backoff. Bootstrap addrs retry
+        until stop(); ordinary peers give up after reconnect_window_s."""
+        if addr in self._reconnecting:
+            return
+        self._reconnecting.add(addr)
+        try:
+            delay = self.reconnect_initial_s
+            deadline = (
+                None
+                if self._addr_key(addr) in self._bootstrap_addrs
+                else time.time() + self.reconnect_window_s
+            )
+            while not self._stopped:
+                await asyncio.sleep(delay)
+                if self._stopped or self._is_departed(addr):
+                    return
+                if await self._connect_peer(addr):
+                    logger.info("reconnected to %s", addr)
+                    return
+                if deadline is not None and time.time() >= deadline:
+                    logger.info("giving up reconnecting to %s", addr)
+                    return
+                delay = min(delay * 2, self.reconnect_max_s)
+        finally:
+            self._reconnecting.discard(addr)
 
     # ------------------------------------------------------------ sending
 
@@ -337,6 +446,13 @@ class P2PNode(StageTaskMixin):
                 self.providers.setdefault(pid, {})[svc] = meta
 
     async def _handle_goodbye(self, ws, data):
+        # clean departure: suppress the redial loop for this address —
+        # EXCEPT for bootstrap addrs, whose goodbye is normally a graceful
+        # restart (stop() sends GOODBYE): losing the bootstrap forever on
+        # every deploy would strand the node outside the mesh
+        addr = self._dial_addr_by_ws.get(ws)
+        if addr and self._addr_key(addr) not in self._bootstrap_addrs:
+            self._mark_departed(addr)
         await self._drop_peer(ws)
 
     async def _peer_for(self, ws) -> str | None:
@@ -539,33 +655,24 @@ class P2PNode(StageTaskMixin):
                     task = asyncio.create_task(
                         self._execute_local(svc, params, True, on_chunk)
                     )
-                    while True:
-                        getter = asyncio.create_task(send_q.get())
-                        done, _ = await asyncio.wait(
-                            {getter, task}, return_when=asyncio.FIRST_COMPLETED
-                        )
-                        if getter in done:
-                            await self._send(
-                                ws, protocol.msg(protocol.GEN_CHUNK, rid=rid, text=getter.result())
-                            )
-                            continue
-                        getter.cancel()
-                        result = await task
-                        # drain anything queued after task finished
-                        while not send_q.empty():
-                            await self._send(
-                                ws,
-                                protocol.msg(protocol.GEN_CHUNK, rid=rid, text=send_q.get_nowait()),
-                            )
-                        break
+                    result = await pump_queue_until(
+                        task,
+                        send_q,
+                        lambda text: self._send(
+                            ws, protocol.msg(protocol.GEN_CHUNK, rid=rid, text=text)
+                        ),
+                    )
                     await self._send(ws, protocol.msg(protocol.GEN_SUCCESS, rid=rid, **result))
                 else:
                     result = await self._execute_local(svc, params, False, None)
                     await self._send(ws, protocol.msg(protocol.GEN_SUCCESS, rid=rid, **result))
             except Exception as e:
-                await self._send(
-                    ws, protocol.msg(protocol.GEN_ERROR, rid=rid, error=f"local_error: {e}")
-                )
+                # the peer may be the reason we failed (died mid-stream):
+                # best-effort error reply, no second exception
+                with contextlib.suppress(Exception):
+                    await self._send(
+                        ws, protocol.msg(protocol.GEN_ERROR, rid=rid, error=f"local_error: {e}")
+                    )
             return
         # swarm relay: one extra hop through another provider
         # (reference p2p_runtime.py:634-655)

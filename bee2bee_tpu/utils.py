@@ -11,6 +11,8 @@ instead of nvidia-smi.
 
 from __future__ import annotations
 
+import asyncio
+import contextlib
 import hashlib
 import json
 import os
@@ -214,3 +216,47 @@ def get_system_metrics(throughput: MetricsAggregator | None = None) -> dict:
         "accelerator": accel,
         "timestamp": now_ms(),
     }
+
+
+async def pump_queue_until(task, q, emit):
+    """Forward queued items through `emit` (awaited per item) until `task`
+    completes, then drain anything queued after completion. Returns the
+    task's result (re-raising its exception).
+
+    The cancellation-sensitive streaming pump shared by the mesh node's
+    GEN_CHUNK forwarding and the web gateway's HTTP chunk relay: cancelling
+    a waiting `q.get()` is safe because put_nowait appends to the queue's
+    internal deque, so items survive for the post-completion drain.
+
+    When `emit` raises (consumer hung up mid-stream), the producer task is
+    cancelled and its outcome consumed — the generation must not keep
+    running to its token budget for nobody, and its eventual exception
+    must not surface as "Task exception was never retrieved". (Work a
+    producer already handed to an executor thread finishes in that thread;
+    cancellation stops everything scheduled after it.)
+    """
+    getter = None
+    try:
+        while True:
+            getter = asyncio.create_task(q.get())
+            done, _ = await asyncio.wait(
+                {getter, task}, return_when=asyncio.FIRST_COMPLETED
+            )
+            if getter in done:
+                await emit(getter.result())
+                continue
+            getter.cancel()
+            break
+        result = await task
+        while not q.empty():
+            await emit(q.get_nowait())
+        return result
+    except BaseException:
+        # also reached when the pump itself is cancelled (client hung up):
+        # neither the producer nor a pending q.get() may be left dangling
+        if getter is not None and not getter.done():
+            getter.cancel()
+        task.cancel()
+        with contextlib.suppress(BaseException):
+            await task
+        raise

@@ -290,11 +290,15 @@ class MeshBridge:
         try:
             result = await asyncio.wait_for(fut, timeout=timeout)
         except asyncio.TimeoutError:
-            self.pending.pop(task_id, None)
             if req["chunks"]:  # partial salvage (bridge.js:333-344)
                 result = {"text": "".join(req["chunks"]), "rid": task_id, "partial": True}
             else:
                 raise TimeoutError("node timeout: no output before deadline")
+        finally:
+            # also covers cancellation (the gateway cancels this coroutine
+            # when the browser hangs up): the entry must never outlive the
+            # request, or pending grows forever under client churn
+            self.pending.pop(task_id, None)
         self.total_tokens += max(1, len(result["text"]) // 4)
         return result
 

@@ -25,6 +25,7 @@ from pathlib import Path
 
 from aiohttp import web
 
+from ..utils import pump_queue_until
 from .bridge import MeshBridge
 
 logger = logging.getLogger("bee2bee_tpu.web.gateway")
@@ -86,24 +87,14 @@ def create_web_app(bridge: MeshBridge, registry=None) -> web.Application:
             target=target,
         ))
         streamed = ""
+
+        async def emit(piece: str):
+            nonlocal streamed
+            streamed += piece
+            await resp.write(piece.encode())
+
         try:
-            while True:
-                getter = asyncio.create_task(chunk_q.get())
-                done, _ = await asyncio.wait(
-                    {getter, req_task}, return_when=asyncio.FIRST_COMPLETED
-                )
-                if getter in done:
-                    piece = getter.result()
-                    streamed += piece
-                    await resp.write(piece.encode())
-                    continue
-                getter.cancel()
-                break
-            result = await req_task
-            while not chunk_q.empty():  # chunks queued after completion
-                piece = chunk_q.get_nowait()
-                streamed += piece
-                await resp.write(piece.encode())
+            result = await pump_queue_until(req_task, chunk_q, emit)
             text = result.get("text") or streamed
             if len(text) > len(streamed):  # non-streamed remainder
                 await resp.write(text[len(streamed):].encode())
@@ -118,8 +109,8 @@ def create_web_app(bridge: MeshBridge, registry=None) -> web.Application:
                     )
                 except Exception:  # noqa: BLE001 — metrics never break serving
                     logger.debug("registry metrics write failed", exc_info=True)
-        except Exception as e:  # noqa: BLE001
-            req_task.cancel()
+        except Exception as e:  # noqa: BLE001 — pump_queue_until already
+            # cancelled and consumed req_task on any failure
             await resp.write(f"\n\n[Error]: {e}".encode())
         await resp.write_eof()
         return resp

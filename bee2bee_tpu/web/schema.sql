@@ -1,13 +1,16 @@
 -- Registry schema for the bee2bee-tpu web tier (Supabase/Postgres).
 -- Capability parity with the reference's SUPABASE_SCHEMA.sql (profiles,
 -- messages token accounting, node_logs telemetry, system_stats view,
--- active_nodes mesh discovery — reference :10-101), with the security
--- defaults the build plan prescribes (SURVEY §7 "what NOT to carry over"):
--- the reference leaves every table writable by the anon role; here writes
--- require authentication and active_nodes upserts are rate-scoped.
+-- active_nodes mesh discovery — reference :10-101), tightened where the
+-- build plan prescribes (SURVEY §7 "what NOT to carry over"): profile
+-- writes require a session and messages/node_logs are insert-only, unlike
+-- the reference's blanket-open policies (:83-96). active_nodes stays
+-- anon-writable — see the RLS note below for why, and its cost.
 
 create table if not exists profiles (
-  id uuid primary key default gen_random_uuid(),
+  -- id mirrors auth.users.id (Supabase convention) — own_profile RLS
+  -- below compares it to auth.uid(), so the default must match
+  id uuid primary key default auth.uid(),
   handle text unique,
   created_at timestamptz not null default now()
 );
@@ -55,10 +58,17 @@ from active_nodes;
 -- writes (active_nodes upserts, messages/node_logs inserts) are open to
 -- the anon role because that is the credential RegistryClient ships with
 -- (nodes register with SUPABASE_ANON_KEY — same operational model as the
--- reference). Unlike the reference (:83-96), UPDATES/DELETES outside the
--- upsert path and all profile writes require a session, and a private
--- mesh can harden further by swapping the three anon policies for
--- service-role checks (RegistryClient then gets the service key).
+-- reference). BE AWARE what that means: the refresh_nodes policy below
+-- necessarily permits anon UPDATE of ANY active_nodes row (RLS cannot
+-- scope a policy to "the upsert conflict path only"), so any holder of
+-- the anon key can rewrite another node's advertised address — the same
+-- registry-poisoning exposure the reference has. The rendezvous registry
+-- is a discovery hint, not an authority: nodes verify peers by the mesh
+-- handshake, and piece payloads are content-hash verified regardless of
+-- who advertised them. A private mesh removes the exposure by swapping
+-- upsert_nodes/refresh_nodes for service-role checks (RegistryClient
+-- then ships the service key). Tightened vs the reference (:83-96):
+-- messages/node_logs are insert-only and profile writes need a session.
 alter table profiles     enable row level security;
 alter table messages     enable row level security;
 alter table node_logs    enable row level security;
@@ -71,8 +81,10 @@ create policy refresh_nodes on active_nodes for update
   using (true) with check (true);  -- upsert's conflict path
 create policy write_message on messages     for insert with check (true);
 create policy write_logs    on node_logs    for insert with check (true);
+-- profiles.id follows the Supabase convention of mirroring auth.users.id,
+-- so ownership is the id itself — a session can only touch its own row
 create policy own_profile   on profiles     for all
-  using (auth.role() = 'authenticated') with check (auth.role() = 'authenticated');
+  using (auth.uid() = id) with check (auth.uid() = id);
 
 -- stale-node pruning (run via pg_cron; the reference documents a manual
 -- DELETE with a 1 h window, :99-101)

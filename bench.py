@@ -54,16 +54,18 @@ def ensure_live_backend() -> None:
     hanging the whole bench)."""
     if os.environ.get("_BEE2BEE_BENCH_PROBED") == "1":
         return
-    env = dict(os.environ, _BEE2BEE_BENCH_PROBED="1")
+    os.environ["_BEE2BEE_BENCH_PROBED"] = "1"
     try:
         subprocess.run(
             [sys.executable, "-c", "import jax; jax.devices()"],
             timeout=150, capture_output=True, check=True,
         )
+        return  # healthy accelerator: carry on in this process
     except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as e:
         log(f"accelerator probe failed ({type(e).__name__}); benching on CPU")
-        env["JAX_PLATFORMS"] = "cpu"
-        env.pop("PALLAS_AXON_POOL_IPS", None)
+    # the platform choice must land before jax is imported: re-exec
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     os.execvpe(sys.executable, [sys.executable, *sys.argv], env)
 
 
@@ -114,38 +116,42 @@ def bench_model(name: str, max_seq_len: int, concurrencies=(1, 8),
             cache_dtype=dtype,
         ),
     )
-    n_params = eng.info["n_params"]
-    platform = jax.devices()[0].platform
-    rng_prompts = [
-        [1 + (i * 37 + j) % 500 for j in range(PROMPT_LEN)] for i in range(16)
-    ]
-    log(f"{name}: warmup (compile) on {platform}...")
-    eng.generate(rng_prompts[0], max_new_tokens=new_tokens, temperature=0.0)
+    try:
+        n_params = eng.info["n_params"]
+        platform = jax.devices()[0].platform
+        rng_prompts = [
+            [1 + (i * 37 + j) % 500 for j in range(PROMPT_LEN)] for i in range(16)
+        ]
+        log(f"{name}: warmup (compile) on {platform}...")
+        eng.generate(rng_prompts[0], max_new_tokens=new_tokens, temperature=0.0)
 
-    out: dict = {"n_params": n_params, "platform": platform}
-    for c in concurrencies:
-        best = None
-        for _ in range(2):
-            r = _bench_concurrency(eng, rng_prompts[:c], new_tokens)
-            if best is None or r["tok_per_s"] > best["tok_per_s"]:
-                best = r
-        out[f"batch{c}"] = best
-        log(f"{name} concurrency {c}: {best['tok_per_s']} tok/s "
-            f"(p50 {best['p50_latency_s']}s)")
+        out: dict = {"n_params": n_params, "platform": platform}
+        for c in concurrencies:
+            best = None
+            for _ in range(2):
+                r = _bench_concurrency(eng, rng_prompts[:c], new_tokens)
+                if best is None or r["tok_per_s"] > best["tok_per_s"]:
+                    best = r
+            out[f"batch{c}"] = best
+            log(f"{name} concurrency {c}: {best['tok_per_s']} tok/s "
+                f"(p50 {best['p50_latency_s']}s)")
 
-    # p50 over short interactive requests at the headline concurrency
-    short = _bench_concurrency(
-        eng, rng_prompts[:P50_REQUESTS],
-        P50_NEW_TOKENS if platform == "tpu" else 16,
-    )
-    out["p50_latency_s_short"] = short["p50_latency_s"]
+        # p50 over short interactive requests at the headline concurrency
+        short = _bench_concurrency(
+            eng, rng_prompts[:P50_REQUESTS],
+            P50_NEW_TOKENS if platform == "tpu" else 16,
+        )
+        out["p50_latency_s_short"] = short["p50_latency_s"]
 
-    peak = V5E_PEAK_BF16 if platform == "tpu" else None
-    if peak:
-        headline = out[f"batch{max(concurrencies)}"]["tok_per_s"]
-        out["mfu"] = round(2 * n_params * headline / peak, 5)
-    eng.close()
-    return out
+        peak = V5E_PEAK_BF16 if platform == "tpu" else None
+        if peak:
+            headline = out[f"batch{max(concurrencies)}"]["tok_per_s"]
+            out["mfu"] = round(2 * n_params * headline / peak, 5)
+        return out
+    finally:
+        # a failed rung (e.g. OOM at high concurrency) is caught by main —
+        # the engine's HBM + scheduler thread must not outlive the attempt
+        eng.close()
 
 
 def bench_reference_path() -> float:

@@ -146,6 +146,91 @@ async def test_piece_not_found():
             await b.request_piece(a.peer_id, "0" * 64)
 
 
+async def test_auto_reconnect_after_unclean_drop():
+    """Dialer redials a peer lost without GOODBYE (reference node.py:286-289
+    reconnect loop / bridge.js:83-95)."""
+    async with mesh(2) as (a, b):
+        b.reconnect_initial_s = 0.1
+        await b.connect_bootstrap(a.addr)
+        await _settle(lambda: a.peers and b.peers)
+        # unclean drop: the listener side closes without saying goodbye
+        await a.peers[b.peer_id]["ws"].close()
+        await _settle(lambda: not b.peers, timeout=2.0)
+        assert await _settle(lambda: b.peers and a.peers, timeout=5.0), (
+            "dialer should redial after an unclean drop"
+        )
+
+
+async def test_no_reconnect_after_goodbye():
+    """An ordinary (non-bootstrap) peer's clean GOODBYE must not trigger
+    redial — the peer chose to leave. (Bootstrap goodbyes DO redial: see
+    test_bootstrap_redialed_after_clean_restart.)"""
+    a = P2PNode(host="127.0.0.1", port=0)
+    b = P2PNode(host="127.0.0.1", port=0)
+    await a.start()
+    await b.start()
+    try:
+        b.reconnect_initial_s = 0.05
+        assert await b._connect_peer(a.addr)  # dialed, NOT bootstrap
+        await _settle(lambda: a.peers and b.peers)
+        addr = a.addr
+        await a.stop()  # sends GOODBYE to b
+        await _settle(lambda: not b.peers)
+        await asyncio.sleep(0.3)
+        assert addr in b._departed
+        assert not b._reconnecting, "goodbye peer must not be redialed"
+    finally:
+        await b.stop()
+
+
+async def test_reconnect_gives_up_for_ordinary_peers():
+    """Non-bootstrap peers stop being redialed after reconnect_window_s."""
+    async with mesh(2) as (a, b):
+        b.reconnect_initial_s = 0.05
+        b.reconnect_max_s = 0.05
+        b.reconnect_window_s = 0.2
+        # make the dialed addr a non-bootstrap peer connection
+        assert await b._connect_peer(a.addr)
+        await _settle(lambda: a.peers and b.peers)
+        listener = a._server
+        # closes the listener AND its established connections: b sees an
+        # unclean drop and every redial hits a dead port
+        listener.close()
+        await listener.wait_closed()
+        await _settle(lambda: not b.peers, timeout=2.0)
+        assert await _settle(lambda: not b._reconnecting, timeout=5.0), (
+            "redial loop should give up after the window"
+        )
+        assert not b.peers
+
+
+async def test_bootstrap_redialed_after_clean_restart():
+    """A bootstrap peer's graceful restart (GOODBYE) must still be redialed
+    — only ordinary peers' goodbyes suppress reconnection."""
+    a = P2PNode(host="127.0.0.1", port=0)
+    await a.start()
+    port = a.port
+    b = P2PNode(host="127.0.0.1", port=0)
+    await b.start()
+    b.reconnect_initial_s = 0.1
+    b.reconnect_max_s = 0.2
+    a2 = None
+    try:
+        await b.connect_bootstrap(a.addr)
+        await _settle(lambda: a.peers and b.peers)
+        await a.stop()  # graceful: sends GOODBYE
+        await _settle(lambda: not b.peers)
+        a2 = P2PNode(host="127.0.0.1", port=port)  # restart on the same addr
+        await a2.start()
+        assert await _settle(lambda: b.peers and a2.peers, timeout=5.0), (
+            "bootstrap not redialed after clean restart"
+        )
+    finally:
+        if a2 is not None:
+            await a2.stop()
+        await b.stop()
+
+
 async def test_disconnect_cleans_peer_table():
     a = P2PNode(host="127.0.0.1", port=0)
     b = P2PNode(host="127.0.0.1", port=0)

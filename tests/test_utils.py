@@ -70,3 +70,53 @@ def test_throughput_not_underreported_on_fresh_window():
     m.record(new_tokens=600, latency_s=0.5)
     # a single 600-token/0.5s generation should read ~1200 tok/s, not 10
     assert m.snapshot()["tokens_per_sec"] > 1000
+
+
+async def test_pump_queue_until_forwards_then_drains():
+    import asyncio
+
+    q: asyncio.Queue = asyncio.Queue()
+
+    async def producer():
+        q.put_nowait("a")
+        await asyncio.sleep(0.01)
+        q.put_nowait("b")
+        q.put_nowait("c")  # lands right before completion: post-drain path
+        return {"n": 3}
+
+    got = []
+
+    async def emit(x):
+        got.append(x)
+
+    result = await utils.pump_queue_until(asyncio.create_task(producer()), q, emit)
+    assert result == {"n": 3}
+    assert got == ["a", "b", "c"]
+
+
+async def test_pump_queue_until_cancels_producer_on_emit_failure():
+    """Consumer hangs up mid-stream: the producer task must be cancelled,
+    not left generating to its budget for nobody."""
+    import asyncio
+
+    q: asyncio.Queue = asyncio.Queue()
+    cancelled = asyncio.Event()
+
+    async def producer():
+        try:
+            q.put_nowait("chunk")
+            await asyncio.sleep(30)
+        except asyncio.CancelledError:
+            cancelled.set()
+            raise
+
+    task = asyncio.create_task(producer())
+
+    async def emit(_):
+        raise RuntimeError("consumer gone")
+
+    import pytest
+
+    with pytest.raises(RuntimeError, match="consumer gone"):
+        await utils.pump_queue_until(task, q, emit)
+    assert cancelled.is_set()
