@@ -34,6 +34,8 @@ def _apply_common_cfg(cfg, kw):
         cfg.price_per_token = kw["price"]
     if kw.get("mesh_shape"):
         cfg.mesh_shape = kw["mesh_shape"]
+    if kw.get("attention"):
+        cfg.attention = kw["attention"]
     return cfg
 
 
@@ -76,18 +78,21 @@ def cli():
 @cli.command("serve-tpu")
 @click.option("--model", default="distilgpt2", help="model name or config key")
 @click.option("--checkpoint", default=None, help="local checkpoint dir (HF or native)")
-@click.option("--mesh-shape", default=None, help='e.g. "data:1,model:8"')
+@click.option("--mesh-shape", default=None, help='e.g. "data:1,model:8" or "seq:4,model:2"')
+@click.option("--attention", type=click.Choice(["dense", "flash", "sp"]), default=None,
+              help="dense | flash (pallas) | sp (seq-sharded long-context cache)")
 @click.option("--publish-weights", is_flag=True,
               help="announce this node's params as DHT pieces for joiners")
 @click.option("--from-mesh", is_flag=True,
               help="fetch weights from mesh providers via the DHT "
                    "(zero local checkpoint)")
 @_common_opts
-def serve_tpu(model, checkpoint, mesh_shape, publish_weights, from_mesh, **kw):
+def serve_tpu(model, checkpoint, mesh_shape, attention, publish_weights, from_mesh, **kw):
     """Serve a model on TPU via the jit engine (the flagship entrypoint)."""
     _serve(
         "tpu", model, checkpoint=checkpoint, mesh_shape=mesh_shape,
-        publish_weights=publish_weights, from_mesh=from_mesh, **kw
+        attention=attention, publish_weights=publish_weights,
+        from_mesh=from_mesh, **kw
     )
 
 
@@ -299,6 +304,48 @@ def train(model, data_path, steps, batch_size, seq_len, lr, ckpt_dir, ckpt_every
             ckpt.save(trainer.state, cfg, tcfg)
     if ckpt:
         ckpt.close()
+
+
+@cli.command("export")
+@click.option("--model", required=True, help="model name or config key")
+@click.option("--checkpoint", default=None,
+              help="source checkpoint dir (HF or native); random init if omitted")
+@click.option("--out", "out_dir", required=True, help="output directory")
+@click.option("--format", "fmt", type=click.Choice(["hf", "native"]), default="hf",
+              help="hf: safetensors + config.json any transformers stack "
+                   "loads; native: content-addressed pieces + manifest")
+@click.option("--dtype", default="float32",
+              help="export dtype (float32/float16/bfloat16)")
+def export_cmd(model, checkpoint, out_dir, fmt, dtype):
+    """Export a model checkpoint to an interchange format.
+
+    The TPU-native analogue of the reference's TorchScript/ONNX export
+    (reference hf.py:139-158): torch graph formats make no sense for a
+    jax stack, so the interchange surface is HF-layout safetensors
+    (loadable by torch/transformers) or the native piece format used for
+    mesh weight distribution."""
+    _setup_logging()
+    import jax
+    import jax.numpy as jnp
+
+    from .models import core, get_config
+    from .models.export import export_hf
+    from .models.loader import load_checkpoint, save_native
+
+    cfg = get_config(model)
+    if checkpoint:
+        params = load_checkpoint(checkpoint, cfg, dtype=jnp.float32)
+    else:
+        click.echo("no --checkpoint: exporting random-init params")
+        params = core.init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    if fmt == "hf":
+        out = export_hf(params, cfg, out_dir, dtype=dtype)
+    else:
+        if dtype != "float32":  # honor --dtype for native pieces too
+            params = jax.tree.map(lambda a: a.astype(jnp.dtype(dtype)), params)
+        save_native(params, cfg, out_dir)
+        out = out_dir
+    click.echo(f"exported {cfg.name} ({fmt}) -> {out}")
 
 
 @cli.command("nat-status")

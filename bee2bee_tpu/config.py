@@ -27,6 +27,7 @@ _ENV_MAP = {
     "BEE2BEE_MESH_SHAPE": "mesh_shape",
     "BEE2BEE_DTYPE": "dtype",
     "BEE2BEE_MAX_BATCH": "max_batch_size",
+    "BEE2BEE_ATTENTION": "attention",
     "BEE2BEE_AUTO_NAT": "auto_nat",
     "BEE2BEE_DHT_PORT": "dht_port",
     "BEE2BEE_DHT_BOOTSTRAP": "dht_bootstrap",
@@ -57,6 +58,9 @@ class NodeConfig:
     # compute (TPU-native additions)
     mesh_shape: str = ""  # e.g. "data:1,model:8" — empty = all devices on model axis
     dtype: str = "bfloat16"
+    # attention impl: dense | flash (pallas kernel) | sp (sequence-parallel
+    # serving over a seq-sharded KV cache; needs seq>1 in mesh_shape)
+    attention: str = "dense"
     max_batch_size: int = 8  # continuous-batching rows (EngineConfig.max_batch)
     max_seq_len: int = 2048
     max_new_tokens: int = 2048  # reference default (services.py:28)

@@ -71,7 +71,11 @@ class EngineConfig:
     max_inflight_chunks: int = 8
     # "dense": einsum attention (models/core._attention, XLA-fused);
     # "flash": pallas tiled kernel (ops/flash.py) — no [T,S] score
-    # materialization, VMEM-resident online softmax
+    # materialization, VMEM-resident online softmax;
+    # "sp": sequence-parallel serving (parallel/sp_serving.py) — the KV
+    # cache's capacity dim is sharded over the mesh's `seq` axis and
+    # attention merges per-shard online-softmax partials via psum; cache
+    # HBM and the quadratic prefill term scale 1/seq. Needs seq > 1.
     attention: str = "dense"
 
 
@@ -123,7 +127,11 @@ class InferenceEngine:
         self.tokenizer = tokenizer or load_tokenizer(checkpoint_path, self.model_cfg.vocab_size)
 
         self._cache_sharding = NamedSharding(
-            self.mesh, partition.cache_spec(self.model_cfg, self.mesh)
+            self.mesh,
+            partition.cache_spec(
+                self.model_cfg, self.mesh,
+                seq_sharded=self.engine_cfg.attention == "sp",
+            ),
         )
         self._replicated = NamedSharding(self.mesh, P())
         # one jit object; it specializes per tokens shape (= per bucket)
@@ -143,17 +151,25 @@ class InferenceEngine:
         Under a non-trivial mesh the pallas kernel runs per-shard via
         shard_map (ops.flash.make_flash_attn_fn) — pallas_call has no SPMD
         partitioning rule, so sharding propagation would all-gather it."""
-        if self.engine_cfg.attention != "flash":
-            return None
-        from ..ops.flash import make_flash_attn_fn
+        if self.engine_cfg.attention == "flash":
+            from ..ops.flash import make_flash_attn_fn
 
-        return make_flash_attn_fn(self.mesh)
+            return make_flash_attn_fn(self.mesh)
+        if self.engine_cfg.attention == "sp":
+            from ..parallel.sp_serving import make_sp_attn_fn
+
+            return make_sp_attn_fn(self.mesh)
+        return None
 
     def _validate_attention_impl(self):
         if self.engine_cfg.attention == "flash":
             from ..ops.flash import validate_flash_mesh
 
             validate_flash_mesh(self.model_cfg, self.mesh)
+        elif self.engine_cfg.attention == "sp":
+            from ..parallel.sp_serving import validate_sp_mesh
+
+            validate_sp_mesh(self.model_cfg, self.engine_cfg, self.mesh)
 
     def _prefill_fn(self, params, tokens, cache, true_len):
         """tokens [B, Tb] padded; returns (cache, last_logits [B, V])."""
@@ -178,7 +194,10 @@ class InferenceEngine:
         )
         # fall back axis-by-axis when a cache dim doesn't divide its mesh
         # axis (e.g. batch=1 on a data=2 mesh) instead of crashing device_put
-        spec = partition.cache_spec(self.model_cfg, self.mesh)
+        spec = partition.cache_spec(
+            self.model_cfg, self.mesh,
+            seq_sharded=self.engine_cfg.attention == "sp",
+        )
         k = cache["k"]
         fitted = P(*[
             e if e is None or k.shape[i] % self.mesh.shape.get(e, 1) == 0 else None

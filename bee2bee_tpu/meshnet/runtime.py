@@ -37,6 +37,7 @@ def build_service(backend: str, model: str, cfg: NodeConfig, **kw):
                 max_seq_len=cfg.max_seq_len,
                 dtype=cfg.dtype,
                 max_batch=cfg.max_batch_size,
+                attention=cfg.attention,
             ),
         )
     if backend == "ollama":
@@ -186,7 +187,7 @@ async def run_p2p_node(
                 mesh=join_mesh,
                 engine_config=EngineConfig(
                     max_seq_len=cfg.max_seq_len, dtype=cfg.dtype,
-                    max_batch=cfg.max_batch_size,
+                    max_batch=cfg.max_batch_size, attention=cfg.attention,
                 ),
                 price_per_token=cfg.price_per_token,
             )

@@ -1,0 +1,221 @@
+"""Checkpoint export: our param pytree → standard interchange formats.
+
+The TPU-native analogue of the reference's model-export surface
+(reference hf.py:139-158 exports TorchScript and ONNX). Torch graph
+formats make no sense for a jax/XLA stack, so the interchange story is:
+
+- **HF-layout safetensors** (`export_hf`): the exact inverse of
+  models/loader's name mapping, plus a matching HF ``config.json`` — any
+  torch/transformers stack loads the result with ``from_pretrained``.
+  Covers the GPT-2 and Llama/Mistral/Mixtral/Gemma families, like the
+  loader.
+- **Native piece format** (loader.save_native): content-addressed shard
+  pieces + manifest — the mesh-distribution and checkpoint/resume format.
+
+Everything is offline and torch-free: safetensors files are written with
+numpy (bf16 via the uint16 bit pattern, mirroring the loader's reader).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import jax
+import numpy as np
+
+from .config import ModelConfig
+
+_DTYPE_NAMES = {
+    "float32": "F32",
+    "float16": "F16",
+    "bfloat16": "BF16",
+    "int64": "I64",
+    "int32": "I32",
+    "uint8": "U8",
+    "bool": "BOOL",
+}
+
+
+def write_safetensors(path: str | Path, tensors: dict[str, np.ndarray],
+                      metadata: dict[str, str] | None = None) -> None:
+    """Minimal safetensors writer (header JSON + raw buffers) — the inverse
+    of loader._read_safetensors, same no-torch rationale."""
+    header: dict = {}
+    if metadata:
+        header["__metadata__"] = metadata
+    bufs: list[bytes] = []
+    offset = 0
+    for name, arr in tensors.items():
+        arr = np.ascontiguousarray(arr)
+        dt = _DTYPE_NAMES.get(arr.dtype.name)
+        if dt is None:
+            raise ValueError(f"unsupported export dtype {arr.dtype} for {name!r}")
+        buf = (
+            arr.view(np.uint16).tobytes() if dt == "BF16" else arr.tobytes()
+        )
+        header[name] = {
+            "dtype": dt,
+            "shape": list(arr.shape),
+            "data_offsets": [offset, offset + len(buf)],
+        }
+        bufs.append(buf)
+        offset += len(buf)
+    blob = json.dumps(header).encode("utf-8")
+    with open(path, "wb") as f:
+        f.write(len(blob).to_bytes(8, "little"))
+        f.write(blob)
+        for buf in bufs:
+            f.write(buf)
+
+
+def _np(x, dtype=None) -> np.ndarray:
+    arr = np.asarray(jax.device_get(x))
+    if dtype is not None:
+        arr = arr.astype(dtype)
+    return np.ascontiguousarray(arr)
+
+
+def _export_gpt2_state(params, cfg: ModelConfig, dtype) -> dict[str, np.ndarray]:
+    """Inverse of loader._convert_gpt2: unstack layers, re-fuse q/k/v into
+    the HF c_attn block."""
+    layers = params["layers"]
+    state = {
+        "transformer.wte.weight": _np(params["tok_embed"], dtype),
+        "transformer.wpe.weight": _np(params["pos_embed"], dtype),
+        "transformer.ln_f.weight": _np(params["final_norm"]["scale"], dtype),
+        "transformer.ln_f.bias": _np(params["final_norm"]["bias"], dtype),
+        # tied embeddings (gpt2 family always ties): transformers expects
+        # the key to exist even though it shares storage with wte
+        "lm_head.weight": _np(params["tok_embed"], dtype),
+    }
+    for i in range(cfg.n_layers):
+        p = f"transformer.h.{i}."
+        state[p + "ln_1.weight"] = _np(layers["ln1"]["scale"][i], dtype)
+        state[p + "ln_1.bias"] = _np(layers["ln1"]["bias"][i], dtype)
+        state[p + "ln_2.weight"] = _np(layers["ln2"]["scale"][i], dtype)
+        state[p + "ln_2.bias"] = _np(layers["ln2"]["bias"][i], dtype)
+        a = layers["attn"]
+        state[p + "attn.c_attn.weight"] = np.concatenate(
+            [_np(a["wq"][i], dtype), _np(a["wk"][i], dtype), _np(a["wv"][i], dtype)],
+            axis=1,
+        )
+        state[p + "attn.c_attn.bias"] = np.concatenate(
+            [_np(a["bq"][i], dtype), _np(a["bk"][i], dtype), _np(a["bv"][i], dtype)]
+        )
+        state[p + "attn.c_proj.weight"] = _np(a["wo"][i], dtype)
+        state[p + "attn.c_proj.bias"] = _np(a["bo"][i], dtype)
+        m = layers["mlp"]
+        state[p + "mlp.c_fc.weight"] = _np(m["w_up"][i], dtype)
+        state[p + "mlp.c_fc.bias"] = _np(m["b_up"][i], dtype)
+        state[p + "mlp.c_proj.weight"] = _np(m["w_down"][i], dtype)
+        state[p + "mlp.c_proj.bias"] = _np(m["b_down"][i], dtype)
+    return state
+
+
+def _export_llama_state(params, cfg: ModelConfig, dtype) -> dict[str, np.ndarray]:
+    """Inverse of loader._convert_llama: transpose back to HF [out, in] and
+    undo the gemma (1 + w) rmsnorm fold."""
+    layers = params["layers"]
+    off = 1.0 if cfg.norm_plus_one else 0.0
+    t = lambda a: _np(a, dtype).T
+    norm = lambda a: _np(np.asarray(jax.device_get(a), np.float32) - off, dtype)
+    state = {
+        "model.embed_tokens.weight": _np(params["tok_embed"], dtype),
+        "model.norm.weight": norm(params["final_norm"]["scale"]),
+    }
+    if not cfg.tie_embeddings:
+        state["lm_head.weight"] = t(params["lm_head"])
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        state[p + "input_layernorm.weight"] = norm(layers["ln1"]["scale"][i])
+        state[p + "post_attention_layernorm.weight"] = norm(layers["ln2"]["scale"][i])
+        a = layers["attn"]
+        for ours, hf in (("wq", "q_proj"), ("wk", "k_proj"), ("wv", "v_proj"), ("wo", "o_proj")):
+            state[p + f"self_attn.{hf}.weight"] = t(a[ours][i])
+        if cfg.is_moe:
+            moe = layers["moe"]
+            state[p + "block_sparse_moe.gate.weight"] = t(moe["router"][i])
+            for e in range(cfg.n_experts):
+                q = p + f"block_sparse_moe.experts.{e}."
+                state[q + "w1.weight"] = t(moe["w_gate"][i][e])
+                state[q + "w2.weight"] = t(moe["w_down"][i][e])
+                state[q + "w3.weight"] = t(moe["w_up"][i][e])
+        else:
+            m = layers["mlp"]
+            state[p + "mlp.gate_proj.weight"] = t(m["w_gate"][i])
+            state[p + "mlp.up_proj.weight"] = t(m["w_up"][i])
+            state[p + "mlp.down_proj.weight"] = t(m["w_down"][i])
+    return state
+
+
+def hf_config_dict(cfg: ModelConfig) -> dict:
+    """A transformers-compatible config.json for the exported checkpoint."""
+    if cfg.pos_embedding == "learned":  # gpt2 family
+        return {
+            "model_type": "gpt2",
+            "architectures": ["GPT2LMHeadModel"],
+            "vocab_size": cfg.vocab_size,
+            "n_positions": cfg.max_seq_len,
+            "n_embd": cfg.d_model,
+            "n_layer": cfg.n_layers,
+            "n_head": cfg.n_heads,
+            "n_inner": cfg.d_ff,
+            "layer_norm_epsilon": cfg.norm_eps,
+            "tie_word_embeddings": True,
+        }
+    base = {
+        "vocab_size": cfg.vocab_size,
+        "hidden_size": cfg.d_model,
+        "num_hidden_layers": cfg.n_layers,
+        "num_attention_heads": cfg.n_heads,
+        "num_key_value_heads": cfg.n_kv_heads,
+        "intermediate_size": cfg.d_ff,
+        "max_position_embeddings": cfg.max_seq_len,
+        "rope_theta": cfg.rope_theta,
+        "rms_norm_eps": cfg.norm_eps,
+        "tie_word_embeddings": cfg.tie_embeddings,
+        "head_dim": cfg.head_dim,
+    }
+    if cfg.is_moe:
+        return {
+            "model_type": "mixtral",
+            "architectures": ["MixtralForCausalLM"],
+            "num_local_experts": cfg.n_experts,
+            "num_experts_per_tok": cfg.n_experts_per_tok,
+            **base,
+        }
+    if cfg.norm_plus_one:  # gemma family
+        return {
+            "model_type": "gemma",
+            "architectures": ["GemmaForCausalLM"],
+            "hidden_act": "gelu_pytorch_tanh" if cfg.activation == "geglu" else cfg.activation,
+            **base,
+        }
+    return {"model_type": "llama", "architectures": ["LlamaForCausalLM"], **base}
+
+
+def export_hf(params, cfg: ModelConfig, out_dir: str | Path,
+              dtype: str = "float32") -> Path:
+    """Write ``out_dir/model.safetensors`` + ``config.json`` in the HF layout
+    for this config's family. Round-trips through models/loader, and loads
+    in torch/transformers via ``from_pretrained(out_dir)``."""
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    np_dtype = np.dtype(dtype) if dtype != "bfloat16" else _bf16_dtype()
+    if cfg.pos_embedding == "learned":
+        state = _export_gpt2_state(params, cfg, np_dtype)
+    else:
+        state = _export_llama_state(params, cfg, np_dtype)
+    write_safetensors(
+        out / "model.safetensors", state,
+        metadata={"format": "pt", "exported_by": "bee2bee_tpu"},
+    )
+    (out / "config.json").write_text(json.dumps(hf_config_dict(cfg), indent=2))
+    return out
+
+
+def _bf16_dtype():
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16)

@@ -130,13 +130,24 @@ def shard_params(params, mesh: Mesh, cfg: ModelConfig | None = None):
     )
 
 
-def cache_spec(cfg: ModelConfig | None = None, mesh: Mesh | None = None) -> P:
-    """KV cache [L, B, S, Hkv, hd]: batch on `data`, kv heads on `model`
-    — except MQA meshes (kv_replicated), where the kv-head dim stays
-    replicated to match the replicated wk/wv projections."""
+def cache_spec(
+    cfg: ModelConfig | None = None,
+    mesh: Mesh | None = None,
+    seq_sharded: bool = False,
+) -> P:
+    """KV cache [L, B, S, Hkv, hd]: batch on `data`, kv heads on `model`.
+
+    With ``seq_sharded=True`` (the engine sets it iff attention='sp'),
+    cache capacity S is sharded over `seq`: per-device cache memory is
+    S/seq and long contexts scale with devices (parallel/sp_serving.py).
+    It is NOT inferred from the mesh alone — dense/flash attention gathers
+    the full cache per step, so a seq-sharded cache under them would be a
+    silent per-step reshard, not a win. MQA meshes (kv_replicated) keep
+    the kv-head dim replicated to match the replicated wk/wv projections."""
+    seq = "seq" if seq_sharded and mesh is not None and mesh.shape.get("seq", 1) > 1 else None
     if cfg is not None and mesh is not None and kv_replicated(cfg, mesh):
-        return P(None, "data", None, None, None)
-    return P(None, "data", None, "model", None)
+        return P(None, "data", seq, None, None)
+    return P(None, "data", seq, "model", None)
 
 
 def flat_partition_specs(

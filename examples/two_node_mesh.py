@@ -1,0 +1,61 @@
+"""Two-node mesh in one process: provider + client, discovery, streaming.
+
+The minimal end-to-end slice (SURVEY §7): a provider node hosts a service
+and announces it; a client node bootstraps in, discovers the provider,
+and streams a generation over the WS mesh protocol.
+
+Run anywhere (no TPU, no model download — FakeService):
+
+    python examples/two_node_mesh.py
+
+For a real model swap FakeService for TPUService (see
+examples/cross_peer_pipeline.py for the imports) or run the CLI twice:
+`python -m bee2bee_tpu serve-tpu --model distilgpt2` /
+`... serve-fake --bootstrap <join link printed by the first>`.
+"""
+
+import asyncio
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo checkout
+
+from bee2bee_tpu.meshnet.node import P2PNode
+from bee2bee_tpu.services.fake import FakeService
+
+
+async def main():
+    # --- provider: host a service, announce it ---------------------------
+    provider = P2PNode(host="127.0.0.1", port=0, node_id="provider")
+    await provider.start()
+    provider.add_service(
+        FakeService("demo-model", reply="Hello from the mesh! " * 4, chunk_size=8)
+    )
+    print(f"provider up: {provider.addr}")
+    print(f"join link:   {provider.join_link()}")
+
+    # --- client: bootstrap, discover, generate ---------------------------
+    client = P2PNode(host="127.0.0.1", port=0, node_id="client")
+    await client.start()
+    await client.connect_bootstrap(provider.join_link())
+    while not client.providers:  # discovery: hello carries the service list
+        await asyncio.sleep(0.05)
+
+    providers = client.list_providers("demo-model")
+    print(f"discovered:  {[(p['provider_id'], p['service']) for p in providers]}")
+
+    print("streaming:   ", end="", flush=True)
+    result = await client.request_generation(
+        providers[0]["provider_id"],
+        "say hello",
+        model="demo-model",
+        on_chunk=lambda text: print(text, end="", flush=True),
+    )
+    print(f"\nresult keys: {sorted(result)}")
+
+    await client.stop()
+    await provider.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
