@@ -1,0 +1,221 @@
+"""Python client SDK for bee2bee-tpu nodes and web gateways.
+
+The reference ships a JS client SDK (/root/reference/app/src/api/index.js)
+that targets a v1 API the shipped gateway never implemented (SURVEY §2.2
+"aspirational"). This SDK targets the REAL shipped surfaces:
+
+- ``NodeClient`` — a node's own HTTP gateway (api.py): status / peers /
+  providers / connect / chat with streaming, X-API-KEY auth.
+- ``GatewayClient`` — the web tier (web/gateway.py): register join link,
+  streamed generate, mesh status, global metrics.
+
+Both are thin aiohttp wrappers with sync convenience methods so scripts
+and notebooks don't need an event loop. Tested against live in-process
+servers in tests/test_client.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from contextlib import asynccontextmanager
+from typing import AsyncIterator, Callable
+
+import aiohttp
+
+DEFAULT_TIMEOUT_S = 300.0  # matches the mesh request timeout
+
+
+class _Base:
+    """Use as an async context manager (`async with NodeClient(...) as c:`)
+    to hold one pooled keep-alive session across calls; outside it, each
+    call opens an ephemeral session (sessions are loop-bound, and the sync
+    wrappers run each call on a fresh loop)."""
+
+    def __init__(self, base_url: str, timeout: float = DEFAULT_TIMEOUT_S):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = aiohttp.ClientTimeout(total=timeout)
+        self._headers: dict[str, str] = {}
+        self._session: aiohttp.ClientSession | None = None
+
+    async def __aenter__(self):
+        self._session = aiohttp.ClientSession(timeout=self.timeout)
+        return self
+
+    async def __aexit__(self, *exc):
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    @asynccontextmanager
+    async def _sess(self):
+        if self._session is not None and not self._session.closed:
+            yield self._session
+        else:
+            async with aiohttp.ClientSession(timeout=self.timeout) as s:
+                yield s
+
+    async def _get(self, path: str, **params) -> dict:
+        async with self._sess() as s:
+            async with s.get(
+                f"{self.base_url}{path}", headers=self._headers,
+                params={k: v for k, v in params.items() if v is not None},
+            ) as r:
+                r.raise_for_status()
+                return await r.json()
+
+    async def _post(self, path: str, body: dict) -> dict:
+        async with self._sess() as s:
+            async with s.post(
+                f"{self.base_url}{path}", json=body, headers=self._headers
+            ) as r:
+                r.raise_for_status()
+                return await r.json()
+
+    def _run(self, coro):
+        """Sync convenience: run the coroutine on a private loop."""
+        return asyncio.run(coro)
+
+
+class NodeClient(_Base):
+    """Client for one node's HTTP gateway (api.py routes)."""
+
+    def __init__(self, base_url: str, api_key: str | None = None,
+                 timeout: float = DEFAULT_TIMEOUT_S):
+        super().__init__(base_url, timeout)
+        if api_key:
+            self._headers["X-API-KEY"] = api_key
+
+    # ---- async API ----
+
+    async def status(self) -> dict:
+        return await self._get("/")
+
+    async def peers(self) -> dict:
+        return await self._get("/peers")
+
+    async def providers(self) -> dict:
+        return await self._get("/providers")
+
+    async def connect(self, addr_or_link: str) -> dict:
+        return await self._post("/connect", {"addr": addr_or_link})
+
+    async def chat(
+        self,
+        prompt: str,
+        model: str | None = None,
+        max_new_tokens: int | None = None,
+        temperature: float | None = None,
+    ) -> dict:
+        body = {"prompt": prompt, "model": model, "stream": False}
+        if max_new_tokens is not None:
+            body["max_new_tokens"] = max_new_tokens
+        if temperature is not None:
+            body["temperature"] = temperature
+        return await self._post("/chat", body)
+
+    async def stream(
+        self,
+        prompt: str,
+        model: str | None = None,
+        max_new_tokens: int | None = None,
+        temperature: float | None = None,
+    ) -> AsyncIterator[dict]:
+        """Yield the JSON-lines objects of a streamed generation
+        ({"text": piece} chunks, then {"done": true, ...})."""
+        body = {"prompt": prompt, "model": model, "stream": True}
+        if max_new_tokens is not None:
+            body["max_new_tokens"] = max_new_tokens
+        if temperature is not None:
+            body["temperature"] = temperature
+        async with self._sess() as s:
+            async with s.post(
+                f"{self.base_url}/chat", json=body, headers=self._headers
+            ) as r:
+                r.raise_for_status()
+                async for line in r.content:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        yield json.loads(line)
+                    except ValueError:
+                        continue
+
+    # ---- sync conveniences ----
+
+    def status_sync(self) -> dict:
+        return self._run(self.status())
+
+    def chat_sync(self, prompt: str, **kw) -> dict:
+        return self._run(self.chat(prompt, **kw))
+
+    def generate_sync(
+        self, prompt: str, on_chunk: Callable[[str], None] | None = None, **kw
+    ) -> str:
+        """Stream a generation, invoking on_chunk per text piece; returns
+        the full text."""
+
+        async def run():
+            parts: list[str] = []
+            async for obj in self.stream(prompt, **kw):
+                if obj.get("text"):
+                    parts.append(obj["text"])
+                    if on_chunk:
+                        on_chunk(obj["text"])
+                if obj.get("status") == "error":
+                    raise RuntimeError(obj.get("message") or "stream error")
+            return "".join(parts)
+
+        return self._run(run())
+
+
+class GatewayClient(_Base):
+    """Client for the web tier (web/gateway.py /api/p2p/* routes)."""
+
+    async def status(self) -> dict:
+        return await self._get("/api/p2p/status")
+
+    async def global_metrics(self) -> dict:
+        return await self._get("/api/p2p/global_metrics")
+
+    async def register(self, join_link: str) -> dict:
+        return await self._post("/api/p2p/register", {"link": join_link})
+
+    async def generate(
+        self,
+        prompt: str,
+        model: str | None = None,
+        target_node: str | None = None,
+        on_chunk: Callable[[str], None] | None = None,
+        max_new_tokens: int | None = None,
+        temperature: float | None = None,
+    ) -> str:
+        """Streamed generate through the gateway; returns the full text.
+        (The gateway streams raw text chunks, not JSON lines.)"""
+        body: dict = {"prompt": prompt, "model": model}
+        if target_node:
+            body["targetNode"] = target_node
+        if max_new_tokens is not None:
+            body["max_new_tokens"] = max_new_tokens
+        if temperature is not None:
+            body["temperature"] = temperature
+        parts: list[str] = []
+        async with self._sess() as s:
+            async with s.post(
+                f"{self.base_url}/api/p2p/generate", json=body,
+                headers=self._headers,
+            ) as r:
+                r.raise_for_status()
+                async for chunk in r.content.iter_any():
+                    text = chunk.decode("utf-8", errors="replace")
+                    parts.append(text)
+                    if on_chunk:
+                        on_chunk(text)
+        return "".join(parts)
+
+    def status_sync(self) -> dict:
+        return self._run(self.status())
+
+    def generate_sync(self, prompt: str, **kw) -> str:
+        return self._run(self.generate(prompt, **kw))

@@ -137,6 +137,9 @@ class InferenceEngine:
         # one jit object; it specializes per tokens shape (= per bucket)
         self._prefill = jax.jit(self._prefill_fn, donate_argnums=(2,))
         self._rng = jax.random.key(self.engine_cfg.rng_seed)
+        # jitted split: an eager jax.random.split is a blocking round trip
+        # on a tunneled chip, and _next_key runs on every admission/window
+        self._split_key = jax.jit(lambda k: tuple(jax.random.split(k)))
         # gateways run execute() on a thread pool: guard the rng stream and
         # lazy scheduler creation (jax itself is safe for concurrent dispatch)
         self._mutex = threading.Lock()
@@ -207,7 +210,7 @@ class InferenceEngine:
 
     def _next_key(self):
         with self._mutex:
-            self._rng, sub = jax.random.split(self._rng)
+            self._rng, sub = self._split_key(self._rng)
             return sub
 
     # ------------------------------------------------------------ public API

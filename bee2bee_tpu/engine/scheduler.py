@@ -157,8 +157,13 @@ class BatchScheduler:
         e = engine
         self._bsz = 1  # current batch bucket (pow2-ish, <= max_batch)
         self._cache = e.new_cache(self._bsz)
-        self._cur = jnp.zeros((self._bsz,), jnp.int32)
-        self._offsets = jnp.zeros((self._bsz,), jnp.int32)
+        # cur/offsets live as HOST numpy mirrors: every eager device op is
+        # a blocking round trip on a tunneled chip (~1 s each, measured),
+        # so the scheduler never runs eager jnp — host state goes in as
+        # jit arguments (a cheap [B] transfer) and comes back with the
+        # token readback it needed anyway
+        self._cur = np.zeros((self._bsz,), np.int32)
+        self._offsets = np.zeros((self._bsz,), np.int32)
         self._rows: list[Request | None] = [None] * self._bsz
         self._row_params_dirty = True
         self._temps = self._topps = self._topks = None
@@ -196,11 +201,16 @@ class BatchScheduler:
         def shrink(src, n):
             return jax.tree.map(lambda s: s[:, :n], src)
 
+        from .sampling import sample_batched
+
         self._insert = jax.jit(insert, donate_argnums=(0,))
         self._move_row = jax.jit(move_row, donate_argnums=(0,))
         self._grow = jax.jit(grow, donate_argnums=(0,))
         self._shrink = jax.jit(shrink, static_argnums=(1,))
         self._decode = jax.jit(self._decode_fn, donate_argnums=(2,))
+        # jitted: sample_batched run eagerly is ~15 tiny ops = ~15 round
+        # trips through a tunneled chip per admission
+        self._sample_first = jax.jit(sample_batched)
 
         self._thread = threading.Thread(
             target=self._loop, name="bee2bee-batch-scheduler", daemon=True
@@ -297,8 +307,8 @@ class BatchScheduler:
         (the old cache may hold donated/poisoned buffers)."""
         self._bsz = 1
         self._cache = self.engine.new_cache(1)
-        self._cur = jnp.zeros((1,), jnp.int32)
-        self._offsets = jnp.zeros((1,), jnp.int32)
+        self._cur = np.zeros((1,), np.int32)
+        self._offsets = np.zeros((1,), np.int32)
         self._rows = [None]
         self._row_params_dirty = True
 
@@ -318,10 +328,10 @@ class BatchScheduler:
         cur = np.zeros((new_bsz,), np.int32)
         offs = np.zeros((new_bsz,), np.int32)
         keep = min(old, new_bsz)
-        cur[:keep] = np.asarray(self._cur)[:keep]
-        offs[:keep] = np.asarray(self._offsets)[:keep]
-        self._cur = jnp.asarray(cur)
-        self._offsets = jnp.asarray(offs)
+        cur[:keep] = self._cur[:keep]
+        offs[:keep] = self._offsets[:keep]
+        self._cur = cur
+        self._offsets = offs
         self._rows = self._rows[:keep] + [None] * (new_bsz - keep)
         self._bsz = new_bsz
         self._row_params_dirty = True
@@ -340,10 +350,10 @@ class BatchScheduler:
             if hole is None or last is None or last < hole:
                 break
             self._cache = self._move_row(
-                self._cache, jnp.int32(last), jnp.int32(hole)
+                self._cache, np.int32(last), np.int32(hole)
             )
-            self._cur = self._cur.at[hole].set(self._cur[last])
-            self._offsets = self._offsets.at[hole].set(self._offsets[last])
+            self._cur[hole] = self._cur[last]
+            self._offsets[hole] = self._offsets[last]
             self._rows[hole] = self._rows[last]
             self._rows[last] = None
             self._row_params_dirty = True
@@ -362,8 +372,6 @@ class BatchScheduler:
         dispatched asynchronously; the first tokens come back in ONE device
         sync (a sync costs ~75-100 ms through a tunneled chip — a burst of
         8 must not pay it 8 times while active streams sit undecoded)."""
-        from .sampling import sample_batched
-
         e = self.engine
         placed: list[tuple] = []  # (req, row, firsts_index)
         firsts: list = []
@@ -390,19 +398,21 @@ class BatchScheduler:
                 with get_tracer().span(
                     "engine.admit", row=b, prompt_tokens=n, bucket=bucket
                 ):
+                    # np arguments throughout: jit converts them on entry
+                    # (one small transfer), no eager ops, no blocking
                     row_cache = e.new_cache(1)
                     row_cache, last_logits = e._prefill(
-                        e.params, jnp.asarray(tokens), row_cache,
-                        jnp.asarray([n], jnp.int32),
+                        e.params, tokens, row_cache,
+                        np.asarray([n], np.int32),
                     )
-                    first = sample_batched(
+                    first = self._sample_first(
                         last_logits,
                         e._next_key(),
-                        jnp.asarray([req.temperature], jnp.float32),
-                        jnp.asarray([req.top_k], jnp.int32),
-                        jnp.asarray([req.top_p], jnp.float32),
+                        np.asarray([req.temperature], np.float32),
+                        np.asarray([req.top_k], np.int32),
+                        np.asarray([req.top_p], np.float32),
                     )
-                    self._cache = self._insert(self._cache, row_cache, jnp.int32(b))
+                    self._cache = self._insert(self._cache, row_cache, np.int32(b))
             except Exception as err:
                 # the popped request is in neither _queue nor _rows: fail it
                 # here or its caller hangs; then let _loop's handler recover
@@ -414,13 +424,15 @@ class BatchScheduler:
                 raise
             # reserve the row now (cur gets the real token after readback)
             self._rows[b] = req
-            self._offsets = self._offsets.at[b].set(n)
+            self._offsets[b] = n
             placed.append((req, b, len(firsts)))
             firsts.append(first)
 
         if not placed:
             return
-        toks = np.asarray(jax.device_get(jnp.concatenate(firsts)))  # ONE sync
+        # ONE blocking gather for the whole burst (device_get on the list
+        # fetches all; no eager concatenate op on device)
+        toks = np.concatenate([np.asarray(x) for x in jax.device_get(firsts)])
         now = time.perf_counter()
         for req, b, i in placed:
             tok = int(toks[i])
@@ -436,7 +448,7 @@ class BatchScheduler:
                 self._rows[b] = None
                 self._retire(req)
                 continue
-            self._cur = self._cur.at[b].set(tok)
+            self._cur[b] = tok
             self._row_params_dirty = True
             self.stats.peak_active = max(self.stats.peak_active, self.active)
         self._compact_and_shrink()
@@ -446,9 +458,10 @@ class BatchScheduler:
             temps = [r.temperature if r else 0.0 for r in self._rows]
             topks = [r.top_k if r else 0 for r in self._rows]
             topps = [r.top_p if r else 1.0 for r in self._rows]
-            self._temps = jnp.asarray(temps, jnp.float32)
-            self._topks = jnp.asarray(topks, jnp.int32)
-            self._topps = jnp.asarray(topps, jnp.float32)
+            # host np: uploaded as jit args, never eager device arrays
+            self._temps = np.asarray(temps, np.float32)
+            self._topks = np.asarray(topks, np.int32)
+            self._topps = np.asarray(topps, np.float32)
             self._row_params_dirty = False
         return self._temps, self._topks, self._topps
 
@@ -478,18 +491,26 @@ class BatchScheduler:
         e = self.engine
         temps, topks, topps = self._row_sampling_arrays()
         W = self._window_size()
+        K = e.engine_cfg.decode_chunk
         with get_tracer().span("engine.decode_window", active=self.active, chunks=W):
+            # host mirrors go in as the first call's args; chunks chain on
+            # the returned DEVICE arrays; the host mirrors then advance
+            # from the same readback the tokens needed anyway — the whole
+            # window runs with zero eager device ops
+            cur_d, off_d = self._cur, self._offsets
             toks_parts = []
             for _ in range(W):
-                self._cur, self._cache, self._offsets, toks = self._decode(
-                    e.params, self._cur, self._cache, self._offsets,
+                cur_d, self._cache, off_d, toks = self._decode(
+                    e.params, cur_d, self._cache, off_d,
                     temps, topks, topps, e._next_key(),
                 )
                 toks_parts.append(toks)
-            window = (
-                jnp.concatenate(toks_parts, axis=1) if W > 1 else toks_parts[0]
-            )
-            toks_host = np.asarray(jax.device_get(window))  # [B, W*K] sync
+            parts_host = [np.asarray(x) for x in jax.device_get(toks_parts)]
+            toks_host = (
+                np.concatenate(parts_host, axis=1) if W > 1 else parts_host[0]
+            )  # [B, W*K]
+        self._cur = toks_host[:, -1].astype(np.int32).copy()
+        self._offsets = self._offsets + np.int32(W * K)
         self.stats.chunks += W
 
         retired_any = False

@@ -1,0 +1,178 @@
+/**
+ * bee2bee-tpu JS client SDK.
+ *
+ * The reference ships a JS SDK (app/src/api/index.js) that targets a v1
+ * API its own gateway never implemented; this one targets the REAL
+ * shipped surfaces (the same routes the Python SDK bee2bee_tpu/client.py
+ * wraps and the test suite exercises):
+ *
+ *   - NodeClient:    a node's HTTP gateway  (bee2bee_tpu/api.py)
+ *   - GatewayClient: the web tier           (bee2bee_tpu/web/gateway.py)
+ *
+ * Zero dependencies — browser fetch / Node >= 18 fetch. ESM.
+ *
+ *   import { NodeClient, GatewayClient } from "./bee2bee.mjs";
+ *   const node = new NodeClient("http://localhost:4002", { apiKey: "..." });
+ *   await node.status();
+ *   await node.generate("hello", { onChunk: (t) => process.stdout.write(t) });
+ */
+
+async function readJsonLines(response, onObject) {
+  const reader = response.body.getReader();
+  const decoder = new TextDecoder();
+  let buf = "";
+  for (;;) {
+    const { done, value } = await reader.read();
+    if (done) break;
+    buf += decoder.decode(value, { stream: true });
+    let nl;
+    while ((nl = buf.indexOf("\n")) >= 0) {
+      const line = buf.slice(0, nl).trim();
+      buf = buf.slice(nl + 1);
+      if (!line) continue;
+      let obj;
+      try {
+        obj = JSON.parse(line); // only the parse is guarded:
+      } catch {
+        continue; /* garbled line — but onObject's throws must propagate */
+      }
+      onObject(obj);
+    }
+  }
+  const tail = buf.trim();
+  if (tail) {
+    let obj;
+    try {
+      obj = JSON.parse(tail);
+    } catch {
+      return;
+    }
+    onObject(obj);
+  }
+}
+
+export class NodeClient {
+  constructor(baseUrl, { apiKey = null, timeoutMs = 300000 } = {}) {
+    this.baseUrl = baseUrl.replace(/\/+$/, "");
+    this.headers = { "Content-Type": "application/json" };
+    if (apiKey) this.headers["X-API-KEY"] = apiKey;
+    this.timeoutMs = timeoutMs;
+  }
+
+  async _get(path) {
+    const r = await fetch(this.baseUrl + path, {
+      headers: this.headers,
+      signal: AbortSignal.timeout(this.timeoutMs),
+    });
+    if (!r.ok) throw new Error(`${path}: HTTP ${r.status}`);
+    return r.json();
+  }
+
+  async _post(path, body, { stream = false } = {}) {
+    const r = await fetch(this.baseUrl + path, {
+      method: "POST",
+      headers: this.headers,
+      body: JSON.stringify(body),
+      signal: AbortSignal.timeout(this.timeoutMs),
+    });
+    if (!r.ok) throw new Error(`${path}: HTTP ${r.status}`);
+    return stream ? r : r.json();
+  }
+
+  status() {
+    return this._get("/");
+  }
+  peers() {
+    return this._get("/peers");
+  }
+  providers() {
+    return this._get("/providers");
+  }
+  connect(addrOrLink) {
+    return this._post("/connect", { addr: addrOrLink });
+  }
+
+  /** Non-streaming chat; resolves to the result object. */
+  chat(prompt, { model = null, maxNewTokens = null, temperature = null } = {}) {
+    const body = { prompt, model, stream: false };
+    if (maxNewTokens != null) body.max_new_tokens = maxNewTokens;
+    if (temperature != null) body.temperature = temperature;
+    return this._post("/chat", body);
+  }
+
+  /** Streaming generate; onChunk(text) per piece; resolves to full text. */
+  async generate(prompt, { model = null, maxNewTokens = null, temperature = null, onChunk = null } = {}) {
+    const body = { prompt, model, stream: true };
+    if (maxNewTokens != null) body.max_new_tokens = maxNewTokens;
+    if (temperature != null) body.temperature = temperature;
+    const r = await this._post("/chat", body, { stream: true });
+    const parts = [];
+    await readJsonLines(r, (obj) => {
+      if (obj.status === "error") throw new Error(obj.message || "stream error");
+      if (obj.text) {
+        parts.push(obj.text);
+        if (onChunk) onChunk(obj.text);
+      }
+    });
+    return parts.join("");
+  }
+}
+
+export class GatewayClient {
+  constructor(baseUrl, { timeoutMs = 300000 } = {}) {
+    this.baseUrl = baseUrl.replace(/\/+$/, "");
+    this.timeoutMs = timeoutMs;
+  }
+
+  async _get(path) {
+    const r = await fetch(this.baseUrl + path, {
+      signal: AbortSignal.timeout(this.timeoutMs),
+    });
+    if (!r.ok) throw new Error(`${path}: HTTP ${r.status}`);
+    return r.json();
+  }
+
+  status() {
+    return this._get("/api/p2p/status");
+  }
+  globalMetrics() {
+    return this._get("/api/p2p/global_metrics");
+  }
+
+  async register(joinLink) {
+    const r = await fetch(this.baseUrl + "/api/p2p/register", {
+      method: "POST",
+      headers: { "Content-Type": "application/json" },
+      body: JSON.stringify({ link: joinLink }),
+      signal: AbortSignal.timeout(this.timeoutMs),
+    });
+    if (!r.ok) throw new Error(`register: HTTP ${r.status}`);
+    return r.json();
+  }
+
+  /** The gateway streams raw text chunks (not JSON lines). */
+  async generate(prompt, { model = null, targetNode = null, maxNewTokens = null, temperature = null, onChunk = null } = {}) {
+    const body = { prompt, model };
+    if (targetNode) body.targetNode = targetNode;
+    if (maxNewTokens != null) body.max_new_tokens = maxNewTokens;
+    if (temperature != null) body.temperature = temperature;
+    const r = await fetch(this.baseUrl + "/api/p2p/generate", {
+      method: "POST",
+      headers: { "Content-Type": "application/json" },
+      body: JSON.stringify(body),
+      signal: AbortSignal.timeout(this.timeoutMs),
+    });
+    if (!r.ok) throw new Error(`generate: HTTP ${r.status}`);
+    const reader = r.body.getReader();
+    const decoder = new TextDecoder();
+    const parts = [];
+    for (;;) {
+      const { done, value } = await reader.read();
+      if (done) break;
+      const text = decoder.decode(value, { stream: true });
+      parts.push(text);
+      if (onChunk) onChunk(text);
+    }
+    return parts.join("");
+  }
+}

@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# One-command local stack: a serving node + the browser web tier.
+# The working analogue of the reference's run.sh (which launches its
+# p2p_runtime + Express API + vite UI — reference run.sh:24-52).
+#
+#   ./run.sh                     # fake backend (demo, no model)
+#   MODEL=distilgpt2 BACKEND=tpu ./run.sh   # real engine
+#
+# Ports: node WS 4003, node HTTP 4002, web UI 4001 (override via env).
+set -euo pipefail
+
+BACKEND="${BACKEND:-fake}"
+MODEL="${MODEL:-demo}"
+WS_PORT="${WS_PORT:-4003}"
+API_PORT="${API_PORT:-4002}"
+WEB_PORT="${WEB_PORT:-4001}"
+PY="${PYTHON:-python}"
+
+# kill only OUR children — `kill 0` would signal the whole process group,
+# including a calling Makefile/CI shell
+PIDS=()
+cleanup() { kill "${PIDS[@]}" 2>/dev/null || true; }
+trap cleanup EXIT INT TERM
+
+echo "[run] node: serve-${BACKEND} --model ${MODEL} (ws :${WS_PORT}, http :${API_PORT})"
+"$PY" -m bee2bee_tpu "serve-${BACKEND}" --model "$MODEL" \
+    --port "$WS_PORT" --api-port "$API_PORT" &
+PIDS+=($!)
+
+sleep 3
+echo "[run] web tier on http://localhost:${WEB_PORT}"
+"$PY" -m bee2bee_tpu serve-web --seeds "ws://127.0.0.1:${WS_PORT}" \
+    --port "$WEB_PORT" &
+PIDS+=($!)
+
+echo "[run] up. UI: http://localhost:${WEB_PORT}  node API: http://localhost:${API_PORT}"
+wait
