@@ -200,6 +200,9 @@ class GatewayClient(_Base):
             body["max_new_tokens"] = max_new_tokens
         if temperature is not None:
             body["temperature"] = temperature
+        import codecs
+
+        decoder = codecs.getincrementaldecoder("utf-8")(errors="replace")
         parts: list[str] = []
         async with self._sess() as s:
             async with s.post(
@@ -208,11 +211,27 @@ class GatewayClient(_Base):
             ) as r:
                 r.raise_for_status()
                 async for chunk in r.content.iter_any():
-                    text = chunk.decode("utf-8", errors="replace")
-                    parts.append(text)
-                    if on_chunk:
-                        on_chunk(text)
-        return "".join(parts)
+                    # incremental decode: a multi-byte UTF-8 sequence split
+                    # across chunks must not become U+FFFD
+                    text = decoder.decode(chunk)
+                    if text:
+                        parts.append(text)
+                        if on_chunk:
+                            on_chunk(text)
+                tail = decoder.decode(b"", final=True)
+                if tail:
+                    parts.append(tail)
+        full = "".join(parts)
+        # the gateway reports failures INSIDE the already-200 stream
+        # (web/gateway.py appends "\n\n[Error]: ..."): surface them as
+        # errors, with any partial output attached
+        marker = "\n\n[Error]: "
+        idx = full.rfind(marker)
+        if idx != -1:
+            err = RuntimeError(f"gateway error: {full[idx + len(marker):].strip()}")
+            err.partial_text = full[:idx]
+            raise err
+        return full
 
     def status_sync(self) -> dict:
         return self._run(self.status())

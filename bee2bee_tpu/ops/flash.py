@@ -44,12 +44,12 @@ def _on_tpu() -> bool:
 
 
 def _flash_kernel(
-    off_ref,  # SMEM [1,1] int32: global position of q[:, 0]
-    q_ref,  # [1, BQ, 1, hd]
-    k_ref,  # [1, BK, 1, hd]
-    v_ref,  # [1, BK, 1, hd]
-    o_ref,  # [1, BQ, 1, hd]
-    m_ref,  # VMEM [BQ, 128] f32 running max
+    off_ref,  # SMEM [B] int32 (scalar-prefetch): global position of q[:, 0]
+    q_ref,  # [1, 1, BQ, hd]  (head-major layout: Mosaic requires the
+    k_ref,  # [1, 1, BK, hd]   trailing two block dims to be (8,128)-tileable
+    v_ref,  # [1, 1, BK, hd]   or dim-equal — [.., seq_block, hd] is; the
+    o_ref,  # [1, 1, BQ, hd]   head axis blocked at 1 in trailing position
+    m_ref,  # VMEM [BQ, 128] f32 running max         is NOT and fails to lower)
     l_ref,  # VMEM [BQ, 128] f32 running sum
     acc_ref,  # VMEM [BQ, hd] f32
     *,
@@ -60,6 +60,7 @@ def _flash_kernel(
 ):
     qi = pl.program_id(2)
     kj = pl.program_id(3)
+    off = off_ref[pl.program_id(0)]
 
     @pl.when(kj == 0)
     def _init():
@@ -69,13 +70,13 @@ def _flash_kernel(
 
     # skip K blocks entirely above the diagonal (offset is dynamic, so the
     # grid can't be pruned statically — predicate out the wasted MXU work)
-    last_qpos = off_ref[0, 0] + (qi + 1) * block_q - 1
+    last_qpos = off + (qi + 1) * block_q - 1
     visible = (kj * block_k <= last_qpos) if causal else jnp.bool_(True)
 
     @pl.when(visible)
     def _attend():
-        q = q_ref[0, :, 0, :]
-        k = k_ref[0, :, 0, :]
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
         s = (
             jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -84,7 +85,7 @@ def _flash_kernel(
         )  # [BQ, BK]
 
         if causal:
-            qpos = off_ref[0, 0] + qi * block_q + jax.lax.broadcasted_iota(
+            qpos = off + qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
             kpos = kj * block_k + jax.lax.broadcasted_iota(
@@ -103,7 +104,7 @@ def _flash_kernel(
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1)
 
-        v = v_ref[0, :, 0, :]
+        v = v_ref[0, 0]
         pv = jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -117,7 +118,7 @@ def _flash_kernel(
         # l == 0 only for rows with no visible keys (e.g. a decode row whose
         # lengths[b] == 0, offset -1): emit 0, not 0/0 = NaN
         l = l_ref[:, 0][:, None]
-        o_ref[0, :, 0, :] = (
+        o_ref[0, 0] = (
             acc_ref[:] / jnp.where(l == 0.0, 1.0, l)
         ).astype(o_ref.dtype)
 
@@ -149,19 +150,27 @@ def flash_attention(
     block_k = min(block_k, max(S, 8))
     Tp = -(-T // block_q) * block_q
     Sp = -(-S // block_k) * block_k
+    # head-major layout [B, H(kv), seq, hd]: the kernel's trailing block
+    # dims become (seq_block, hd), which Mosaic can tile; the original
+    # [B, seq, H, hd] layout put the head axis (blocked at 1) second-to-
+    # last and failed to lower on real TPU
+    qT = jnp.transpose(q, (0, 2, 1, 3))
+    kT = jnp.transpose(k, (0, 2, 1, 3))
+    vT = jnp.transpose(v, (0, 2, 1, 3))
     if Tp != T:
-        q = jnp.pad(q, ((0, 0), (0, Tp - T), (0, 0), (0, 0)))
+        qT = jnp.pad(qT, ((0, 0), (0, 0), (0, Tp - T), (0, 0)))
     if Sp != S:
-        k = jnp.pad(k, ((0, 0), (0, Sp - S), (0, 0), (0, 0)))
-        v = jnp.pad(v, ((0, 0), (0, Sp - S), (0, 0), (0, 0)))
+        kT = jnp.pad(kT, ((0, 0), (0, 0), (0, Sp - S), (0, 0)))
+        vT = jnp.pad(vT, ((0, 0), (0, 0), (0, Sp - S), (0, 0)))
     if not causal and Sp != S:
         raise ValueError("non-causal flash requires S divisible by block_k")
 
-    # per-batch offsets in SMEM: [B, 1], one (1,1) block per batch step
+    # per-batch offsets ride whole into SMEM via scalar prefetch — a
+    # blocked [B,1] SMEM operand hits the same Mosaic trailing-dims rule
     off = jnp.broadcast_to(
         jnp.asarray(offset if offset is not None else 0, jnp.int32).reshape(-1),
         (B,),
-    ).reshape(B, 1)
+    )
 
     grid = (B, H, Tp // block_q, Sp // block_k)
     kernel = functools.partial(
@@ -171,31 +180,36 @@ def flash_attention(
         block_k=block_k,
         causal=causal,
     )
-    out = pl.pallas_call(
-        kernel,
+    # index maps take the scalar-prefetch ref as a trailing arg
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
         grid=grid,
         in_specs=[
+            pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i, j, off: (b, h, i, 0)),
             pl.BlockSpec(
-                (1, 1), lambda b, h, i, j: (b, 0), memory_space=pltpu.SMEM
-            ),
-            pl.BlockSpec((1, block_q, 1, hd), lambda b, h, i, j: (b, i, h, 0)),
-            pl.BlockSpec(
-                (1, block_k, 1, hd), lambda b, h, i, j: (b, j, h // group, 0)
+                (1, 1, block_k, hd), lambda b, h, i, j, off: (b, h // group, j, 0)
             ),
             pl.BlockSpec(
-                (1, block_k, 1, hd), lambda b, h, i, j: (b, j, h // group, 0)
+                (1, 1, block_k, hd), lambda b, h, i, j, off: (b, h // group, j, 0)
             ),
         ],
-        out_specs=pl.BlockSpec((1, block_q, 1, hd), lambda b, h, i, j: (b, i, h, 0)),
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, hd), lambda b, h, i, j, off: (b, h, i, 0)
+        ),
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, hd), jnp.float32),
         ],
-        out_shape=jax.ShapeDtypeStruct((B, Tp, H, hd), q.dtype),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, Tp, hd), q.dtype),
         interpret=interpret,
-    )(off, q, k, v)
-    return out[:, :T].reshape(B, T, H * hd)
+    )(off, qT, kT, vT)
+    # [B, H, Tp, hd] -> [B, T, H*hd]
+    return jnp.transpose(out[:, :, :T], (0, 2, 1, 3)).reshape(B, T, H * hd)
 
 
 # ----------------------------------------------------- TP/mesh wrapper

@@ -21,6 +21,7 @@ async function readJsonLines(response, onObject) {
   const reader = response.body.getReader();
   const decoder = new TextDecoder();
   let buf = "";
+  try {
   for (;;) {
     const { done, value } = await reader.read();
     if (done) break;
@@ -49,6 +50,28 @@ async function readJsonLines(response, onObject) {
     }
     onObject(obj);
   }
+  } finally {
+    // an onObject throw (stream error) must not leak the connection for
+    // the 300 s abort window
+    try {
+      await reader.cancel();
+    } catch {
+      /* already closed */
+    }
+  }
+}
+
+// the web gateway reports failures INSIDE its already-200 chunked stream
+// (web/gateway.py appends "\n\n[Error]: ..."): surface them as rejections
+function throwOnGatewayError(text) {
+  const marker = "\n\n[Error]: ";
+  const idx = text.lastIndexOf(marker);
+  if (idx !== -1) {
+    const err = new Error(`gateway error: ${text.slice(idx + marker.length).trim()}`);
+    err.partialText = text.slice(0, idx);
+    throw err;
+  }
+  return text;
 }
 
 export class NodeClient {
@@ -173,6 +196,6 @@ export class GatewayClient {
       parts.push(text);
       if (onChunk) onChunk(text);
     }
-    return parts.join("");
+    return throwOnGatewayError(parts.join(""));
   }
 }

@@ -133,6 +133,21 @@ def test_node_client_sync_wrappers():
         t.join(timeout=10)
 
 
+async def test_gateway_client_surfaces_stream_errors():
+    """The gateway reports failures inside its 200 stream; the client must
+    raise, not return the error text as model output."""
+    bridge = MeshBridge(seeds=[])  # nothing to connect to -> request fails
+    server = TestServer(create_web_app(bridge))
+    await server.start_server()
+    try:
+        g = GatewayClient(f"http://127.0.0.1:{server.port}")
+        with pytest.raises(RuntimeError, match="gateway error"):
+            await g.generate("hi", model="nope")
+    finally:
+        await server.close()
+        await bridge.stop()
+
+
 async def test_gateway_client_against_live_web_tier():
     async with node_server() as (node, _):
         bridge = MeshBridge(seeds=[node.addr])
