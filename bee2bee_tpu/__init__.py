@@ -17,6 +17,8 @@ _LAZY = {
     "P2PNode": ("bee2bee_tpu.meshnet.node", "P2PNode"),
     "run_p2p_node": ("bee2bee_tpu.meshnet.runtime", "run_p2p_node"),
     "InferenceEngine": ("bee2bee_tpu.engine.engine", "InferenceEngine"),
+    "NodeClient": ("bee2bee_tpu.client", "NodeClient"),
+    "GatewayClient": ("bee2bee_tpu.client", "GatewayClient"),
 }
 
 
@@ -34,4 +36,11 @@ def __getattr__(name):
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
-__all__ = ["P2PNode", "run_p2p_node", "InferenceEngine", "__version__"]
+__all__ = [
+    "P2PNode",
+    "run_p2p_node",
+    "InferenceEngine",
+    "NodeClient",
+    "GatewayClient",
+    "__version__",
+]

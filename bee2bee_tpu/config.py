@@ -28,13 +28,15 @@ _ENV_MAP = {
     "BEE2BEE_DTYPE": "dtype",
     "BEE2BEE_MAX_BATCH": "max_batch_size",
     "BEE2BEE_ATTENTION": "attention",
+    "BEE2BEE_PREFILL_CHUNK": "prefill_chunk",
     "BEE2BEE_AUTO_NAT": "auto_nat",
     "BEE2BEE_DHT_PORT": "dht_port",
     "BEE2BEE_DHT_BOOTSTRAP": "dht_bootstrap",
 }
 
 _INT_FIELDS = {
-    "port", "api_port", "announce_port", "max_batch_size", "max_seq_len", "dht_port",
+    "port", "api_port", "announce_port", "max_batch_size", "max_seq_len",
+    "dht_port", "prefill_chunk",
 }
 _BOOL_FIELDS = {"auto_nat"}
 
@@ -61,6 +63,9 @@ class NodeConfig:
     # attention impl: dense | flash (pallas kernel) | sp (sequence-parallel
     # serving over a seq-sharded KV cache; needs seq>1 in mesh_shape)
     attention: str = "dense"
+    # chunked prefill size (0 = whole-prompt buckets); bounds dense
+    # prefill score memory for long prompts (EngineConfig.prefill_chunk)
+    prefill_chunk: int = 0
     max_batch_size: int = 8  # continuous-batching rows (EngineConfig.max_batch)
     max_seq_len: int = 2048
     max_new_tokens: int = 2048  # reference default (services.py:28)
@@ -73,6 +78,20 @@ class NodeConfig:
 
     def to_dict(self) -> dict:
         return asdict(self)
+
+    def engine_config(self):
+        """The EngineConfig this node config implies — the ONE place the
+        NodeConfig→engine knob mapping (and its 0-means-disabled sentinel
+        for prefill_chunk) lives."""
+        from .engine.engine import EngineConfig
+
+        return EngineConfig(
+            max_seq_len=self.max_seq_len,
+            dtype=self.dtype,
+            max_batch=self.max_batch_size,
+            attention=self.attention,
+            prefill_chunk=self.prefill_chunk or None,
+        )
 
 
 def load_config() -> NodeConfig:

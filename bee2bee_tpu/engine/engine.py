@@ -77,6 +77,19 @@ class EngineConfig:
     # attention merges per-shard online-softmax partials via psum; cache
     # HBM and the quadratic prefill term scale 1/seq. Needs seq > 1.
     attention: str = "dense"
+    # chunked prefill: process the prompt in fixed chunks of this many
+    # tokens instead of one whole-prompt bucket. Bounds dense-attention
+    # prefill score memory to [H, chunk, S] (a whole 8k prompt at once is
+    # [H, 8k, 8k] — gigabytes), and ONE compiled shape serves every
+    # prompt length. None = whole-prompt power-of-two buckets.
+    prefill_chunk: int | None = None
+
+    def __post_init__(self):
+        # <= 0 means "disabled" (NodeConfig uses 0 as its sentinel); a raw
+        # 0 reaching the admission loop would make an empty chunk that
+        # never advances
+        if self.prefill_chunk is not None and self.prefill_chunk < 1:
+            self.prefill_chunk = None
 
 
 @dataclass
@@ -174,10 +187,13 @@ class InferenceEngine:
 
             validate_sp_mesh(self.model_cfg, self.engine_cfg, self.mesh)
 
-    def _prefill_fn(self, params, tokens, cache, true_len):
-        """tokens [B, Tb] padded; returns (cache, last_logits [B, V])."""
+    def _prefill_fn(self, params, tokens, cache, true_len, offset):
+        """tokens [B, Tb] padded; returns (cache, last_logits [B, V]).
+        `offset` is the global cache position of tokens[:, 0] — 0 for a
+        whole-prompt prefill, the running position for chunked prefill.
+        `true_len` is the valid length WITHIN this chunk."""
         logits, cache = core.forward(
-            params, self.model_cfg, tokens, cache, jnp.int32(0), attn_fn=self._attn_fn()
+            params, self.model_cfg, tokens, cache, offset, attn_fn=self._attn_fn()
         )
         idx = (true_len - 1).reshape(-1, 1, 1)  # [B,1,1]
         last = jnp.take_along_axis(logits, jnp.broadcast_to(idx, (logits.shape[0], 1, logits.shape[2])), axis=1)

@@ -390,10 +390,12 @@ class BatchScheduler:
             b = next(i for i, r in enumerate(self._rows) if r is None)
 
             n = len(req.ids)
-            bucket = e._bucket_for(n)
+            C = e.engine_cfg.prefill_chunk
+            if C is not None and n > C:
+                bucket = C  # chunked: one compiled shape for all lengths
+            else:
+                bucket = e._bucket_for(n)
             req.bucket = bucket
-            tokens = np.zeros((1, bucket), np.int32)
-            tokens[0, :n] = req.ids
             try:
                 with get_tracer().span(
                     "engine.admit", row=b, prompt_tokens=n, bucket=bucket
@@ -401,10 +403,22 @@ class BatchScheduler:
                     # np arguments throughout: jit converts them on entry
                     # (one small transfer), no eager ops, no blocking
                     row_cache = e.new_cache(1)
-                    row_cache, last_logits = e._prefill(
-                        e.params, tokens, row_cache,
-                        np.asarray([n], np.int32),
-                    )
+                    # walk the prompt in bucket-sized chunks writing the
+                    # row cache at the running offset; a single whole-
+                    # prompt bucket is the one-chunk case of the same loop
+                    pos = 0
+                    while True:
+                        chunk = req.ids[pos:pos + bucket]
+                        tokens = np.zeros((1, bucket), np.int32)
+                        tokens[0, :len(chunk)] = chunk
+                        row_cache, last_logits = e._prefill(
+                            e.params, tokens, row_cache,
+                            np.asarray([len(chunk)], np.int32),
+                            np.int32(pos),
+                        )
+                        pos += len(chunk)
+                        if pos >= n:
+                            break
                     first = self._sample_first(
                         last_logits,
                         e._next_key(),

@@ -21,7 +21,6 @@ def build_service(backend: str, model: str, cfg: NodeConfig, **kw):
     """Service factory for the CLI/runtime (reference run_p2p_node's backend
     switch, p2p_runtime.py:891-902)."""
     if backend == "tpu":
-        from ..engine.engine import EngineConfig
         from ..parallel import MeshSpec, build_mesh
         from ..services.tpu import TPUService
 
@@ -33,12 +32,7 @@ def build_service(backend: str, model: str, cfg: NodeConfig, **kw):
             max_new_tokens=cfg.max_new_tokens,
             mesh=mesh,
             checkpoint_path=kw.get("checkpoint_path"),
-            engine_config=EngineConfig(
-                max_seq_len=cfg.max_seq_len,
-                dtype=cfg.dtype,
-                max_batch=cfg.max_batch_size,
-                attention=cfg.attention,
-            ),
+            engine_config=cfg.engine_config(),
         )
     if backend == "ollama":
         from ..services.ollama import OllamaService
@@ -173,7 +167,6 @@ async def run_p2p_node(
         if backend == "tpu" and from_mesh:
             # the zero-local-checkpoint join: manifest + pieces come from
             # mesh providers via the DHT (meshnet/weights.py)
-            from ..engine.engine import EngineConfig
             from .weights import serve_model_from_mesh
 
             shape = parse_mesh_shape(cfg.mesh_shape)
@@ -185,10 +178,7 @@ async def run_p2p_node(
             svc = await serve_model_from_mesh(
                 node, dht, model,
                 mesh=join_mesh,
-                engine_config=EngineConfig(
-                    max_seq_len=cfg.max_seq_len, dtype=cfg.dtype,
-                    max_batch=cfg.max_batch_size, attention=cfg.attention,
-                ),
+                engine_config=cfg.engine_config(),
                 price_per_token=cfg.price_per_token,
             )
             logger.info("serving %s from mesh pieces; join link: %s", model, node.join_link())
