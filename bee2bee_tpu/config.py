@@ -29,6 +29,7 @@ _ENV_MAP = {
     "BEE2BEE_MAX_BATCH": "max_batch_size",
     "BEE2BEE_ATTENTION": "attention",
     "BEE2BEE_PREFILL_CHUNK": "prefill_chunk",
+    "BEE2BEE_PREFIX_CACHE": "prefix_cache_entries",
     "BEE2BEE_AUTO_NAT": "auto_nat",
     "BEE2BEE_DHT_PORT": "dht_port",
     "BEE2BEE_DHT_BOOTSTRAP": "dht_bootstrap",
@@ -36,7 +37,7 @@ _ENV_MAP = {
 
 _INT_FIELDS = {
     "port", "api_port", "announce_port", "max_batch_size", "max_seq_len",
-    "dht_port", "prefill_chunk",
+    "dht_port", "prefill_chunk", "prefix_cache_entries",
 }
 _BOOL_FIELDS = {"auto_nat"}
 
@@ -66,6 +67,9 @@ class NodeConfig:
     # chunked prefill size (0 = whole-prompt buckets); bounds dense
     # prefill score memory for long prompts (EngineConfig.prefill_chunk)
     prefill_chunk: int = 0
+    # prompt prefix cache entries (0 = off): chat turns resend the whole
+    # transcript; cached prompt K/V makes turn N+1 prefill only the delta
+    prefix_cache_entries: int = 0
     max_batch_size: int = 8  # continuous-batching rows (EngineConfig.max_batch)
     max_seq_len: int = 2048
     max_new_tokens: int = 2048  # reference default (services.py:28)
@@ -91,6 +95,7 @@ class NodeConfig:
             max_batch=self.max_batch_size,
             attention=self.attention,
             prefill_chunk=self.prefill_chunk or None,
+            prefix_cache_entries=self.prefix_cache_entries,
         )
 
 

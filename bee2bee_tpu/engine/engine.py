@@ -83,6 +83,13 @@ class EngineConfig:
     # [H, 8k, 8k] — gigabytes), and ONE compiled shape serves every
     # prompt length. None = whole-prompt power-of-two buckets.
     prefill_chunk: int | None = None
+    # prompt prefix cache: keep up to this many prompt K/V snapshots and
+    # admit new requests from the longest cached prefix, prefilling only
+    # the remainder. Chat transcripts resend the whole history every turn
+    # (the reference rebuilds full context per message — its hf.py
+    # transcript path), so turn N+1 pays only the delta. Each entry costs
+    # one batch-1 row cache in HBM. 0 = disabled.
+    prefix_cache_entries: int = 0
 
     def __post_init__(self):
         # <= 0 means "disabled" (NodeConfig uses 0 as its sentinel); a raw

@@ -140,7 +140,53 @@ class SchedulerStats:
     retired: int = 0
     chunks: int = 0  # batched decode chunks dispatched
     peak_active: int = 0
+    prefix_hits: int = 0
+    prefix_tokens_saved: int = 0
     history: deque = field(default_factory=lambda: deque(maxlen=64))
+
+
+class PrefixCache:
+    """LRU of prompt K/V snapshots: key = token-id tuple, value = a batch-1
+    row cache valid for positions [0, len(key)).
+
+    Lookup returns the entry sharing the longest common prefix with the
+    incoming prompt, capped at len(prompt) - 1 — the final prompt token
+    always prefills so admission gets its last_logits for the first
+    sample. A key LONGER than the prompt is usable too (identical-prompt
+    repeats, a truncated retry): its positions beyond the match are stale
+    but the engine's causal invariant already guarantees any position >=
+    the write offset is either masked or overwritten at write time.
+    Entries are device pytrees; the scheduler thread owns all access, so
+    no locking. Capacity is small (entries are row-cache-sized in HBM);
+    the linear prefix scan over <= capacity keys is noise."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._entries: dict[tuple, object] = {}  # insertion-ordered (LRU)
+
+    def match(self, ids: list[int]):
+        """-> (m, row_cache | None): longest usable cached prefix."""
+        cap = len(ids) - 1
+        best_key, best_m = None, 0
+        for key in self._entries:
+            m = min(len(key), cap)
+            if m > best_m and tuple(ids[:m]) == key[:m]:
+                best_key, best_m = key, m
+        if best_key is None:
+            return 0, None
+        entry = self._entries.pop(best_key)  # LRU touch
+        self._entries[best_key] = entry
+        return best_m, entry
+
+    def has(self, ids: list[int]) -> bool:
+        return tuple(ids) in self._entries
+
+    def put(self, ids: list[int], row_cache) -> None:
+        key = tuple(ids)
+        self._entries.pop(key, None)
+        self._entries[key] = row_cache
+        while len(self._entries) > self.capacity:
+            self._entries.pop(next(iter(self._entries)))
 
 
 class BatchScheduler:
@@ -211,6 +257,14 @@ class BatchScheduler:
         # jitted: sample_batched run eagerly is ~15 tiny ops = ~15 round
         # trips through a tunneled chip per admission
         self._sample_first = jax.jit(sample_batched)
+        # jitted device-side deep copy (explicit jnp.copy — a bare identity
+        # could alias buffers): snapshots for / restores from the prefix cache
+        self._copy_cache = jax.jit(lambda c: jax.tree.map(jnp.copy, c))
+        self._prefix_cache = (
+            PrefixCache(e.engine_cfg.prefix_cache_entries)
+            if e.engine_cfg.prefix_cache_entries > 0
+            else None
+        )
 
         self._thread = threading.Thread(
             target=self._loop, name="bee2bee-batch-scheduler", daemon=True
@@ -390,24 +444,50 @@ class BatchScheduler:
             b = next(i for i, r in enumerate(self._rows) if r is None)
 
             n = len(req.ids)
+            # longest cached prompt prefix: admit from there and prefill
+            # only the remainder (chat transcripts grow by appending)
+            start, cached = (
+                self._prefix_cache.match(req.ids)
+                if self._prefix_cache is not None
+                else (0, None)
+            )
             C = e.engine_cfg.prefill_chunk
-            if C is not None and n > C:
+            remaining = n - (start if cached is not None else 0)
+            if C is not None and remaining > C:
                 bucket = C  # chunked: one compiled shape for all lengths
             else:
-                bucket = e._bucket_for(n)
+                bucket = e._bucket_for(remaining)
             req.bucket = bucket
             try:
                 with get_tracer().span(
-                    "engine.admit", row=b, prompt_tokens=n, bucket=bucket
+                    "engine.admit", row=b, prompt_tokens=n, bucket=bucket,
+                    prefix=start,
                 ):
                     # np arguments throughout: jit converts them on entry
                     # (one small transfer), no eager ops, no blocking
-                    row_cache = e.new_cache(1)
+                    if cached is not None:
+                        row_cache = self._copy_cache(cached)
+                        self.stats.prefix_hits += 1
+                        self.stats.prefix_tokens_saved += start
+                    else:
+                        start = 0
+                        row_cache = e.new_cache(1)
                     # walk the prompt in bucket-sized chunks writing the
                     # row cache at the running offset; a single whole-
                     # prompt bucket is the one-chunk case of the same loop
-                    pos = 0
+                    S = e.max_seq_len
+                    pos = start
                     while True:
+                        if pos + bucket > S:
+                            # a write spanning past capacity would be
+                            # CLAMPED by dynamic_update_slice (silently
+                            # shifting K/V rows): re-anchor the final
+                            # window to end at S. Tokens below the old
+                            # pos are re-fed and recompute identical K/V
+                            # in place — static shape preserved, no
+                            # corruption. Terminates: the anchored window
+                            # reaches n (n < S always).
+                            pos = max(0, S - bucket)
                         chunk = req.ids[pos:pos + bucket]
                         tokens = np.zeros((1, bucket), np.int32)
                         tokens[0, :len(chunk)] = chunk
@@ -419,6 +499,13 @@ class BatchScheduler:
                         pos += len(chunk)
                         if pos >= n:
                             break
+                    if self._prefix_cache is not None and not self._prefix_cache.has(req.ids):
+                        # snapshot BEFORE _insert donates row_cache away;
+                        # an exact-key hit skips the redundant re-snapshot
+                        # (match already LRU-touched it)
+                        self._prefix_cache.put(
+                            req.ids, self._copy_cache(row_cache)
+                        )
                     first = self._sample_first(
                         last_logits,
                         e._next_key(),

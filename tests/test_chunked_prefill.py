@@ -43,6 +43,51 @@ def test_chunked_prefill_exact_multiple_and_short():
         assert got == want, f"mismatch at n={n}"
 
 
+def test_chunk_tail_near_capacity_not_clamped():
+    """Regression: a final chunk whose window would span past max_seq_len
+    must not be clamp-shifted by dynamic_update_slice (silent K/V row
+    corruption). chunk=48 over a 120-token prompt in a 128 cache puts the
+    last window at [96,144) — it must re-anchor, not clamp."""
+    prompt = list(np.random.default_rng(3).integers(3, 500, size=120))
+    want = _rollout(
+        InferenceEngine("tiny-llama", engine_config=EngineConfig(**KW)), prompt, n=6
+    )
+    got = _rollout(
+        InferenceEngine(
+            "tiny-llama", engine_config=EngineConfig(prefill_chunk=48, **KW)
+        ),
+        prompt,
+        n=6,
+    )
+    assert got == want
+
+
+def test_prefix_hit_near_capacity_not_clamped():
+    """Regression: a prefix-cache hit whose remainder bucket rounds past
+    max_seq_len (start=90, remaining 30 -> bucket 32 or 64) must re-anchor
+    the window instead of clamp-shifting the write."""
+    rng = np.random.default_rng(4)
+    turn1 = list(rng.integers(3, 500, size=90))
+    long_prompt = turn1 + list(rng.integers(3, 500, size=30))  # n=120 of 128
+
+    fresh = InferenceEngine("tiny-llama", engine_config=EngineConfig(**KW))
+    want = fresh.generate(long_prompt, max_new_tokens=6, temperature=0.0).token_ids
+    fresh.close()
+
+    for chunk in (None, 16):  # bucket-rounded and chunked variants
+        eng = InferenceEngine(
+            "tiny-llama",
+            engine_config=EngineConfig(
+                prefix_cache_entries=4, prefill_chunk=chunk, **KW
+            ),
+        )
+        eng.generate(turn1, max_new_tokens=2, temperature=0.0)  # seed the cache
+        got = eng.generate(long_prompt, max_new_tokens=6, temperature=0.0).token_ids
+        assert eng.scheduler.stats.prefix_hits == 1
+        eng.close()
+        assert got == want, f"mismatch with prefill_chunk={chunk}"
+
+
 def test_chunked_prefill_composes_with_sp():
     """Chunked prefill over a seq-sharded cache (the long-context serving
     combination: bounded score memory AND 1/seq cache per device)."""

@@ -1,0 +1,81 @@
+"""Prompt prefix cache: repeat/extended prompts must admit from cached
+K/V and still match a fresh engine token-for-token."""
+
+import numpy as np
+
+from bee2bee_tpu.engine import EngineConfig, InferenceEngine
+from bee2bee_tpu.engine.scheduler import PrefixCache
+
+KW = dict(max_seq_len=128, dtype="float32", cache_dtype="float32")
+
+
+def test_prefix_cache_lru_and_matching():
+    pc = PrefixCache(2)
+    pc.put([1, 2, 3], "A")
+    pc.put([1, 2], "B")
+    # longest common prefix wins, capped at len(ids)-1
+    assert pc.match([1, 2, 3, 4]) == (3, "A")
+    m, entry = pc.match([1, 2, 3])  # both keys usable up to n-1: tie
+    assert m == 2 and entry in ("A", "B")
+    m, entry = pc.match([1, 2])  # longer keys still match n-1 tokens
+    assert m == 1 and entry in ("A", "B")
+    assert pc.match([9, 9]) == (0, None)
+    pc.put([7], "C")  # capacity 2: evicts LRU ("B" was never touched... )
+    assert len(pc._entries) == 2
+    assert pc.match([7, 8]) == (1, "C")
+
+
+def test_repeat_prompt_hits_prefix_cache():
+    prompt = list(np.random.default_rng(0).integers(3, 500, size=40))
+    ref = InferenceEngine("tiny-llama", engine_config=EngineConfig(**KW))
+    want = ref.generate(prompt, max_new_tokens=8, temperature=0.0).token_ids
+    ref.close()
+
+    eng = InferenceEngine(
+        "tiny-llama", engine_config=EngineConfig(prefix_cache_entries=4, **KW)
+    )
+    first = eng.generate(prompt, max_new_tokens=8, temperature=0.0).token_ids
+    stats = eng.scheduler.stats
+    assert stats.prefix_hits == 0
+    second = eng.generate(prompt, max_new_tokens=8, temperature=0.0).token_ids
+    assert stats.prefix_hits == 1
+    assert stats.prefix_tokens_saved == len(prompt) - 1  # last token reprefills
+    eng.close()
+    assert first == want and second == want
+
+
+def test_chat_turn_extension_prefills_only_delta():
+    """Turn N+1 = turn N transcript + new text: the cached turn-N prompt
+    covers the prefix; only the delta prefills."""
+    rng = np.random.default_rng(1)
+    turn1 = list(rng.integers(3, 500, size=30))
+    eng = InferenceEngine(
+        "tiny-llama",
+        engine_config=EngineConfig(prefix_cache_entries=4, prefill_chunk=16, **KW),
+    )
+    r1 = eng.generate(turn1, max_new_tokens=6, temperature=0.0)
+    turn2 = turn1 + r1.token_ids + list(rng.integers(3, 500, size=10))
+    r2 = eng.generate(turn2, max_new_tokens=6, temperature=0.0)
+    stats = eng.scheduler.stats
+    assert stats.prefix_hits == 1
+    assert stats.prefix_tokens_saved == len(turn1)
+    eng.close()
+
+    fresh = InferenceEngine("tiny-llama", engine_config=EngineConfig(**KW))
+    want = fresh.generate(turn2, max_new_tokens=6, temperature=0.0).token_ids
+    fresh.close()
+    assert r2.token_ids == want
+
+
+def test_prefix_cache_entries_are_isolated():
+    """The cached snapshot must be a COPY: decoding after admission from a
+    cached prefix must not corrupt the stored entry for later hits."""
+    prompt = list(np.random.default_rng(2).integers(3, 500, size=24))
+    eng = InferenceEngine(
+        "tiny-llama", engine_config=EngineConfig(prefix_cache_entries=4, **KW)
+    )
+    a = eng.generate(prompt, max_new_tokens=10, temperature=0.0).token_ids
+    b = eng.generate(prompt, max_new_tokens=10, temperature=0.0).token_ids
+    c = eng.generate(prompt, max_new_tokens=10, temperature=0.0).token_ids
+    eng.close()
+    assert a == b == c
