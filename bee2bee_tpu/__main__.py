@@ -252,12 +252,26 @@ def register(bootstrap):
 @click.option("--ckpt-every", default=50,
               help="steps between checkpoints (0 = only at the end)")
 @click.option("--mesh-shape", default="", help='e.g. "data:2,model:4"')
-def train(model, data_path, steps, batch_size, seq_len, lr, ckpt_dir, ckpt_every, mesh_shape):
+@click.option("--coordinator", default=None, envvar="BEE2BEE_COORDINATOR",
+              help="multi-host: host:port of process 0 (jax.distributed); "
+                   "run the SAME command on every host")
+@click.option("--num-hosts", type=int, default=1, envvar="BEE2BEE_NUM_HOSTS")
+@click.option("--host-id", type=int, default=0, envvar="BEE2BEE_HOST_ID")
+def train(model, data_path, steps, batch_size, seq_len, lr, ckpt_dir, ckpt_every,
+          mesh_shape, coordinator, num_hosts, host_id):
     """Train a causal LM on a local text corpus (checkpoint/resume-able).
 
     The SPMD realization of the reference's per-layer WS training protocol
-    (reference node.py:94-182)."""
+    (reference node.py:94-182). Multi-host: every host runs this same
+    command with --coordinator host0:port --num-hosts N --host-id i; the
+    mesh spans all hosts' chips, each host feeds its batch shard, and
+    gradients ride XLA collectives over ICI/DCN (parallel/multihost.py)."""
     _setup_logging()
+    if coordinator:
+        # must run BEFORE anything touches the jax backend
+        from .parallel.multihost import init_multihost
+
+        init_multihost(coordinator, num_processes=num_hosts, process_id=host_id)
     from .datasets import PreprocessConfig, from_text_file
     from .engine.tokenizer import ByteTokenizer
     from .models.config import get_config
@@ -271,6 +285,17 @@ def train(model, data_path, steps, batch_size, seq_len, lr, ckpt_dir, ckpt_every
         from .parallel import MeshSpec, build_mesh
 
         mesh = build_mesh(MeshSpec.from_dict(parse_mesh_shape(mesh_shape)))
+    elif coordinator:
+        # multi-host without an explicit shape: mesh=None would make every
+        # host run an identical independent single-device job (and race on
+        # the checkpoint dir) — default to data-parallel over ALL hosts'
+        # devices instead
+        import jax
+
+        from .parallel import MeshSpec, build_mesh
+
+        mesh = build_mesh(MeshSpec(data=len(jax.devices())))
+        click.echo(f"multi-host: defaulting mesh to data:{len(jax.devices())}")
 
     data = from_text_file(
         data_path, ByteTokenizer(cfg.vocab_size),

@@ -1,0 +1,96 @@
+"""Multi-host SPMD: one logical mesh spanning processes/hosts.
+
+The reference scales across machines with per-layer JSON-over-WebSocket
+hops (reference node.py:94-182) — bandwidth-bound and lock-step slow.
+The TPU-native equivalent is jax.distributed: every host runs the SAME
+jit program over a GLOBAL mesh; XLA inserts collectives that ride
+ICI within a slice and DCN between hosts. This module is the thin,
+testable entry to that:
+
+- ``init_multihost``: wraps jax.distributed.initialize with the node
+  config's coordinator knobs and returns the global device list.
+- ``global_mesh``: builds a MeshSpec-shaped Mesh over ALL processes'
+  devices (jax.devices() is global after initialize).
+- ``global_array``: every host holds the SAME global batch (same corpus
+  + shuffle seed) and each materializes exactly its addressable shards
+  via ``make_array_from_callback`` — correct for ANY sharding, including
+  meshes whose data axis does not span processes (where a naive
+  per-process row split would silently feed different data per host).
+- ``host_local_batch``: convenience row-slice for loaders that shard
+  reading; only valid when the batch rows genuinely map to processes.
+
+Tested for real in tests/test_multihost.py: two localhost processes,
+each with 4 virtual CPU devices, form one 8-device mesh and take a
+dp2 x sp2 x tp2 train step whose loss matches the single-process
+8-device run bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from .mesh import MeshSpec, build_mesh
+
+
+def init_multihost(
+    coordinator: str,
+    num_processes: int,
+    process_id: int,
+    local_device_count: int | None = None,
+) -> list:
+    """Join the jax.distributed cluster; returns the GLOBAL device list.
+
+    coordinator: "host:port" of process 0 (any free port). Call before
+    any other jax API touches the backend. Idempotent re-init raises in
+    jax — callers own process lifecycle.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=(
+            list(range(local_device_count)) if local_device_count else None
+        ),
+    )
+    return jax.devices()
+
+
+def global_mesh(spec: MeshSpec | dict | None = None):
+    """A Mesh over every process's devices (call after init_multihost)."""
+    return build_mesh(spec, devices=jax.devices())
+
+
+def process_mesh_info() -> dict:
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }
+
+
+def host_local_batch(global_batch: np.ndarray) -> np.ndarray:
+    """This process's row-slice of a batch sharded over hosts (batch dim
+    must divide process_count)."""
+    n = jax.process_count()
+    b = global_batch.shape[0]
+    if b % n:
+        raise ValueError(f"global batch {b} not divisible by {n} processes")
+    i = jax.process_index()
+    per = b // n
+    return global_batch[i * per : (i + 1) * per]
+
+
+def global_array(global_batch: np.ndarray, mesh, spec):
+    """Assemble one global sharded array from the FULL global batch
+    (identical on every host): each process materializes exactly its
+    addressable shards. Works for any sharding — data axis spanning
+    processes, replicated batches under pure TP, anything between."""
+    from jax.sharding import NamedSharding
+
+    return jax.make_array_from_callback(
+        global_batch.shape,
+        NamedSharding(mesh, spec),
+        lambda idx: global_batch[idx],
+    )

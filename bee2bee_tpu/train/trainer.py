@@ -181,8 +181,28 @@ class Trainer:
         )
         self._step = make_train_step(model_cfg, self.train_cfg, mesh)
 
+    def _globalize(self, batch: dict) -> dict:
+        """Multi-process: every host loads the SAME global batch (same
+        corpus + shuffle seed) and materializes its addressable shards —
+        jit under jax.distributed only accepts process-spanning inputs
+        built this way. Sharding-driven (make_array_from_callback), so it
+        stays correct even when the mesh's data axis does not span the
+        processes (pure-TP meshes replicate the batch)."""
+        if self.mesh is None or jax.process_count() == 1:
+            return batch
+        import numpy as np
+
+        from ..parallel.multihost import global_array
+
+        out = {}
+        for k, v in batch.items():
+            arr = np.asarray(v)
+            spec = P("data", "seq") if arr.ndim >= 2 else P("data")
+            out[k] = global_array(arr, self.mesh, spec)
+        return out
+
     def train_step(self, batch: dict) -> dict:
-        self.state, metrics = self._step(self.state, batch)
+        self.state, metrics = self._step(self.state, self._globalize(batch))
         return {k: float(v) for k, v in metrics.items()}
 
     @property
