@@ -21,6 +21,7 @@ class FakeService(BaseService):
         reply: str | None = None,
         chunk_size: int = 4,
         fail_with: str | None = None,
+        delay_s: float = 0.0,  # per-chunk stream delay (chaos/latency tests)
     ):
         super().__init__("fake")
         self.model_name = model_name
@@ -28,6 +29,7 @@ class FakeService(BaseService):
         self.reply = reply
         self.chunk_size = chunk_size
         self.fail_with = fail_with
+        self.delay_s = delay_s
         self.calls: list[dict] = []
 
     def get_metadata(self) -> dict[str, Any]:
@@ -59,5 +61,7 @@ class FakeService(BaseService):
             return
         text = self._reply_for(params)
         for i in range(0, len(text), self.chunk_size):
+            if self.delay_s:
+                time.sleep(self.delay_s)
             yield self.stream_line({"text": text[i : i + self.chunk_size]})
         yield self.stream_line({"done": True})

@@ -1,0 +1,135 @@
+"""Fault injection: the mesh under node churn. The reference has NO fault
+injection anywhere (SURVEY §5); here we hard-kill and restart providers
+mid-workload and require (a) requests either succeed or fail fast with a
+clean error — never hang, (b) the mesh heals (reconnect + re-discovery),
+(c) serving resumes after every restart."""
+
+import asyncio
+import contextlib
+
+import pytest
+
+from bee2bee_tpu.meshnet.node import P2PNode
+from bee2bee_tpu.services.fake import FakeService
+
+
+async def _settle(cond, timeout=8.0, interval=0.05):
+    for _ in range(int(timeout / interval)):
+        if cond():
+            return True
+        await asyncio.sleep(interval)
+    return False
+
+
+async def _hard_kill(node: P2PNode):
+    """Process-death semantics for an in-process node: every socket dies,
+    no GOODBYE is sent, nothing of the node keeps responding."""
+    node._stopped = True  # noqa: SLF001 — simulating death, not clean stop
+    for info in list(node.peers.values()):
+        with contextlib.suppress(Exception):
+            await info["ws"].close()
+    if node._server is not None:
+        node._server.close()
+        await node._server.wait_closed()
+    for t in list(node._tasks):
+        t.cancel()
+
+
+async def test_mesh_survives_provider_churn():
+    hub = P2PNode(host="127.0.0.1", port=0, node_id="hub")
+    await hub.start()
+    client = P2PNode(host="127.0.0.1", port=0, node_id="client")
+    await client.start()
+    client.reconnect_initial_s = 0.1
+    client.reconnect_max_s = 0.2
+    await client.connect_bootstrap(hub.addr)
+
+    provider_port = None
+    provider = None
+
+    async def start_provider():
+        nonlocal provider, provider_port
+        provider = P2PNode(
+            host="127.0.0.1", port=provider_port or 0, node_id="provider"
+        )
+        provider.reconnect_initial_s = 0.1
+        await provider.start()
+        provider_port = provider.port
+        provider.add_service(FakeService("churn-model", reply="alive"))
+        await provider.connect_bootstrap(hub.addr)
+        await provider.announce_service(provider.local_services["fake"])
+
+    await start_provider()
+    assert await _settle(lambda: "provider" in client.providers), "no discovery"
+
+    served = 0
+    try:
+        for round_no in range(3):
+            result = await asyncio.wait_for(
+                client.request_generation("provider", "ping", model="churn-model"),
+                timeout=10,
+            )
+            assert result["text"] == "alive"
+            served += 1
+
+            # CHAOS: hard-kill (no GOODBYE, all sockets die)
+            await _hard_kill(provider)
+            assert await _settle(lambda: "provider" not in client.peers), (
+                "client kept a dead peer"
+            )
+            # requests at the dead peer fail FAST with a clean error
+            with pytest.raises(RuntimeError):
+                await asyncio.wait_for(
+                    client.request_generation(
+                        "provider", "ping", model="churn-model"
+                    ),
+                    timeout=5,
+                )
+
+            # restart on the same port; its bootstrap dial re-heals the
+            # mesh and gossip re-advertises the service
+            await start_provider()
+            assert await _settle(lambda: "provider" in client.providers), (
+                f"mesh did not heal after churn round {round_no}"
+            )
+            result = await asyncio.wait_for(
+                client.request_generation("provider", "ping", model="churn-model"),
+                timeout=10,
+            )
+            assert result["text"] == "alive"
+            served += 1
+    finally:
+        for n in (provider, client, hub):
+            with contextlib.suppress(Exception):
+                await n.stop()
+
+    assert served == 6  # every round served before AND after the kill
+
+
+async def test_request_to_peer_dying_mid_stream_fails_fast():
+    """A request in flight when the provider dies must error within the
+    timeout — never deadlock the caller."""
+    a = P2PNode(host="127.0.0.1", port=0)
+    b = P2PNode(host="127.0.0.1", port=0)
+    await a.start()
+    await b.start()
+    b.reconnect_enabled = False  # this test is about the pending future
+    try:
+        a.add_service(FakeService("m", reply="x" * 60, chunk_size=1, delay_s=0.05))
+        await b.connect_bootstrap(a.addr)
+        assert await _settle(lambda: b.providers)
+        chunks: list[str] = []
+        task = asyncio.create_task(
+            b.request_generation(
+                a.peer_id, "p", model="m", timeout=4, on_chunk=chunks.append
+            )
+        )
+        await _settle(lambda: chunks, timeout=3)  # streaming has started
+        await _hard_kill(a)
+        with pytest.raises(RuntimeError):
+            await asyncio.wait_for(task, timeout=8)
+    finally:
+        with contextlib.suppress(Exception):
+            await b.stop()
+        with contextlib.suppress(Exception):
+            await a.stop()
