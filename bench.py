@@ -17,8 +17,10 @@ What runs (BASELINE.md's north star: output tok/s/chip + p50 latency):
   configuration; the reference path cannot batch at all);
 - p50 request latency over short requests at the headline concurrency;
 - MFU on TPU: 2 * n_params * tok/s / chip peak bf16 FLOPs;
-- gemma-2b rung (random init, bf16) at concurrency 1 and 8 on TPU —
-  BASELINE ladder step 2 — skipped off-TPU (CPU would take minutes/tok).
+- gemma-2b rung (random init, bf16) at concurrency 1, 8, and 32 on TPU
+  (decode is weight-bound at 2.5B, so batch rides nearly free; MFU is
+  computed from the highest concurrency that completed) — BASELINE
+  ladder step 2 — skipped off-TPU (CPU would take minutes/tok).
 
 Resilience: a wedged/hung TPU plugin (stale pool lease) must not hang the
 driver — device availability is probed in a subprocess with a timeout and
@@ -120,32 +122,47 @@ def bench_model(name: str, max_seq_len: int, concurrencies=(1, 8),
         n_params = eng.info["n_params"]
         platform = jax.devices()[0].platform
         rng_prompts = [
-            [1 + (i * 37 + j) % 500 for j in range(PROMPT_LEN)] for i in range(16)
+            [1 + (i * 37 + j) % 500 for j in range(PROMPT_LEN)]
+            for i in range(max(16, max(concurrencies)))
         ]
         log(f"{name}: warmup (compile) on {platform}...")
         eng.generate(rng_prompts[0], max_new_tokens=new_tokens, temperature=0.0)
 
         out: dict = {"n_params": n_params, "platform": platform}
+        done_c = []
         for c in concurrencies:
-            best = None
-            for _ in range(2):
-                r = _bench_concurrency(eng, rng_prompts[:c], new_tokens)
-                if best is None or r["tok_per_s"] > best["tok_per_s"]:
-                    best = r
+            try:
+                best = None
+                for _ in range(2):
+                    r = _bench_concurrency(eng, rng_prompts[:c], new_tokens)
+                    if best is None or r["tok_per_s"] > best["tok_per_s"]:
+                        best = r
+            except Exception as e:  # noqa: BLE001 — e.g. OOM at batch 32:
+                # keep the lower-concurrency results already measured
+                log(f"{name} concurrency {c} failed ({e}); keeping lower rungs")
+                out[f"batch{c}"] = {"error": str(e)}
+                break
+            done_c.append(c)
             out[f"batch{c}"] = best
             log(f"{name} concurrency {c}: {best['tok_per_s']} tok/s "
                 f"(p50 {best['p50_latency_s']}s)")
+        if not done_c:
+            raise RuntimeError(f"{name}: no concurrency level completed")
 
         # p50 over short interactive requests at the headline concurrency
-        short = _bench_concurrency(
-            eng, rng_prompts[:P50_REQUESTS],
-            P50_NEW_TOKENS if platform == "tpu" else 16,
-        )
-        out["p50_latency_s_short"] = short["p50_latency_s"]
+        try:
+            short = _bench_concurrency(
+                eng, rng_prompts[:min(P50_REQUESTS, max(done_c))],
+                P50_NEW_TOKENS if platform == "tpu" else 16,
+            )
+            out["p50_latency_s_short"] = short["p50_latency_s"]
+        except Exception as e:  # noqa: BLE001 — keep the throughput rungs
+            log(f"{name} p50 run failed ({e})")
+            out["p50_latency_s_short"] = None
 
         peak = V5E_PEAK_BF16 if platform == "tpu" else None
         if peak:
-            headline = out[f"batch{max(concurrencies)}"]["tok_per_s"]
+            headline = out[f"batch{max(done_c)}"]["tok_per_s"]
             out["mfu"] = round(2 * n_params * headline / peak, 5)
         return out
     finally:
@@ -202,16 +219,21 @@ def main() -> None:
     extras["distilgpt2"] = distil
 
     if platform == "tpu":
-        try:  # BASELINE rung 2; random init — nothing downloads
+        try:  # BASELINE rung 2; random init — nothing downloads. Decode is
+            # weight-bound at 2.5B params, so batch 32 rides nearly free:
+            # the cache adds ~19 MB/row against 5 GB of weights per step
             extras["gemma-2b"] = bench_model(
-                "gemma-2b", max_seq_len=1024, concurrencies=(1, 8), new_tokens=64
+                "gemma-2b", max_seq_len=1024, concurrencies=(1, 8, 32), new_tokens=64
             )
         except Exception as e:  # noqa: BLE001 — the rung must not kill the bench
             log(f"gemma-2b rung failed: {e}")
             extras["gemma-2b"] = {"error": str(e)}
 
     ref = bench_reference_path()
-    headline = distil["batch8"]["tok_per_s"]
+    headline_entry = distil.get("batch8") or {}
+    if "tok_per_s" not in headline_entry:  # degraded chip: fall back to b1
+        headline_entry = distil["batch1"]
+    headline = headline_entry["tok_per_s"]
     extras["single_stream_tok_per_s"] = distil["batch1"]["tok_per_s"]
     extras["p50_latency_s"] = distil["p50_latency_s_short"]
     vs = round(headline / ref, 3) if ref > 0 else 0.0
