@@ -167,6 +167,76 @@ def serve_stage(model, n_stages, stage, checkpoint, max_seq_len, **kw):
         click.echo("shutting down")
 
 
+@cli.command("serve-pipeline")
+@click.option("--model", required=True, help="model name or config key")
+@click.option("--stage-peers", required=True,
+              help="comma-separated ws:// addrs of serve-stage workers, "
+                   "in stage order")
+@click.option("--checkpoint", default=None,
+              help="checkpoint dir readable by the WORKERS (part_load path)")
+@click.option("--max-seq-len", type=int, default=2048)
+@_common_opts
+def serve_pipeline(model, stage_peers, checkpoint, max_seq_len, **kw):
+    """Coordinate a model SPLIT ACROSS stage workers and serve it as a
+    normal mesh service (BASELINE config 4: layers [0,L/2) on one peer,
+    [L/2,L) on another; activations hop as binary tensor frames).
+
+    Start workers first (`serve-stage`), then this coordinator:
+    part_load is pushed to every worker, and the chained generation is
+    announced like any other model — gateway /chat, mesh gen_request,
+    and streaming all work unchanged."""
+    from .meshnet.pipeline import PipelineCoordinator
+    from .meshnet.runtime import run_p2p_node
+    from .services.pipeline import PipelineService
+
+    _setup_logging()
+    cfg = _apply_common_cfg(load_config(), kw)
+    addrs = [a.strip() for a in stage_peers.split(",") if a.strip()]
+    if not addrs:
+        raise click.ClickException("no stage peers given")
+
+    async def main():
+        import asyncio as _asyncio
+
+        async def setup(node):
+            # dial the workers in stage order; peer ids come from hello
+            peer_ids = []
+            for addr in addrs:
+                if not await node.connect_bootstrap(addr):
+                    raise RuntimeError(f"cannot reach stage worker {addr}")
+            for _ in range(100):
+                peer_ids = [node.peer_for_addr(a) for a in addrs]
+                if all(peer_ids):
+                    break
+                await _asyncio.sleep(0.1)
+            if not all(peer_ids):
+                raise RuntimeError(f"stage workers not identified: {addrs}")
+            coordinator = PipelineCoordinator(
+                node, model, stage_peers=peer_ids,
+                max_seq_len=max_seq_len, dtype=cfg.dtype,
+            )
+            infos = await coordinator.load(checkpoint_path=checkpoint)
+            for i, info in enumerate(infos):
+                click.echo(f"stage {i} on {peer_ids[i]}: layers {info.get('layers')}")
+            svc = PipelineService(
+                coordinator, _asyncio.get_running_loop(), model,
+                price_per_token=cfg.price_per_token,
+                max_new_tokens=cfg.max_new_tokens,
+            )
+            await node.announce_service(svc)
+            click.echo(f"pipeline model {model} serving; join link: {node.join_link()}")
+
+        await run_p2p_node(
+            backend=None, model=model, cfg=cfg,
+            bootstrap=kw.get("bootstrap"), post_start=setup,
+        )
+
+    try:
+        asyncio.run(main())
+    except KeyboardInterrupt:
+        click.echo("shutting down")
+
+
 @cli.command("serve-fake")
 @click.option("--model", default="fake-model")
 @_common_opts

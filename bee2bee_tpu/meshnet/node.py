@@ -455,6 +455,19 @@ class P2PNode(StageTaskMixin):
             self._mark_departed(addr)
         await self._drop_peer(ws)
 
+    def peer_for_addr(self, addr: str) -> str | None:
+        """peer_id for a dialed OR announced address (scheme-insensitive).
+        A dialed peer may announce a different host than we dialed
+        (loopback dial vs LAN announce), so both are checked."""
+        key = self._addr_key(addr)
+        for pid, info in self.peers.items():
+            dial = self._dial_addr_by_ws.get(info.get("ws"))
+            if dial and self._addr_key(dial) == key:
+                return pid
+            if info.get("addr") and self._addr_key(info["addr"]) == key:
+                return pid
+        return None
+
     async def _peer_for(self, ws) -> str | None:
         async with self._lock:
             for pid, info in self.peers.items():

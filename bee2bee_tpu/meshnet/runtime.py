@@ -98,6 +98,8 @@ async def run_p2p_node(
     dht=None,  # DHTNode for weight distribution (created on demand)
     publish_weights: bool = False,  # announce this node's params as pieces
     from_mesh: bool = False,  # tpu backend: fetch weights from the mesh DHT
+    post_start=None,  # async callback(node) after services are set up —
+    # the serve-pipeline coordinator wires its stage workers here
 ):
     """Boot a full serving node; runs until shutdown_event (or forever)."""
     cfg = cfg or load_config()
@@ -217,6 +219,8 @@ async def run_p2p_node(
             if client.enabled:
                 registry_task = asyncio.create_task(client.sync_loop(node))
 
+        if post_start is not None:
+            await post_start(node)
         if ready_event is not None:
             ready_event.set()
         if shutdown_event is not None:

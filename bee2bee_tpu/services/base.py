@@ -98,3 +98,16 @@ def scrub_stop_words(text: str) -> str:
         if idx > 0:
             cut = min(cut, idx)
     return text[:cut]
+
+
+def scrub_stream_delta(acc_text: str, emitted: int) -> tuple[str, int, bool]:
+    """Streaming stop-scrub step over CUMULATIVE text: returns
+    (delta_to_emit, new_emitted, marker_hit). Holds back STOP_HOLDBACK
+    chars so a marker split across chunk boundaries never leaks its
+    prefix — the streamed bytes must equal what execute()'s full-text
+    scrub produces. Shared by every streaming backend (tpu / pipeline)."""
+    scrubbed = scrub_stop_words(acc_text)
+    if len(scrubbed) < len(acc_text):  # a marker completed: flush & stop
+        return scrubbed[emitted:], len(scrubbed), True
+    safe = max(emitted, len(scrubbed) - STOP_HOLDBACK)
+    return scrubbed[emitted:safe], safe, False

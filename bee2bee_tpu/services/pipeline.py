@@ -1,0 +1,156 @@
+"""PipelineService: cross-peer pipeline serving behind the BaseService
+contract.
+
+BASELINE config 4 (a model split across peers) as a FIRST-CLASS mesh
+service: a coordinator node part_loads stage workers
+(meshnet/pipeline.PipelineCoordinator), then this wrapper exposes the
+chained generation through the same execute/execute_stream contract
+every other backend speaks — so a pipeline-split model is served
+through the standard gateway, mesh routing, and streaming paths, not a
+bespoke code path. (Reference contrast: the worker hops exist at
+node.py:249-277 but nothing ever served them as a model.)
+
+Threading: services run on executor threads (meshnet node / HTTP
+gateway), while the coordinator speaks WebSockets on the node's asyncio
+loop — execute() bridges with run_coroutine_threadsafe against the loop
+captured at construction.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import queue
+import time
+from typing import Any, Iterator
+
+from .base import (
+    BaseService,
+    ServiceError,
+    parse_transcript,
+    scrub_stop_words,
+    scrub_stream_delta,
+)
+
+REQUEST_TIMEOUT_S = 300.0
+
+
+class PipelineService(BaseService):
+    def __init__(
+        self,
+        coordinator,  # meshnet.pipeline.PipelineCoordinator (stages loaded)
+        loop: asyncio.AbstractEventLoop,
+        model_name: str,
+        tokenizer=None,
+        price_per_token: float = 0.0,
+        max_new_tokens: int = 2048,
+    ):
+        super().__init__("pipeline")
+        self.coordinator = coordinator
+        self.loop = loop
+        self.model_name = model_name
+        if tokenizer is None:
+            from ..engine.tokenizer import load_tokenizer
+            from ..models import get_config
+
+            tokenizer = load_tokenizer(None, get_config(model_name).vocab_size)
+        self.tokenizer = tokenizer
+        self.price_per_token = price_per_token
+        self.max_new_tokens = max_new_tokens
+
+    def get_metadata(self) -> dict[str, Any]:
+        return {
+            "models": [self.model_name],
+            "price_per_token": self.price_per_token,
+            "max_new_tokens": self.max_new_tokens,
+            "backend": "pipeline",
+            "stages": len(self.coordinator.stage_peers),
+        }
+
+    def _gen_args(self, params: dict) -> tuple[list[int], dict]:
+        prompt = self._require_prompt(params)
+        messages, was_transcript = parse_transcript(prompt)
+        if was_transcript:
+            prompt = "\n".join(f"{m['role']}: {m['content']}" for m in messages)
+            prompt += "\nassistant:"
+        ids = self.tokenizer.encode(prompt)
+        kw = {
+            "max_new_tokens": min(
+                int(params.get("max_new_tokens", self.max_new_tokens)),
+                self.max_new_tokens,
+            ),
+            "temperature": float(params.get("temperature", 0.0)),
+            "eos_token_id": self.tokenizer.eos_token_id,
+        }
+        return ids, kw
+
+    def _run(self, coro):
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        try:
+            return fut.result(timeout=REQUEST_TIMEOUT_S)
+        except BaseException:
+            # cancel the coroutine (a hung worker would otherwise keep the
+            # request's KV-cache slots allocated on EVERY stage forever —
+            # generate's finally releases them only if it gets to run)
+            fut.cancel()
+            raise
+
+    def execute(self, params: dict[str, Any]) -> dict[str, Any]:
+        t0 = time.time()
+        ids, kw = self._gen_args(params)
+        try:
+            out_ids = self._run(self.coordinator.generate(ids, **kw))
+        except Exception as e:  # noqa: BLE001 — surface as a service error
+            raise ServiceError(f"pipeline generation failed: {e}") from e
+        text = scrub_stop_words(self.tokenizer.decode(out_ids))
+        return self.result_dict(text, len(out_ids), t0, self.price_per_token)
+
+    def execute_stream(self, params: dict[str, Any]) -> Iterator[str]:
+        ids, kw = self._gen_args(params)
+        q: queue.Queue = queue.Queue()
+        DONE = object()
+
+        def on_token(tok: int):
+            q.put(tok)
+
+        async def run():
+            try:
+                await self.coordinator.generate(ids, on_token=on_token, **kw)
+                q.put(DONE)
+            except Exception as e:  # noqa: BLE001 — stream-error contract
+                q.put(e)
+
+        producer = asyncio.run_coroutine_threadsafe(run(), self.loop)
+        out_ids: list[int] = []
+        emitted = 0  # chars of scrub(acc) already yielded (see base helper)
+        deadline = time.time() + REQUEST_TIMEOUT_S
+        while True:
+            try:
+                item = q.get(timeout=max(0.1, deadline - time.time()))
+            except queue.Empty:
+                producer.cancel()  # release worker-side KV slots
+                yield self.stream_line(
+                    {"status": "error", "message": "Stream error: pipeline timeout"}
+                )
+                return
+            if item is DONE:
+                break
+            if isinstance(item, Exception):
+                yield self.stream_line(
+                    {"status": "error", "message": f"Stream error: {item}"}
+                )
+                return
+            out_ids.append(item)
+            # cumulative decode keeps multi-byte tokens UTF-8-safe; the
+            # shared holdback keeps streamed bytes identical to execute()'s
+            # scrubbed full text (no role-marker prefix ever leaks)
+            acc = self.tokenizer.decode(out_ids).rstrip("�")
+            delta, emitted, hit = scrub_stream_delta(acc, emitted)
+            if delta:
+                yield self.stream_line({"text": delta})
+            if hit:
+                producer.cancel()  # the rest would be scrubbed anyway
+                break
+        tail = scrub_stop_words(self.tokenizer.decode(out_ids))
+        if tail[emitted:]:
+            yield self.stream_line({"text": tail[emitted:]})
+        yield self.stream_line({"done": True})

@@ -9,11 +9,11 @@ import time
 from typing import Any, Iterator
 
 from .base import (
-    STOP_HOLDBACK,
     BaseService,
     ServiceError,
     parse_transcript,
     scrub_stop_words,
+    scrub_stream_delta,
 )
 
 
@@ -101,8 +101,8 @@ class TPUService(BaseService):
             raise ServiceError("Model not loaded")
         args = self._gen_args(params)
         try:
-            # hold back STOP_HOLDBACK chars so a stop marker split across
-            # chunk boundaries never leaks its prefix to the client (execute()
+            # scrub_stream_delta holds back chars so a stop marker split
+            # across chunk boundaries never leaks its prefix (execute()
             # scrubs the full text; streaming must match it byte-for-byte)
             acc = ""  # full raw accumulation
             emitted = 0  # chars of scrub(acc) already yielded
@@ -113,15 +113,11 @@ class TPUService(BaseService):
                         yield self.stream_line({"text": tail[emitted:]})
                     break
                 acc += ev.get("text", "")
-                scrubbed = scrub_stop_words(acc)
-                if len(scrubbed) < len(acc):  # a marker completed: flush & stop
-                    if scrubbed[emitted:]:
-                        yield self.stream_line({"text": scrubbed[emitted:]})
+                delta, emitted, hit = scrub_stream_delta(acc, emitted)
+                if delta:
+                    yield self.stream_line({"text": delta})
+                if hit:
                     break
-                safe = max(emitted, len(scrubbed) - STOP_HOLDBACK)
-                if scrubbed[emitted:safe]:
-                    yield self.stream_line({"text": scrubbed[emitted:safe]})
-                    emitted = safe
             yield self.stream_line({"done": True})
         except Exception as e:  # match reference stream-error contract
             yield self.stream_line({"status": "error", "message": f"Stream error: {e}"})

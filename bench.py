@@ -131,21 +131,27 @@ def bench_model(name: str, max_seq_len: int, concurrencies=(1, 8),
         out: dict = {"n_params": n_params, "platform": platform}
         done_c = []
         for c in concurrencies:
-            try:
-                best = None
-                for _ in range(2):
+            best, err = None, None
+            for _ in range(2):
+                try:
                     r = _bench_concurrency(eng, rng_prompts[:c], new_tokens)
-                    if best is None or r["tok_per_s"] > best["tok_per_s"]:
-                        best = r
-            except Exception as e:  # noqa: BLE001 — e.g. OOM at batch 32:
-                # keep the lower-concurrency results already measured
-                log(f"{name} concurrency {c} failed ({e}); keeping lower rungs")
-                out[f"batch{c}"] = {"error": str(e)}
+                except Exception as e:  # noqa: BLE001 — e.g. OOM at batch 32
+                    err = e
+                    break
+                if best is None or r["tok_per_s"] > best["tok_per_s"]:
+                    best = r
+            if best is not None:
+                # a transient failure on the repeat run must not discard a
+                # completed measurement of the same level
+                done_c.append(c)
+                out[f"batch{c}"] = best
+                log(f"{name} concurrency {c}: {best['tok_per_s']} tok/s "
+                    f"(p50 {best['p50_latency_s']}s)"
+                    + (f" [repeat run failed: {err}]" if err else ""))
+            else:
+                log(f"{name} concurrency {c} failed ({err}); keeping lower rungs")
+                out[f"batch{c}"] = {"error": str(err)}
                 break
-            done_c.append(c)
-            out[f"batch{c}"] = best
-            log(f"{name} concurrency {c}: {best['tok_per_s']} tok/s "
-                f"(p50 {best['p50_latency_s']}s)")
         if not done_c:
             raise RuntimeError(f"{name}: no concurrency level completed")
 
@@ -231,8 +237,12 @@ def main() -> None:
 
     ref = bench_reference_path()
     headline_entry = distil.get("batch8") or {}
-    if "tok_per_s" not in headline_entry:  # degraded chip: fall back to b1
+    metric = "serve_tokens_per_sec_distilgpt2_batch8"
+    if "tok_per_s" not in headline_entry:  # degraded chip: fall back to b1,
+        # and SAY so in the metric name — a dashboard must never compare
+        # single-stream throughput against true batch-8 numbers silently
         headline_entry = distil["batch1"]
+        metric = "serve_tokens_per_sec_distilgpt2_batch1_degraded"
     headline = headline_entry["tok_per_s"]
     extras["single_stream_tok_per_s"] = distil["batch1"]["tok_per_s"]
     extras["p50_latency_s"] = distil["p50_latency_s_short"]
@@ -240,7 +250,7 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "serve_tokens_per_sec_distilgpt2_batch8",
+                "metric": metric,
                 "value": round(headline, 2),
                 "unit": "tok/s",
                 "vs_baseline": vs,
