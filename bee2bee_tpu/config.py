@@ -30,6 +30,7 @@ _ENV_MAP = {
     "BEE2BEE_ATTENTION": "attention",
     "BEE2BEE_PREFILL_CHUNK": "prefill_chunk",
     "BEE2BEE_PREFIX_CACHE": "prefix_cache_entries",
+    "BEE2BEE_QUANTIZE": "quantize",
     "BEE2BEE_AUTO_NAT": "auto_nat",
     "BEE2BEE_DHT_PORT": "dht_port",
     "BEE2BEE_DHT_BOOTSTRAP": "dht_bootstrap",
@@ -70,6 +71,8 @@ class NodeConfig:
     # prompt prefix cache entries (0 = off): chat turns resend the whole
     # transcript; cached prompt K/V makes turn N+1 prefill only the delta
     prefix_cache_entries: int = 0
+    # weight-only quantization: "none" | "int8" (halves decode HBM traffic)
+    quantize: str = "none"
     max_batch_size: int = 8  # continuous-batching rows (EngineConfig.max_batch)
     max_seq_len: int = 2048
     max_new_tokens: int = 2048  # reference default (services.py:28)
@@ -96,6 +99,7 @@ class NodeConfig:
             attention=self.attention,
             prefill_chunk=self.prefill_chunk or None,
             prefix_cache_entries=self.prefix_cache_entries,
+            quantize=self.quantize,
         )
 
 

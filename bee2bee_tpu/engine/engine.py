@@ -83,6 +83,11 @@ class EngineConfig:
     # [H, 8k, 8k] — gigabytes), and ONE compiled shape serves every
     # prompt length. None = whole-prompt power-of-two buckets.
     prefill_chunk: int | None = None
+    # weight-only quantization: "none" | "int8" (models/quant.py). Decode
+    # streams every weight per step, so int8 halves that HBM traffic;
+    # activations/KV stay in `dtype`. Applied after checkpoint load,
+    # before sharding.
+    quantize: str = "none"
     # prompt prefix cache: keep up to this many prompt K/V snapshots and
     # admit new requests from the longest cached prefix, prefilling only
     # the remainder. Chat transcripts resend the whole history every turn
@@ -131,6 +136,12 @@ class InferenceEngine:
         self.mesh = mesh if mesh is not None else local_mesh()
         partition.validate_divisibility(self.model_cfg, self.mesh)
         self._validate_attention_impl()
+        if self.engine_cfg.quantize not in ("none", "int8", "", None):
+            # fail BEFORE the (multi-GB) checkpoint load, like the other
+            # config validation above
+            raise ValueError(
+                f"quantize={self.engine_cfg.quantize!r}: only 'int8' or 'none'"
+            )
         self.dtype = jnp.dtype(self.engine_cfg.dtype)
         self.max_seq_len = min(self.engine_cfg.max_seq_len, self.model_cfg.max_seq_len)
         self.metrics = MetricsAggregator()
@@ -143,6 +154,10 @@ class InferenceEngine:
             params = core.init_params(
                 self.model_cfg, jax.random.key(self.engine_cfg.rng_seed), dtype=self.dtype
             )
+        if self.engine_cfg.quantize == "int8":
+            from ..models.quant import quantize_params
+
+            params = quantize_params(jax.device_get(params))
         self.params = partition.shard_params(params, self.mesh, cfg=self.model_cfg)
         self.tokenizer = tokenizer or load_tokenizer(checkpoint_path, self.model_cfg.vocab_size)
 

@@ -192,9 +192,24 @@ async def serve_model_from_mesh(
     loop = asyncio.get_running_loop()
 
     def build_engine():
+        import jax
+        import numpy as np
+
         params = _unflatten(flat)
         dtype = jnp.dtype(engine_config.dtype) if engine_config else jnp.bfloat16
-        params = __import__("jax").tree.map(lambda a: jnp.asarray(a, dtype), params)
+
+        def cast(path, a):
+            # a quantized publisher ships {"q": int8, "s": f32} subtrees:
+            # casting them to the engine dtype would silently undo the
+            # quantization (int8 -> bf16 payload, truncated scales).
+            # Integers pass through; scale leaves keep f32 precision.
+            if not np.issubdtype(np.asarray(a).dtype, np.floating):
+                return jnp.asarray(a)
+            if path and str(getattr(path[-1], "key", "")) == "s":
+                return jnp.asarray(a, jnp.float32)
+            return jnp.asarray(a, dtype)
+
+        params = jax.tree_util.tree_map_with_path(cast, params)
         return InferenceEngine(cfg, params, mesh=mesh, engine_config=engine_config)
 
     engine = await loop.run_in_executor(None, build_engine)

@@ -176,13 +176,24 @@ def _attention(q, k, v, mask, cfg: ModelConfig):
     return out.reshape(B, T, H * hd)
 
 
+def matmul(x, w):
+    """x @ w where w may be an int8 weight-only quantized subtree
+    {"q": int8 [..., in, out], "s": f32 [..., out]} (models/quant.py).
+    Per-out-channel scales commute with the dot, so dequant applies to
+    the OUTPUT — XLA fuses the int8 convert into the operand read and
+    the weights stream from HBM at half the bf16 bytes."""
+    if isinstance(w, dict) and "q" in w:
+        return (x @ w["q"].astype(x.dtype)) * w["s"].astype(x.dtype)
+    return x @ w
+
+
 def _mlp(x, p, cfg: ModelConfig):
-    up = x @ p["w_up"]
+    up = matmul(x, p["w_up"])
     if "b_up" in p:
         up = up + p["b_up"]
-    gate = x @ p["w_gate"] if "w_gate" in p else None
+    gate = matmul(x, p["w_gate"]) if "w_gate" in p else None
     h = _activate(up, gate, cfg)
-    out = h @ p["w_down"]
+    out = matmul(h, p["w_down"])
     if "b_down" in p:
         out = out + p["b_down"]
     return out
@@ -309,9 +320,9 @@ def transformer_block(
     H, Hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
     h = _norm(x, lp["ln1"], cfg)
-    q = h @ lp["attn"]["wq"]
-    k = h @ lp["attn"]["wk"]
-    v = h @ lp["attn"]["wv"]
+    q = matmul(h, lp["attn"]["wq"])
+    k = matmul(h, lp["attn"]["wk"])
+    v = matmul(h, lp["attn"]["wv"])
     if "bq" in lp["attn"]:
         q = q + lp["attn"]["bq"]
         k = k + lp["attn"]["bk"]
@@ -328,7 +339,7 @@ def transformer_block(
         attn_out = _attention(q, k, v, mask, cfg)
     else:
         attn_out = attn_fn(q, k, v, mask, cfg, positions=positions)
-    attn_out = attn_out @ lp["attn"]["wo"]
+    attn_out = matmul(attn_out, lp["attn"]["wo"])
     if "bo" in lp["attn"]:
         attn_out = attn_out + lp["attn"]["bo"]
     x = x + attn_out

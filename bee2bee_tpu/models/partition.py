@@ -60,9 +60,23 @@ _RULES: list[tuple[str, P]] = [
 ]
 
 
+def _unquant_path(path: str) -> tuple[str, str | None]:
+    """Strip a quantization leaf suffix: "attn/wq/q" -> ("attn/wq", "q").
+    models/quant.py stores int8 weights as {"q","s"} subtrees; partition
+    rules are written against the WEIGHT path."""
+    if path.endswith(("/q", "/s")):
+        return path[:-2], path[-1]
+    return path, None
+
+
 def spec_for_path(path: str) -> P:
+    path, leaf = _unquant_path(path)
     for suffix, spec in _RULES:
         if path.endswith(suffix):
+            if leaf == "s":
+                # per-out-channel scales [L, out]: shard like the weight's
+                # leading (layer) and trailing (out) axes
+                return P(spec[0], spec[-1])
             return spec
     return P()  # replicate by default
 
@@ -117,7 +131,9 @@ def shard_params(params, mesh: Mesh, cfg: ModelConfig | None = None):
     if cfg is not None and kv_replicated(cfg, mesh):
         specs = jax.tree_util.tree_map_with_path(
             lambda path, s: (
-                P() if _path_str(path).endswith(_KV_PARAM_SUFFIXES) else s
+                P()
+                if _unquant_path(_path_str(path))[0].endswith(_KV_PARAM_SUFFIXES)
+                else s
             ),
             specs,
         )
@@ -168,7 +184,7 @@ def flat_partition_specs(
     def visit(path, leaf):
         ps = _path_str(path)
         spec = tuple(spec_for_path(ps))
-        if kv_repl and ps.endswith(_KV_PARAM_SUFFIXES):
+        if kv_repl and _unquant_path(ps)[0].endswith(_KV_PARAM_SUFFIXES):
             spec = ()
         if mesh_axes:
             ok = all(

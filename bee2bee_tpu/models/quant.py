@@ -1,0 +1,67 @@
+"""Weight-only int8 quantization for serving.
+
+Decode is HBM-bandwidth-bound on the WEIGHTS (every step streams all of
+them); storing the big matmul weights as int8 + per-output-channel f32
+scales halves that traffic vs bf16 — the standard weight-only quant
+recipe, with no quality-relevant change to activations (which stay
+bf16/f32). The reference has no quantization story at all.
+
+Representation: a quantized weight is the subtree {"q": int8 [..., in,
+out], "s": f32 [..., out]} in place of the dense array. Per-OUT-channel
+scales commute with the matmul — (x @ q) * s == x @ (q * s) — so
+core.matmul dequantizes AFTER the dot and XLA fuses the int8->bf16
+convert into the dot's operand read (weights leave HBM as int8).
+
+What quantizes: attention projections (wq/wk/wv/wo) and dense-MLP
+weights (w_up/w_gate/w_down) — the bulk of a dense model. Embeddings
+(gather, often tied to the LM head), norms, biases, and MoE experts
+stay dense; MoE models still get their attention quantized.
+
+Engine flag: EngineConfig(quantize="int8") / BEE2BEE_QUANTIZE=int8.
+Partition rules treat {"q","s"} transparently (models/partition strips
+the /q and /s path suffixes; scales shard like the weight's out axis).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# path suffixes (models/partition path convention) that quantize
+QUANT_SUFFIXES = (
+    "attn/wq", "attn/wk", "attn/wv", "attn/wo",
+    "mlp/w_up", "mlp/w_gate", "mlp/w_down",
+)
+
+
+def is_quantized(w) -> bool:
+    return isinstance(w, dict) and "q" in w and "s" in w
+
+
+def quantize_weight(w: np.ndarray) -> dict:
+    """[..., in, out] float -> {"q": int8 same shape, "s": f32 [..., out]}
+    with symmetric per-out-channel scales (amax over the in dim)."""
+    w = np.asarray(w, np.float32)
+    amax = np.max(np.abs(w), axis=-2)  # [..., out]
+    s = (amax / 127.0).astype(np.float32)
+    safe = np.where(s == 0.0, 1.0, s)
+    q = np.clip(np.rint(w / safe[..., None, :]), -127, 127).astype(np.int8)
+    return {"q": q, "s": s}
+
+
+def dequantize_weight(qw: dict) -> np.ndarray:
+    return qw["q"].astype(np.float32) * qw["s"][..., None, :]
+
+
+def quantize_params(params: dict) -> dict:
+    """Return a copy of the param tree with QUANT_SUFFIXES weights
+    replaced by {"q","s"} subtrees (host-side numpy — runs before
+    shard_params so devices only ever see int8)."""
+
+    def walk(node, path=""):
+        if isinstance(node, dict):
+            return {k: walk(v, f"{path}/{k}" if path else k) for k, v in node.items()}
+        if path.endswith(QUANT_SUFFIXES):
+            return quantize_weight(np.asarray(node))
+        return node
+
+    return walk(params)

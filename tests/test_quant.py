@@ -1,0 +1,128 @@
+"""Weight-only int8 quantization (models/quant.py + core.matmul)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bee2bee_tpu.engine import EngineConfig, InferenceEngine
+from bee2bee_tpu.models import core, get_config
+from bee2bee_tpu.models.quant import (
+    dequantize_weight,
+    is_quantized,
+    quantize_params,
+    quantize_weight,
+)
+from bee2bee_tpu.parallel import MeshSpec, build_mesh
+
+KW = dict(max_seq_len=64, dtype="float32", cache_dtype="float32")
+
+
+def test_quantize_weight_roundtrip_error_bounded():
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((2, 32, 16)).astype(np.float32) * 0.05
+    qw = quantize_weight(w)
+    assert qw["q"].dtype == np.int8 and qw["s"].shape == (2, 16)
+    back = dequantize_weight(qw)
+    # symmetric int8: error <= scale/2 per element
+    assert np.max(np.abs(back - w) / np.maximum(qw["s"][:, None, :], 1e-12)) <= 0.5
+
+
+def test_quantize_weight_zero_column_safe():
+    w = np.zeros((4, 3), np.float32)
+    qw = quantize_weight(w)
+    assert np.all(qw["q"] == 0)
+    np.testing.assert_array_equal(dequantize_weight(qw), 0.0)
+
+
+def test_quantize_params_targets_only_matmuls():
+    cfg = get_config("tiny-llama")
+    params = quantize_params(
+        jax.device_get(core.init_params(cfg, jax.random.key(0), dtype=jnp.float32))
+    )
+    assert is_quantized(params["layers"]["attn"]["wq"])
+    assert is_quantized(params["layers"]["mlp"]["w_down"])
+    assert not is_quantized(params["tok_embed"])  # embeddings stay dense
+    assert not isinstance(params["layers"]["ln1"]["scale"], dict)
+
+
+def test_core_matmul_quantized_close_to_dense():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((3, 32)), jnp.float32)
+    w = rng.standard_normal((32, 16)).astype(np.float32) * 0.1
+    want = np.asarray(x) @ w
+    qw = quantize_weight(w)
+    got = core.matmul(x, {"q": jnp.asarray(qw["q"]), "s": jnp.asarray(qw["s"])})
+    np.testing.assert_allclose(np.asarray(got), want, atol=0.05, rtol=0.05)
+
+
+def test_quantized_forward_logits_close():
+    """The quality bar: int8 logits stay close to f32 logits."""
+    cfg = get_config("tiny-llama")
+    params = core.init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    qparams = jax.tree.map(
+        jnp.asarray, quantize_params(jax.device_get(params)),
+    )
+    ids = jnp.asarray([[5, 17, 99, 42, 7, 250, 8, 11]], jnp.int32)
+    want, _ = core.forward(params, cfg, ids, None, jnp.int32(0))
+    got, _ = core.forward(qparams, cfg, ids, None, jnp.int32(0))
+    diff = np.abs(np.asarray(got) - np.asarray(want))
+    spread = float(np.asarray(want).max() - np.asarray(want).min())
+    assert float(diff.max()) < 0.05 * max(spread, 1.0), (
+        f"quantized logits drifted: max diff {diff.max():.4f} vs spread {spread:.2f}"
+    )
+
+
+def test_engine_serves_quantized():
+    eng = InferenceEngine(
+        "tiny-llama", engine_config=EngineConfig(quantize="int8", **KW)
+    )
+    assert is_quantized(eng.params["layers"]["attn"]["wq"])
+    r = eng.generate([5, 17, 99, 42], max_new_tokens=8, temperature=0.0)
+    eng.close()
+    assert r.new_tokens == 8
+
+
+def test_engine_rejects_unknown_quantize():
+    with pytest.raises(ValueError, match="only 'int8'"):
+        InferenceEngine(
+            "tiny-llama", engine_config=EngineConfig(quantize="int4", **KW)
+        )
+
+
+def test_quantized_engine_on_tp_mesh_matches_single_device():
+    """Quantized weights shard under TP ({"q","s"} leaves follow the
+    weight's partition rules) and the rollout matches single-device."""
+    kw = dict(quantize="int8", **KW)
+    ref = InferenceEngine("tiny-llama", engine_config=EngineConfig(**kw))
+    want = ref.generate([5, 17, 99, 42, 7], max_new_tokens=8, temperature=0.0)
+    ref.close()
+
+    mesh = build_mesh(MeshSpec(model=2))
+    eng = InferenceEngine("tiny-llama", mesh=mesh, engine_config=EngineConfig(**kw))
+    wq = eng.params["layers"]["attn"]["wq"]
+    # int8 payload sharded on the out (head) dim; scales follow it
+    assert {s.data.shape[-1] for s in wq["q"].addressable_shards} == {
+        wq["q"].shape[-1] // 2
+    }
+    assert {s.data.shape[-1] for s in wq["s"].addressable_shards} == {
+        wq["s"].shape[-1] // 2
+    }
+    got = eng.generate([5, 17, 99, 42, 7], max_new_tokens=8, temperature=0.0)
+    eng.close()
+    assert got.token_ids == want.token_ids
+
+
+def test_quantized_mqa_replication():
+    """gemma-style MQA on a TP mesh: quantized K/V projections replicate
+    whole (the kv_replicated path must see through the /q,/s leaves)."""
+    mesh = build_mesh(MeshSpec(model=4))
+    eng = InferenceEngine(
+        "tiny-gemma", mesh=mesh, engine_config=EngineConfig(quantize="int8", **KW)
+    )
+    wk = eng.params["layers"]["attn"]["wk"]
+    full = wk["q"].shape
+    assert {s.data.shape for s in wk["q"].addressable_shards} == {full}  # replicated
+    r = eng.generate([5, 17, 99], max_new_tokens=4, temperature=0.0)
+    eng.close()
+    assert r.new_tokens == 4

@@ -80,6 +80,36 @@ async def test_fresh_peer_serves_from_mesh_with_zero_checkpoint():
             await dht.stop()
 
 
+async def test_quantized_publisher_join_keeps_int8():
+    """Regression: a peer joining from a quantized publisher must keep the
+    int8 payload and f32 scales — the old cast-everything path silently
+    upcast them, undoing the quantization."""
+    from bee2bee_tpu.models.quant import is_quantized, quantize_params
+
+    async with mesh(2) as (a, c):
+        dht = DHTNode()
+        await dht.start()
+        try:
+            qparams = jax.tree.map(
+                jnp.asarray, quantize_params(jax.device_get(_params()))
+            )
+            await weights.publish_model_weights(a, dht, CFG, qparams, mesh_axes={})
+            svc = await weights.serve_model_from_mesh(
+                c, dht, "tiny-llama", engine_config=ECFG
+            )
+            wq = svc.engine.params["layers"]["attn"]["wq"]
+            assert is_quantized(wq)
+            assert wq["q"].dtype == jnp.int8
+            assert wq["s"].dtype == jnp.float32
+            out = svc.execute(
+                {"prompt": "int8 join", "max_new_tokens": 4, "temperature": 0.0}
+            )
+            assert out["tokens"] == 4
+            svc.engine.close()
+        finally:
+            await dht.stop()
+
+
 async def test_fetch_tp_coordinate_gets_exact_slice():
     """A TP-group member fetches only its mesh coordinate's pieces."""
     async with mesh(2) as (a, c):
