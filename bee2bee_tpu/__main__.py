@@ -36,6 +36,8 @@ def _apply_common_cfg(cfg, kw):
         cfg.mesh_shape = kw["mesh_shape"]
     if kw.get("attention"):
         cfg.attention = kw["attention"]
+    if kw.get("quantize"):
+        cfg.quantize = kw["quantize"]
     return cfg
 
 
@@ -81,18 +83,21 @@ def cli():
 @click.option("--mesh-shape", default=None, help='e.g. "data:1,model:8" or "seq:4,model:2"')
 @click.option("--attention", type=click.Choice(["dense", "flash", "sp"]), default=None,
               help="dense | flash (pallas) | sp (seq-sharded long-context cache)")
+@click.option("--quantize", type=click.Choice(["none", "int8"]), default=None,
+              help="weight-only quantization (int8 halves decode HBM traffic)")
 @click.option("--publish-weights", is_flag=True,
               help="announce this node's params as DHT pieces for joiners")
 @click.option("--from-mesh", is_flag=True,
               help="fetch weights from mesh providers via the DHT "
                    "(zero local checkpoint)")
 @_common_opts
-def serve_tpu(model, checkpoint, mesh_shape, attention, publish_weights, from_mesh, **kw):
+def serve_tpu(model, checkpoint, mesh_shape, attention, quantize,
+              publish_weights, from_mesh, **kw):
     """Serve a model on TPU via the jit engine (the flagship entrypoint)."""
     _serve(
         "tpu", model, checkpoint=checkpoint, mesh_shape=mesh_shape,
-        attention=attention, publish_weights=publish_weights,
-        from_mesh=from_mesh, **kw
+        attention=attention, quantize=quantize,
+        publish_weights=publish_weights, from_mesh=from_mesh, **kw
     )
 
 

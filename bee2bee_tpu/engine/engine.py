@@ -146,17 +146,25 @@ class InferenceEngine:
         self.max_seq_len = min(self.engine_cfg.max_seq_len, self.model_cfg.max_seq_len)
         self.metrics = MetricsAggregator()
 
+        quantized = self.engine_cfg.quantize == "int8"
         if params is None and checkpoint_path:
             from ..models.loader import load_checkpoint
 
-            params = load_checkpoint(checkpoint_path, self.model_cfg, dtype=self.dtype)
+            # quantizing: keep the checkpoint HOST-side so the dense model
+            # never materializes in HBM (peak device memory stays int8-sized)
+            params = load_checkpoint(
+                checkpoint_path, self.model_cfg, dtype=self.dtype, host=quantized
+            )
         if params is None:
             params = core.init_params(
                 self.model_cfg, jax.random.key(self.engine_cfg.rng_seed), dtype=self.dtype
             )
-        if self.engine_cfg.quantize == "int8":
+        if quantized:
             from ..models.quant import quantize_params
 
+            # device_get is a no-op for the host-loaded checkpoint path;
+            # random-init params (tests/demos) do round-trip, but anything
+            # that fit dense at init fits trivially
             params = quantize_params(jax.device_get(params))
         self.params = partition.shard_params(params, self.mesh, cfg=self.model_cfg)
         self.tokenizer = tokenizer or load_tokenizer(checkpoint_path, self.model_cfg.vocab_size)

@@ -202,8 +202,11 @@ async def serve_model_from_mesh(
             # a quantized publisher ships {"q": int8, "s": f32} subtrees:
             # casting them to the engine dtype would silently undo the
             # quantization (int8 -> bf16 payload, truncated scales).
-            # Integers pass through; scale leaves keep f32 precision.
-            if not np.issubdtype(np.asarray(a).dtype, np.floating):
+            # INTEGER payloads pass through; scale leaves keep f32. The
+            # check must be issubdtype(..., np.integer) — ml_dtypes
+            # bfloat16 is NOT an np.floating subtype, so a "not floating"
+            # test would wrongly exempt every bf16 weight from the cast.
+            if np.issubdtype(np.asarray(a).dtype, np.integer):
                 return jnp.asarray(a)
             if path and str(getattr(path[-1], "key", "")) == "s":
                 return jnp.asarray(a, jnp.float32)

@@ -168,21 +168,35 @@ def _convert_llama(state, cfg: ModelConfig) -> dict:
     return params
 
 
-def load_checkpoint(path: str | Path, cfg: ModelConfig, dtype=jnp.bfloat16) -> dict:
+def _materialize(params, dtype, host: bool):
+    """Cast the tree to `dtype` — on DEVICE normally, or as HOST numpy
+    arrays (ml_dtypes handles bf16) when the caller wants to transform
+    weights before the upload (e.g. int8 quantization: materializing the
+    dense model in HBM first would double the load-time peak)."""
+    if host:
+        np_dtype = np.dtype(dtype)
+        return jax.tree.map(lambda a: np.asarray(a).astype(np_dtype), params)
+    return jax.tree.map(lambda a: jnp.asarray(a, dtype), params)
+
+
+def load_checkpoint(
+    path: str | Path, cfg: ModelConfig, dtype=jnp.bfloat16, host: bool = False
+) -> dict:
     """Load a LOCAL checkpoint directory into our param pytree.
 
     Accepts: a dir with *.safetensors / pytorch_model*.bin (HF layout), or a
-    dir produced by save_native().
+    dir produced by save_native(). host=True keeps the tree in host memory
+    (see _materialize).
     """
     path = Path(path)
     if (path / "bee2bee_manifest.json").exists():
-        return load_native(path, dtype=dtype)
+        return load_native(path, dtype=dtype, host=host)
     state = _load_hf_state(path)
     if any(".c_attn." in k for k in state):
         params = _convert_gpt2(state, cfg)
     else:
         params = _convert_llama(state, cfg)
-    return jax.tree.map(lambda a: jnp.asarray(a, dtype), params)
+    return _materialize(params, dtype, host)
 
 
 # ---- native format: content-addressed pieces + manifest ---------------------
@@ -209,7 +223,7 @@ def save_native(params, cfg: ModelConfig, path: str | Path, mesh_axes: dict[str,
     return manifest
 
 
-def load_native(path: str | Path, dtype=jnp.bfloat16) -> dict:
+def load_native(path: str | Path, dtype=jnp.bfloat16, host: bool = False) -> dict:
     from ..pieces import ShardManifest, load_piece
 
     path = Path(path)
@@ -227,7 +241,7 @@ def load_native(path: str | Path, dtype=jnp.bfloat16) -> dict:
             shard = next(p for p in manifest.pieces if p.param == k)
             flat[k] = np.concatenate(v, axis=shard.axis)
     params = _unflatten(flat)
-    return jax.tree.map(lambda a: jnp.asarray(a, dtype), params)
+    return _materialize(params, dtype, host)
 
 
 def _flatten(params, prefix="") -> dict[str, np.ndarray]:

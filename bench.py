@@ -106,7 +106,8 @@ def _bench_concurrency(eng, prompts: list[list[int]], new_tokens: int) -> dict:
 
 
 def bench_model(name: str, max_seq_len: int, concurrencies=(1, 8),
-                new_tokens: int = NEW_TOKENS, dtype: str = "bfloat16") -> dict:
+                new_tokens: int = NEW_TOKENS, dtype: str = "bfloat16",
+                quantize: str = "none") -> dict:
     import jax
 
     from bee2bee_tpu.engine import EngineConfig, InferenceEngine
@@ -115,7 +116,7 @@ def bench_model(name: str, max_seq_len: int, concurrencies=(1, 8),
         name,
         engine_config=EngineConfig(
             max_seq_len=max_seq_len, max_batch=max(concurrencies), dtype=dtype,
-            cache_dtype=dtype,
+            cache_dtype=dtype, quantize=quantize,
         ),
     )
     try:
@@ -234,6 +235,15 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — the rung must not kill the bench
             log(f"gemma-2b rung failed: {e}")
             extras["gemma-2b"] = {"error": str(e)}
+        try:  # int8 weight-only quant: decode is weight-bound, so halved
+            # weight bytes should show directly in tok/s (models/quant.py)
+            extras["gemma-2b-int8"] = bench_model(
+                "gemma-2b", max_seq_len=1024, concurrencies=(1, 8),
+                new_tokens=64, quantize="int8",
+            )
+        except Exception as e:  # noqa: BLE001
+            log(f"gemma-2b int8 rung failed: {e}")
+            extras["gemma-2b-int8"] = {"error": str(e)}
 
     ref = bench_reference_path()
     headline_entry = distil.get("batch8") or {}

@@ -73,6 +73,38 @@ def test_quantized_forward_logits_close():
     )
 
 
+def test_host_checkpoint_load_for_quantize(tmp_path):
+    """quantize='int8' must load checkpoints host-side (the dense model
+    never materializes in HBM) and serve identically to the dense load."""
+    from bee2bee_tpu.models.loader import load_checkpoint, save_native
+
+    cfg = get_config("tiny-llama")
+    params = core.init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    save_native(jax.device_get(params), cfg, tmp_path / "ckpt")
+
+    host = load_checkpoint(tmp_path / "ckpt", cfg, dtype=jnp.float32, host=True)
+    assert isinstance(jax.tree.leaves(host)[0], np.ndarray)  # not on device
+
+    eng = InferenceEngine(
+        "tiny-llama",
+        checkpoint_path=str(tmp_path / "ckpt"),
+        engine_config=EngineConfig(quantize="int8", **KW),
+    )
+    r = eng.generate([5, 17, 99], max_new_tokens=4, temperature=0.0)
+    eng.close()
+    assert r.new_tokens == 4
+
+
+def test_mesh_join_bf16_still_casts_to_engine_dtype():
+    """Regression: ml_dtypes bfloat16 is NOT np.floating — the quant
+    pass-through must key on np.integer, or bf16 weights skip the cast."""
+    import ml_dtypes
+
+    assert not np.issubdtype(np.dtype(ml_dtypes.bfloat16), np.floating)
+    assert not np.issubdtype(np.dtype(ml_dtypes.bfloat16), np.integer)
+    assert np.issubdtype(np.int8, np.integer)
+
+
 def test_engine_serves_quantized():
     eng = InferenceEngine(
         "tiny-llama", engine_config=EngineConfig(quantize="int8", **KW)
