@@ -185,10 +185,52 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
             }
         )
 
+    async def metrics(request):
+        """Prometheus text exposition of the node's live gauges — scrape
+        with any standard collector (the reference's only machine surface
+        is JSON status; this is the ops-stack-native variant)."""
+        from . import utils
+
+        snap = node.throughput.snapshot()
+        # None: one snapshot is enough — only cpu/gpu are read from sysm
+        sysm = utils.get_system_metrics(None)
+        lines = [
+            "# TYPE bee2bee_tokens_per_sec gauge",
+            f"bee2bee_tokens_per_sec {snap.get('tokens_per_sec', 0.0)}",
+            "# TYPE bee2bee_total_tokens counter",
+            f"bee2bee_total_tokens {snap.get('total_tokens', 0)}",
+            "# TYPE bee2bee_total_requests counter",
+            f"bee2bee_total_requests {snap.get('total_requests', 0)}",
+            "# TYPE bee2bee_peers gauge",
+            f"bee2bee_peers {len(node.peers)}",
+            "# TYPE bee2bee_providers gauge",
+            f"bee2bee_providers {sum(len(v) for v in node.providers.values())}",
+            "# TYPE bee2bee_local_services gauge",
+            f"bee2bee_local_services {len(node.local_services)}",
+            "# TYPE bee2bee_pieces gauge",
+            f"bee2bee_pieces {len(node.piece_store)}",
+            "# TYPE bee2bee_cpu_percent gauge",
+            f"bee2bee_cpu_percent {sysm.get('cpu', 0.0)}",
+            "# TYPE bee2bee_accelerator_mem_percent gauge",
+            f"bee2bee_accelerator_mem_percent {sysm.get('gpu', 0.0)}",
+        ]
+        p50 = snap.get("p50_latency_s")
+        if p50 is not None:
+            lines += [
+                "# TYPE bee2bee_p50_latency_seconds gauge",
+                f"bee2bee_p50_latency_seconds {p50}",
+            ]
+        return web.Response(
+            text="\n".join(lines) + "\n",
+            content_type="text/plain",
+            charset="utf-8",
+        )
+
     app.router.add_get("/", home)
     app.router.add_get("/peers", peers)
     app.router.add_get("/providers", providers)
     app.router.add_get("/trace", trace)
+    app.router.add_get("/metrics", metrics)
     app.router.add_post("/connect", connect)
     app.router.add_post("/chat", chat)
     app.router.add_post("/generate", chat)  # alias (reference api.py:190-191)
@@ -237,16 +279,25 @@ async def _stream_service(request, node: P2PNode, svc, params, cors=()) -> web.S
 
     # span + copy_context mirror node._execute_local (the service lines pass
     # through verbatim here, so we can't reuse it directly)
+    import time as _time
+
     with get_tracer().span("gen.local", service=svc.name, stream=True) as span:
         ctx = contextvars.copy_context()
         task = loop.run_in_executor(None, ctx.run, pump)
         chunks = 0
+        text_chars = 0
+        t0 = _time.time()
         try:
             while True:
                 item = await q.get()
                 if item is DONE:
                     break
                 chunks += 1
+                try:  # count streamed text for the node's measured throughput
+                    obj = json.loads(item)
+                    text_chars += len(obj.get("text") or "")
+                except ValueError:
+                    pass
                 await resp.write(item.encode("utf-8"))
             await resp.write_eof()
         except (ConnectionResetError, asyncio.CancelledError):
@@ -256,6 +307,9 @@ async def _stream_service(request, node: P2PNode, svc, params, cors=()) -> web.S
             span.attrs["chunks"] = chunks
             cancelled.set()
             await task
+            # node-level measured throughput must not miss the streaming
+            # path (chars/4 = the reference's own token estimate)
+            node.throughput.record(max(0, text_chars // 4), _time.time() - t0)
     return resp
 
 

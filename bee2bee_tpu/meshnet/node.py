@@ -638,11 +638,26 @@ class P2PNode(StageTaskMixin):
                         if obj.get("status") == "error":
                             raise RuntimeError(obj.get("message", "stream error"))
 
+                t0 = time.time()
                 await loop.run_in_executor(None, ctx.run, run_stream)
                 span.attrs["chunks"] = len(text_parts)
+                # mesh-level throughput: streamed token counts live in the
+                # service layer; chars/4 is the reference's own estimate
+                est = max(1, len("".join(text_parts)) // 4) if text_parts else 0
+                if est:
+                    self.throughput.record(est, time.time() - t0)
                 return {"text": "".join(text_parts), "tokens": None, "streamed": True}
             result = await loop.run_in_executor(None, ctx.run, svc.execute, params)
             span.attrs["tokens"] = result.get("tokens")
+            # feed the node's advertised throughput (rides pings/registry/
+            # metrics — the reference FABRICATES this number, we measure
+            # it). `is not None`: a 0-token completion (instant EOS,
+            # max_new_tokens=0) still counts as a served request.
+            if result.get("tokens") is not None:
+                self.throughput.record(
+                    int(result["tokens"]),
+                    float(result.get("latency_ms") or 0) / 1000.0,
+                )
             return result
 
     async def _handle_gen_request(self, ws, data):

@@ -168,3 +168,28 @@ async def test_unknown_model_not_served_by_wrong_local_service():
             assert r.status == 404
         finally:
             await client.close()
+
+
+async def test_metrics_prometheus_exposition():
+    """GET /metrics: Prometheus text format with the node's live gauges."""
+    async with mesh(1) as (node,):
+        node.add_service(FakeService("m", reply="four words here now"))
+        client = await _client(node)
+        try:
+            await client.post("/chat", json={"prompt": "hi", "model": "m"})
+            resp = await client.get("/metrics")
+            assert resp.status == 200
+            assert resp.content_type == "text/plain"
+            body = await resp.text()
+            assert "# TYPE bee2bee_tokens_per_sec gauge" in body
+            lines = {
+                l.split(" ")[0]: l.split(" ")[1]
+                for l in body.splitlines()
+                if l and not l.startswith("#")
+            }
+            assert float(lines["bee2bee_local_services"]) == 1
+            # serving recorded into the node's MEASURED throughput
+            assert float(lines["bee2bee_total_requests"]) >= 1
+            assert float(lines["bee2bee_total_tokens"]) >= 1
+        finally:
+            await client.close()
