@@ -332,8 +332,11 @@ def register(bootstrap):
                    "run the SAME command on every host")
 @click.option("--num-hosts", type=int, default=1, envvar="BEE2BEE_NUM_HOSTS")
 @click.option("--host-id", type=int, default=0, envvar="BEE2BEE_HOST_ID")
+@click.option("--zero1", is_flag=True,
+              help="shard optimizer state over the data axis (ZeRO-1): "
+                   "saves ~2x params of HBM per replica")
 def train(model, data_path, steps, batch_size, seq_len, lr, ckpt_dir, ckpt_every,
-          mesh_shape, coordinator, num_hosts, host_id):
+          mesh_shape, coordinator, num_hosts, host_id, zero1):
     """Train a causal LM on a local text corpus (checkpoint/resume-able).
 
     The SPMD realization of the reference's per-layer WS training protocol
@@ -353,7 +356,7 @@ def train(model, data_path, steps, batch_size, seq_len, lr, ckpt_dir, ckpt_every
     from .train.trainer import TrainConfig, Trainer
 
     cfg = get_config(model)
-    tcfg = TrainConfig(learning_rate=lr, total_steps=steps)
+    tcfg = TrainConfig(learning_rate=lr, total_steps=steps, zero1=zero1)
     mesh = None
     if mesh_shape:
         from .config import parse_mesh_shape

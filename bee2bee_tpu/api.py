@@ -296,7 +296,9 @@ async def _stream_service(request, node: P2PNode, svc, params, cors=()) -> web.S
                 try:  # count streamed text for the node's measured throughput
                     obj = json.loads(item)
                     text_chars += len(obj.get("text") or "")
-                except ValueError:
+                except (ValueError, AttributeError, TypeError):
+                    # metrics must never kill a stream: non-object lines or
+                    # non-string "text" from custom services pass through
                     pass
                 await resp.write(item.encode("utf-8"))
             await resp.write_eof()

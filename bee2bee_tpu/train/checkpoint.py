@@ -173,11 +173,28 @@ def _abstract_state(
         }
         params_shape = jax.tree.map(with_sharding, params_shape, specs)
 
+        zero1 = (
+            getattr(train_cfg, "zero1", False) and mesh.shape.get("data", 1) > 1
+        )
+        n_data = mesh.shape.get("data", 1)
+
         def opt_sharding(path, leaf):
             ps = keystr(path)
             spec = next(
                 (s for pp, s in param_paths.items() if ps.endswith(pp)), P()
             )
+            if zero1 and leaf.ndim >= 1:
+                # mirror trainer.zero1_opt_specs: a --zero1 run's moments
+                # restore DATA-SHARDED — a replicated restore template
+                # would materialize the full moments per replica (OOM at
+                # exactly the scale zero1 exists for) and force a resharding
+                # retrace on the first post-resume step
+                entries = list(spec) + [None] * (leaf.ndim - len(spec))
+                for i, (e, d) in enumerate(zip(entries, leaf.shape)):
+                    if e is None and d % n_data == 0 and d >= n_data:
+                        entries[i] = "data"
+                        break
+                spec = P(*entries)
             return jax.ShapeDtypeStruct(
                 leaf.shape, leaf.dtype, sharding=NamedSharding(mesh, spec)
             )

@@ -45,6 +45,13 @@ class TrainConfig:
     total_steps: int = 0  # >0 enables cosine decay after warmup
     remat: bool = False
     param_dtype: str = "float32"  # master params; compute casts per model
+    # ZeRO-1 / cross-replica weight-update sharding (the "Automatic
+    # Cross-Replica Sharding of Weight Update in Data-Parallel Training"
+    # recipe, done the XLA way): Adam moments shard over the `data` axis
+    # instead of replicating — a constraint on the optimizer state is all
+    # it takes, the partitioner inserts the reduce-scatter/all-gather.
+    # Saves ~2x params of HBM per replica at data-parallel degree N.
+    zero1: bool = False
 
 
 class TrainState(struct.PyTreeNode):
@@ -96,6 +103,25 @@ def loss_fn(params, cfg: ModelConfig, batch: dict, remat: bool = False):
     return xent_loss_metrics(logits, ids, batch.get("loss_mask"))
 
 
+def zero1_opt_specs(opt_state, mesh: Mesh):
+    """PartitionSpec tree for the optimizer state with every param-shaped
+    leaf additionally sharded over `data` on its first divisible,
+    currently-unsharded dim. Scalars (step counts) stay replicated."""
+    n = mesh.shape.get("data", 1)
+
+    def widen(leaf):
+        spec = list(getattr(getattr(leaf, "sharding", None), "spec", ()) or ())
+        spec += [None] * (leaf.ndim - len(spec))
+        if n > 1 and leaf.ndim >= 1:
+            for i, (e, d) in enumerate(zip(spec, leaf.shape)):
+                if e is None and d % n == 0 and d >= n:
+                    spec[i] = "data"
+                    break
+        return P(*spec)
+
+    return jax.tree.map(widen, opt_state)
+
+
 def make_train_state(
     cfg: ModelConfig,
     tcfg: TrainConfig,
@@ -112,13 +138,26 @@ def make_train_state(
     opt_state = make_optimizer(tcfg).init(params)
     # adam moments inherit the param shardings by structure (same shapes);
     # jit propagates them from inputs, no explicit placement needed
+    if tcfg.zero1 and mesh is not None and mesh.shape.get("data", 1) > 1:
+        specs = zero1_opt_specs(opt_state, mesh)
+        opt_state = jax.tree.map(
+            lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+            opt_state, specs,
+        )
     return TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state)
 
 
-def make_step_from_loss(loss, tcfg: TrainConfig, batch_sharding=None, donate=True):
+def make_step_from_loss(
+    loss, tcfg: TrainConfig, batch_sharding=None, donate=True, opt_sharding=None
+):
     """Shared step body: loss(params, batch) -> (loss, metrics) becomes a
     jitted (state, batch) -> (state, metrics) with optimizer update,
-    grad_norm, optional batch sharding constraint, and state donation."""
+    grad_norm, optional batch sharding constraint, and state donation.
+
+    opt_sharding: a sharding pytree matching opt_state — the ZeRO-1 path
+    constrains the UPDATED optimizer state to it so the data-axis shard
+    survives every step (unconstrained propagation may silently follow
+    the replicated grads instead)."""
     opt = make_optimizer(tcfg)
 
     def step(state: TrainState, batch: dict):
@@ -131,6 +170,8 @@ def make_step_from_loss(loss, tcfg: TrainConfig, batch_sharding=None, donate=Tru
             state.params, batch
         )
         updates, opt_state = opt.update(grads, state.opt_state, state.params)
+        if opt_sharding is not None:
+            opt_state = jax.lax.with_sharding_constraint(opt_state, opt_sharding)
         params = optax.apply_updates(state.params, updates)
         metrics = dict(metrics)
         metrics["grad_norm"] = optax.global_norm(grads)
@@ -142,7 +183,9 @@ def make_step_from_loss(loss, tcfg: TrainConfig, batch_sharding=None, donate=Tru
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
-def make_train_step(cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh | None = None):
+def make_train_step(
+    cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh | None = None, opt_sharding=None
+):
     """Returns jitted (state, batch) -> (state, metrics).
 
     With a mesh: the batch is constrained to ('data','seq') over (B, T) so
@@ -155,6 +198,7 @@ def make_train_step(cfg: ModelConfig, tcfg: TrainConfig, mesh: Mesh | None = Non
         lambda params, batch: loss_fn(params, cfg, batch, tcfg.remat),
         tcfg,
         batch_sharding,
+        opt_sharding=opt_sharding,
     )
 
 
@@ -179,7 +223,14 @@ class Trainer:
         self.state = make_train_state(
             model_cfg, self.train_cfg, jax.random.key(seed), params=params, mesh=mesh
         )
-        self._step = make_train_step(model_cfg, self.train_cfg, mesh)
+        opt_sharding = None
+        if self.train_cfg.zero1 and mesh is not None and mesh.shape.get("data", 1) > 1:
+            # the REAL placed state carries the widened (data-sharded)
+            # shardings — constrain the step to keep them
+            opt_sharding = jax.tree.map(lambda x: x.sharding, self.state.opt_state)
+        self._step = make_train_step(
+            model_cfg, self.train_cfg, mesh, opt_sharding=opt_sharding
+        )
 
     def _globalize(self, batch: dict) -> dict:
         """Multi-process: every host loads the SAME global batch (same
