@@ -151,6 +151,8 @@ def _abstract_state(
     )
 
     if mesh is not None:
+        from .trainer import opt_partition_specs
+
         specs = partition_specs(params_shape)
 
         def with_sharding(leaf, spec):
@@ -158,48 +160,19 @@ def _abstract_state(
                 leaf.shape, leaf.dtype, sharding=NamedSharding(mesh, spec)
             )
 
-        # optimizer moments (adam mu/nu) are param-tree-shaped subtrees, so
-        # their leaf KEYPATHS end with the corresponding param's keypath —
-        # match on that, never on shape (same-shaped params can carry
-        # opposite TP axes, e.g. attn wq vs wo)
-        from jax.tree_util import keystr, tree_flatten_with_path, tree_map_with_path
-
-        param_paths = {
-            keystr(path): spec
-            for (path, _), spec in zip(
-                tree_flatten_with_path(params_shape)[0],
-                jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P)),
-            )
-        }
-        params_shape = jax.tree.map(with_sharding, params_shape, specs)
-
+        # the ONE opt-spec builder (trainer.opt_partition_specs): keypath
+        # matching + divisibility fallback + zero1 data-widening — a
+        # --zero1 run's moments restore DATA-SHARDED; a replicated restore
+        # template would materialize the full moments per replica (OOM at
+        # exactly the scale zero1 exists for)
         zero1 = (
             getattr(train_cfg, "zero1", False) and mesh.shape.get("data", 1) > 1
         )
-        n_data = mesh.shape.get("data", 1)
-
-        def opt_sharding(path, leaf):
-            ps = keystr(path)
-            spec = next(
-                (s for pp, s in param_paths.items() if ps.endswith(pp)), P()
-            )
-            if zero1 and leaf.ndim >= 1:
-                # mirror trainer.zero1_opt_specs: a --zero1 run's moments
-                # restore DATA-SHARDED — a replicated restore template
-                # would materialize the full moments per replica (OOM at
-                # exactly the scale zero1 exists for) and force a resharding
-                # retrace on the first post-resume step
-                entries = list(spec) + [None] * (leaf.ndim - len(spec))
-                for i, (e, d) in enumerate(zip(entries, leaf.shape)):
-                    if e is None and d % n_data == 0 and d >= n_data:
-                        entries[i] = "data"
-                        break
-                spec = P(*entries)
-            return jax.ShapeDtypeStruct(
-                leaf.shape, leaf.dtype, sharding=NamedSharding(mesh, spec)
-            )
-
-        opt_shape = tree_map_with_path(opt_sharding, opt_shape)
+        opt_specs = opt_partition_specs(params_shape, opt_shape, mesh, zero1=zero1)
+        params_shape = jax.tree.map(with_sharding, params_shape, specs)
+        opt_shape = jax.tree.map(
+            with_sharding, opt_shape, opt_specs,
+        )
 
     return {
         "step": jax.ShapeDtypeStruct((), jax.numpy.int32)

@@ -103,23 +103,48 @@ def loss_fn(params, cfg: ModelConfig, batch: dict, remat: bool = False):
     return xent_loss_metrics(logits, ids, batch.get("loss_mask"))
 
 
-def zero1_opt_specs(opt_state, mesh: Mesh):
-    """PartitionSpec tree for the optimizer state with every param-shaped
-    leaf additionally sharded over `data` on its first divisible,
-    currently-unsharded dim. Scalars (step counts) stay replicated."""
-    n = mesh.shape.get("data", 1)
+def widen_spec(spec: P, shape, n: int) -> P:
+    """Add `data` to the first divisible, currently-unsharded dim — THE
+    zero1 widening rule, shared by init and checkpoint restore (a desync
+    would make a --zero1 resume reshard or OOM)."""
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    for i, (e, d) in enumerate(zip(entries, shape)):
+        if e is None and d % n == 0 and d >= n:
+            entries[i] = "data"
+            break
+    return P(*entries)
 
-    def widen(leaf):
-        spec = list(getattr(getattr(leaf, "sharding", None), "spec", ()) or ())
-        spec += [None] * (leaf.ndim - len(spec))
+
+def opt_partition_specs(params, opt_shape, mesh: Mesh, zero1: bool):
+    """PartitionSpec tree for the optimizer state: each param-shaped leaf
+    inherits its param's spec (keypath-suffix matching — same-shaped
+    params can carry opposite TP axes), degraded to replicated when the
+    dims don't divide the mesh (shard_params' own fallback), and widened
+    over `data` when zero1. Scalars (step counts) stay replicated."""
+    from jax.tree_util import keystr, tree_flatten_with_path, tree_map_with_path
+
+    from ..models.partition import _fits
+
+    specs = partition_specs(params)
+    param_paths = {
+        keystr(path): spec
+        for (path, _), spec in zip(
+            tree_flatten_with_path(params)[0],
+            jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P)),
+        )
+    }
+    n = mesh.shape.get("data", 1) if zero1 else 1
+
+    def build(path, leaf):
+        ps = keystr(path)
+        spec = next((s for pp, s in param_paths.items() if ps.endswith(pp)), P())
+        if not _fits(leaf, spec, mesh):
+            spec = P()
         if n > 1 and leaf.ndim >= 1:
-            for i, (e, d) in enumerate(zip(spec, leaf.shape)):
-                if e is None and d % n == 0 and d >= n:
-                    spec[i] = "data"
-                    break
-        return P(*spec)
+            spec = widen_spec(spec, leaf.shape, n)
+        return spec
 
-    return jax.tree.map(widen, opt_state)
+    return tree_map_with_path(build, opt_shape)
 
 
 def make_train_state(
@@ -135,15 +160,33 @@ def make_train_state(
         params = core.init_params(cfg, key, dtype=jnp.dtype(tcfg.param_dtype))
     if mesh is not None:
         params = shard_params(params, mesh)
-    opt_state = make_optimizer(tcfg).init(params)
-    # adam moments inherit the param shardings by structure (same shapes);
-    # jit propagates them from inputs, no explicit placement needed
+    opt = make_optimizer(tcfg)
     if tcfg.zero1 and mesh is not None and mesh.shape.get("data", 1) > 1:
-        specs = zero1_opt_specs(opt_state, mesh)
-        opt_state = jax.tree.map(
-            lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
-            opt_state, specs,
+        # moments are BORN data-sharded (jit init with out_shardings): an
+        # eager init would transiently allocate the replicated footprint —
+        # the exact allocation zero1 exists to avoid
+        opt_shape = jax.eval_shape(opt.init, params)
+        specs = opt_partition_specs(params, opt_shape, mesh, zero1=True)
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, P),
         )
+        opt_state = jax.jit(opt.init, out_shardings=shardings)(params)
+        n_sharded = sum(
+            1 for s in jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
+            if "data" in tuple(s)
+        )
+        if n_sharded == 0:
+            import logging
+
+            logging.getLogger("bee2bee_tpu.train").warning(
+                "zero1 requested but no optimizer leaf dim divides the data "
+                "axis (%d): moments stay replicated, no HBM saved",
+                mesh.shape.get("data", 1),
+            )
+    else:
+        # moments inherit the param shardings by structure (same shapes)
+        opt_state = opt.init(params)
     return TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state)
 
 
