@@ -1,0 +1,82 @@
+"""Feature composition: the serving knobs must work TOGETHER, not just
+alone — each combination pinned to the plain single-device rollout."""
+
+import numpy as np
+import pytest
+
+from bee2bee_tpu.engine import EngineConfig, InferenceEngine
+from bee2bee_tpu.parallel import MeshSpec, build_mesh
+
+KW = dict(max_seq_len=128, dtype="float32", cache_dtype="float32")
+PROMPT = list(np.random.default_rng(9).integers(3, 500, size=40))
+
+
+def _rollout(engine, n=8):
+    r = engine.generate(PROMPT, max_new_tokens=n, temperature=0.0)
+    engine.close()
+    return r.token_ids
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    return _rollout(InferenceEngine("tiny-llama", engine_config=EngineConfig(**KW)))
+
+
+def test_sp_with_prefix_cache_and_chunked_prefill(baseline):
+    eng = InferenceEngine(
+        "tiny-llama",
+        mesh=build_mesh(MeshSpec(seq=4)),
+        engine_config=EngineConfig(
+            attention="sp", prefix_cache_entries=4, prefill_chunk=16, **KW
+        ),
+    )
+    first = eng.generate(PROMPT, max_new_tokens=8, temperature=0.0).token_ids
+    second = eng.generate(PROMPT, max_new_tokens=8, temperature=0.0).token_ids
+    assert eng.scheduler.stats.prefix_hits == 1  # cache worked under SP
+    eng.close()
+    assert first == baseline and second == baseline
+
+
+def test_quantize_with_prefix_cache_and_chunks():
+    """int8 changes logits slightly, so pin quantized-combo rollouts to
+    the quantized-baseline rollout instead of the f32 one."""
+    qkw = dict(quantize="int8", **KW)
+    want = _rollout(InferenceEngine("tiny-llama", engine_config=EngineConfig(**qkw)))
+    eng = InferenceEngine(
+        "tiny-llama",
+        engine_config=EngineConfig(
+            prefix_cache_entries=4, prefill_chunk=16, **qkw
+        ),
+    )
+    first = eng.generate(PROMPT, max_new_tokens=8, temperature=0.0).token_ids
+    second = eng.generate(PROMPT, max_new_tokens=8, temperature=0.0).token_ids
+    assert eng.scheduler.stats.prefix_hits == 1
+    eng.close()
+    assert first == want and second == want
+
+
+def test_quantize_with_sp_mesh():
+    qkw = dict(quantize="int8", **KW)
+    want = _rollout(InferenceEngine("tiny-llama", engine_config=EngineConfig(**qkw)))
+    got = _rollout(
+        InferenceEngine(
+            "tiny-llama",
+            mesh=build_mesh(MeshSpec(data=2, seq=2, model=2)),
+            engine_config=EngineConfig(attention="sp", **qkw),
+        )
+    )
+    assert got == want
+
+
+def test_quantize_with_tp_flash_mesh():
+    """int8 + the pallas flash kernel + TP (interpret mode on CPU)."""
+    qkw = dict(quantize="int8", **KW)
+    want = _rollout(InferenceEngine("tiny-llama", engine_config=EngineConfig(**qkw)))
+    got = _rollout(
+        InferenceEngine(
+            "tiny-llama",
+            mesh=build_mesh(MeshSpec(model=2)),
+            engine_config=EngineConfig(attention="flash", **qkw),
+        )
+    )
+    assert got == want
