@@ -54,8 +54,14 @@ class ModelConfig:
         if self.moe_group_size < 1:
             raise ValueError(f"moe_group_size={self.moe_group_size} must be >= 1")
 
+    # families where attention width != d_model (gemma-7b: 16 heads of 256
+    # over d_model 3072) set this; None derives d_model // n_heads
+    head_dim_override: int | None = None
+
     @property
     def head_dim(self) -> int:
+        if self.head_dim_override is not None:
+            return self.head_dim_override
         return self.d_model // self.n_heads
 
     @property
@@ -120,7 +126,24 @@ CONFIGS: dict[str, ModelConfig] = {
         n_kv_heads=8, d_ff=14336, max_seq_len=8192, tie_embeddings=False,
         n_experts=8, n_experts_per_tok=2,
     ),
+    # -- larger members of the already-supported families --
+    "gemma-7b": ModelConfig(
+        # attention width 4096 != d_model 3072: heads are 256-dim like
+        # gemma-2b's, hence the explicit head_dim_override
+        name="gemma-7b", vocab_size=256000, d_model=3072, n_layers=28, n_heads=16,
+        n_kv_heads=16, d_ff=24576, max_seq_len=8192, activation="geglu",
+        embedding_scale=True, norm_eps=1e-6, norm_plus_one=True,
+        head_dim_override=256,
+    ),
+    "llama-3-70b": ModelConfig(
+        name="llama-3-70b", vocab_size=128256, d_model=8192, n_layers=80,
+        n_heads=64, n_kv_heads=8, d_ff=28672, max_seq_len=8192,
+        rope_theta=500000.0, tie_embeddings=False,
+    ),
 }
+
+# zephyr IS mistral-7b architecture — one definition, two names (drift-proof)
+CONFIGS["mistral-7b"] = replace(CONFIGS["zephyr-7b"], name="mistral-7b")
 
 
 def get_config(name: str, **overrides) -> ModelConfig:
@@ -139,14 +162,20 @@ def get_config(name: str, **overrides) -> ModelConfig:
             if "tiny" in short or not k.startswith("tiny-")
         }
         # tiers: exact short name > key contained in query > query contained
-        # in key; within a tier prefer the longest (most specific) key
+        # in key. Tie-breaks differ by direction: when the KEY is inside the
+        # query (tier 2), the longest key is the most specific match; when
+        # the QUERY is inside several keys (tier 3, e.g. "llama-3" matching
+        # both -8b and -70b), the SHORTEST key is the family default — the
+        # longest would silently resolve a bare family name to its biggest
+        # member
         tiers = (
-            [k for k in pool if k == short or flat(k) == flat(short)],
-            [k for k in pool if flat(k) in flat(short)],
-            [k for k in pool if flat(short) in flat(k)],
+            ([k for k in pool if k == short or flat(k) == flat(short)], max),
+            ([k for k in pool if flat(k) in flat(short)], max),
+            ([k for k in pool if flat(short) in flat(k)], min),
         )
-        hit = next((t for t in tiers if t), None)
+        hit = next(((t, pick) for t, pick in tiers if t), None)
         if hit is None:
             raise KeyError(f"no model config matches {name!r}; known: {sorted(CONFIGS)}")
-        cfg = pool[max(hit, key=len)]
+        t, pick = hit
+        cfg = pool[pick(t, key=len)]
     return replace(cfg, **overrides) if overrides else cfg

@@ -240,3 +240,47 @@ def test_moe_impl_validated():
 
     with pytest.raises(ValueError, match="moe_impl"):
         get_config("tiny-mixtral", moe_impl="Routed")
+
+
+def test_large_family_configs_resolve_and_validate():
+    """The bigger members of supported families: fuzzy names resolve, and
+    each fits its natural serving mesh (divisibility check — the configs
+    must actually serve, not just exist)."""
+    from bee2bee_tpu.models import get_config
+    from bee2bee_tpu.models.partition import validate_divisibility
+    from bee2bee_tpu.parallel import MeshSpec, build_mesh
+
+    cases = {
+        "google/gemma-7b": "gemma-7b",
+        "mistralai/Mistral-7B-v0.1": "mistral-7b",
+        "meta-llama/Meta-Llama-3-70B": "llama-3-70b",
+    }
+    mesh8 = build_mesh(MeshSpec(data=1, model=8))
+    for query, want in cases.items():
+        cfg = get_config(query)
+        assert cfg.name == want, (query, cfg.name)
+        validate_divisibility(cfg, mesh8)  # must not raise
+    # bare family names resolve to the family DEFAULT, not the biggest
+    assert get_config("llama-3").name == "llama-3-8b"
+    assert get_config("gemma").name == "gemma-2b"
+    # gemma-7b's 256-dim heads: attention width independent of d_model
+    g7 = get_config("gemma-7b")
+    assert g7.head_dim == 256 and g7.n_heads * g7.head_dim == 4096
+    # mistral-7b is zephyr's architecture under its own name (one source)
+    from dataclasses import asdict
+    z, m = asdict(get_config("zephyr-7b")), asdict(get_config("mistral-7b"))
+    z.pop("name"), m.pop("name")
+    assert z == m
+    # forward math smoke on a shrunken llama-3-70b-shaped config
+    import jax
+    import jax.numpy as jnp
+
+    from bee2bee_tpu.models import core
+
+    cfg = get_config("llama-3-70b", d_model=128, n_layers=2, n_heads=8,
+                     n_kv_heads=2, d_ff=256, vocab_size=512)
+    params = core.init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    logits, _ = core.forward(
+        params, cfg, jnp.asarray([[1, 5, 9]], jnp.int32), None, jnp.int32(0)
+    )
+    assert logits.shape == (1, 3, 512)
