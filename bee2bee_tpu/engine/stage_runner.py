@@ -70,12 +70,18 @@ class StageRunner:
             )
         self.params = stages.extract_stage_params(params, self.model_cfg, self.spec)
 
-        self._fwd = jax.jit(
-            lambda p, x, cache, off: stages.stage_forward(
-                p, self.model_cfg, self.spec, x, cache, off
-            ),
-            donate_argnums=(2,),
-        )
+        def _wrapped(p, x, cache, off, mask, gather):
+            out, c = stages.stage_forward(
+                p, self.model_cfg, self.spec, x, cache, off, write_mask=mask
+            )
+            if gather is not None and self.spec.is_last:
+                # per-row position pick: [B, T, V] -> [B, V]. Keeps a
+                # session prefill from shipping bucket*V logits per row
+                # over the wire when only one position per row matters.
+                out = out[jnp.arange(out.shape[0]), jnp.asarray(gather, jnp.int32)]
+            return out, c
+
+        self._fwd = jax.jit(_wrapped, donate_argnums=(2,))
         self._caches: dict[str, dict] = {}  # request_id -> {"cache", "touched"}
         self._lock = threading.Lock()
 
@@ -93,11 +99,22 @@ class StageRunner:
             "max_seq_len": self.max_seq_len,
         }
 
-    def forward(self, request_id: str, x: np.ndarray, offset: int) -> np.ndarray:
+    def forward(
+        self,
+        request_id: str,
+        x: np.ndarray,
+        offset,  # int | [B] int array — per-row write positions
+        write_mask=None,  # [B] bool — rows whose cache this call updates
+        gather=None,  # [B] int — last stage returns logits[b, gather[b]] only
+    ) -> np.ndarray:
         """Run a chunk through this stage against the request's cache.
 
         x: [B, T] int ids on the first stage, [B, T, D] hidden later.
-        Returns hidden [B, T, D] (f32) or logits [B, T, V] (f32, last)."""
+        Returns hidden [B, T, D] (f32) or logits [B, T, V] (f32, last).
+
+        A batched pipeline session passes offset as a [B] vector (each row
+        decodes at its own depth) and write_mask to admit one row's prefill
+        without touching live rows (meshnet/pipeline.PipelineSession)."""
         if self.spec.is_first:
             xj = jnp.asarray(x, jnp.int32)
             B = xj.shape[0]
@@ -125,8 +142,15 @@ class StageRunner:
                 # otherwise run uncached (None) and silently diverge
                 raise RuntimeError(f"concurrent forward for request {request_id!r}")
             entry["cache"] = None  # donated below; never leave a stale ref
+        off = jnp.asarray(np.asarray(offset, np.int32))
+        mask = None if write_mask is None else jnp.asarray(np.asarray(write_mask, bool))
+        gat = (
+            None
+            if (gather is None or not self.spec.is_last)
+            else jnp.asarray(np.asarray(gather, np.int32))
+        )
         try:
-            out, cache = self._fwd(self.params, xj, cache, jnp.int32(offset))
+            out, cache = self._fwd(self.params, xj, cache, off, mask, gat)
         except Exception:
             # free the slot: leaving the None entry would burn a max_batch
             # row for STALE_CACHE_S and turn retries into misleading

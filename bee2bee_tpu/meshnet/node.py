@@ -58,6 +58,9 @@ PING_INTERVAL_S = 15.0
 RECONNECT_INITIAL_S = 2.0
 RECONNECT_MAX_S = 30.0
 RECONNECT_WINDOW_S = 300.0
+# spawned gen/task handlers per connection before the reader processes
+# inline (TCP backpressure); sized past any engine/session batch depth
+MAX_CONCURRENT_SERVES_PER_CONN = 32
 
 
 class P2PNode(StageTaskMixin):
@@ -97,6 +100,7 @@ class P2PNode(StageTaskMixin):
         self._pending: dict[str, asyncio.Future] = {}
         self._chunk_cbs: dict[str, Callable[[str], None]] = {}
         self._tasks: list[asyncio.Task] = []
+        self._serving: dict[Any, int] = {}  # ws -> in-flight spawned serves
         self._stopped = False
         self.started_at: float | None = None
 
@@ -149,7 +153,10 @@ class P2PNode(StageTaskMixin):
     def addr(self) -> str:
         host = self.announce_host or (get_lan_ip() if self.host in ("0.0.0.0", "::") else self.host)
         port = self.announce_port or self.port
-        return f"ws://{host}:{port}"
+        # announce_scheme: "wss" when a TLS-terminating tunnel fronts us
+        # (cloudflared — tunnel.apply_to_node); peers dial wss directly
+        scheme = getattr(self, "announce_scheme", None) or "ws"
+        return f"{scheme}://{host}:{port}"
 
     def join_link(self) -> str:
         return generate_join_link(self.peer_id, [self.addr])
@@ -378,6 +385,30 @@ class P2PNode(StageTaskMixin):
         if handler is None:
             logger.debug("unknown message type %r", data.get("type"))
             return
+        # Serving handlers run as tasks so one long generation (or stage
+        # forward) never blocks this connection's reader — that's what lets
+        # concurrent gen_requests batch into one PipelineSession/engine
+        # batch, and lets a stage worker overlap tasks for different
+        # requests (pipeline microbatching). Bounded per connection: past
+        # the cap the handler runs inline, so the reader stops pulling
+        # frames and TCP backpressure paces a flooding peer instead of
+        # unbounded tasks/threads. Everything else stays inline:
+        # gen_chunk/result ordering is part of the streaming contract.
+        if data.get("type") in (protocol.GEN_REQUEST, protocol.TASK):
+            if self._serving.get(ws, 0) >= MAX_CONCURRENT_SERVES_PER_CONN:
+                await handler(ws, data)
+                return
+            self._serving[ws] = self._serving.get(ws, 0) + 1
+
+            def _served(_t, ws=ws):
+                left = self._serving.get(ws, 1) - 1
+                if left <= 0:
+                    self._serving.pop(ws, None)
+                else:
+                    self._serving[ws] = left
+
+            self._spawn(handler(ws, data)).add_done_callback(_served)
+            return
         await handler(ws, data)
 
     async def _handle_hello(self, ws, data):
@@ -458,9 +489,14 @@ class P2PNode(StageTaskMixin):
     def peer_for_addr(self, addr: str) -> str | None:
         """peer_id for a dialed OR announced address (scheme-insensitive).
         A dialed peer may announce a different host than we dialed
-        (loopback dial vs LAN announce), so both are checked."""
+        (loopback dial vs LAN announce), so both are checked.
+
+        Sync on purpose (callers aren't async) — safe because a sync
+        method on the loop thread can't interleave with the async
+        mutators; the list() snapshot keeps it safe even if a future
+        refactor calls this from an executor thread."""
         key = self._addr_key(addr)
-        for pid, info in self.peers.items():
+        for pid, info in list(self.peers.items()):
             dial = self._dial_addr_by_ws.get(info.get("ws"))
             if dial and self._addr_key(dial) == key:
                 return pid
@@ -627,27 +663,57 @@ class P2PNode(StageTaskMixin):
                 import json as _json
 
                 text_parts: list[str] = []
+                final: dict = {}  # real accounting off the done line
+
+                def feed(line: str, threadsafe: bool):
+                    obj = _json.loads(line)
+                    if obj.get("text"):
+                        text_parts.append(obj["text"])
+                        if on_chunk:
+                            if threadsafe:
+                                loop.call_soon_threadsafe(on_chunk, obj["text"])
+                            else:
+                                on_chunk(obj["text"])
+                    if obj.get("done") and obj.get("tokens") is not None:
+                        final["tokens"] = int(obj["tokens"])
+                        final["cost"] = float(obj.get("cost") or 0.0)
+                    if obj.get("status") == "error":
+                        raise RuntimeError(obj.get("message", "stream error"))
 
                 def run_stream():
                     for line in svc.execute_stream(params):
-                        obj = _json.loads(line)
-                        if obj.get("text"):
-                            text_parts.append(obj["text"])
-                            if on_chunk:
-                                loop.call_soon_threadsafe(on_chunk, obj["text"])
-                        if obj.get("status") == "error":
-                            raise RuntimeError(obj.get("message", "stream error"))
+                        feed(line, threadsafe=True)
 
                 t0 = time.time()
-                await loop.run_in_executor(None, ctx.run, run_stream)
+                stream_async = getattr(svc, "execute_stream_async", None)
+                if stream_async is not None:
+                    # loop-native service (e.g. PipelineService): no
+                    # executor thread blocked per request — the session
+                    # coroutine lives on this same loop
+                    async for line in stream_async(params):
+                        feed(line, threadsafe=False)
+                else:
+                    await loop.run_in_executor(None, ctx.run, run_stream)
                 span.attrs["chunks"] = len(text_parts)
-                # mesh-level throughput: streamed token counts live in the
-                # service layer; chars/4 is the reference's own estimate
-                est = max(1, len("".join(text_parts)) // 4) if text_parts else 0
+                # mesh-level throughput: real token counts ride the done
+                # line when the service reports them; chars/4 (the
+                # reference's estimate) is only the fallback
+                est = final.get("tokens") or (
+                    max(1, len("".join(text_parts)) // 4) if text_parts else 0
+                )
                 if est:
                     self.throughput.record(est, time.time() - t0)
-                return {"text": "".join(text_parts), "tokens": None, "streamed": True}
-            result = await loop.run_in_executor(None, ctx.run, svc.execute, params)
+                return {
+                    "text": "".join(text_parts),
+                    "tokens": final.get("tokens"),
+                    "cost": final.get("cost"),
+                    "streamed": True,
+                }
+            exec_async = getattr(svc, "execute_async", None)
+            if exec_async is not None:
+                result = await exec_async(params)
+            else:
+                result = await loop.run_in_executor(None, ctx.run, svc.execute, params)
             span.attrs["tokens"] = result.get("tokens")
             # feed the node's advertised throughput (rides pings/registry/
             # metrics — the reference FABRICATES this number, we measure

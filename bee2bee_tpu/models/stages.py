@@ -114,13 +114,19 @@ def stage_forward(
     x,  # [B, T] int32 ids (first stage) | [B, T, D] hidden (later stages)
     cache,  # init_stage_cache pytree or None (uncached full forward)
     offset,  # [] or [B] int32 write position, as core.forward
+    write_mask=None,  # [B] bool: rows whose cache this call may write
 ):
     """Run one stage. Returns (out, new_cache) where out is logits
     [B, T, V] on the last stage and hidden [B, T, D] otherwise.
 
     Mirrors core.forward's cache/mask semantics exactly — a chain of
     stage_forward calls over all stages is numerically identical to one
-    core.forward (test_stages asserts this)."""
+    core.forward (test_stages asserts this).
+
+    `write_mask` enables continuous batching across the wire: a new
+    request prefills into ITS row of a shared [B]-row session cache while
+    the other rows' K/V stay untouched (their outputs for this call are
+    discarded by the caller). None means write every row."""
     if spec.is_first:
         B, T = x.shape
     else:
@@ -155,13 +161,19 @@ def stage_forward(
         def kv_hook(k, v):
             nonlocal ck, cv
 
-            def write(row, new, start):
-                return lax.dynamic_update_slice(
+            def write(row, new, start, keep):
+                upd = lax.dynamic_update_slice(
                     row, new.astype(row.dtype), (start, 0, 0)
                 )
+                return jnp.where(keep, upd, row)
 
-            wk = jax.vmap(write)(ck[idx], k, off_b)
-            wv = jax.vmap(write)(cv[idx], v, off_b)
+            keep_b = (
+                jnp.ones((B,), bool)
+                if write_mask is None
+                else jnp.asarray(write_mask, bool)
+            )
+            wk = jax.vmap(write)(ck[idx], k, off_b, keep_b)
+            wv = jax.vmap(write)(cv[idx], v, off_b, keep_b)
             ck = ck.at[idx].set(wk)
             cv = cv.at[idx].set(wv)
             return wk, wv

@@ -19,7 +19,7 @@ captured at construction.
 from __future__ import annotations
 
 import asyncio
-import queue
+import concurrent.futures
 import time
 from typing import Any, Iterator
 
@@ -43,9 +43,17 @@ class PipelineService(BaseService):
         tokenizer=None,
         price_per_token: float = 0.0,
         max_new_tokens: int = 2048,
+        max_batch: int = 8,
+        n_microbatches: int = 1,  # >1: stages overlap microbatch groups
     ):
         super().__init__("pipeline")
         self.coordinator = coordinator
+        # concurrent execute() calls ride one continuous-batching session:
+        # n_stages wire hops per decode step for the whole batch, not per
+        # request (meshnet/pipeline.PipelineSession)
+        self.session = coordinator.session(
+            max_batch=max_batch, n_microbatches=n_microbatches
+        )
         self.loop = loop
         self.model_name = model_name
         if tokenizer is None:
@@ -98,59 +106,103 @@ class PipelineService(BaseService):
         t0 = time.time()
         ids, kw = self._gen_args(params)
         try:
-            out_ids = self._run(self.coordinator.generate(ids, **kw))
+            out_ids = self._run(self.session.generate(ids, **kw))
         except Exception as e:  # noqa: BLE001 — surface as a service error
             raise ServiceError(f"pipeline generation failed: {e}") from e
         text = scrub_stop_words(self.tokenizer.decode(out_ids))
         return self.result_dict(text, len(out_ids), t0, self.price_per_token)
 
-    def execute_stream(self, params: dict[str, Any]) -> Iterator[str]:
+    async def execute_async(self, params: dict[str, Any]) -> dict[str, Any]:
+        """Loop-native execute: the mesh node awaits this directly instead
+        of parking an executor thread on _run() — N concurrent requests
+        cost N coroutines, not N blocked threads, and all of them batch
+        into the one PipelineSession."""
+        t0 = time.time()
         ids, kw = self._gen_args(params)
-        q: queue.Queue = queue.Queue()
+        try:
+            out_ids = await asyncio.wait_for(
+                self.session.generate(ids, **kw), timeout=REQUEST_TIMEOUT_S
+            )
+        except Exception as e:  # noqa: BLE001 — surface as a service error
+            raise ServiceError(f"pipeline generation failed: {e}") from e
+        text = scrub_stop_words(self.tokenizer.decode(out_ids))
+        return self.result_dict(text, len(out_ids), t0, self.price_per_token)
+
+    async def execute_stream_async(self, params: dict[str, Any]):
+        """Async-generator twin of execute_stream for loop-native callers."""
+        ids, kw = self._gen_args(params)
+        q: asyncio.Queue = asyncio.Queue()
         DONE = object()
 
         def on_token(tok: int):
-            q.put(tok)
+            q.put_nowait(tok)  # session loop runs on this same event loop
 
         async def run():
             try:
-                await self.coordinator.generate(ids, on_token=on_token, **kw)
-                q.put(DONE)
+                await self.session.generate(ids, on_token=on_token, **kw)
+                q.put_nowait(DONE)
             except Exception as e:  # noqa: BLE001 — stream-error contract
-                q.put(e)
+                q.put_nowait(e)
 
-        producer = asyncio.run_coroutine_threadsafe(run(), self.loop)
+        producer = asyncio.get_running_loop().create_task(run())
         out_ids: list[int] = []
-        emitted = 0  # chars of scrub(acc) already yielded (see base helper)
+        emitted = 0
         deadline = time.time() + REQUEST_TIMEOUT_S
-        while True:
-            try:
-                item = q.get(timeout=max(0.1, deadline - time.time()))
-            except queue.Empty:
-                producer.cancel()  # release worker-side KV slots
-                yield self.stream_line(
-                    {"status": "error", "message": "Stream error: pipeline timeout"}
-                )
-                return
-            if item is DONE:
-                break
-            if isinstance(item, Exception):
-                yield self.stream_line(
-                    {"status": "error", "message": f"Stream error: {item}"}
-                )
-                return
-            out_ids.append(item)
-            # cumulative decode keeps multi-byte tokens UTF-8-safe; the
-            # shared holdback keeps streamed bytes identical to execute()'s
-            # scrubbed full text (no role-marker prefix ever leaks)
-            acc = self.tokenizer.decode(out_ids).rstrip("�")
-            delta, emitted, hit = scrub_stream_delta(acc, emitted)
-            if delta:
-                yield self.stream_line({"text": delta})
-            if hit:
-                producer.cancel()  # the rest would be scrubbed anyway
-                break
+        try:
+            while True:
+                try:
+                    item = await asyncio.wait_for(
+                        q.get(), timeout=max(0.1, deadline - time.time())
+                    )
+                except asyncio.TimeoutError:
+                    yield self.stream_line(
+                        {"status": "error", "message": "Stream error: pipeline timeout"}
+                    )
+                    return
+                if item is DONE:
+                    break
+                if isinstance(item, Exception):
+                    yield self.stream_line(
+                        {"status": "error", "message": f"Stream error: {item}"}
+                    )
+                    return
+                out_ids.append(item)
+                acc = self.tokenizer.decode(out_ids).rstrip("�")
+                delta, emitted, hit = scrub_stream_delta(acc, emitted)
+                if delta:
+                    yield self.stream_line({"text": delta})
+                if hit:
+                    break
+        finally:
+            if not producer.done():
+                producer.cancel()  # release the row on early exit
         tail = scrub_stop_words(self.tokenizer.decode(out_ids))
         if tail[emitted:]:
             yield self.stream_line({"text": tail[emitted:]})
-        yield self.stream_line({"done": True})
+        yield self.stream_line({
+            "done": True, "tokens": len(out_ids),
+            "cost": self.price_per_token * len(out_ids),
+        })
+
+    def execute_stream(self, params: dict[str, Any]) -> Iterator[str]:
+        """Thread-bridge over execute_stream_async: one streaming
+        implementation, two call conventions — executor-thread callers
+        pull each item off the loop via run_coroutine_threadsafe."""
+        agen = self.execute_stream_async(params)
+        try:
+            while True:
+                fut = asyncio.run_coroutine_threadsafe(agen.__anext__(), self.loop)
+                try:
+                    yield fut.result(timeout=REQUEST_TIMEOUT_S)
+                except StopAsyncIteration:
+                    return
+                except concurrent.futures.TimeoutError:
+                    fut.cancel()
+                    yield self.stream_line(
+                        {"status": "error", "message": "Stream error: pipeline timeout"}
+                    )
+                    return
+        finally:
+            # abandoned/errored consumer: close the generator ON THE LOOP
+            # so its producer task is cancelled and the session row retires
+            asyncio.run_coroutine_threadsafe(agen.aclose(), self.loop)
