@@ -119,20 +119,33 @@ class RegistryClient:
         if getattr(self, "_http", None) is not None and not self._http.is_closed:
             await self._http.aclose()
 
-    async def record_message(self, node_id: str, tokens: int, role: str = "assistant") -> bool:
-        """Token-metrics insert into the `messages` table (the web
-        gateway's per-generation accounting — reference index.js:65-86)."""
+    async def record_message(
+        self,
+        node_id: str,
+        tokens: int,
+        role: str = "assistant",
+        cost: float = 0.0,
+        user_id: str | None = None,
+    ) -> bool:
+        """Token + cost accounting insert into the `messages` table (the
+        web gateway's per-generation accounting — reference index.js:65-86
+        writes user_id/cost rows; cost here is the node-computed
+        price_per_token x tokens from services/base.py result_dict)."""
         if self.mode != "supabase":
             return False
         try:
+            row = {
+                "node_id": node_id,
+                "content": "[metric log]",
+                "role": role,
+                "tokens": int(tokens),
+                "cost": float(cost or 0.0),
+            }
+            if user_id:
+                row["user_id"] = user_id
             r = await self._client().post(
                 f"{self.supabase_url.rstrip('/')}/rest/v1/messages",
-                json={
-                    "node_id": node_id,
-                    "content": "[metric log]",
-                    "role": role,
-                    "tokens": int(tokens),
-                },
+                json=row,
                 headers={
                     "apikey": self.supabase_key,
                     "Authorization": f"Bearer {self.supabase_key}",

@@ -64,4 +64,7 @@ class FakeService(BaseService):
             if self.delay_s:
                 time.sleep(self.delay_s)
             yield self.stream_line({"text": text[i : i + self.chunk_size]})
-        yield self.stream_line({"done": True})
+        n = len(text.split())  # same accounting as execute()
+        yield self.stream_line(
+            {"done": True, "tokens": n, "cost": self.price_per_token * n}
+        )

@@ -106,8 +106,12 @@ class TPUService(BaseService):
             # scrubs the full text; streaming must match it byte-for-byte)
             acc = ""  # full raw accumulation
             emitted = 0  # chars of scrub(acc) already yielded
+            n_new = None  # real token count, when the engine reports it
             for ev in self.engine.generate_stream(**args):
                 if ev.get("done"):  # flush the held-back tail
+                    res = ev.get("result")
+                    if res is not None:
+                        n_new = res.new_tokens
                     tail = scrub_stop_words(acc)
                     if tail[emitted:]:
                         yield self.stream_line({"text": tail[emitted:]})
@@ -118,6 +122,12 @@ class TPUService(BaseService):
                     yield self.stream_line({"text": delta})
                 if hit:
                     break
-            yield self.stream_line({"done": True})
+            # the done line carries the node's REAL accounting so mesh
+            # peers / the web gateway don't fall back to len/4 estimates
+            done: dict[str, Any] = {"done": True}
+            if n_new is not None:
+                done["tokens"] = int(n_new)
+                done["cost"] = self.price_per_token * int(n_new)
+            yield self.stream_line(done)
         except Exception as e:  # match reference stream-error contract
             yield self.stream_line({"status": "error", "message": f"Stream error: {e}"})

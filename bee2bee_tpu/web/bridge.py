@@ -176,6 +176,10 @@ class MeshBridge:
                             "rid": tid,
                             "latency_ms": int((time.time() - req["start"]) * 1000),
                             "backend": msg.get("backend"),
+                            # real accounting when the node reports it
+                            # (services' done line → gen_success fields)
+                            "tokens": msg.get("tokens"),
+                            "cost": msg.get("cost"),
                         }
                     )
             return
@@ -227,6 +231,7 @@ class MeshBridge:
 
         t0 = time.time()
         chunks: list[str] = []
+        final: dict = {}
         async with aiohttp.ClientSession() as session:
             async with session.post(
                 f"{base}/generate",
@@ -250,11 +255,16 @@ class MeshBridge:
                         if on_chunk:
                             on_chunk(text)
                     if obj.get("done"):
+                        if obj.get("tokens") is not None:
+                            final["tokens"] = int(obj["tokens"])
+                            final["cost"] = float(obj.get("cost") or 0.0)
                         break
         return {
             "text": "".join(chunks),
             "latency_ms": int((time.time() - t0) * 1000),
             "via": "direct",
+            "tokens": final.get("tokens"),
+            "cost": final.get("cost"),
         }
 
     async def request(
@@ -271,7 +281,7 @@ class MeshBridge:
         if base:
             try:
                 result = await self._request_direct(base, payload, on_chunk)
-                self.total_tokens += max(1, len(result["text"]) // 4)
+                self.total_tokens += result.get("tokens") or max(1, len(result["text"]) // 4)
                 return result
             except Exception as e:  # noqa: BLE001 — WS fallback
                 logger.info("direct path to %s failed (%s); using WS", base, e)
@@ -299,7 +309,7 @@ class MeshBridge:
             # when the browser hangs up): the entry must never outlive the
             # request, or pending grows forever under client churn
             self.pending.pop(task_id, None)
-        self.total_tokens += max(1, len(result["text"]) // 4)
+        self.total_tokens += result.get("tokens") or max(1, len(result["text"]) // 4)
         return result
 
     async def _send_gen_request(self, task_id: str, payload: dict):

@@ -37,7 +37,7 @@ def create_web_app(bridge: MeshBridge, registry=None) -> web.Application:
     app = web.Application()
     app["bridge"] = bridge
     app["registry"] = registry
-    app["metrics"] = {"messages": 0, "tokens": 0}
+    app["metrics"] = {"messages": 0, "tokens": 0, "cost": 0.0}
 
     async def register(request: web.Request):
         body = await request.json()
@@ -98,14 +98,22 @@ def create_web_app(bridge: MeshBridge, registry=None) -> web.Application:
             text = result.get("text") or streamed
             if len(text) > len(streamed):  # non-streamed remainder
                 await resp.write(text[len(streamed):].encode())
-            tokens = max(1, len(text) // 4)
+            # prefer the node's REAL accounting when the mesh result
+            # carries it (services/base.py result_dict: tokens + cost =
+            # price_per_token x tokens); len/4 is the reference's estimate,
+            # kept only as the streamed-remainder fallback
+            tokens = result.get("tokens") or max(1, len(text) // 4)
+            cost = float(result.get("cost") or 0.0)
+            user_id = body.get("user_id") or (task.get("user_id") if task else None)
             loop["messages"] += 1
             loop["tokens"] += tokens
+            loop["cost"] += cost
             registry = request.app["registry"]
             if registry is not None and getattr(registry, "enabled", False):
                 try:
                     await registry.record_message(
-                        node_id=target or "GLOBAL_METRICS", tokens=tokens
+                        node_id=target or "GLOBAL_METRICS", tokens=tokens,
+                        cost=cost, user_id=user_id,
                     )
                 except Exception:  # noqa: BLE001 — metrics never break serving
                     logger.debug("registry metrics write failed", exc_info=True)
@@ -147,6 +155,7 @@ def create_web_app(bridge: MeshBridge, registry=None) -> web.Application:
         if request.method == "POST":
             body = await request.json()
             metrics["tokens"] += int(body.get("tokens") or 0)
+            metrics["cost"] += float(body.get("cost") or 0.0)
             metrics["messages"] += 1
         return web.json_response(
             {**metrics, "total_requests": bridge.total_requests,

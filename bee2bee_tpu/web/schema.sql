@@ -15,16 +15,37 @@ create table if not exists profiles (
   created_at timestamptz not null default now()
 );
 
--- per-generation token accounting (gateway writes after each stream)
+-- per-generation token + cost accounting (gateway writes after each
+-- stream). user_id ties spend to an authenticated profile; cost is the
+-- node-computed price_per_token x tokens that rides each mesh result
+-- (services/base.py result_dict — reference :10-20 carries the same pair)
 create table if not exists messages (
   id bigint generated always as identity primary key,
   node_id text not null,
+  user_id uuid references profiles (id),
   role text not null default 'assistant',
   content text,
   tokens integer not null default 0,
+  cost double precision not null default 0,
   created_at timestamptz not null default now()
 );
 create index if not exists messages_node_created on messages (node_id, created_at);
+create index if not exists messages_user on messages (user_id, created_at);
+
+-- auth hook: a signup creates its profile row automatically (reference
+-- :41-52) — the gateway can then attribute messages.user_id immediately
+create or replace function public.handle_new_user()
+returns trigger language plpgsql security definer set search_path = public as $$
+begin
+  insert into public.profiles (id, handle)
+  values (new.id, coalesce(new.raw_user_meta_data->>'handle', new.email))
+  on conflict (id) do nothing;
+  return new;
+end; $$;
+drop trigger if exists on_auth_user_created on auth.users;
+create trigger on_auth_user_created
+  after insert on auth.users
+  for each row execute function public.handle_new_user();
 
 -- raw node telemetry (optional; the mesh itself carries metrics on pings)
 create table if not exists node_logs (
@@ -51,6 +72,7 @@ create or replace view system_stats as
 select
   count(*) filter (where last_seen > now() - interval '5 minutes') as live_nodes,
   (select coalesce(sum(tokens), 0) from messages)                  as total_tokens,
+  (select coalesce(sum(cost), 0)   from messages)                  as total_cost,
   (select count(*) from messages)                                  as total_messages
 from active_nodes;
 

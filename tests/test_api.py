@@ -87,7 +87,7 @@ async def test_chat_streaming_ndjson():
             lines = [json.loads(ln) for ln in raw.strip().splitlines()]
             text = "".join(ln.get("text", "") for ln in lines)
             assert text == "streaming!"
-            assert lines[-1] == {"done": True}
+            assert lines[-1]["done"] is True  # done line may carry accounting
         finally:
             await client.close()
 

@@ -26,7 +26,10 @@ def test_fake_service_execute_and_stream():
     assert out["text"] == "hello world"
     lines = [json.loads(ln) for ln in svc.execute_stream({"prompt": "x"})]
     assert "".join(ln.get("text", "") for ln in lines) == "hello world"
-    assert lines[-1] == {"done": True}
+    # the done line carries the node's real accounting (tokens + cost)
+    assert lines[-1]["done"] is True
+    assert lines[-1]["tokens"] == 2  # "hello world" = 2 fake tokens
+    assert lines[-1]["cost"] == 0.0
 
 
 def test_fake_service_missing_prompt():
@@ -80,7 +83,9 @@ def test_tpu_service_execute(tpu_service):
 
 def test_tpu_service_stream_matches_contract(tpu_service):
     lines = [json.loads(ln) for ln in tpu_service.execute_stream({"prompt": "hi", "temperature": 0})]
-    assert lines[-1] == {"done": True}
+    assert lines[-1]["done"] is True
+    assert lines[-1]["tokens"] > 0  # real engine count on the done line
+    assert lines[-1]["cost"] == pytest.approx(lines[-1]["tokens"] * 0.001)
     assert all("text" in ln or "done" in ln for ln in lines)
 
 

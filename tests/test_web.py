@@ -194,8 +194,50 @@ async def test_gateway_serves_ui():
             assert resp.status == 200
             html = await resp.text()
             assert "bee2bee-tpu" in html and "/api/p2p/generate" in html
+            # dashboard parity features (VERDICT r3 item 5): markdown chat
+            # rendering, the live-metrics monitor polling /status, and the
+            # direct-node probe cascade for when the gateway dies
+            assert "renderMd" in html and "<pre><code>" in html
+            assert "openMonitor" in html and "setInterval(poll, 2000)" in html
+            assert "directFallback" in html and "fallbackCandidates" in html
+            assert "/generate" in html  # direct node NDJSON endpoint
     finally:
         await bridge.stop()
+
+
+async def test_gateway_accounts_real_tokens_and_cost():
+    """The generate route must book the node's REAL accounting (tokens +
+    price_per_token x tokens off the stream's done line — VERDICT r3
+    item 7), not the len/4 estimate, and expose cost in global_metrics."""
+    node = P2PNode(host="127.0.0.1", port=0)
+    await node.start()
+    node.add_service(
+        FakeService("paid-model", reply="alpha beta gamma", price_per_token=0.5)
+    )
+    try:
+        async with bridge_for(node) as bridge:
+            await _settle(lambda: bridge.peer_metadata)
+            async with gateway_client(bridge) as client:
+                resp = await client.post(
+                    "/api/p2p/generate",
+                    json={"prompt": "count me", "model": "paid-model"},
+                )
+                assert resp.status == 200
+                body = (await resp.read()).decode()
+                assert "alpha beta gamma" in body
+                metrics = await (await client.get("/api/p2p/global_metrics")).json()
+                # 3 words = 3 fake tokens at 0.5/token — real counts, not len/4
+                assert metrics["tokens"] == 3
+                assert metrics["cost"] == pytest.approx(1.5)
+                # POST accumulation includes cost (direct-fallback sync path)
+                await client.post(
+                    "/api/p2p/global_metrics", json={"tokens": 10, "cost": 0.25}
+                )
+                metrics = await (await client.get("/api/p2p/global_metrics")).json()
+                assert metrics["tokens"] == 13
+                assert metrics["cost"] == pytest.approx(1.75)
+    finally:
+        await node.stop()
 
 
 async def test_gateway_streams_incrementally():
