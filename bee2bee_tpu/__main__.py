@@ -57,6 +57,7 @@ def _serve(backend: str, model: str, **kw):
                 ollama_host=kw.get("ollama_host"),
                 publish_weights=kw.get("publish_weights", False),
                 from_mesh=kw.get("from_mesh", False),
+                tunnel=kw.get("tunnel"),
             )
         )
     except KeyboardInterrupt:
@@ -68,6 +69,13 @@ def _common_opts(f):
     f = click.option("--api-port", type=int, default=None, help="HTTP gateway port")(f)
     f = click.option("--bootstrap", default=None, help="bootstrap ws:// addr or join link")(f)
     f = click.option("--price", type=float, default=None, help="price per token")(f)
+    f = click.option(
+        "--tunnel",
+        type=click.Choice(["auto", "bore", "ngrok", "cloudflared", "stub"]),
+        default=None,
+        help="expose this node through a public tunnel and announce its "
+             "address (cloud/Colab onboarding — docs/CLOUD_NODE.md)",
+    )(f)
     return f
 
 
@@ -164,6 +172,7 @@ def serve_stage(model, n_stages, stage, checkpoint, max_seq_len, **kw):
             cfg=cfg,
             bootstrap=kw.get("bootstrap"),
             stage_runner=preload,
+            tunnel=kw.get("tunnel"),
         )
 
     try:
@@ -180,8 +189,14 @@ def serve_stage(model, n_stages, stage, checkpoint, max_seq_len, **kw):
 @click.option("--checkpoint", default=None,
               help="checkpoint dir readable by the WORKERS (part_load path)")
 @click.option("--max-seq-len", type=int, default=2048)
+@click.option("--max-batch", type=int, default=8,
+              help="continuous-batching rows in the pipeline session")
+@click.option("--microbatches", type=int, default=1,
+              help=">1 overlaps microbatch groups across stages (GPipe-"
+                   "style over the wire; costs proportionally more hops)")
 @_common_opts
-def serve_pipeline(model, stage_peers, checkpoint, max_seq_len, **kw):
+def serve_pipeline(model, stage_peers, checkpoint, max_seq_len,
+                   max_batch, microbatches, **kw):
     """Coordinate a model SPLIT ACROSS stage workers and serve it as a
     normal mesh service (BASELINE config 4: layers [0,L/2) on one peer,
     [L/2,L) on another; activations hop as binary tensor frames).
@@ -227,6 +242,7 @@ def serve_pipeline(model, stage_peers, checkpoint, max_seq_len, **kw):
                 coordinator, _asyncio.get_running_loop(), model,
                 price_per_token=cfg.price_per_token,
                 max_new_tokens=cfg.max_new_tokens,
+                max_batch=max_batch, n_microbatches=microbatches,
             )
             await node.announce_service(svc)
             click.echo(f"pipeline model {model} serving; join link: {node.join_link()}")
@@ -234,6 +250,7 @@ def serve_pipeline(model, stage_peers, checkpoint, max_seq_len, **kw):
         await run_p2p_node(
             backend=None, model=model, cfg=cfg,
             bootstrap=kw.get("bootstrap"), post_start=setup,
+            tunnel=kw.get("tunnel"),
         )
 
     try:

@@ -100,6 +100,9 @@ async def run_p2p_node(
     from_mesh: bool = False,  # tpu backend: fetch weights from the mesh DHT
     post_start=None,  # async callback(node) after services are set up —
     # the serve-pipeline coordinator wires its stage workers here
+    tunnel: str | None = None,  # bore|ngrok|cloudflared|stub|auto: expose the
+    # WS port through a public tunnel and announce ITS address (cloud-node
+    # onboarding — tunnel.py; supersedes NAT auto-forward when set)
 ):
     """Boot a full serving node; runs until shutdown_event (or forever)."""
     cfg = cfg or load_config()
@@ -117,13 +120,26 @@ async def run_p2p_node(
     api_runner = None
     registry_task = None
     forwarder = None
+    tun = None
     own_dht = dht is None  # stop a DHT we created ourselves
     try:
+        if tunnel:
+            from .. import tunnel as tunnel_mod
+
+            loop = asyncio.get_running_loop()
+            tun = await loop.run_in_executor(
+                None, lambda: tunnel_mod.open_tunnel(node.port, provider=tunnel)
+            )
+            link = tunnel_mod.apply_to_node(node, tun)
+            logger.info(
+                "tunnel (%s) up: %s — join link: %s", tun.provider, tun.ws_url, link
+            )
+
         # Announce-address resolution (reference p2p_runtime.py:195-274): when
         # no explicit announce host was configured, try NAT auto-forward →
         # STUN/echo public IP in an executor so router round-trips never block
         # the loop.
-        if not cfg.announce_host and cfg.auto_nat:
+        if tun is None and not cfg.announce_host and cfg.auto_nat:
             from .. import nat
 
             loop = asyncio.get_running_loop()
@@ -229,6 +245,9 @@ async def run_p2p_node(
             while True:
                 await asyncio.sleep(3600)
     finally:
+        if tun is not None:
+            with contextlib.suppress(Exception):
+                tun.close()
         if own_dht and dht is not None:
             with contextlib.suppress(Exception):
                 await dht.stop()
