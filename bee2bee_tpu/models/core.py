@@ -187,6 +187,21 @@ def matmul(x, w):
     return x @ w
 
 
+def expert_einsum(spec, x, w, s_expand):
+    """Expert-weight einsum with optional int8 quantization.
+
+    MoE expert weights are [E, in, out] (per-layer slice); their scales
+    are [E, out] (models/quant.py, amax over the in dim), which commute
+    with the contraction exactly as in matmul(). `s_expand` reshapes the
+    scale to broadcast against the einsum OUTPUT (the out/expert dims
+    land in different positions per formulation — dense puts E next to
+    last, routed inserts a capacity dim)."""
+    if isinstance(w, dict) and "q" in w:
+        out = jnp.einsum(spec, x, w["q"].astype(x.dtype))
+        return out * s_expand(w["s"].astype(out.dtype))
+    return jnp.einsum(spec, x, w)
+
+
 def _mlp(x, p, cfg: ModelConfig):
     up = matmul(x, p["w_up"])
     if "b_up" in p:
@@ -249,10 +264,16 @@ def _moe_routed(x, p, cfg: ModelConfig):
     disp_tok = jnp.sum(disp, axis=2)  # [G, g, E, C] 0/1
 
     xe = jnp.einsum("gnec,gnd->gecd", disp_tok.astype(x.dtype), xg)
-    up = jnp.einsum("gecd,edf->gecf", xe, p["w_up"])
-    gate = jnp.einsum("gecd,edf->gecf", xe, p["w_gate"]) if "w_gate" in p else None
+    # out [G,E,C,*]: scales [E,*] broadcast as [E,1,*] over the C dim
+    s_ec = lambda s: s[:, None, :]  # noqa: E731
+    up = expert_einsum("gecd,edf->gecf", xe, p["w_up"], s_ec)
+    gate = (
+        expert_einsum("gecd,edf->gecf", xe, p["w_gate"], s_ec)
+        if "w_gate" in p
+        else None
+    )
     h = _activate(up, gate, cfg)
-    ye = jnp.einsum("gecf,efd->gecd", h, p["w_down"])  # [G, E, C, D]
+    ye = expert_einsum("gecf,efd->gecd", h, p["w_down"], s_ec)  # [G, E, C, D]
     out = jnp.einsum("gnec,gecd->gnd", combine.astype(ye.dtype), ye)
     return out.reshape(Np, D)[:N].reshape(B, T, D)
 
@@ -278,13 +299,15 @@ def _moe(x, p, cfg: ModelConfig):
     topp = jax.nn.softmax(topv, axis=-1)  # renormalized over the top-k
     # dense per-expert weight [B, T, E]: scatter top-k probs via one-hot
     weights = jnp.sum(jax.nn.one_hot(topi, E, dtype=jnp.float32) * topp[..., None], axis=-2)
-    up = jnp.einsum("btd,edf->btef", x, p["w_up"])
+    # out [B,T,E,*]: scales [E,*] align with the trailing dims directly
+    s_id = lambda s: s  # noqa: E731
+    up = expert_einsum("btd,edf->btef", x, p["w_up"], s_id)
     if "w_gate" in p:
-        gate = jnp.einsum("btd,edf->btef", x, p["w_gate"])
+        gate = expert_einsum("btd,edf->btef", x, p["w_gate"], s_id)
     else:
         gate = None
     h = _activate(up, gate, cfg)  # [B, T, E, F]
-    out = jnp.einsum("btef,efd->bted", h, p["w_down"])
+    out = expert_einsum("btef,efd->bted", h, p["w_down"], s_id)
     return jnp.einsum("bted,bte->btd", out, weights.astype(out.dtype))
 
 

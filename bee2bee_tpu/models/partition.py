@@ -74,9 +74,10 @@ def spec_for_path(path: str) -> P:
     for suffix, spec in _RULES:
         if path.endswith(suffix):
             if leaf == "s":
-                # per-out-channel scales [L, out]: shard like the weight's
-                # leading (layer) and trailing (out) axes
-                return P(spec[0], spec[-1])
+                # per-out-channel scales are the weight minus its IN axis
+                # (dim -2): [L, out] for dense weights, [L, E, out] for MoE
+                # experts — shard like the surviving axes of the weight
+                return P(*spec[:-2], spec[-1])
             return spec
     return P()  # replicate by default
 

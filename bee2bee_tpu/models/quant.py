@@ -12,10 +12,13 @@ scales commute with the matmul — (x @ q) * s == x @ (q * s) — so
 core.matmul dequantizes AFTER the dot and XLA fuses the int8->bf16
 convert into the dot's operand read (weights leave HBM as int8).
 
-What quantizes: attention projections (wq/wk/wv/wo) and dense-MLP
-weights (w_up/w_gate/w_down) — the bulk of a dense model. Embeddings
-(gather, often tied to the LM head), norms, biases, and MoE experts
-stay dense; MoE models still get their attention quantized.
+What quantizes: attention projections (wq/wk/wv/wo), dense-MLP weights
+(w_up/w_gate/w_down), and MoE EXPERT weights (moe/w_up|w_gate|w_down,
+[L, E, in, out] with per-expert per-out-channel scales [L, E, out] —
+for Mixtral-class models the experts ARE the weights, so int8 halves
+almost all of decode's HBM traffic; core.expert_einsum applies the
+scales after the contraction). Embeddings (gather, often tied to the
+LM head), norms, biases, and the tiny MoE router stay dense.
 
 Engine flag: EngineConfig(quantize="int8") / BEE2BEE_QUANTIZE=int8.
 Partition rules treat {"q","s"} transparently (models/partition strips
@@ -30,6 +33,7 @@ import numpy as np
 QUANT_SUFFIXES = (
     "attn/wq", "attn/wk", "attn/wv", "attn/wo",
     "mlp/w_up", "mlp/w_gate", "mlp/w_down",
+    "moe/w_up", "moe/w_gate", "moe/w_down",  # per-expert scales
 )
 
 

@@ -73,6 +73,59 @@ def test_quantized_forward_logits_close():
     )
 
 
+def test_quantize_params_covers_moe_experts():
+    """VERDICT r3 item 8: for Mixtral the experts ARE the weights — they
+    must quantize (per-expert scales), router stays dense."""
+    cfg = get_config("tiny-mixtral")
+    params = quantize_params(
+        jax.device_get(core.init_params(cfg, jax.random.key(0), dtype=jnp.float32))
+    )
+    moe = params["layers"]["moe"]
+    for k in ("w_up", "w_gate", "w_down"):
+        if k in moe:
+            assert is_quantized(moe[k]), k
+            # weight [L, E, in, out] -> scales [L, E, out]
+            assert moe[k]["s"].shape == moe[k]["q"].shape[:2] + moe[k]["q"].shape[-1:]
+    assert not is_quantized(moe["router"])  # tiny; stays dense
+
+
+@pytest.mark.parametrize("impl", ["dense", "routed"])
+def test_quantized_moe_forward_logits_close(impl):
+    """int8 experts stay close to f32 logits in BOTH MoE formulations."""
+    from dataclasses import replace
+
+    cfg = replace(get_config("tiny-mixtral"), moe_impl=impl)
+    params = core.init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    qparams = jax.tree.map(jnp.asarray, quantize_params(jax.device_get(params)))
+    ids = jnp.asarray([[5, 17, 99, 42, 7, 250, 8, 11]], jnp.int32)
+    want, _ = core.forward(params, cfg, ids, None, jnp.int32(0))
+    got, _ = core.forward(qparams, cfg, ids, None, jnp.int32(0))
+    diff = np.abs(np.asarray(got) - np.asarray(want))
+    spread = float(np.asarray(want).max() - np.asarray(want).min())
+    assert float(diff.max()) < 0.05 * max(spread, 1.0), (
+        f"{impl}: max diff {diff.max():.4f} vs spread {spread:.2f}"
+    )
+
+
+def test_quantized_moe_engine_on_expert_mesh():
+    """Quantized experts shard over the `expert` axis ({"q","s"} follow
+    the weight's rules) and the EP rollout matches single-device."""
+    kw = dict(quantize="int8", **KW)
+    ref = InferenceEngine("tiny-mixtral", engine_config=EngineConfig(**kw))
+    want = ref.generate([5, 17, 99, 42, 7], max_new_tokens=8, temperature=0.0)
+    ref.close()
+
+    mesh = build_mesh(MeshSpec(expert=2))
+    eng = InferenceEngine("tiny-mixtral", mesh=mesh, engine_config=EngineConfig(**kw))
+    wu = eng.params["layers"]["moe"]["w_up"]
+    E = wu["q"].shape[1]
+    assert {s.data.shape[1] for s in wu["q"].addressable_shards} == {E // 2}
+    assert {s.data.shape[1] for s in wu["s"].addressable_shards} == {E // 2}
+    got = eng.generate([5, 17, 99, 42, 7], max_new_tokens=8, temperature=0.0)
+    eng.close()
+    assert got.token_ids == want.token_ids
+
+
 def test_host_checkpoint_load_for_quantize(tmp_path):
     """quantize='int8' must load checkpoints host-side (the dense model
     never materializes in HBM) and serve identically to the dense load."""
