@@ -53,18 +53,42 @@ def log(msg: str) -> None:
 def ensure_live_backend() -> None:
     """Probe jax init in a subprocess; on hang/failure, re-exec onto CPU
     (a stale axon pool lease otherwise blocks make_c_api_client forever,
-    hanging the whole bench)."""
+    hanging the whole bench).
+
+    The probe retries with backoff before surrendering: a wedged pool
+    lease recycles on the order of minutes, so a single 150 s attempt
+    (round 3) threw away a recoverable chip. The probe runs a real
+    matmul, not just jax.devices() — a lease can hand out a device
+    handle whose first dispatch then hangs."""
     if os.environ.get("_BEE2BEE_BENCH_PROBED") == "1":
         return
     os.environ["_BEE2BEE_BENCH_PROBED"] = "1"
-    try:
-        subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=150, capture_output=True, check=True,
-        )
-        return  # healthy accelerator: carry on in this process
-    except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as e:
-        log(f"accelerator probe failed ({type(e).__name__}); benching on CPU")
+    probe_src = (
+        "import jax, jax.numpy as jnp;"
+        "x = jnp.ones((128, 128));"
+        "jax.jit(lambda a: a @ a)(x).block_until_ready();"
+        "print(jax.devices()[0].platform)"
+    )
+    attempts = 3
+    for i in range(attempts):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", probe_src],
+                timeout=150, capture_output=True, check=True, text=True,
+            )
+            log(f"accelerator probe ok (platform={r.stdout.strip()})")
+            return  # healthy accelerator: carry on in this process
+        except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as e:
+            detail = ""
+            if isinstance(e, subprocess.CalledProcessError) and e.stderr:
+                detail = ": " + str(e.stderr).strip().splitlines()[-1][:200]
+            log(f"accelerator probe {i + 1}/{attempts} failed "
+                f"({type(e).__name__}{detail})")
+            if i < attempts - 1:
+                delay = 30 * (i + 1)  # 30 s, then 60 s — lease recycle window
+                log(f"retrying probe in {delay}s (pool lease may recycle)")
+                time.sleep(delay)
+    log("all probes failed; benching on CPU")
     # the platform choice must land before jax is imported: re-exec
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("PALLAS_AXON_POOL_IPS", None)

@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Probe a LIVE node: hello metadata, providers, then a streamed
+generation with per-chunk timing and the final accounting line.
+
+The live-debugging analogue of the reference's scripts/
+(debug_generation.py, debug_p2p_request.py, test_connection.py —
+behavior studied): one script, both transports.
+
+Usage:
+  python scripts/debug_generation.py ws://host:4003 --prompt "hi" --model m
+  python scripts/debug_generation.py http://host:3333 --prompt "hi" --stream
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+from pathlib import Path
+
+# runnable straight from a checkout: scripts/ is not a package
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+async def probe_ws(addr: str, args) -> int:
+    import websockets
+
+    from bee2bee_tpu import protocol
+
+    t0 = time.perf_counter()
+    async with websockets.connect(addr, max_size=protocol.MAX_FRAME) as ws:
+        await ws.send(protocol.encode(
+            protocol.msg(protocol.HELLO, peer_id="debug-probe", services={})
+        ))
+        hello = json.loads(await asyncio.wait_for(ws.recv(), 15))
+        dt = time.perf_counter() - t0
+        print(f"[hello {dt * 1000:.0f}ms] peer={hello.get('peer_id')} "
+              f"api={hello.get('api_host')}:{hello.get('api_port')}")
+        for name, meta in (hello.get("services") or {}).items():
+            print(f"  service {name}: models={meta.get('models')} "
+                  f"price={meta.get('price_per_token')}")
+        met = hello.get("metrics") or {}
+        print(f"  metrics: cpu={met.get('cpu')} ram={met.get('ram')} "
+              f"throughput={met.get('throughput')} tok/s")
+        if args.no_generate:
+            return 0
+
+        await ws.send(json.dumps({
+            "type": "gen_request", "task_id": "debug-1",
+            "model": args.model, "prompt": args.prompt,
+            "max_new_tokens": args.max_new_tokens, "temperature": args.temperature,
+            "stream": True,
+        }))
+        t0 = time.perf_counter()
+        last = t0
+        n_chunks = 0
+        while True:
+            msg = json.loads(await asyncio.wait_for(ws.recv(), args.timeout))
+            now = time.perf_counter()
+            mtype = msg.get("type")
+            if mtype == "gen_chunk":
+                n_chunks += 1
+                if n_chunks == 1:
+                    print(f"[ttfc {now - t0:.3f}s]", end=" ", flush=True)
+                print(msg.get("text", ""), end="", flush=True)
+                if args.chunk_timing:
+                    print(f"  <+{(now - last) * 1000:.0f}ms>", flush=True)
+                last = now
+            elif mtype in ("gen_success", "gen_result"):
+                wall = now - t0
+                print(f"\n[done {wall:.2f}s] tokens={msg.get('tokens')} "
+                      f"cost={msg.get('cost')} latency_ms={msg.get('latency_ms')} "
+                      f"chunks={n_chunks}")
+                if msg.get("tokens"):
+                    print(f"  -> {msg['tokens'] / wall:.1f} tok/s end-to-end")
+                return 0
+            elif mtype == "gen_error":
+                print(f"\n[error] {msg.get('error')}", file=sys.stderr)
+                return 1
+            elif mtype == "ping":
+                await ws.send(json.dumps({"type": "pong", "ts": msg.get("ts")}))
+
+
+async def probe_http(base: str, args) -> int:
+    import aiohttp
+
+    base = base.rstrip("/")
+    async with aiohttp.ClientSession() as s:
+        t0 = time.perf_counter()
+        async with s.get(f"{base}/", timeout=aiohttp.ClientTimeout(total=10)) as r:
+            home = await r.json()
+        print(f"[home {(time.perf_counter() - t0) * 1000:.0f}ms] "
+              f"node={home.get('node_id')} models={home.get('models')}")
+        async with s.get(f"{base}/metrics") as r:
+            print(f"  /metrics: {json.dumps(await r.json())[:200]}")
+        if args.no_generate:
+            return 0
+
+        payload = {"prompt": args.prompt, "model": args.model,
+                   "max_new_tokens": args.max_new_tokens,
+                   "temperature": args.temperature, "stream": bool(args.stream)}
+        t0 = time.perf_counter()
+        async with s.post(
+            f"{base}/generate", json=payload,
+            timeout=aiohttp.ClientTimeout(total=args.timeout),
+        ) as r:
+            if not args.stream:
+                out = await r.json()
+                wall = time.perf_counter() - t0
+                print(f"[done {wall:.2f}s] {json.dumps(out)[:400]}")
+                return 0 if r.status == 200 else 1
+            first = None
+            async for line in r.content:
+                line = line.strip()
+                if not line:
+                    continue
+                obj = json.loads(line)
+                if first is None:
+                    first = time.perf_counter()
+                    print(f"[ttfc {first - t0:.3f}s]", end=" ", flush=True)
+                if obj.get("status") == "error":
+                    print(f"\n[error] {obj.get('message')}", file=sys.stderr)
+                    return 1
+                print(obj.get("text", ""), end="", flush=True)
+                if obj.get("done"):
+                    wall = time.perf_counter() - t0
+                    print(f"\n[done {wall:.2f}s] tokens={obj.get('tokens')} "
+                          f"cost={obj.get('cost')}")
+                    if obj.get("tokens"):
+                        print(f"  -> {obj['tokens'] / wall:.1f} tok/s end-to-end")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("addr", help="ws://host:port (mesh) or http://host:port (api)")
+    ap.add_argument("--prompt", default="Say hello from the mesh.")
+    ap.add_argument("--model", default=None)
+    ap.add_argument("--max-new-tokens", type=int, default=64)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--timeout", type=float, default=120.0)
+    ap.add_argument("--stream", action="store_true", default=True)
+    ap.add_argument("--no-stream", dest="stream", action="store_false")
+    ap.add_argument("--no-generate", action="store_true",
+                    help="probe metadata/metrics only")
+    ap.add_argument("--chunk-timing", action="store_true",
+                    help="print inter-chunk latency per chunk")
+    args = ap.parse_args()
+    if args.addr.startswith(("ws://", "wss://")):
+        return asyncio.run(probe_ws(args.addr, args))
+    return asyncio.run(probe_http(args.addr, args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
