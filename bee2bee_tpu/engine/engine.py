@@ -166,6 +166,17 @@ class InferenceEngine:
             # random-init params (tests/demos) do round-trip, but anything
             # that fit dense at init fits trivially
             params = quantize_params(jax.device_get(params))
+        if (
+            jax.default_backend() == "cpu"
+            and all(n == 1 for n in self.mesh.shape.values())
+        ):
+            # CPU fallback serving: unstack [L, ...] layers into per-layer
+            # contiguous arrays. XLA:CPU can't pre-pack a GEMM operand it
+            # must slice out of the stacked array inside the graph — every
+            # layer dot drops to a naive kernel (measured 20x per block on
+            # distilgpt2 decode). Unrolled layers compile O(L) but CPU
+            # compiles fast; TPU keeps the stacked lax.scan (core.forward).
+            params = core.unstack_layers(jax.device_get(params))
         self.params = partition.shard_params(params, self.mesh, cfg=self.model_cfg)
         self.tokenizer = tokenizer or load_tokenizer(checkpoint_path, self.model_cfg.vocab_size)
 

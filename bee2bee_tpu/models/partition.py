@@ -138,13 +138,26 @@ def shard_params(params, mesh: Mesh, cfg: ModelConfig | None = None):
             ),
             specs,
         )
-    return jax.tree.map(
-        lambda leaf, spec: jax.device_put(
+    def place(leaf, spec):
+        t = tuple(spec)
+        if len(t) > getattr(leaf, "ndim", 0):
+            # rules are written against STACKED [L, ...] weights; unstacked
+            # per-layer leaves (core.unstack_layers, the CPU path) drop the
+            # leading layer dim — trim leading spec entries to match. Only
+            # None entries may be dropped: trimming a real mesh axis would
+            # silently mask a rule/shape mismatch that must fail loudly.
+            drop, t = t[: len(t) - leaf.ndim], t[len(t) - leaf.ndim:]
+            if any(d is not None for d in drop):
+                raise ValueError(
+                    f"partition spec {spec} does not fit rank-{leaf.ndim} "
+                    f"leaf: would drop sharded axes {drop}"
+                )
+        spec = P(*t)
+        return jax.device_put(
             leaf, NamedSharding(mesh, spec if _fits(leaf, spec, mesh) else P())
-        ),
-        params,
-        specs,
-    )
+        )
+
+    return jax.tree.map(place, params, specs)
 
 
 def cache_spec(

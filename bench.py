@@ -81,7 +81,9 @@ def ensure_live_backend() -> None:
         except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as e:
             detail = ""
             if isinstance(e, subprocess.CalledProcessError) and e.stderr:
-                detail = ": " + str(e.stderr).strip().splitlines()[-1][:200]
+                lines = str(e.stderr).strip().splitlines()
+                if lines:
+                    detail = ": " + lines[-1][:200]
             log(f"accelerator probe {i + 1}/{attempts} failed "
                 f"({type(e).__name__}{detail})")
             if i < attempts - 1:
@@ -241,11 +243,14 @@ def main() -> None:
     extras: dict = {}
 
     # CPU is the degraded fallback (stale chip lease / no accelerator):
-    # keep it a smoke-scale run so the bench still lands inside the
-    # driver's budget
+    # smoke-scale tokens AND a cache sized to the workload — CPU decode is
+    # compute-bound, so attention/cache work over unused capacity is pure
+    # loss (1024-slot cache: 12 tok/s aggregate; 128: ~40, above the
+    # reference's torch-CPU path — docs/PERF.md "CPU fallback")
     tokens = NEW_TOKENS if platform == "tpu" else 32
+    msl = 1024 if platform == "tpu" else 128
     distil = bench_model(
-        "distilgpt2", max_seq_len=1024, concurrencies=(1, 8), new_tokens=tokens
+        "distilgpt2", max_seq_len=msl, concurrencies=(1, 8), new_tokens=tokens
     )
     extras["distilgpt2"] = distil
 

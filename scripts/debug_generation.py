@@ -48,10 +48,10 @@ async def probe_ws(addr: str, args) -> int:
             return 0
 
         await ws.send(json.dumps({
-            "type": "gen_request", "task_id": "debug-1",
+            "type": protocol.GEN_REQUEST, "task_id": "debug-1",
             "model": args.model, "prompt": args.prompt,
             "max_new_tokens": args.max_new_tokens, "temperature": args.temperature,
-            "stream": True,
+            "stream": bool(args.stream),
         }))
         t0 = time.perf_counter()
         last = t0
@@ -60,7 +60,7 @@ async def probe_ws(addr: str, args) -> int:
             msg = json.loads(await asyncio.wait_for(ws.recv(), args.timeout))
             now = time.perf_counter()
             mtype = msg.get("type")
-            if mtype == "gen_chunk":
+            if mtype == protocol.GEN_CHUNK:
                 n_chunks += 1
                 if n_chunks == 1:
                     print(f"[ttfc {now - t0:.3f}s]", end=" ", flush=True)
@@ -68,19 +68,21 @@ async def probe_ws(addr: str, args) -> int:
                 if args.chunk_timing:
                     print(f"  <+{(now - last) * 1000:.0f}ms>", flush=True)
                 last = now
-            elif mtype in ("gen_success", "gen_result"):
+            elif mtype in (protocol.GEN_SUCCESS, protocol.GEN_RESULT):
                 wall = now - t0
+                if n_chunks == 0 and msg.get("text"):
+                    print(msg["text"], end="")  # non-streamed: whole reply
                 print(f"\n[done {wall:.2f}s] tokens={msg.get('tokens')} "
                       f"cost={msg.get('cost')} latency_ms={msg.get('latency_ms')} "
                       f"chunks={n_chunks}")
                 if msg.get("tokens"):
                     print(f"  -> {msg['tokens'] / wall:.1f} tok/s end-to-end")
                 return 0
-            elif mtype == "gen_error":
+            elif mtype == protocol.GEN_ERROR:
                 print(f"\n[error] {msg.get('error')}", file=sys.stderr)
                 return 1
-            elif mtype == "ping":
-                await ws.send(json.dumps({"type": "pong", "ts": msg.get("ts")}))
+            elif mtype == protocol.PING:
+                await ws.send(json.dumps({"type": protocol.PONG, "ts": msg.get("ts")}))
 
 
 async def probe_http(base: str, args) -> int:

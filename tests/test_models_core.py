@@ -103,6 +103,51 @@ def test_prefill_pad_overwritten_by_decode(model):
     np.testing.assert_allclose(l1, l2, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.parametrize("model", ["tiny-llama", "tiny-mixtral"])
+def test_unstacked_layers_match_stacked(model):
+    """core.unstack_layers (the CPU serving fast path — per-layer
+    contiguous weights, unrolled loop) must be numerically identical to
+    the stacked lax.scan, cached and uncached."""
+    cfg = get_config(model)
+    params = core.init_params(cfg, jax.random.key(3), dtype=jnp.float32)
+    uparams = core.unstack_layers(jax.device_get(params))
+    assert isinstance(uparams["layers"], list) and len(uparams["layers"]) == cfg.n_layers
+
+    ids = jnp.asarray([[7, 3, 99, 42, 11]], jnp.int32)
+    want, _ = core.forward(params, cfg, ids, None, jnp.int32(0))
+    got, _ = core.forward(uparams, cfg, ids, None, jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+    cache_s = core.init_cache(cfg, 1, 32, jnp.float32)
+    cache_u = core.init_cache(cfg, 1, 32, jnp.float32)
+    w1, cache_s = core.forward(params, cfg, ids, cache_s, jnp.int32(0))
+    g1, cache_u = core.forward(uparams, cfg, ids, cache_u, jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(w1), atol=1e-5)
+    nxt = jnp.asarray([[5]], jnp.int32)
+    w2, _ = core.forward(params, cfg, nxt, cache_s, jnp.int32(5))
+    g2, _ = core.forward(uparams, cfg, nxt, cache_u, jnp.int32(5))
+    np.testing.assert_allclose(np.asarray(g2), np.asarray(w2), atol=1e-5)
+
+
+def test_engine_unstacks_on_single_device_cpu():
+    """On a trivial CPU mesh the engine takes the unstacked fast path
+    (the XLA:CPU packed-GEMM issue — docs/PERF.md 'CPU fallback')."""
+    from bee2bee_tpu.engine import EngineConfig, InferenceEngine
+
+    eng = InferenceEngine(
+        "tiny-llama",
+        engine_config=EngineConfig(
+            max_seq_len=64, dtype="float32", cache_dtype="float32"
+        ),
+    )
+    try:
+        assert isinstance(eng.params["layers"], list)
+        r = eng.generate([5, 17, 99], max_new_tokens=4, temperature=0.0)
+        assert r.new_tokens == 4
+    finally:
+        eng.close()
+
+
 def test_gqa_head_counts():
     cfg = get_config("tiny-llama")
     assert cfg.n_kv_heads < cfg.n_heads  # actually grouped

@@ -162,7 +162,11 @@ def test_engine_serves_quantized():
     eng = InferenceEngine(
         "tiny-llama", engine_config=EngineConfig(quantize="int8", **KW)
     )
-    assert is_quantized(eng.params["layers"]["attn"]["wq"])
+    # single-device CPU engines unstack layers (list of per-layer trees);
+    # quantized subtrees ride through either layout
+    layer0 = eng.params["layers"][0] if isinstance(
+        eng.params["layers"], list) else eng.params["layers"]
+    assert is_quantized(layer0["attn"]["wq"])
     r = eng.generate([5, 17, 99, 42], max_new_tokens=8, temperature=0.0)
     eng.close()
     assert r.new_tokens == 8
