@@ -41,9 +41,15 @@ async def publish_model_weights(
     mesh_axes={} (or None) publishes whole-param pieces — what a
     single-chip peer fetches; a TP group publishes with its axis sizes so
     members fetch only their coordinates' slices."""
+    from ..models import core
     from ..models.loader import _flatten
     from ..models.partition import flat_partition_specs
     from ..pieces import build_shard_manifest
+
+    # the wire/manifest layout is canonical STACKED [L, ...]: a CPU
+    # engine's unstacked list (core.unstack_layers) must be restacked —
+    # np.asarray on a list of trees would serialize pointer garbage
+    params = core.restack_layers(params)
 
     loop = asyncio.get_running_loop()
 

@@ -515,6 +515,24 @@ def unstack_layers(params: Params) -> Params:
     return out
 
 
+def restack_layers(params: Params) -> Params:
+    """Inverse of unstack_layers: list of per-layer trees → stacked
+    [L, ...] arrays. Consumers that serialize or shard the canonical
+    layout (weight publishing, export) restack a CPU engine's params
+    before use — np.asarray on the list would silently produce a
+    dtype=object array of POINTERS, not weights."""
+    import numpy as np
+
+    layers = params["layers"]
+    if not isinstance(layers, (list, tuple)):
+        return params
+    out = dict(params)
+    out["layers"] = jax.tree.map(
+        lambda *leaves: np.stack([np.asarray(a) for a in leaves]), *layers
+    )
+    return out
+
+
 def init_cache(cfg: ModelConfig, batch: int, max_len: int | None = None, dtype=jnp.bfloat16):
     """Preallocate the fixed-capacity KV cache: {"k","v"}: [L,B,S,Hkv,hd]."""
     S = max_len or cfg.max_seq_len

@@ -170,6 +170,8 @@ def create_web_app(bridge: MeshBridge, registry=None) -> web.Application:
     app.router.add_route("*", "/api/p2p/status", status)
     app.router.add_route("*", "/api/p2p/global_metrics", global_metrics)
     app.router.add_get("/", index)
+    # the component kit + any other static assets (web/static/ui.js)
+    app.router.add_static("/static/", STATIC_DIR)
     return app
 
 

@@ -1,0 +1,122 @@
+/* bee2bee-tpu UI component kit (dependency-free).
+ *
+ * The reference ships a shadcn-style kit (app/src/components/ui/
+ * badge|button|card|input|globe — behavior studied); this is the same
+ * layer for the no-build static tier: DOM factories + the markdown
+ * renderer, consumed by index.html. Everything renders through esc()
+ * first — model output can never inject HTML. */
+'use strict';
+
+const B2B = (() => {
+  /* ------------------------------- primitives ------------------------ */
+  function el(tag, attrs = {}, ...children) {
+    const node = document.createElement(tag);
+    for (const [k, v] of Object.entries(attrs)) {
+      if (k === 'class') node.className = v;
+      else if (k.startsWith('on')) node[k] = v;
+      else node.setAttribute(k, v);
+    }
+    for (const c of children)
+      node.append(typeof c === 'string' ? document.createTextNode(c) : c);
+    return node;
+  }
+
+  const badge = (text, tone = '') => el('span', {class: `b2b-badge ${tone}`}, text);
+  const button = (label, onclick, attrs = {}) =>
+    el('button', {class: 'b2b-btn', onclick, ...attrs}, label);
+  const input = (attrs = {}) => el('input', {class: 'b2b-input', ...attrs});
+  const card = (title, ...children) =>
+    el('div', {class: 'b2b-card'},
+       ...(title ? [el('div', {class: 'b2b-card-title'}, title)] : []),
+       ...children);
+  const statTile = (label, valueId) =>
+    el('div', {class: 'tile'},
+       el('div', {class: 'v', id: valueId}, '—'),
+       el('div', {class: 'l'}, label));
+
+  /* --------------------------- markdown renderer --------------------- */
+  function esc(s) {
+    return s.replace(/&/g,'&amp;').replace(/</g,'&lt;').replace(/>/g,'&gt;')
+            .replace(/"/g,'&quot;').replace(/'/g,'&#39;');
+  }
+  function unesc(s) {  // exact inverse of esc(); &amp; LAST
+    return s.replace(/&lt;/g,'<').replace(/&gt;/g,'>')
+            .replace(/&quot;/g,'"').replace(/&#39;/g,"'").replace(/&amp;/g,'&');
+  }
+  function hiCode(code, lang) {
+    let h = esc(code);
+    if (/^(py|python|js|javascript|ts|c|cpp|java|go|rust|sh|bash)/.test(lang||'')) {
+      h = h.replace(/(#[^\n]*|\/\/[^\n]*)/g, '<span class="c">$1</span>')
+           .replace(/(&quot;[^&]*?&quot;|'[^'\n]*'|"[^"\n]*")/g, '<span class="s">$1</span>')
+           .replace(/\b(def|class|return|import|from|if|elif|else|for|while|in|not|and|or|try|except|finally|with|as|lambda|yield|await|async|const|let|var|function|new|this|fn|pub|struct|impl|match)\b/g,
+                    '<span class="k">$1</span>')
+           .replace(/\b(\d+\.?\d*)\b/g, '<span class="n">$1</span>');
+    }
+    return h;
+  }
+  function mdInline(s) {
+    return s
+      .replace(/`([^`]+)`/g, (_, c) => '<code>' + c + '</code>')
+      .replace(/\*\*([^*]+)\*\*/g, '<strong>$1</strong>')
+      .replace(/(^|\W)\*([^*\n]+)\*(?=\W|$)/g, '$1<em>$2</em>')
+      .replace(/\[([^\]]+)\]\((https?:[^)\s"'`&<>]+)\)/g,
+               '<a href="$2" target="_blank" rel="noopener">$1</a>');
+  }
+  function renderMd(src) {
+    const lines = esc(src).split('\n');
+    const out = [];
+    let i = 0, para = [];
+    const flush = () => { if (para.length) { out.push('<p>'+mdInline(para.join('<br>'))+'</p>'); para = []; } };
+    while (i < lines.length) {
+      const L = lines[i];
+      const fence = L.match(/^```(\w*)\s*$/);
+      if (fence) {                                   // fenced code block
+        flush();
+        const lang = fence[1]; const buf = [];
+        for (i++; i < lines.length && !/^```\s*$/.test(lines[i]); i++) buf.push(lines[i]);
+        i++;  // closing fence
+        out.push('<pre><code>' + hiCode(unesc(buf.join('\n')), lang) + '</code></pre>');
+        continue;
+      }
+      const h = L.match(/^(#{1,3})\s+(.*)$/);
+      if (h) { flush(); out.push(`<h${h[1].length}>`+mdInline(h[2])+`</h${h[1].length}>`); i++; continue; }
+      if (/^\s*([-*])\s+/.test(L)) {                 // unordered list
+        flush(); const items = [];
+        while (i < lines.length && /^\s*([-*])\s+/.test(lines[i]))
+          items.push('<li>'+mdInline(lines[i].replace(/^\s*[-*]\s+/,''))+'</li>'), i++;
+        out.push('<ul>'+items.join('')+'</ul>'); continue;
+      }
+      if (/^\s*\d+\.\s+/.test(L)) {                  // ordered list
+        flush(); const items = [];
+        while (i < lines.length && /^\s*\d+\.\s+/.test(lines[i]))
+          items.push('<li>'+mdInline(lines[i].replace(/^\s*\d+\.\s+/,''))+'</li>'), i++;
+        out.push('<ol>'+items.join('')+'</ol>'); continue;
+      }
+      if (/^&gt;\s?/.test(L)) {                      // blockquote
+        flush(); const buf = [];
+        while (i < lines.length && /^&gt;\s?/.test(lines[i]))
+          buf.push(lines[i].replace(/^&gt;\s?/,'')), i++;
+        out.push('<blockquote>'+mdInline(buf.join('<br>'))+'</blockquote>'); continue;
+      }
+      if (!L.trim()) { flush(); i++; continue; }
+      para.push(L); i++;
+    }
+    flush();
+    return out.join('\n');
+  }
+
+  /* ----------------------------- chat components --------------------- */
+  function messageBubble(role, text) {
+    const div = el('div', {class: 'msg ' + role});
+    if (role === 'assistant') div.innerHTML = renderMd(text);
+    else div.textContent = text;
+    return div;
+  }
+  function metaLine(text) {
+    return el('div', {class: 'meta'}, text);
+  }
+
+  return {el, badge, button, input, card, statTile,
+          esc, unesc, hiCode, mdInline, renderMd,
+          messageBubble, metaLine};
+})();

@@ -197,10 +197,17 @@ async def test_gateway_serves_ui():
             # dashboard parity features (VERDICT r3 item 5): markdown chat
             # rendering, the live-metrics monitor polling /status, and the
             # direct-node probe cascade for when the gateway dies
-            assert "renderMd" in html and "<pre><code>" in html
             assert "openMonitor" in html and "setInterval(poll, 2000)" in html
             assert "directFallback" in html and "fallbackCandidates" in html
             assert "/generate" in html  # direct node NDJSON endpoint
+            # component kit (reference components/ui analogue) served as
+            # its own layer and consumed by the page
+            assert '/static/ui.js' in html and "B2B.messageBubble" in html
+            ui = await (await client.get("/static/ui.js")).text()
+            for component in ("renderMd", "statTile", "messageBubble",
+                              "badge", "button", "card"):
+                assert component in ui, component
+            assert "<pre><code>" in ui
     finally:
         await bridge.stop()
 

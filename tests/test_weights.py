@@ -80,6 +80,35 @@ async def test_fresh_peer_serves_from_mesh_with_zero_checkpoint():
             await dht.stop()
 
 
+async def test_publish_from_unstacked_cpu_engine_params():
+    """A CPU-fallback engine holds UNSTACKED layers (list of per-layer
+    trees); publishing must restack to the canonical wire layout — the
+    naive np.asarray would serialize a dtype=object array of pointers
+    and poison every fetching peer (round-4 review finding)."""
+    async with mesh(2) as (a, c):
+        dht = DHTNode()
+        await dht.start()
+        try:
+            eng = InferenceEngine(CFG, _params(), engine_config=ECFG)
+            assert isinstance(eng.params["layers"], list)  # CPU unstacked
+            await weights.publish_model_weights(a, dht, CFG, eng.params, mesh_axes={})
+            eng.close()
+
+            svc = await weights.serve_model_from_mesh(
+                c, dht, "tiny-llama", engine_config=ECFG
+            )
+            out = svc.execute(
+                {"prompt": "restacked", "max_new_tokens": 6, "temperature": 0.0}
+            )
+            ref = InferenceEngine(CFG, _params(), engine_config=ECFG)
+            want = ref.generate("restacked", max_new_tokens=6, temperature=0.0)
+            assert out["text"] == want.text
+            ref.close()
+            svc.engine.close()
+        finally:
+            await dht.stop()
+
+
 async def test_quantized_publisher_join_keeps_int8():
     """Regression: a peer joining from a quantized publisher must keep the
     int8 payload and f32 scales — the old cast-everything path silently
@@ -97,7 +126,9 @@ async def test_quantized_publisher_join_keeps_int8():
             svc = await weights.serve_model_from_mesh(
                 c, dht, "tiny-llama", engine_config=ECFG
             )
-            wq = svc.engine.params["layers"]["attn"]["wq"]
+            layers = svc.engine.params["layers"]
+            # single-device CPU engines unstack layers into a list
+            wq = (layers[0] if isinstance(layers, list) else layers)["attn"]["wq"]
             assert is_quantized(wq)
             assert wq["q"].dtype == jnp.int8
             assert wq["s"].dtype == jnp.float32
