@@ -15,10 +15,29 @@ from .config import load_config, save_config
 
 
 def _setup_logging():
-    logging.basicConfig(
-        level=os.environ.get("LOG_LEVEL", "INFO"),
-        format="%(asctime)s %(name)s %(levelname)s %(message)s",
-    )
+    fmt = "%(asctime)s %(name)s %(levelname)s %(message)s"
+    logging.basicConfig(level=os.environ.get("LOG_LEVEL", "INFO"), format=fmt)
+    # rotating file sink alongside stderr (the reference's loguru setup:
+    # reference __main__.py:13-16). BEE2BEE_LOG_FILE overrides the path;
+    # set it empty to disable. The default is per-PROCESS (pid suffix):
+    # two processes rotating one shared file clobber each other's backups
+    # — an explicit BEE2BEE_LOG_FILE opts into sharing deliberately.
+    log_file = os.environ.get("BEE2BEE_LOG_FILE")
+    if log_file is None:
+        from .utils import bee2bee_home
+
+        log_file = str(bee2bee_home() / f"bee2bee-{os.getpid()}.log")
+    if log_file:
+        from logging.handlers import RotatingFileHandler
+
+        try:
+            handler = RotatingFileHandler(
+                log_file, maxBytes=5 * 1024 * 1024, backupCount=3
+            )
+            handler.setFormatter(logging.Formatter(fmt))
+            logging.getLogger().addHandler(handler)
+        except OSError:  # read-only fs etc. — stderr logging still works
+            pass
     # orbax/absl emit per-save INFO floods; keep them at WARNING unless asked
     if os.environ.get("LOG_LEVEL", "INFO").upper() != "DEBUG":
         logging.getLogger("absl").setLevel(logging.WARNING)

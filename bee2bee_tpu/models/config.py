@@ -26,6 +26,7 @@ class ModelConfig:
     norm: str = "rmsnorm"  # "rmsnorm" | "layernorm"
     activation: str = "silu"  # "silu" (gated) | "gelu" (gpt2 mlp) | "geglu"
     use_bias: bool = False  # attn/mlp biases (gpt2 style)
+    qkv_bias: bool = False  # bias on q/k/v ONLY (qwen2 style; no bo/mlp bias)
     tie_embeddings: bool = True
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
@@ -103,6 +104,11 @@ CONFIGS: dict[str, ModelConfig] = {
         n_kv_heads=1, d_ff=128, max_seq_len=256, activation="geglu",
         embedding_scale=True, norm_plus_one=True, norm_eps=1e-6,
     ),
+    "tiny-qwen": ModelConfig(  # qwen2 style: llama arch + q/k/v-only bias
+        name="tiny-qwen", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+        n_kv_heads=2, d_ff=128, max_seq_len=256, qkv_bias=True,
+        rope_theta=1000000.0,
+    ),
     # -- BASELINE ladder --
     "distilgpt2": _gpt2("distilgpt2", d_model=768, n_layers=6, n_heads=12),
     "gpt2": _gpt2("gpt2", d_model=768, n_layers=12, n_heads=12),
@@ -125,6 +131,18 @@ CONFIGS: dict[str, ModelConfig] = {
         name="mixtral-8x7b", vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
         n_kv_heads=8, d_ff=14336, max_seq_len=8192, tie_embeddings=False,
         n_experts=8, n_experts_per_tok=2,
+    ),
+    # -- qwen2 family (llama arch + q/k/v bias, 1e6 rope theta) --
+    "qwen2-0.5b": ModelConfig(
+        name="qwen2-0.5b", vocab_size=151936, d_model=896, n_layers=24,
+        n_heads=14, n_kv_heads=2, d_ff=4864, max_seq_len=32768,
+        qkv_bias=True, rope_theta=1000000.0, norm_eps=1e-6,
+    ),
+    "qwen2-7b": ModelConfig(
+        name="qwen2-7b", vocab_size=152064, d_model=3584, n_layers=28,
+        n_heads=28, n_kv_heads=4, d_ff=18944, max_seq_len=32768,
+        qkv_bias=True, rope_theta=1000000.0, norm_eps=1e-6,
+        tie_embeddings=False,
     ),
     # -- larger members of the already-supported families --
     "gemma-7b": ModelConfig(

@@ -86,10 +86,11 @@ def init_params(cfg: ModelConfig, key, dtype=jnp.bfloat16) -> Params:
     if cfg.norm == "layernorm":
         layers["ln1"]["bias"] = jnp.zeros((L, D), dtype)
         layers["ln2"]["bias"] = jnp.zeros((L, D), dtype)
-    if cfg.use_bias:
+    if cfg.use_bias or cfg.qkv_bias:
         layers["attn"]["bq"] = jnp.zeros((L, H * hd), dtype)
         layers["attn"]["bk"] = jnp.zeros((L, Hkv * hd), dtype)
         layers["attn"]["bv"] = jnp.zeros((L, Hkv * hd), dtype)
+    if cfg.use_bias:  # qwen2 (qkv_bias) has NO output-projection bias
         layers["attn"]["bo"] = jnp.zeros((L, D), dtype)
 
     gated = cfg.activation in ("silu", "geglu")

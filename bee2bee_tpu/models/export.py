@@ -133,6 +133,9 @@ def _export_llama_state(params, cfg: ModelConfig, dtype) -> dict[str, np.ndarray
         a = layers["attn"]
         for ours, hf in (("wq", "q_proj"), ("wk", "k_proj"), ("wv", "v_proj"), ("wo", "o_proj")):
             state[p + f"self_attn.{hf}.weight"] = t(a[ours][i])
+        if "bq" in a:  # qwen2: q/k/v-only bias
+            for ours, hf in (("bq", "q_proj"), ("bk", "k_proj"), ("bv", "v_proj")):
+                state[p + f"self_attn.{hf}.bias"] = _np(a[ours][i], dtype)
         if cfg.is_moe:
             moe = layers["moe"]
             state[p + "block_sparse_moe.gate.weight"] = t(moe["router"][i])
@@ -149,8 +152,13 @@ def _export_llama_state(params, cfg: ModelConfig, dtype) -> dict[str, np.ndarray
     return state
 
 
-def hf_config_dict(cfg: ModelConfig) -> dict:
-    """A transformers-compatible config.json for the exported checkpoint."""
+def hf_config_dict(cfg: ModelConfig, qkv_bias: bool | None = None) -> dict:
+    """A transformers-compatible config.json for the exported checkpoint.
+
+    `qkv_bias` overrides cfg.qkv_bias from the ACTUAL params ("bq" leaves
+    present): a checkpoint loaded with biases under a biasless config must
+    still export as qwen2, or transformers would silently drop the bias
+    tensors the state dict carries."""
     if cfg.pos_embedding == "learned":  # gpt2 family
         return {
             "model_type": "gpt2",
@@ -192,6 +200,8 @@ def hf_config_dict(cfg: ModelConfig) -> dict:
             "hidden_act": "gelu_pytorch_tanh" if cfg.activation == "geglu" else cfg.activation,
             **base,
         }
+    if qkv_bias if qkv_bias is not None else cfg.qkv_bias:  # qwen2 family
+        return {"model_type": "qwen2", "architectures": ["Qwen2ForCausalLM"], **base}
     return {"model_type": "llama", "architectures": ["LlamaForCausalLM"], **base}
 
 
@@ -200,6 +210,10 @@ def export_hf(params, cfg: ModelConfig, out_dir: str | Path,
     """Write ``out_dir/model.safetensors`` + ``config.json`` in the HF layout
     for this config's family. Round-trips through models/loader, and loads
     in torch/transformers via ``from_pretrained(out_dir)``."""
+    from . import core
+
+    params = core.restack_layers(params)  # no-op unless a CPU engine's
+    # unstacked list — the exporters index stacked [L, ...] arrays
     out = Path(out_dir)
     out.mkdir(parents=True, exist_ok=True)
     np_dtype = np.dtype(dtype) if dtype != "bfloat16" else _bf16_dtype()
@@ -211,7 +225,15 @@ def export_hf(params, cfg: ModelConfig, out_dir: str | Path,
         out / "model.safetensors", state,
         metadata={"format": "pt", "exported_by": "bee2bee_tpu"},
     )
-    (out / "config.json").write_text(json.dumps(hf_config_dict(cfg), indent=2))
+    # key the family choice on the ACTUAL params: a bias-carrying tree
+    # under a biasless config must still export as qwen2 (see hf_config_dict)
+    has_qkv_bias = (
+        None if cfg.pos_embedding == "learned"
+        else "bq" in params["layers"].get("attn", {})
+    )
+    (out / "config.json").write_text(
+        json.dumps(hf_config_dict(cfg, qkv_bias=has_qkv_bias), indent=2)
+    )
     return out
 
 

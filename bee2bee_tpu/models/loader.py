@@ -134,6 +134,11 @@ def _convert_llama(state, cfg: ModelConfig) -> dict:
             "wo": _stack([t(g(f"layers.{i}.self_attn.o_proj.weight")) for i in range(L)]),
         },
     }
+    if pre + "layers.0.self_attn.q_proj.bias" in state:  # qwen2: q/k/v-only bias
+        for ours, theirs in (("bq", "q_proj"), ("bk", "k_proj"), ("bv", "v_proj")):
+            layers["attn"][ours] = _stack(
+                [g(f"layers.{i}.self_attn.{theirs}.bias") for i in range(L)]
+            )
     if cfg.is_moe:
         E = cfg.n_experts
         layers["moe"] = {

@@ -50,7 +50,7 @@ def test_write_safetensors_roundtrip_dtypes(tmp_path):
 
 
 @pytest.mark.parametrize(
-    "name", ["tiny-gpt2", "tiny-llama", "tiny-mixtral", "tiny-gemma"]
+    "name", ["tiny-gpt2", "tiny-llama", "tiny-mixtral", "tiny-gemma", "tiny-qwen"]
 )
 def test_export_hf_roundtrips_through_loader(tmp_path, name):
     """export_hf must be the exact inverse of the loader's HF conversion
@@ -83,6 +83,36 @@ def test_untied_lm_head_roundtrip(tmp_path):
     out = export_hf(params, cfg, tmp_path / "untied")
     back = load_checkpoint(out, cfg, dtype=jnp.float32)
     _tree_allclose(params, back)
+
+
+def test_torch_loads_qwen2_export_and_logits_match(tmp_path):
+    """qwen2 family conformance: Qwen2ForCausalLM.from_pretrained(our
+    export) matches our forward — with NON-zero q/k/v biases, so the
+    qkv_bias weight semantics are actually exercised."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    if not hasattr(transformers, "Qwen2ForCausalLM"):
+        pytest.skip("transformers too old for qwen2")
+
+    cfg = get_config("tiny-qwen")
+    params = core.init_params(cfg, jax.random.key(5), dtype=jnp.float32)
+    attn = dict(params["layers"]["attn"])
+    k = jax.random.key(6)
+    for b in ("bq", "bk", "bv"):
+        k, sub = jax.random.split(k)
+        attn[b] = 0.1 * jax.random.normal(sub, attn[b].shape, jnp.float32)
+    params = {**params, "layers": {**params["layers"], "attn": attn}}
+    out = export_hf(params, cfg, tmp_path / "hf_qwen", dtype="float32")
+
+    model = transformers.Qwen2ForCausalLM.from_pretrained(out)
+    model.eval()
+    ids = np.array([[1, 7, 42, 99, 3, 250, 8, 11]], np.int32)
+    ours, _ = core.forward(params, cfg, jnp.asarray(ids), None, jnp.int32(0))
+    with torch.no_grad():
+        theirs = model(torch.from_numpy(ids.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(
+        np.asarray(ours, np.float32), theirs, atol=2e-4, rtol=1e-3
+    )
 
 
 def test_torch_loads_export_and_logits_match(tmp_path):
