@@ -24,9 +24,20 @@ def _setup_logging():
     # — an explicit BEE2BEE_LOG_FILE opts into sharing deliberately.
     log_file = os.environ.get("BEE2BEE_LOG_FILE")
     if log_file is None:
+        import contextlib
+        import time as _time
+
         from .utils import bee2bee_home
 
-        log_file = str(bee2bee_home() / f"bee2bee-{os.getpid()}.log")
+        home = bee2bee_home()
+        # reap per-pid logs from dead runs (>7 days) so short CLI
+        # invocations don't accumulate orphans forever
+        cutoff = _time.time() - 7 * 86400
+        for old in home.glob("bee2bee-*.log*"):
+            with contextlib.suppress(OSError):
+                if old.stat().st_mtime < cutoff:
+                    old.unlink()
+        log_file = str(home / f"bee2bee-{os.getpid()}.log")
     if log_file:
         from logging.handlers import RotatingFileHandler
 

@@ -87,6 +87,7 @@ class P2PNode(StageTaskMixin):
         self.providers: dict[str, dict] = {}  # peer_id -> {svc_name: meta}
         self.local_services: dict[str, Any] = {}
         self.stage_runners: dict[str, Any] = {}  # model -> StageRunner (pipeline.py)
+        self.stage_next: dict[str, str] = {}  # model -> next stage's peer_id (relay)
         self.throughput = MetricsAggregator()
 
         # piece store: hash -> bytes (optionally spilled to piece_dir)

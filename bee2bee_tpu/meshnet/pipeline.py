@@ -50,6 +50,21 @@ class StageTaskMixin:
         task_id = data.get("task_id")
 
         async def fail(error: str):
+            # relay tasks report failure to the ORIGIN coordinator, not the
+            # previous stage (which isn't waiting on anything)
+            origin = data.get("origin_peer")
+            if kind == protocol.TASK_PART_FORWARD_RELAY and origin:
+                async with self._lock:
+                    info = self.peers.get(origin)
+                if info is not None:
+                    await self._send(
+                        info["ws"],
+                        protocol.msg(
+                            protocol.TASK_ERROR,
+                            task_id=data.get("origin_task_id"), error=error,
+                        ),
+                    )
+                    return
             await self._send(
                 ws, protocol.msg(protocol.TASK_ERROR, task_id=task_id, error=error)
             )
@@ -59,6 +74,8 @@ class StageTaskMixin:
                 await self._task_part_load(ws, data)
             elif kind == protocol.TASK_PART_FORWARD:
                 await self._task_part_forward(ws, data)
+            elif kind == protocol.TASK_PART_FORWARD_RELAY:
+                await self._task_part_forward_relay(ws, data)
             elif kind == "part_release":
                 runner = self.stage_runners.get(data.get("model"))
                 if runner is not None:
@@ -90,17 +107,42 @@ class StageTaskMixin:
             ),
         )
         self.add_stage_runner(runner)
+        # relay chaining: dial the NEXT stage so hidden states can hop
+        # worker→worker without bouncing through the coordinator
+        relay = False
+        next_addr = data.get("next_addr")
+        if next_addr:
+            try:
+                # plain peer dial — NOT connect_bootstrap: bootstrap addrs
+                # are redialed forever even after a clean GOODBYE, which
+                # would chase a retired successor for the process lifetime
+                if self.peer_for_addr(next_addr) or await self._connect_peer(next_addr):
+                    for _ in range(50):
+                        pid = self.peer_for_addr(next_addr)
+                        if pid:
+                            self.stage_next[data["model"]] = pid
+                            relay = True
+                            break
+                        await asyncio.sleep(0.1)
+            except Exception:  # noqa: BLE001 — relay optional; fall back
+                logger.exception("next-stage dial %s failed", next_addr)
         await self._send(
-            ws, protocol.msg(protocol.RESULT, task_id=task_id, ok=True, info=runner.info)
+            ws,
+            protocol.msg(
+                protocol.RESULT, task_id=task_id, ok=True,
+                info={**runner.info, "relay": relay or runner.spec.is_last},
+            ),
         )
 
-    async def _task_part_forward(self, ws, data):
-        task_id = data.get("task_id")
+    async def _run_stage_forward(self, data) -> np.ndarray:
+        """Shared parse + executor dispatch for both forward task kinds:
+        pull x off the binary frame, coerce offset/write_mask/gather
+        (int | [B] lists — the batched session), run the stage."""
         runner = self.stage_runners.get(data.get("model"))
         if runner is None:
             raise RuntimeError(f"no stage loaded for model {data.get('model')!r}")
         x = data["_tensors"]["x"]
-        offset = data.get("offset", 0)  # int | [B] list (batched session)
+        offset = data.get("offset", 0)
         if not isinstance(offset, int):
             offset = np.asarray(offset, np.int32)
         mask = data.get("write_mask")
@@ -110,17 +152,70 @@ class StageTaskMixin:
         if gather is not None:
             gather = np.asarray(gather, np.int32)
         loop = asyncio.get_running_loop()
-        out = await loop.run_in_executor(
+        return await loop.run_in_executor(
             None,
             lambda: runner.forward(
                 data["request_id"], x, offset, write_mask=mask, gather=gather
             ),
         )
+
+    async def _task_part_forward(self, ws, data):
+        out = await self._run_stage_forward(data)
         frame = protocol.encode_binary(
-            protocol.msg(protocol.RESULT, task_id=task_id, ok=True),
+            protocol.msg(protocol.RESULT, task_id=data.get("task_id"), ok=True),
             {"out": out},
         )
         await self._send(ws, frame)
+
+    async def _task_part_forward_relay(self, ws, data):
+        """Relay-chained forward: run this stage, then hand the output
+        DIRECTLY to the next stage (or, on the last stage, answer the
+        origin coordinator). Per decode step the coordinator pays one
+        send + one receive instead of two round trips per stage, and
+        hidden states never transit the coordinator at all."""
+        # first hop (coordinator → stage 0) carries no origin fields: the
+        # sender IS the origin and its task_id is the reply correlation id
+        if not data.get("origin_peer"):
+            data["origin_peer"] = await self._peer_for(ws)
+            data["origin_task_id"] = data.get("task_id")
+        out = await self._run_stage_forward(data)
+        runner = self.stage_runners[data["model"]]
+        if runner.spec.is_last:
+            async with self._lock:
+                info = self.peers.get(data.get("origin_peer"))
+            if info is None:
+                raise RuntimeError(
+                    f"relay origin {data.get('origin_peer')!r} not connected"
+                )
+            frame = protocol.encode_binary(
+                protocol.msg(
+                    protocol.RESULT, task_id=data.get("origin_task_id"), ok=True
+                ),
+                {"out": out},
+            )
+            await self._send(info["ws"], frame)
+            return
+        nxt = self.stage_next.get(data["model"])
+        if nxt is None:
+            raise RuntimeError("relay chain broken: no next stage dialed")
+        async with self._lock:
+            info = self.peers.get(nxt)
+        if info is None:
+            raise RuntimeError(f"relay chain broken: next stage {nxt!r} gone")
+        fields = {
+            k: data[k]
+            for k in ("model", "request_id", "offset", "write_mask", "gather",
+                      "origin_peer", "origin_task_id")
+            if k in data
+        }
+        frame = protocol.encode_binary(
+            protocol.msg(
+                protocol.TASK, kind=protocol.TASK_PART_FORWARD_RELAY,
+                task_id=new_id("task"), **fields,
+            ),
+            {"x": out},
+        )
+        await self._send(info["ws"], frame)
 
     async def _handle_result(self, ws, data):
         """RESULT / TASK_ERROR → resolve the matching pending future."""
@@ -186,6 +281,9 @@ class PipelineCoordinator:
         self.max_seq_len = max_seq_len
         self.dtype = dtype
         self.rng_seed = rng_seed
+        # set by load(): every stage dialed its successor, so chains can
+        # relay worker→worker instead of round-tripping the coordinator
+        self.relay_ok = False
 
     async def load(
         self, checkpoint_path: str | None = None, timeout: float = 600.0
@@ -193,6 +291,12 @@ class PipelineCoordinator:
         """part_load every stage concurrently; returns their stage infos.
         `timeout` covers checkpoint read + compile per stage (a 7B half
         takes minutes — far beyond the per-step default)."""
+        # each stage gets its successor's dial address for relay chaining
+        async with self.node._lock:
+            addrs = [
+                (self.node.peers.get(pid) or {}).get("addr")
+                for pid in self.stage_peers
+            ]
         results = await asyncio.gather(
             *(
                 self.node.run_stage_task(
@@ -206,22 +310,33 @@ class PipelineCoordinator:
                         "dtype": self.dtype,
                         "rng_seed": self.rng_seed,
                         "checkpoint_path": checkpoint_path,
+                        "next_addr": (
+                            addrs[s + 1] if s + 1 < len(self.stage_peers) else None
+                        ),
                     },
                     timeout=timeout,
                 )
                 for s, peer in enumerate(self.stage_peers)
             )
         )
-        return [r.get("info", {}) for r in results]
+        infos = [r.get("info", {}) for r in results]
+        self.relay_ok = len(infos) > 0 and all(i.get("relay") for i in infos)
+        return infos
 
     async def _chain(self, request_id: str, x: np.ndarray, offset: int) -> np.ndarray:
-        """ids/hidden through every stage; returns last stage's logits."""
+        """ids/hidden through every stage; returns last stage's logits.
+        With relay chaining (load() dialed stage→stage links) the whole
+        chain is one send + one receive at the coordinator."""
+        fields = {"model": self.model, "request_id": request_id, "offset": offset}
+        if self.relay_ok and len(self.stage_peers) > 1:
+            result = await self.node.run_stage_task(
+                self.stage_peers[0], protocol.TASK_PART_FORWARD_RELAY,
+                fields, tensors={"x": x},
+            )
+            return result["_tensors"]["out"]
         for peer in self.stage_peers:
             result = await self.node.run_stage_task(
-                peer,
-                protocol.TASK_PART_FORWARD,
-                {"model": self.model, "request_id": request_id, "offset": offset},
-                tensors={"x": x},
+                peer, protocol.TASK_PART_FORWARD, fields, tensors={"x": x}
             )
             x = result["_tensors"]["out"]
         return x
@@ -313,6 +428,7 @@ class PipelineCoordinator:
             max_seq_len=self.max_seq_len,
             dtype=self.dtype,
             n_microbatches=n_microbatches,
+            relay=self.relay_ok,
         )
 
 
@@ -391,6 +507,7 @@ class PipelineSession:
         max_seq_len: int = 2048,
         dtype: str = "bfloat16",
         n_microbatches: int = 1,
+        relay: bool = False,  # stage→stage links up (coordinator.load)
     ):
         self.node = node
         self.model = model
@@ -398,6 +515,7 @@ class PipelineSession:
         self.max_batch = max_batch
         self.max_seq_len = max_seq_len
         self.dtype = dtype
+        self.relay = relay and len(stage_peers) > 1
         self.sid = new_id("ppsess")
         M = max(1, min(n_microbatches, max_batch))
         base, extra = divmod(max_batch, M)
@@ -410,7 +528,11 @@ class PipelineSession:
         self._wake = asyncio.Event()
         self._task: asyncio.Task | None = None
         self._closed = False
-        self.stats = {"chains": 0, "steps": 0, "prefills": 0, "tokens": 0}
+        self.stats = {
+            "chains": 0, "steps": 0, "prefills": 0, "tokens": 0,
+            "tasks_sent": 0,  # coordinator sends: chains x stages, or
+            # chains x 1 under relay — the wire-cost metric tests assert
+        }
 
     # ------------------------------------------------------------- public
 
@@ -519,11 +641,23 @@ class PipelineSession:
             "offset": [int(o) for o in offsets],
             "write_mask": [bool(m) for m in mask],
         }
+        if self.relay:
+            # one send, one receive: stages hand hidden states to each
+            # other; the LAST stage answers us (gather rides the chain)
+            self.stats["tasks_sent"] += 1
+            result = await self.node.run_stage_task(
+                self.stage_peers[0], protocol.TASK_PART_FORWARD_RELAY,
+                {**fields, "gather": [int(g_) for g_ in gather]},
+                tensors={"x": x},
+            )
+            return result["_tensors"]["out"]
         for peer in self.stage_peers[:-1]:
+            self.stats["tasks_sent"] += 1
             result = await self.node.run_stage_task(
                 peer, protocol.TASK_PART_FORWARD, fields, tensors={"x": x}
             )
             x = result["_tensors"]["out"]
+        self.stats["tasks_sent"] += 1
         result = await self.node.run_stage_task(
             self.stage_peers[-1],
             protocol.TASK_PART_FORWARD,

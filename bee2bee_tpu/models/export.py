@@ -200,7 +200,8 @@ def hf_config_dict(cfg: ModelConfig, qkv_bias: bool | None = None) -> dict:
             "hidden_act": "gelu_pytorch_tanh" if cfg.activation == "geglu" else cfg.activation,
             **base,
         }
-    if qkv_bias if qkv_bias is not None else cfg.qkv_bias:  # qwen2 family
+    is_qwen2 = cfg.qkv_bias if qkv_bias is None else qkv_bias
+    if is_qwen2:
         return {"model_type": "qwen2", "architectures": ["Qwen2ForCausalLM"], **base}
     return {"model_type": "llama", "architectures": ["LlamaForCausalLM"], **base}
 

@@ -48,6 +48,9 @@ TASK_MODEL_INFER = "model_infer"
 TASK_MODEL_UNLOAD = "model_unload"
 TASK_PART_LOAD = "part_load"
 TASK_PART_FORWARD = "part_forward"
+# relay chaining: hidden states hop stage→stage directly; only the last
+# stage answers the coordinator (meshnet/pipeline.py)
+TASK_PART_FORWARD_RELAY = "part_forward_relay"
 TASK_TRAIN_STEP = "train_step"
 
 MESSAGE_TYPES = frozenset(

@@ -154,6 +154,50 @@ async def test_pipeline_session_microbatch_overlap_matches():
             await coord_session.close()
 
 
+async def test_pipeline_relay_chain_one_roundtrip_per_step():
+    """part_load with next_addr dials stage→stage links; chains then
+    relay worker→worker and the coordinator pays ONE send per step
+    (tasks_sent == chains) instead of one round trip per stage — and
+    the output still matches the single-process rollout exactly."""
+    workers = [P2PNode(host="127.0.0.1", port=0, node_id=f"rstage{i}") for i in range(2)]
+    coord = P2PNode(host="127.0.0.1", port=0, node_id="rcoord")
+    nodes = [*workers, coord]
+    for n in nodes:
+        await n.start()
+    try:
+        for w in workers:
+            await coord.connect_bootstrap(w.addr)
+        await _settle(lambda: len(coord.peers) >= 2)
+        coordinator = PipelineCoordinator(
+            coord, MODEL, stage_peers=[w.peer_id for w in workers],
+            max_seq_len=128, dtype="float32", rng_seed=SEED,
+        )
+        infos = await coordinator.load(timeout=120.0)
+        assert coordinator.relay_ok, infos
+        assert workers[0].stage_next.get(MODEL) == workers[1].peer_id
+
+        tok = ByteTokenizer(get_config(MODEL).vocab_size)
+        sess = coordinator.session(max_batch=2)
+        try:
+            out = await sess.generate(
+                tok.encode("relay me"), max_new_tokens=8, temperature=0.0
+            )
+            assert tok.decode(out) == _expected_text("relay me", 8)
+            assert sess.relay
+            assert sess.stats["tasks_sent"] == sess.stats["chains"]
+        finally:
+            await sess.close()
+
+        # the unbatched coordinator path relays too
+        out2 = await coordinator.generate(
+            tok.encode("relay me"), max_new_tokens=8, temperature=0.0
+        )
+        assert tok.decode(out2) == _expected_text("relay me", 8)
+    finally:
+        for n in nodes:
+            await n.stop()
+
+
 async def test_pipeline_session_direct_mixed_lengths_and_eos():
     """Session API directly: staggered admission, per-row offsets, and a
     row retiring early (token budget) while others continue."""
