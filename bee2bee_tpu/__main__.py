@@ -30,11 +30,22 @@ def _setup_logging():
         from .utils import bee2bee_home
 
         home = bee2bee_home()
-        # reap per-pid logs from dead runs (>7 days) so short CLI
-        # invocations don't accumulate orphans forever
+        # reap per-pid logs of DEAD processes (>7 days) — a quiet but
+        # live daemon's open log must never be unlinked out from under
+        # its handler
         cutoff = _time.time() - 7 * 86400
         for old in home.glob("bee2bee-*.log*"):
-            with contextlib.suppress(OSError):
+            with contextlib.suppress(OSError, ValueError):
+                pid = int(old.name.split("-", 1)[1].split(".", 1)[0])
+                if pid == os.getpid():
+                    continue
+                try:
+                    os.kill(pid, 0)  # raises if the pid is gone
+                    continue  # still alive: keep its logs
+                except ProcessLookupError:
+                    pass
+                except PermissionError:
+                    continue  # alive under another uid
                 if old.stat().st_mtime < cutoff:
                     old.unlink()
         log_file = str(home / f"bee2bee-{os.getpid()}.log")

@@ -88,6 +88,7 @@ class P2PNode(StageTaskMixin):
         self.local_services: dict[str, Any] = {}
         self.stage_runners: dict[str, Any] = {}  # model -> StageRunner (pipeline.py)
         self.stage_next: dict[str, str] = {}  # model -> next stage's peer_id (relay)
+        self.stage_bursts: dict[str, dict] = {}  # ring decode accumulators (last stage)
         self.throughput = MetricsAggregator()
 
         # piece store: hash -> bytes (optionally spilled to piece_dir)

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 
 import numpy as np
 
@@ -45,20 +46,33 @@ class StageTaskMixin:
         """Host a pipeline stage (StageRunner) on this node."""
         self.stage_runners[runner.model_cfg.name] = runner
 
+    async def _peer_ws(self, peer_id: str | None, what: str):
+        """Resolve a peer's live ws or raise — the relay/ring handlers'
+        shared lookup (one place to change if peer bookkeeping does)."""
+        if not peer_id:
+            raise RuntimeError(f"{what}: peer unknown (dropped mid-task?)")
+        async with self._lock:
+            info = self.peers.get(peer_id)
+        if info is None:
+            raise RuntimeError(f"{what}: peer {peer_id!r} gone")
+        return info["ws"]
+
     async def _handle_task(self, ws, data):
         kind = data.get("kind")
         task_id = data.get("task_id")
 
         async def fail(error: str):
-            # relay tasks report failure to the ORIGIN coordinator, not the
-            # previous stage (which isn't waiting on anything)
+            # relayed tasks report failure to the ORIGIN coordinator, not
+            # the previous stage (which isn't waiting on anything)
             origin = data.get("origin_peer")
-            if kind == protocol.TASK_PART_FORWARD_RELAY and origin:
-                async with self._lock:
-                    info = self.peers.get(origin)
-                if info is not None:
+            if origin:
+                try:
+                    origin_ws = await self._peer_ws(origin, "task error routing")
+                except RuntimeError:
+                    origin_ws = None
+                if origin_ws is not None:
                     await self._send(
-                        info["ws"],
+                        origin_ws,
                         protocol.msg(
                             protocol.TASK_ERROR,
                             task_id=data.get("origin_task_id"), error=error,
@@ -76,6 +90,8 @@ class StageTaskMixin:
                 await self._task_part_forward(ws, data)
             elif kind == protocol.TASK_PART_FORWARD_RELAY:
                 await self._task_part_forward_relay(ws, data)
+            elif kind == protocol.TASK_DECODE_RUN:
+                await self._task_decode_run(ws, data)
             elif kind == "part_release":
                 runner = self.stage_runners.get(data.get("model"))
                 if runner is not None:
@@ -130,7 +146,13 @@ class StageTaskMixin:
             ws,
             protocol.msg(
                 protocol.RESULT, task_id=task_id, ok=True,
-                info={**runner.info, "relay": relay or runner.spec.is_last},
+                # relay: can this stage chain forward (last stage answers
+                # the origin instead, so it chains by definition).
+                # ring: did the successor dial actually succeed — the last
+                # stage's wrap-around link to stage 0 enables burst decode.
+                info={**runner.info,
+                      "relay": relay or runner.spec.is_last,
+                      "ring": relay},
             ),
         )
 
@@ -176,32 +198,26 @@ class StageTaskMixin:
         # first hop (coordinator → stage 0) carries no origin fields: the
         # sender IS the origin and its task_id is the reply correlation id
         if not data.get("origin_peer"):
-            data["origin_peer"] = await self._peer_for(ws)
+            sender = await self._peer_for(ws)
+            if sender is None:  # a None origin would misroute the RESULT
+                raise RuntimeError("relay sender unknown (dropped mid-task?)")
+            data["origin_peer"] = sender
             data["origin_task_id"] = data.get("task_id")
         out = await self._run_stage_forward(data)
         runner = self.stage_runners[data["model"]]
         if runner.spec.is_last:
-            async with self._lock:
-                info = self.peers.get(data.get("origin_peer"))
-            if info is None:
-                raise RuntimeError(
-                    f"relay origin {data.get('origin_peer')!r} not connected"
-                )
+            origin_ws = await self._peer_ws(data.get("origin_peer"), "relay origin")
             frame = protocol.encode_binary(
                 protocol.msg(
                     protocol.RESULT, task_id=data.get("origin_task_id"), ok=True
                 ),
                 {"out": out},
             )
-            await self._send(info["ws"], frame)
+            await self._send(origin_ws, frame)
             return
-        nxt = self.stage_next.get(data["model"])
-        if nxt is None:
-            raise RuntimeError("relay chain broken: no next stage dialed")
-        async with self._lock:
-            info = self.peers.get(nxt)
-        if info is None:
-            raise RuntimeError(f"relay chain broken: next stage {nxt!r} gone")
+        next_ws = await self._peer_ws(
+            self.stage_next.get(data["model"]), "relay next stage"
+        )
         fields = {
             k: data[k]
             for k in ("model", "request_id", "offset", "write_mask", "gather",
@@ -215,7 +231,80 @@ class StageTaskMixin:
             ),
             {"x": out},
         )
-        await self._send(info["ws"], frame)
+        await self._send(next_ws, frame)
+
+    _RING_FIELDS = ("model", "request_id", "offset", "k", "eos", "gather",
+                    "origin_peer", "origin_task_id")
+    BURST_STALE_S = 600.0
+
+    async def _task_decode_run(self, ws, data):
+        """Ring-burst greedy decode (kind=decode_run): the coordinator
+        sends ONE message for up to k tokens. Each token circulates
+        stage0→…→last; the LAST stage samples (greedy — argmax needs no
+        rng state) and feeds the new token straight back to stage 0 over
+        the ring link, accumulating the burst locally; the coordinator
+        hears back once per burst, not once per token. Non-greedy
+        requests use the per-token chain instead (coordinator gates)."""
+        runner = self.stage_runners.get(data.get("model"))
+        if runner is None:
+            raise RuntimeError(f"no stage loaded for model {data.get('model')!r}")
+        if not data.get("origin_peer"):
+            sender = await self._peer_for(ws)
+            if sender is None:  # a None origin would misroute the RESULT
+                raise RuntimeError("ring sender unknown (dropped mid-task?)")
+            data["origin_peer"] = sender
+            data["origin_task_id"] = data.get("task_id")
+        if runner.spec.is_first and "x" not in (data.get("_tensors") or {}):
+            data["_tensors"] = {
+                "x": np.asarray([[int(data["token"])]], np.int32)
+            }
+        data.setdefault("gather", [0])  # last stage returns [1, V]
+        out = await self._run_stage_forward(data)
+        nxt = self.stage_next.get(data["model"])
+        if not runner.spec.is_last:
+            next_ws = await self._peer_ws(nxt, "ring next stage")
+            fields = {k: data[k] for k in self._RING_FIELDS if k in data}
+            await self._send(next_ws, protocol.encode_binary(
+                protocol.msg(protocol.TASK, kind=protocol.TASK_DECODE_RUN,
+                             task_id=new_id("task"), **fields),
+                {"x": out},
+            ))
+            return
+        # ---- last stage: sample, accumulate, circulate or answer ----
+        tok = int(np.argmax(out[0]))
+        otid = data["origin_task_id"]
+        now = time.time()
+        for stale in [k for k, v in self.stage_bursts.items()
+                      if now - v["t"] > self.BURST_STALE_S]:
+            self.stage_bursts.pop(stale, None)
+        burst = self.stage_bursts.setdefault(otid, {"tokens": [], "t": now})
+        burst["t"] = now  # refresh: a live burst must never be reaped
+        eos = data.get("eos")
+        k = int(data.get("k", 1))
+        stopped = eos is not None and tok == eos
+        if not stopped:
+            burst["tokens"].append(tok)
+        if stopped or len(burst["tokens"]) >= k:
+            tokens = burst["tokens"]
+            self.stage_bursts.pop(otid, None)
+            origin_ws = await self._peer_ws(data["origin_peer"], "ring origin")
+            await self._send(origin_ws, protocol.msg(
+                protocol.RESULT, task_id=otid, ok=True,
+                tokens=tokens, stopped=stopped,
+            ))
+            return
+        try:
+            next_ws = await self._peer_ws(nxt, "ring link to stage 0")
+        except RuntimeError:
+            self.stage_bursts.pop(otid, None)
+            raise
+        fields = {key: data[key] for key in self._RING_FIELDS if key in data}
+        fields["offset"] = int(np.asarray(data["offset"]).reshape(-1)[0]) + 1
+        fields["token"] = tok
+        await self._send(next_ws, protocol.msg(
+            protocol.TASK, kind=protocol.TASK_DECODE_RUN,
+            task_id=new_id("task"), **fields,
+        ))
 
     async def _handle_result(self, ws, data):
         """RESULT / TASK_ERROR → resolve the matching pending future."""
@@ -284,6 +373,10 @@ class PipelineCoordinator:
         # set by load(): every stage dialed its successor, so chains can
         # relay worker→worker instead of round-tripping the coordinator
         self.relay_ok = False
+        # the ring closes (last stage → stage 0): greedy decode can run
+        # K-token bursts with last-stage sampling
+        self.ring_ok = False
+        self.ring_burst = 16  # tokens per coordinator round trip
 
     async def load(
         self, checkpoint_path: str | None = None, timeout: float = 600.0
@@ -310,8 +403,11 @@ class PipelineCoordinator:
                         "dtype": self.dtype,
                         "rng_seed": self.rng_seed,
                         "checkpoint_path": checkpoint_path,
+                        # wrap-around: the LAST stage dials stage 0, closing
+                        # the ring for burst decode
                         "next_addr": (
-                            addrs[s + 1] if s + 1 < len(self.stage_peers) else None
+                            addrs[(s + 1) % len(self.stage_peers)]
+                            if len(self.stage_peers) > 1 else None
                         ),
                     },
                     timeout=timeout,
@@ -321,6 +417,9 @@ class PipelineCoordinator:
         )
         infos = [r.get("info", {}) for r in results]
         self.relay_ok = len(infos) > 0 and all(i.get("relay") for i in infos)
+        self.ring_ok = (
+            len(infos) > 1 and all(i.get("ring") for i in infos)
+        )
         return infos
 
     async def _chain(self, request_id: str, x: np.ndarray, offset: int) -> np.ndarray:
@@ -332,6 +431,10 @@ class PipelineCoordinator:
             result = await self.node.run_stage_task(
                 self.stage_peers[0], protocol.TASK_PART_FORWARD_RELAY,
                 fields, tensors={"x": x},
+                # ONE await covers the whole chain (first prefill lazily
+                # compiles every stage) — budget per stage, like the
+                # per-stage path effectively did
+                timeout=DEFAULT_STEP_TIMEOUT * len(self.stage_peers),
             )
             return result["_tensors"]["out"]
         for peer in self.stage_peers:
@@ -385,9 +488,14 @@ class PipelineCoordinator:
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :n] = prompt_ids
         out: list[int] = []
+        greedy = temperature is None or temperature <= 0.0
         try:
             logits = await self._chain(rid, padded, offset=0)
             tok = self._sample(logits[0, n - 1], temperature, rng)
+            if self.ring_ok and greedy and max_new_tokens > 1:
+                return await self._generate_ring(
+                    rid, tok, n, max_new_tokens, eos_token_id, on_token, out
+                )
             offset = n
             while True:
                 if eos_token_id is not None and tok == eos_token_id:
@@ -404,6 +512,43 @@ class PipelineCoordinator:
                 tok = self._sample(logits[0, -1], temperature, rng)
         finally:
             await self.release(rid)
+        return out
+
+    async def _generate_ring(
+        self, rid, first_tok, n, max_new_tokens, eos_token_id, on_token, out
+    ) -> list[int]:
+        """Greedy decode in ring bursts: one coordinator round trip per K
+        tokens — tokens circulate stage0→…→last→stage0 with last-stage
+        argmax sampling (TASK_DECODE_RUN). The caller's finally releases
+        the stage caches."""
+        if eos_token_id is not None and first_tok == eos_token_id:
+            return out
+        out.append(first_tok)
+        if on_token is not None:
+            on_token(first_tok)
+        tok, offset = first_tok, n  # position tok's K/V takes when fed
+        while len(out) < max_new_tokens:
+            k = min(self.ring_burst, max_new_tokens - len(out))
+            result = await self.node.run_stage_task(
+                self.stage_peers[0],
+                protocol.TASK_DECODE_RUN,
+                {
+                    "model": self.model, "request_id": rid,
+                    "token": int(tok), "offset": int(offset), "k": int(k),
+                    "eos": eos_token_id,
+                },
+                timeout=DEFAULT_STEP_TIMEOUT + 2.0 * k,
+            )
+            toks = result.get("tokens") or []
+            for t in toks:
+                out.append(t)
+                if on_token is not None:
+                    on_token(t)
+            if result.get("stopped") or not toks:
+                break
+            # fed this burst: tok + toks[:-1]; toks[-1] feeds next burst
+            offset += len(toks)
+            tok = toks[-1]
         return out
 
     @staticmethod
@@ -643,12 +788,14 @@ class PipelineSession:
         }
         if self.relay:
             # one send, one receive: stages hand hidden states to each
-            # other; the LAST stage answers us (gather rides the chain)
+            # other; the LAST stage answers us (gather rides the chain).
+            # Timeout budgets per stage — one await covers the whole chain
             self.stats["tasks_sent"] += 1
             result = await self.node.run_stage_task(
                 self.stage_peers[0], protocol.TASK_PART_FORWARD_RELAY,
                 {**fields, "gather": [int(g_) for g_ in gather]},
                 tensors={"x": x},
+                timeout=DEFAULT_STEP_TIMEOUT * len(self.stage_peers),
             )
             return result["_tensors"]["out"]
         for peer in self.stage_peers[:-1]:

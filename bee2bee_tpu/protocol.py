@@ -51,6 +51,9 @@ TASK_PART_FORWARD = "part_forward"
 # relay chaining: hidden states hop stage→stage directly; only the last
 # stage answers the coordinator (meshnet/pipeline.py)
 TASK_PART_FORWARD_RELAY = "part_forward_relay"
+# ring-burst decode: K greedy tokens circulate stage0→…→last→stage0
+# with last-stage sampling; coordinator gets ONE result per burst
+TASK_DECODE_RUN = "decode_run"
 TASK_TRAIN_STEP = "train_step"
 
 MESSAGE_TYPES = frozenset(

@@ -188,11 +188,30 @@ async def test_pipeline_relay_chain_one_roundtrip_per_step():
         finally:
             await sess.close()
 
-        # the unbatched coordinator path relays too
-        out2 = await coordinator.generate(
-            tok.encode("relay me"), max_new_tokens=8, temperature=0.0
-        )
+        # the unbatched greedy path runs ring BURSTS: tokens circulate
+        # stage0->stage1->stage0 with last-stage argmax; the coordinator
+        # pays ONE round trip per K tokens (prefill relay + 1 decode_run
+        # for 8 tokens at burst size 16), not one per token
+        assert coordinator.ring_ok
+        from bee2bee_tpu import protocol as proto
+
+        kinds: list[str] = []
+        orig_run = coord.run_stage_task
+
+        async def counting(peer, kind, *a, **kw):
+            kinds.append(kind)
+            return await orig_run(peer, kind, *a, **kw)
+
+        coord.run_stage_task = counting
+        try:
+            out2 = await coordinator.generate(
+                tok.encode("relay me"), max_new_tokens=8, temperature=0.0
+            )
+        finally:
+            coord.run_stage_task = orig_run
         assert tok.decode(out2) == _expected_text("relay me", 8)
+        assert kinds.count(proto.TASK_DECODE_RUN) == 1, kinds
+        assert kinds.count(proto.TASK_PART_FORWARD_RELAY) == 1, kinds
     finally:
         for n in nodes:
             await n.stop()
