@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""The round-4 on-chip measurement plan, runnable as one command the
+moment the TPU lease recovers (VERDICT r3 items 1–3):
+
+1. engine-graph compile time, dense vs flash attention (the open
+   question PERF.md carries since round 3);
+2. distilgpt2 serving rates (the headline bench rungs);
+3. gemma-2b decode_chunk sweep at batch 8/32 + the int8 rung
+   (the 658 → ≥1000 tok/s roofline push);
+4. flash vs dense long-context (2k) prefill+decode on gemma.
+
+Each phase is independently try/except'd and the JSON report is written
+incrementally to --out (default /tmp/tpu_measurements.json) so a
+mid-run wedge still leaves every completed number on disk.
+
+Usage:  python scripts/tpu_measurements.py [--quick] [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+REPORT: dict = {"platform": None, "phases": {}}
+OUT = Path("/tmp/tpu_measurements.json")
+
+
+def save():
+    OUT.write_text(json.dumps(REPORT, indent=2))
+
+
+def phase(name):
+    def deco(fn):
+        def run(*a, **kw):
+            t0 = time.time()
+            try:
+                REPORT["phases"][name] = {"result": fn(*a, **kw), "ok": True}
+            except Exception as e:  # noqa: BLE001 — keep later phases alive
+                REPORT["phases"][name] = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+            REPORT["phases"][name]["wall_s"] = round(time.time() - t0, 1)
+            save()
+            print(f"[{name}] {json.dumps(REPORT['phases'][name])[:300]}", flush=True)
+        return run
+    return deco
+
+
+def serve_rate(eng, prompts, new_tokens, repeats=2):
+    import threading
+
+    best = 0.0
+    for _ in range(repeats):
+        results = [None] * len(prompts)
+
+        def run(i):
+            results[i] = eng.generate(prompts[i], max_new_tokens=new_tokens,
+                                      temperature=0.0)
+
+        t0 = time.perf_counter()
+        ts = [threading.Thread(target=run, args=(i,)) for i in range(len(prompts))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        wall = time.perf_counter() - t0
+        total = sum(r.new_tokens for r in results if r)
+        best = max(best, total / wall)
+    return round(best, 1)
+
+
+@phase("compile_dense_vs_flash")
+def compile_times(quick):
+    """Engine-graph compile (build + first generate) per attention impl."""
+    from bee2bee_tpu.engine import EngineConfig, InferenceEngine
+
+    out = {}
+    for attn in ("dense", "flash"):
+        t0 = time.perf_counter()
+        eng = InferenceEngine(
+            "distilgpt2",
+            engine_config=EngineConfig(max_seq_len=1024, max_batch=8,
+                                       attention=attn),
+        )
+        t_build = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        eng.generate([1] * 64, max_new_tokens=8, temperature=0.0)
+        t_first = time.perf_counter() - t0
+        eng.close()
+        out[attn] = {"build_s": round(t_build, 1), "first_gen_s": round(t_first, 1)}
+    return out
+
+
+@phase("distilgpt2_serving")
+def distil(quick):
+    from bee2bee_tpu.engine import EngineConfig, InferenceEngine
+
+    eng = InferenceEngine(
+        "distilgpt2",
+        engine_config=EngineConfig(max_seq_len=1024, max_batch=8),
+    )
+    prompts = [[1 + (i * 37 + j) % 500 for j in range(64)] for i in range(8)]
+    eng.generate(prompts[0], max_new_tokens=16, temperature=0.0)  # warm
+    n = 64 if quick else 256
+    out = {
+        "batch1_tok_s": serve_rate(eng, prompts[:1], n),
+        "batch8_tok_s": serve_rate(eng, prompts, n),
+    }
+    eng.close()
+    return out
+
+
+@phase("gemma_decode_chunk_sweep")
+def gemma_sweep(quick):
+    """The roofline push: bigger decode chunks amortize per-chunk dispatch
+    through the tunnel; int8 halves weight HBM bytes."""
+    from bee2bee_tpu.engine import EngineConfig, InferenceEngine
+
+    out = {}
+    prompts = [[1 + (i * 37 + j) % 500 for j in range(64)] for i in range(32)]
+    chunks = (32, 64) if quick else (32, 64, 128)
+    for chunk in chunks:
+        eng = InferenceEngine(
+            "gemma-2b",
+            engine_config=EngineConfig(max_seq_len=1024, max_batch=32,
+                                       decode_chunk=chunk),
+        )
+        eng.generate(prompts[0], max_new_tokens=16, temperature=0.0)
+        out[f"chunk{chunk}"] = {
+            "batch8_tok_s": serve_rate(eng, prompts[:8], 64),
+            "batch32_tok_s": serve_rate(eng, prompts, 64, repeats=1),
+        }
+        eng.close()
+        save()
+    eng = InferenceEngine(
+        "gemma-2b",
+        engine_config=EngineConfig(max_seq_len=1024, max_batch=8,
+                                   quantize="int8"),
+    )
+    eng.generate(prompts[0], max_new_tokens=16, temperature=0.0)
+    out["int8_batch8_tok_s"] = serve_rate(eng, prompts[:8], 64)
+    eng.close()
+    return out
+
+
+@phase("flash_long_context")
+def flash_long(quick):
+    """2k-context prefill+decode, flash vs dense (where the [T,S] score
+    materialization should start to matter)."""
+    from bee2bee_tpu.engine import EngineConfig, InferenceEngine
+
+    out = {}
+    prompt = [1 + i % 500 for i in range(2048 - 80)]
+    for attn in ("dense", "flash"):
+        eng = InferenceEngine(
+            "distilgpt2",
+            engine_config=EngineConfig(max_seq_len=2048, max_batch=4,
+                                       attention=attn),
+        )
+        eng.generate(prompt, max_new_tokens=8, temperature=0.0)  # compile
+        t0 = time.perf_counter()
+        r = eng.generate(prompt, max_new_tokens=64, temperature=0.0)
+        wall = time.perf_counter() - t0
+        out[attn] = {
+            "gen64_wall_s": round(wall, 2),
+            "ttft_s": round(r.ttft_s, 3) if r.ttft_s else None,
+        }
+        eng.close()
+        save()
+    return out
+
+
+PHASES = {
+    "compile": lambda q: compile_times(q),
+    "distil": lambda q: distil(q),
+    "gemma": lambda q: gemma_sweep(q),
+    "flash_long": lambda q: flash_long(q),
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    global OUT
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--out", default=str(OUT))
+    ap.add_argument("--phases", default="compile,distil,gemma,flash_long",
+                    help="comma list (CPU smoke: --phases distil --quick)")
+    args = ap.parse_args()
+    OUT = Path(args.out)
+
+    import jax
+
+    REPORT["platform"] = jax.devices()[0].platform
+    save()
+    print(f"platform: {REPORT['platform']}", flush=True)
+    if REPORT["platform"] != "tpu":
+        print("WARNING: not on TPU — numbers are not the measurement plan's",
+              flush=True)
+
+    for name in args.phases.split(","):
+        PHASES[name.strip()](args.quick)
+    print(json.dumps(REPORT, indent=2))
+
+
+if __name__ == "__main__":
+    main()
