@@ -100,6 +100,10 @@ class P2PNode(StageTaskMixin):
         self._lock = asyncio.Lock()  # guards peers/providers
         self._pending_lock = asyncio.Lock()  # guards _pending/_chunk_cbs
         self._pending: dict[str, asyncio.Future] = {}
+        # request/task id -> the ws its reply rides on: a dropped
+        # connection rejects its pending futures immediately instead of
+        # stranding callers until their timeout (stage chains: 120 s)
+        self._pending_ws: dict[str, Any] = {}
         self._chunk_cbs: dict[str, Callable[[str], None]] = {}
         self._tasks: list[asyncio.Task] = []
         self._serving: dict[Any, int] = {}  # ws -> in-flight spawned serves
@@ -205,6 +209,7 @@ class P2PNode(StageTaskMixin):
                 if not fut.done():
                     fut.set_exception(RuntimeError("node_stopped"))
             self._pending.clear()
+            self._pending_ws.clear()
             self._chunk_cbs.clear()
 
     # ------------------------------------------------------------ connections
@@ -295,6 +300,18 @@ class P2PNode(StageTaskMixin):
                 self.providers.pop(pid, None)
         for pid in dead:
             logger.info("peer %s disconnected", pid)
+        # fail fast anything awaiting a reply on this connection — the
+        # reply can no longer arrive, and callers would otherwise hang
+        # until their own timeout
+        async with self._pending_lock:
+            orphaned = [k for k, w in self._pending_ws.items() if w is ws]
+            for key in orphaned:
+                self._pending_ws.pop(key, None)
+                fut = self._pending.get(key)
+                if fut and not fut.done():
+                    fut.set_exception(
+                        RuntimeError("peer connection lost mid-request")
+                    )
         # we dialed this connection: redial unless the peer said goodbye
         # (or we are shutting down). Inbound connections are the remote
         # dialer's job to restore.
@@ -599,6 +616,7 @@ class P2PNode(StageTaskMixin):
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         async with self._pending_lock:
             self._pending[rid] = fut
+            self._pending_ws[rid] = info["ws"]
             if on_chunk:
                 self._chunk_cbs[rid] = on_chunk
         try:
@@ -629,6 +647,7 @@ class P2PNode(StageTaskMixin):
         finally:
             async with self._pending_lock:
                 self._pending.pop(rid, None)
+                self._pending_ws.pop(rid, None)
                 self._chunk_cbs.pop(rid, None)
         return result
 
@@ -849,6 +868,7 @@ class P2PNode(StageTaskMixin):
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         async with self._pending_lock:
             self._pending[rid] = fut
+            self._pending_ws[rid] = info["ws"]
         try:
             await self._send(
                 info["ws"], protocol.msg(protocol.PIECE_REQUEST, rid=rid, hash=digest)
@@ -857,6 +877,7 @@ class P2PNode(StageTaskMixin):
         finally:
             async with self._pending_lock:
                 self._pending.pop(rid, None)
+                self._pending_ws.pop(rid, None)
         if result.get("error"):
             raise RuntimeError(result["error"])
         data = bytes(result["_tensors"]["data"].tobytes())

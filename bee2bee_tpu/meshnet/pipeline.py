@@ -321,17 +321,27 @@ class StageTaskMixin:
         fields: dict,
         tensors: dict | None = None,
         timeout: float = DEFAULT_STEP_TIMEOUT,
+        reply_from: str | None = None,  # peer whose ws carries the REPLY
+        # (relay/ring: the LAST stage answers, not the stage we send to)
     ) -> dict:
         """Send one task to a peer and await its RESULT (tensors included
         under '_tensors'). Raises on TASK_ERROR or timeout."""
         async with self._lock:
             info = self.peers.get(peer_id)
+            reply_info = self.peers.get(reply_from) if reply_from else info
         if info is None:
             raise RuntimeError(f"unknown peer {peer_id!r}")
+        if reply_info is None:
+            raise RuntimeError(f"unknown reply peer {reply_from!r}")
         task_id = new_id("task")
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         async with self._pending_lock:
             self._pending[task_id] = fut
+            # the connection the reply rides on: its death means the reply
+            # can never arrive — fail fast instead of waiting out the
+            # timeout. (Mid-chain stage deaths are covered separately: the
+            # predecessor's failed send routes a TASK_ERROR to the origin.)
+            self._pending_ws[task_id] = reply_info["ws"]
         message = protocol.msg(protocol.TASK, kind=kind, task_id=task_id, **fields)
         try:
             if tensors:
@@ -342,6 +352,7 @@ class StageTaskMixin:
         finally:
             async with self._pending_lock:
                 self._pending.pop(task_id, None)
+                self._pending_ws.pop(task_id, None)
         if result.get("type") == protocol.TASK_ERROR or result.get("error"):
             raise RuntimeError(result.get("error") or "task failed")
         return result
@@ -435,6 +446,7 @@ class PipelineCoordinator:
                 # compiles every stage) — budget per stage, like the
                 # per-stage path effectively did
                 timeout=DEFAULT_STEP_TIMEOUT * len(self.stage_peers),
+                reply_from=self.stage_peers[-1],
             )
             return result["_tensors"]["out"]
         for peer in self.stage_peers:
@@ -538,6 +550,7 @@ class PipelineCoordinator:
                     "eos": eos_token_id,
                 },
                 timeout=DEFAULT_STEP_TIMEOUT + 2.0 * k,
+                reply_from=self.stage_peers[-1],
             )
             toks = result.get("tokens") or []
             for t in toks:
@@ -796,6 +809,7 @@ class PipelineSession:
                 {**fields, "gather": [int(g_) for g_ in gather]},
                 tensors={"x": x},
                 timeout=DEFAULT_STEP_TIMEOUT * len(self.stage_peers),
+                reply_from=self.stage_peers[-1],
             )
             return result["_tensors"]["out"]
         for peer in self.stage_peers[:-1]:

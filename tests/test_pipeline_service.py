@@ -8,6 +8,7 @@ from contextlib import asynccontextmanager
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from bee2bee_tpu.engine.stage_runner import StageRunner
 from bee2bee_tpu.engine.tokenizer import ByteTokenizer
@@ -238,6 +239,95 @@ async def test_pipeline_session_direct_mixed_lengths_and_eos():
             assert tok.decode(out_b) == _expected_text("beta longer prompt", 10)
         finally:
             await sess.close()
+
+
+async def test_pipeline_session_stage_death_fails_fast_not_hangs():
+    """A stage worker dying mid-generation must reject the in-flight
+    futures (review hardening r4) — not strand them until the 300s
+    service timeout — and rotate the session id for the next request."""
+    async with pipeline_mesh() as (workers, coord, client, svc):
+        sess = svc.coordinator.session(max_batch=2)
+        tok = ByteTokenizer(get_config(MODEL).vocab_size)
+        # healthy request proves the session works first
+        out = await sess.generate(tok.encode("ok"), max_new_tokens=3, temperature=0.0)
+        assert tok.decode(out) == _expected_text("ok", 3)
+        sid_before = sess.sid
+
+        # kill the last stage as soon as the FIRST token is out — the
+        # generation is then provably mid-flight with budget remaining
+        # (a fixed timer races a fast machine)
+        first_token = asyncio.Event()
+
+        async def kill_on_first_token():
+            await first_token.wait()
+            await workers[1].stop()
+
+        killer = asyncio.create_task(kill_on_first_token())
+        with pytest.raises(RuntimeError):
+            await asyncio.wait_for(
+                sess.generate(
+                    tok.encode("doomed"), max_new_tokens=120, temperature=0.0,
+                    on_token=lambda _t: first_token.set(),
+                ),
+                timeout=60.0,
+            )
+        await killer
+        # rotation happens after the (async) best-effort cache release
+        assert await _settle(lambda: sess.sid != sid_before, timeout=10.0)
+        await sess.close()
+
+
+async def test_node_serving_cap_falls_back_inline():
+    """Past MAX_CONCURRENT_SERVES_PER_CONN the reader processes serving
+    messages inline (backpressure) — every request still completes."""
+    from bee2bee_tpu.meshnet import node as node_mod
+    from bee2bee_tpu.services.fake import FakeService
+
+    old_cap = node_mod.MAX_CONCURRENT_SERVES_PER_CONN
+    node_mod.MAX_CONCURRENT_SERVES_PER_CONN = 2
+    provider = P2PNode(host="127.0.0.1", port=0)
+    client = P2PNode(host="127.0.0.1", port=0)
+    await provider.start()
+    await client.start()
+    try:
+        # STREAMING requests: FakeService's delay_s applies per stream
+        # chunk, so serves genuinely overlap and exceed the patched cap
+        provider.add_service(
+            FakeService("capped", reply="w x y z", delay_s=0.15, chunk_size=2)
+        )
+        await client.connect_bootstrap(provider.addr)
+        for _ in range(100):
+            if client.providers.get(provider.peer_id):
+                break
+            await asyncio.sleep(0.05)
+        peak = {"v": 0}
+        orig_spawn = provider._spawn
+
+        def counting_spawn(coro):
+            task = orig_spawn(coro)
+            peak["v"] = max(peak["v"], sum(
+                provider._serving.values()
+            ))
+            return task
+
+        provider._spawn = counting_spawn
+        chunks: list[str] = []
+        results = await asyncio.gather(*(
+            client.request_generation(
+                provider.peer_id, f"req {i}", model="capped",
+                max_new_tokens=8, on_chunk=chunks.append,
+            )
+            for i in range(6)
+        ))
+        assert len(results) == 6
+        assert all(r.get("text") for r in results)
+        # the spawned-serve count never exceeded the cap: the overflow
+        # requests were processed inline (backpressure), yet completed
+        assert 0 < peak["v"] <= 2, peak
+    finally:
+        node_mod.MAX_CONCURRENT_SERVES_PER_CONN = old_cap
+        await provider.stop()
+        await client.stop()
 
 
 async def test_pipeline_service_streams_through_mesh():
