@@ -123,11 +123,19 @@ def encode_binary(message: dict, tensors: dict[str, "Any"] | None = None) -> byt
     specs = []
     buffers = []
     for name, arr in tensors.items():
-        a = np.ascontiguousarray(arr)
+        a = np.asarray(arr)
+        # record the shape BEFORE ascontiguousarray: numpy promotes 0-d
+        # inputs to 1-d there, which silently mangled scalar tensors
+        shape = list(a.shape)
+        a = np.ascontiguousarray(a)
         specs.append(
-            {"name": name, "dtype": str(a.dtype), "shape": list(a.shape), "nbytes": a.nbytes}
+            {"name": name, "dtype": str(a.dtype), "shape": shape, "nbytes": a.nbytes}
         )
         buffers.append(a.tobytes())
+    if "tensors" in message:
+        # reserved: the header slot the specs ride in — a message field of
+        # that name would be silently clobbered here and popped on decode
+        raise ValueError("'tensors' is a reserved message field")
     header = dict(message)
     header["tensors"] = specs
     hb = json.dumps(header, separators=(",", ":")).encode("utf-8")

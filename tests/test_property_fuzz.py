@@ -1,0 +1,133 @@
+"""Property-based fuzzing (hypothesis) of the pure codecs every mesh
+byte rides through: binary tensor frames, join links, piece chunking/
+bitfields, and the int8 quantizer's error bound. These are the layers
+where a malformed byte corrupts silently rather than crashing loudly —
+exactly what example-based tests under-cover (SURVEY §4 gap class)."""
+
+from __future__ import annotations
+
+import numpy as np
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from bee2bee_tpu import protocol
+from bee2bee_tpu.joinlink import (
+    bitfield_from_pieces,
+    chunk_bytes,
+    generate_join_link,
+    parse_join_link,
+    pieces_from_bitfield,
+)
+from bee2bee_tpu.models.quant import dequantize_weight, quantize_weight
+
+# keep runs bounded: these execute inside the normal suite
+SETTINGS = settings(max_examples=60, deadline=None)
+
+_dtypes = st.sampled_from([np.float32, np.int32, np.uint8, np.float16])
+_shapes = st.lists(st.integers(1, 8), min_size=0, max_size=3).map(tuple)
+
+
+@st.composite
+def tensors(draw):
+    out = {}
+    for name in draw(st.lists(st.text(st.characters(min_codepoint=33, max_codepoint=126), min_size=1, max_size=8), min_size=0, max_size=3, unique=True)):
+        shape = draw(_shapes)
+        dtype = draw(_dtypes)
+        n = int(np.prod(shape)) if shape else 1
+        arr = np.arange(n, dtype=np.int64).reshape(shape)
+        if np.issubdtype(dtype, np.floating):
+            arr = (arr - n / 2).astype(dtype) / 3
+        else:
+            arr = (arr % 200).astype(dtype)
+        out[name] = arr
+    return out
+
+
+json_values = st.recursive(
+    st.none() | st.booleans() | st.integers(-(2**31), 2**31)
+    | st.floats(allow_nan=False, allow_infinity=False, width=32)
+    | st.text(max_size=30),
+    lambda children: st.lists(children, max_size=4)
+    | st.dictionaries(st.text(max_size=10), children, max_size=4),
+    max_leaves=12,
+)
+
+
+@SETTINGS
+@given(fields=st.dictionaries(
+    st.text(st.characters(min_codepoint=97, max_codepoint=122), min_size=1, max_size=10),
+    json_values, max_size=5,
+), tens=tensors())
+def test_binary_frame_roundtrip(fields, tens):
+    """encode_binary∘decode_binary is the identity on (message, tensors)
+    for every JSON-able header and every supported dtype/shape."""
+    fields.pop("type", None)
+    fields.pop("tensors", None)  # reserved — see test_reserved_field below
+    message = protocol.msg("task", **fields)
+    raw = protocol.encode_binary(message, tens)
+    back_msg, back_tens = protocol.decode_binary(raw)
+    for k, v in message.items():
+        if isinstance(v, float):
+            assert abs(back_msg[k] - v) < 1e-6 or back_msg[k] == v
+        else:
+            assert back_msg[k] == v
+    assert set(back_tens) == set(tens)
+    for k in tens:
+        assert back_tens[k].dtype == tens[k].dtype
+        assert back_tens[k].shape == tens[k].shape
+        np.testing.assert_array_equal(back_tens[k], tens[k])
+
+
+def test_reserved_tensors_field_rejected():
+    """A message field named 'tensors' would be clobbered by the frame
+    header slot — the codec must refuse it loudly, not corrupt it."""
+    import pytest
+
+    with pytest.raises(ValueError, match="reserved"):
+        protocol.encode_binary(protocol.msg("task", tensors=[1, 2]), {})
+
+
+@SETTINGS
+@given(
+    node_id=st.text(st.characters(min_codepoint=33, max_codepoint=126), min_size=1, max_size=24),
+    addrs=st.lists(
+        st.text(st.characters(min_codepoint=33, max_codepoint=126), min_size=1, max_size=40),
+        min_size=1, max_size=4,
+    ),
+)
+def test_join_link_roundtrip(node_id, addrs):
+    link = generate_join_link(node_id, addrs)
+    parsed = parse_join_link(link)
+    assert parsed["node_id"] == node_id
+    assert parsed["bootstrap_addrs"] == addrs
+
+
+@SETTINGS
+@given(data=st.binary(max_size=512), size=st.integers(1, 64))
+def test_chunk_bytes_reassembles(data, size):
+    chunks = chunk_bytes(data, size)
+    assert b"".join(chunks) == data
+    assert all(len(c) <= size for c in chunks)
+
+
+@SETTINGS
+@given(total=st.integers(1, 200), frac=st.floats(0, 1))
+def test_bitfield_roundtrip(total, frac):
+    have = {i for i in range(total) if (i * 2654435761 % 1000) / 1000 < frac}
+    assert pieces_from_bitfield(bitfield_from_pieces(have, total), total) == have
+
+
+@SETTINGS
+@given(
+    rows=st.integers(1, 16), cols=st.integers(1, 16),
+    scale=st.floats(1e-4, 100.0),
+)
+def test_quantize_error_bound_holds(rows, cols, scale):
+    """Symmetric per-out-channel int8: |deq - w| <= s/2 elementwise, for
+    any magnitude (the bound the engine's quality story rests on)."""
+    rng = np.random.default_rng(rows * 1000 + cols)
+    w = (rng.standard_normal((rows, cols)) * scale).astype(np.float32)
+    qw = quantize_weight(w)
+    back = dequantize_weight(qw)
+    s = np.maximum(qw["s"][None, :], 1e-30)
+    assert np.all(np.abs(back - w) <= s / 2 + 1e-7)
