@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import threading
 import time
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -84,6 +85,41 @@ class StageRunner:
         self._fwd = jax.jit(_wrapped, donate_argnums=(2,))
         self._caches: dict[str, dict] = {}  # request_id -> {"cache", "touched"}
         self._lock = threading.Lock()
+
+        # ---- cross-peer pipeline TRAINING (TPU-native realization of the
+        # reference's layer_forward_train/layer_backward worker tasks,
+        # reference node.py:99-182 — toy numpy MLP there; real stage VJP
+        # + in-place SGD on the stage's own params here) ----
+        # all dtype casts live INSIDE the jitted fns: an eager astype is a
+        # blocking round trip per call on a tunneled chip (see memory/PERF)
+        out_dtype = jnp.float32 if self.spec.is_last else self.dtype
+
+        def _fwd_train_raw(p, x):
+            out, _ = stages.stage_forward(p, self.model_cfg, self.spec, x, None, 0)
+            return out
+
+        def _fwd_train(p, x):
+            return _fwd_train_raw(p, x).astype(out_dtype)
+
+        def _bwd(p, x, dy):
+            if self.spec.is_first:  # x is int ids: no gradient flows to it
+                out, vjp = jax.vjp(lambda p_: _fwd_train_raw(p_, x), p)
+                (dp,) = vjp(dy.astype(out.dtype))
+                return dp, None
+            out, vjp = jax.vjp(_fwd_train_raw, p, x)
+            dp, dx = vjp(dy.astype(out.dtype))
+            return dp, dx.astype(self.dtype)
+
+        def _sgd(p, dp, lr):
+            return jax.tree.map(lambda w, g: w - lr * g.astype(w.dtype), p, dp)
+
+        self._fwd_train = jax.jit(_fwd_train)
+        self._bwd = jax.jit(_bwd)
+        # NO donation: a concurrent inference forward may hold the old
+        # param tree mid-dispatch (serve + train share the runner);
+        # donating would delete buffers out from under it
+        self._sgd = jax.jit(_sgd)
+        self._train_acts: dict[str, dict] = {}  # request_id -> {"x", "touched"}
 
     # ------------------------------------------------------------------ API
 
@@ -168,19 +204,48 @@ class StageRunner:
             return np.asarray(jax.device_get(out), np.float32)
         return np.asarray(jax.device_get(out.astype(self.dtype)))
 
+    # ----------------------------------------------------------- training
+
+    def forward_train(self, request_id: str, x: np.ndarray) -> np.ndarray:
+        """Uncached full forward, retaining this stage's input for the
+        matching backward (one in-flight microbatch per request_id).
+        Abandoned retentions are reaped with the stale caches."""
+        x_host = np.asarray(x, np.int32 if self.spec.is_first else None)
+        with self._lock:
+            self._reap_stale()
+            self._train_acts[request_id] = {"x": x_host, "touched": time.time()}
+        out = self._fwd_train(self.params, x_host)
+        return np.asarray(jax.device_get(out))
+
+    def backward(self, request_id: str, dy: np.ndarray, lr: float) -> np.ndarray | None:
+        """VJP against the retained activation; SGD-update this stage's
+        params; return dX for the previous stage (None on the first stage
+        — ids take no gradient). Cotangent/output casts happen inside the
+        jitted _bwd (dtype bookkeeping is compiled, not eager)."""
+        with self._lock:
+            entry = self._train_acts.pop(request_id, None)
+        if entry is None:
+            raise RuntimeError(f"no retained forward for request {request_id!r}")
+        dp, dx = self._bwd(self.params, entry["x"], np.asarray(dy))
+        self.params = self._sgd(self.params, dp, np.float32(lr))
+        if dx is None:
+            return None
+        return np.asarray(jax.device_get(dx))
+
     def release(self, request_id: str) -> None:
         with self._lock:
             self._caches.pop(request_id, None)
+            self._train_acts.pop(request_id, None)
 
     def _reap_stale(self) -> None:
         now = time.time()
-        dead = [
-            rid
-            for rid, e in self._caches.items()
-            if now - e["touched"] > STALE_CACHE_S
-        ]
-        for rid in dead:
-            self._caches.pop(rid, None)
+        for table in (self._caches, self._train_acts):
+            dead = [
+                rid for rid, e in table.items()
+                if now - e["touched"] > STALE_CACHE_S
+            ]
+            for rid in dead:
+                table.pop(rid, None)
 
     @property
     def active_requests(self) -> int:

@@ -92,6 +92,10 @@ class StageTaskMixin:
                 await self._task_part_forward_relay(ws, data)
             elif kind == protocol.TASK_DECODE_RUN:
                 await self._task_decode_run(ws, data)
+            elif kind == protocol.TASK_LAYER_FORWARD_TRAIN:
+                await self._task_forward_train(ws, data)
+            elif kind == protocol.TASK_LAYER_BACKWARD:
+                await self._task_backward(ws, data)
             elif kind == "part_release":
                 runner = self.stage_runners.get(data.get("model"))
                 if runner is not None:
@@ -232,6 +236,44 @@ class StageTaskMixin:
             {"x": out},
         )
         await self._send(next_ws, frame)
+
+    async def _task_forward_train(self, ws, data):
+        """Training forward: run the stage uncached, retaining activations
+        for the backward (the reference's layer_forward_train worker task,
+        reference node.py:99-130, realized as real stage VJP state)."""
+        runner = self.stage_runners.get(data.get("model"))
+        if runner is None:
+            raise RuntimeError(f"no stage loaded for model {data.get('model')!r}")
+        loop = asyncio.get_running_loop()
+        out = await loop.run_in_executor(
+            None,
+            lambda: runner.forward_train(data["request_id"], data["_tensors"]["x"]),
+        )
+        await self._send(ws, protocol.encode_binary(
+            protocol.msg(protocol.RESULT, task_id=data.get("task_id"), ok=True),
+            {"out": out},
+        ))
+
+    async def _task_backward(self, ws, data):
+        """Training backward: VJP against the retained activation, SGD on
+        this stage's params, dX back to the coordinator (reference
+        node.py:131-182's layer_backward, with real gradients)."""
+        runner = self.stage_runners.get(data.get("model"))
+        if runner is None:
+            raise RuntimeError(f"no stage loaded for model {data.get('model')!r}")
+        loop = asyncio.get_running_loop()
+        dx = await loop.run_in_executor(
+            None,
+            lambda: runner.backward(
+                data["request_id"], data["_tensors"]["dy"],
+                float(data.get("lr", 1e-3)),
+            ),
+        )
+        msg = protocol.msg(protocol.RESULT, task_id=data.get("task_id"), ok=True)
+        if dx is None:  # first stage: ids take no gradient
+            await self._send(ws, msg)
+        else:
+            await self._send(ws, protocol.encode_binary(msg, {"dx": dx}))
 
     _RING_FIELDS = ("model", "request_id", "offset", "k", "eos", "gather",
                     "origin_peer", "origin_task_id")
@@ -525,6 +567,73 @@ class PipelineCoordinator:
         finally:
             await self.release(rid)
         return out
+
+    async def train_step(
+        self,
+        input_ids: np.ndarray,  # [B, T] int32
+        targets: np.ndarray,  # [B, T] int32 next-token labels
+        lr: float = 1e-3,
+        timeout: float = DEFAULT_STEP_TIMEOUT,
+    ) -> float:
+        """One cross-peer pipeline TRAINING step: forward through every
+        stage (each retains its activations), softmax-cross-entropy grad
+        at the coordinator, backward through the stages in reverse (each
+        VJPs and SGD-updates its own params). Returns the mean loss.
+
+        The reference's coordinator-worker training protocol
+        (layer_forward_train / layer_backward, reference node.py:94-182)
+        over real transformer stages — the cross-PEER counterpart of the
+        in-slice GPipe trainer (parallel/pipeline.py).
+
+        Caveat: tie_embeddings=True models hold the tied weight on BOTH
+        the first and last stage (extract_stage_params), so cross-peer
+        training updates the two copies with their partial gradients —
+        effectively untying them. Train untied configs for exact parity
+        with single-process training."""
+        rid = new_id("pptrain")
+        # first step compiles the stage forward AND the (bigger) VJP graph
+        # — budget like load() does, not like a warm decode step
+        step_timeout = max(timeout, 600.0)
+        try:
+            x = np.asarray(input_ids, np.int32)
+            for peer in self.stage_peers:
+                result = await self.node.run_stage_task(
+                    peer, protocol.TASK_LAYER_FORWARD_TRAIN,
+                    {"model": self.model, "request_id": rid},
+                    tensors={"x": x}, timeout=step_timeout,
+                )
+                x = result["_tensors"]["out"]
+            logits = x.astype(np.float64)  # [B, T, V]
+            B, T, V = logits.shape
+            z = logits - logits.max(axis=-1, keepdims=True)
+            p = np.exp(z)
+            p /= p.sum(axis=-1, keepdims=True)
+            tgt = np.asarray(targets, np.int64).reshape(-1)
+            n = B * T
+            flat = p.reshape(n, V)
+            loss = float(-np.log(
+                np.maximum(flat[np.arange(n), tgt], 1e-30)
+            ).mean())
+            # grad in place: softmax minus one at the target index (no
+            # [n, V] one-hot materialization)
+            dlogits = flat.astype(np.float32)
+            dlogits[np.arange(n), tgt] -= 1.0
+            dlogits /= n
+            dy = dlogits.reshape(B, T, V)
+            for peer in reversed(self.stage_peers):
+                result = await self.node.run_stage_task(
+                    peer, protocol.TASK_LAYER_BACKWARD,
+                    {"model": self.model, "request_id": rid, "lr": lr},
+                    tensors={"dy": dy}, timeout=step_timeout,
+                )
+                tens = result.get("_tensors") or {}
+                if "dx" in tens:
+                    dy = tens["dx"]
+            return loss
+        finally:
+            # a failed/partial step must not strand retained activations
+            # on the stages that DID run forward_train
+            await self.release(rid)
 
     async def _generate_ring(
         self, rid, first_tok, n, max_new_tokens, eos_token_id, on_token, out

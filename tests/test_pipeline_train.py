@@ -1,0 +1,116 @@
+"""Cross-peer pipeline TRAINING over the mesh: forward_train/backward
+stage tasks (the reference's coordinator-worker training protocol,
+reference node.py:94-182, realized with real stage VJPs + per-stage SGD)
+must match single-process training step-for-step."""
+
+from __future__ import annotations
+
+import asyncio
+from contextlib import asynccontextmanager
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bee2bee_tpu.engine.stage_runner import StageRunner
+from bee2bee_tpu.meshnet.node import P2PNode
+from bee2bee_tpu.meshnet.pipeline import PipelineCoordinator
+from bee2bee_tpu.models import core, get_config
+
+SEED = 0
+# untied embeddings: a tied weight would live on BOTH stages and receive
+# partial grads (see PipelineCoordinator.train_step caveat)
+CFG = get_config("tiny-llama", tie_embeddings=False)
+LR = 0.05
+
+
+async def _settle(cond, timeout=8.0):
+    for _ in range(int(timeout / 0.05)):
+        if cond():
+            return True
+        await asyncio.sleep(0.05)
+    return False
+
+
+@asynccontextmanager
+async def train_mesh():
+    workers = [P2PNode(host="127.0.0.1", port=0, node_id=f"tstage{i}") for i in range(2)]
+    coord = P2PNode(host="127.0.0.1", port=0, node_id="tcoord")
+    nodes = [*workers, coord]
+    for n in nodes:
+        await n.start()
+    loop = asyncio.get_running_loop()
+    for i, w in enumerate(workers):
+        runner = await loop.run_in_executor(
+            None,
+            lambda i=i: StageRunner(
+                CFG, n_stages=2, stage=i, max_seq_len=128,
+                dtype="float32", rng_seed=SEED,
+            ),
+        )
+        w.add_stage_runner(runner)
+    for w in workers:
+        await coord.connect_bootstrap(w.addr)
+    await _settle(lambda: len(coord.peers) >= 2)
+    coordinator = PipelineCoordinator(
+        coord, CFG.name, stage_peers=[w.peer_id for w in workers],
+        max_seq_len=128, dtype="float32", rng_seed=SEED,
+    )
+    try:
+        yield coordinator
+    finally:
+        for n in nodes:
+            await n.stop()
+
+
+def _reference_losses(ids, tgt, steps):
+    """Single-process SGD with the same init/batch/lr — ground truth."""
+    params = core.init_params(CFG, jax.random.key(SEED), dtype=jnp.float32)
+
+    def loss_fn(p):
+        logits, _ = core.forward(p, CFG, jnp.asarray(ids), None, jnp.int32(0))
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        picked = jnp.take_along_axis(
+            logp, jnp.asarray(tgt)[..., None], axis=-1
+        )[..., 0]
+        return -picked.mean()
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+    losses = []
+    for _ in range(steps):
+        loss, g = grad_fn(params)
+        losses.append(float(loss))
+        params = jax.tree.map(lambda w, d: w - LR * d, params, g)
+    return losses
+
+
+async def test_cross_peer_train_matches_single_process():
+    rng = np.random.default_rng(7)
+    ids = rng.integers(1, CFG.vocab_size, size=(2, 16)).astype(np.int32)
+    tgt = rng.integers(1, CFG.vocab_size, size=(2, 16)).astype(np.int32)
+    steps = 4
+    want = _reference_losses(ids, tgt, steps)
+    async with train_mesh() as coordinator:
+        got = []
+        for _ in range(steps):
+            got.append(await coordinator.train_step(ids, tgt, lr=LR))
+    # same init, batch, and lr: losses must track step-for-step (f32
+    # reassociation between the chained-stage and full-scan graphs only)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+    # and training actually learns: loss strictly decreases
+    assert got[-1] < got[0]
+
+
+async def test_backward_without_forward_raises():
+    async with train_mesh() as coordinator:
+        node = coordinator.node
+        from bee2bee_tpu import protocol
+
+        with pytest.raises(RuntimeError, match="no retained forward"):
+            await node.run_stage_task(
+                coordinator.stage_peers[0], protocol.TASK_LAYER_BACKWARD,
+                {"model": CFG.name, "request_id": "ghost", "lr": 0.1},
+                tensors={"dy": np.zeros((1, 4, CFG.d_model), np.float32)},
+            )
