@@ -27,6 +27,7 @@ import time
 import numpy as np
 
 from .. import protocol
+from ..tracing import get_tracer
 from ..utils import new_id
 
 logger = logging.getLogger("bee2bee_tpu.pipeline")
@@ -595,45 +596,53 @@ class PipelineCoordinator:
         # — budget like load() does, not like a warm decode step
         step_timeout = max(timeout, 600.0)
         try:
-            x = np.asarray(input_ids, np.int32)
-            for peer in self.stage_peers:
-                result = await self.node.run_stage_task(
-                    peer, protocol.TASK_LAYER_FORWARD_TRAIN,
-                    {"model": self.model, "request_id": rid},
-                    tensors={"x": x}, timeout=step_timeout,
-                )
-                x = result["_tensors"]["out"]
-            logits = x.astype(np.float64)  # [B, T, V]
-            B, T, V = logits.shape
-            z = logits - logits.max(axis=-1, keepdims=True)
-            p = np.exp(z)
-            p /= p.sum(axis=-1, keepdims=True)
-            tgt = np.asarray(targets, np.int64).reshape(-1)
-            n = B * T
-            flat = p.reshape(n, V)
-            loss = float(-np.log(
-                np.maximum(flat[np.arange(n), tgt], 1e-30)
-            ).mean())
-            # grad in place: softmax minus one at the target index (no
-            # [n, V] one-hot materialization)
-            dlogits = flat.astype(np.float32)
-            dlogits[np.arange(n), tgt] -= 1.0
-            dlogits /= n
-            dy = dlogits.reshape(B, T, V)
-            for peer in reversed(self.stage_peers):
-                result = await self.node.run_stage_task(
-                    peer, protocol.TASK_LAYER_BACKWARD,
-                    {"model": self.model, "request_id": rid, "lr": lr},
-                    tensors={"dy": dy}, timeout=step_timeout,
-                )
-                tens = result.get("_tensors") or {}
-                if "dx" in tens:
-                    dy = tens["dx"]
-            return loss
+            with get_tracer().span(
+                "pipeline.train_step", model=self.model,
+                stages=len(self.stage_peers), lr=lr,
+            ):
+                return await self._train_step_inner(rid, input_ids, targets,
+                                                    lr, step_timeout)
         finally:
             # a failed/partial step must not strand retained activations
             # on the stages that DID run forward_train
             await self.release(rid)
+
+    async def _train_step_inner(self, rid, input_ids, targets, lr, step_timeout):
+        x = np.asarray(input_ids, np.int32)
+        for peer in self.stage_peers:
+            result = await self.node.run_stage_task(
+                peer, protocol.TASK_LAYER_FORWARD_TRAIN,
+                {"model": self.model, "request_id": rid},
+                tensors={"x": x}, timeout=step_timeout,
+            )
+            x = result["_tensors"]["out"]
+        logits = x.astype(np.float64)  # [B, T, V]
+        B, T, V = logits.shape
+        z = logits - logits.max(axis=-1, keepdims=True)
+        p = np.exp(z)
+        p /= p.sum(axis=-1, keepdims=True)
+        tgt = np.asarray(targets, np.int64).reshape(-1)
+        n = B * T
+        flat = p.reshape(n, V)
+        loss = float(-np.log(
+            np.maximum(flat[np.arange(n), tgt], 1e-30)
+        ).mean())
+        # grad in place: softmax minus one at the target index (no
+        # [n, V] one-hot materialization)
+        dlogits = flat.astype(np.float32)
+        dlogits[np.arange(n), tgt] -= 1.0
+        dlogits /= n
+        dy = dlogits.reshape(B, T, V)
+        for peer in reversed(self.stage_peers):
+            result = await self.node.run_stage_task(
+                peer, protocol.TASK_LAYER_BACKWARD,
+                {"model": self.model, "request_id": rid, "lr": lr},
+                tensors={"dy": dy}, timeout=step_timeout,
+            )
+            tens = result.get("_tensors") or {}
+            if "dx" in tens:
+                dy = tens["dx"]
+        return loss
 
     async def _generate_ring(
         self, rid, first_tok, n, max_new_tokens, eos_token_id, on_token, out
@@ -1004,6 +1013,13 @@ class PipelineSession:
         group g+1's stage-0 hop overlaps group g's stage-1 compute."""
         self.stats["steps"] += 1
         busy = [g for g in range(len(self.groups)) if self._active(g)]
+        rows = sum(len(self._active(g)) for g in busy)
+        with get_tracer().span(
+            "pipeline.step", groups=len(busy), rows=rows, relay=self.relay
+        ):
+            await self._step_inner(busy)
+
+    async def _step_inner(self, busy) -> None:
         if len(busy) == 1:
             await self._step_group(busy[0])
             return
