@@ -174,8 +174,10 @@ def serve_hf_remote(model, **kw):
 @click.option("--stage", type=int, default=0, help="0-based stage index")
 @click.option("--checkpoint", default=None, help="local checkpoint dir")
 @click.option("--max-seq-len", type=int, default=2048)
+@click.option("--quantize", type=click.Choice(["none", "int8"]), default="none",
+              help="weight-only int8 of THIS stage's slice (halves its HBM)")
 @_common_opts
-def serve_stage(model, n_stages, stage, checkpoint, max_seq_len, **kw):
+def serve_stage(model, n_stages, stage, checkpoint, max_seq_len, quantize, **kw):
     """Host a pipeline-stage worker (layers [a, b) of a model).
 
     A coordinator peer drives generation across stage workers via the
@@ -205,6 +207,7 @@ def serve_stage(model, n_stages, stage, checkpoint, max_seq_len, **kw):
                     checkpoint_path=checkpoint,
                     max_seq_len=max_seq_len,
                     dtype=cfg.dtype,
+                    quantize=quantize,
                 ),
             )
         await run_p2p_node(
@@ -235,9 +238,11 @@ def serve_stage(model, n_stages, stage, checkpoint, max_seq_len, **kw):
 @click.option("--microbatches", type=int, default=1,
               help=">1 overlaps microbatch groups across stages (GPipe-"
                    "style over the wire; costs proportionally more hops)")
+@click.option("--quantize", type=click.Choice(["none", "int8"]), default="none",
+              help="each stage int8-quantizes its slice at part_load")
 @_common_opts
 def serve_pipeline(model, stage_peers, checkpoint, max_seq_len,
-                   max_batch, microbatches, **kw):
+                   max_batch, microbatches, quantize, **kw):
     """Coordinate a model SPLIT ACROSS stage workers and serve it as a
     normal mesh service (BASELINE config 4: layers [0,L/2) on one peer,
     [L/2,L) on another; activations hop as binary tensor frames).
@@ -274,7 +279,7 @@ def serve_pipeline(model, stage_peers, checkpoint, max_seq_len,
                 raise RuntimeError(f"stage workers not identified: {addrs}")
             coordinator = PipelineCoordinator(
                 node, model, stage_peers=peer_ids,
-                max_seq_len=max_seq_len, dtype=cfg.dtype,
+                max_seq_len=max_seq_len, dtype=cfg.dtype, quantize=quantize,
             )
             infos = await coordinator.load(checkpoint_path=checkpoint)
             for i, info in enumerate(infos):

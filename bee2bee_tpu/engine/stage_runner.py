@@ -46,6 +46,8 @@ class StageRunner:
         dtype: str = "bfloat16",
         rng_seed: int = 0,
         max_batch: int = 8,
+        quantize: str = "none",  # "int8": weight-only quant of THIS stage's
+        # slice — a 7B half per peer is exactly where halved weight HBM pays
     ):
         self.model_cfg = (
             model
@@ -56,11 +58,20 @@ class StageRunner:
         self.dtype = jnp.dtype(dtype)
         self.max_seq_len = min(max_seq_len, self.model_cfg.max_seq_len)
         self.max_batch = max_batch
+        quantize = quantize or "none"  # accept ''/None like the engine does
+        if quantize not in ("none", "int8"):
+            raise ValueError(f"quantize={quantize!r}: only 'int8' or 'none'")
+        self.quantize = quantize
 
         if params is None and checkpoint_path:
             from ..models.loader import load_checkpoint
 
-            params = load_checkpoint(checkpoint_path, self.model_cfg, dtype=self.dtype)
+            # quantizing: keep the load host-side so the dense model never
+            # materializes in device memory (engine.py does the same)
+            params = load_checkpoint(
+                checkpoint_path, self.model_cfg, dtype=self.dtype,
+                host=quantize == "int8",
+            )
         if params is None:
             # deterministic random init: every stage of a pipeline derives
             # the SAME full tree from the seed, then keeps its slice — so
@@ -70,6 +81,14 @@ class StageRunner:
                 self.model_cfg, jax.random.key(rng_seed), dtype=self.dtype
             )
         self.params = stages.extract_stage_params(params, self.model_cfg, self.spec)
+        if quantize == "int8":
+            from ..models.quant import quantize_params
+
+            # quantize the SLICE (host-side numpy), then upload: the
+            # matmul/expert_einsum consumers see {q,s} leaves transparently
+            self.params = jax.tree.map(
+                jnp.asarray, quantize_params(jax.device_get(self.params))
+            )
 
         def _wrapped(p, x, cache, off, mask, gather):
             out, c = stages.stage_forward(
@@ -133,6 +152,9 @@ class StageRunner:
             "is_first": self.spec.is_first,
             "is_last": self.spec.is_last,
             "max_seq_len": self.max_seq_len,
+            # observable over the wire (part_load RESULT): a coordinator
+            # can CONFIRM its stages quantized, not just request it
+            "quantize": self.quantize,
         }
 
     def forward(
@@ -210,6 +232,11 @@ class StageRunner:
         """Uncached full forward, retaining this stage's input for the
         matching backward (one in-flight microbatch per request_id).
         Abandoned retentions are reaped with the stale caches."""
+        if self.quantize != "none":
+            raise RuntimeError(
+                "training through a quantized stage is unsupported "
+                "(gradients w.r.t. int8 payloads are meaningless)"
+            )
         x_host = np.asarray(x, np.int32 if self.spec.is_first else None)
         with self._lock:
             self._reap_stale()

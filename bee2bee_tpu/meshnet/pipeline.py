@@ -125,6 +125,7 @@ class StageTaskMixin:
                 max_seq_len=int(data.get("max_seq_len", 2048)),
                 dtype=data.get("dtype", "bfloat16"),
                 rng_seed=int(data.get("rng_seed", 0)),
+                quantize=data.get("quantize", "none"),
             ),
         )
         self.add_stage_runner(runner)
@@ -417,6 +418,7 @@ class PipelineCoordinator:
         max_seq_len: int = 2048,
         dtype: str = "bfloat16",
         rng_seed: int = 0,
+        quantize: str = "none",  # int8: each stage quantizes ITS slice
     ):
         self.node = node
         self.model = model
@@ -424,6 +426,7 @@ class PipelineCoordinator:
         self.max_seq_len = max_seq_len
         self.dtype = dtype
         self.rng_seed = rng_seed
+        self.quantize = quantize
         # set by load(): every stage dialed its successor, so chains can
         # relay worker→worker instead of round-tripping the coordinator
         self.relay_ok = False
@@ -456,6 +459,7 @@ class PipelineCoordinator:
                         "max_seq_len": self.max_seq_len,
                         "dtype": self.dtype,
                         "rng_seed": self.rng_seed,
+                        "quantize": self.quantize,
                         "checkpoint_path": checkpoint_path,
                         # wrap-around: the LAST stage dials stage 0, closing
                         # the ring for burst decode
