@@ -22,7 +22,6 @@ Partition rules over the same paths live in partition.py.
 from __future__ import annotations
 
 import math
-from functools import partial
 from typing import Any
 
 import jax
