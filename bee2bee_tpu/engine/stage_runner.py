@@ -79,15 +79,24 @@ class StageRunner:
             params = core.init_params(
                 self.model_cfg, jax.random.key(rng_seed), dtype=self.dtype
             )
-        self.params = stages.extract_stage_params(params, self.model_cfg, self.spec)
-        if quantize == "int8":
-            from ..models.quant import quantize_params
+        sliced = stages.extract_stage_params(params, self.model_cfg, self.spec)
+        if quantize == "int8" or jax.default_backend() == "cpu":
+            # host-side transforms, then ONE device upload (a 7B-class
+            # slice making extra device round trips at part_load is real
+            # time): int8 quantizes the slice; single-device CPU unstacks
+            # layers into contiguous per-layer arrays (the XLA:CPU
+            # packed-GEMM issue — core.forward / docs/PERF.md). TPU keeps
+            # the stacked scan.
+            host = jax.device_get(sliced)
+            if quantize == "int8":
+                from ..models.quant import quantize_params
 
-            # quantize the SLICE (host-side numpy), then upload: the
-            # matmul/expert_einsum consumers see {q,s} leaves transparently
-            self.params = jax.tree.map(
-                jnp.asarray, quantize_params(jax.device_get(self.params))
-            )
+                host = quantize_params(host)
+            if jax.default_backend() == "cpu":
+                host = core.unstack_layers(host)
+            self.params = jax.tree.map(jnp.asarray, host)
+        else:
+            self.params = sliced
 
         def _wrapped(p, x, cache, off, mask, gather):
             out, c = stages.stage_forward(

@@ -182,11 +182,23 @@ def stage_forward(
         return (h, ck, cv), None
 
     n_local = spec.end - spec.start
-    xs = (sparams["layers"], jnp.arange(n_local))
-    if cache is not None:
+    layer_params = sparams["layers"]
+    if isinstance(layer_params, (list, tuple)):
+        # Unstacked per-layer trees: unrolled loop (the CPU fast path —
+        # XLA:CPU can't pre-pack GEMM operands sliced in-graph from the
+        # stacked arrays; see core.forward / docs/PERF.md "CPU fallback").
+        # The same `layer` body runs with a static layer index.
+        carry = (h, cache["k"], cache["v"]) if cache is not None else (h, None, None)
+        for i, lp in enumerate(layer_params):
+            carry, _ = layer(carry, (lp, i))
+        h, ck, cv = carry
+        new_cache = {"k": ck, "v": cv} if cache is not None else None
+    elif cache is not None:
+        xs = (layer_params, jnp.arange(n_local))
         (h, ck, cv), _ = lax.scan(layer, (h, cache["k"], cache["v"]), xs)
         new_cache = {"k": ck, "v": cv}
     else:
+        xs = (layer_params, jnp.arange(n_local))
         (h, _, _), _ = lax.scan(layer, (h, None, None), xs)
         new_cache = None
 

@@ -109,6 +109,45 @@ def test_stage_chain_cached_prefill_plus_decode_matches_uncached():
         )
 
 
+def test_stage_forward_unstacked_matches_stacked():
+    """The unrolled (list-of-layers) stage_forward branch — the CPU fast
+    path — must be bit-for-bit faithful to the lax.scan branch, cached
+    AND masked: a direct equivalence, not an end-to-end comparison where
+    a systematic unrolled-path bug would cancel out."""
+    params = _params()
+    spec = stages.StageSpec.build(CFG, 2, 0)
+    sp = stages.extract_stage_params(params, CFG, spec)
+    sp_unstacked = core.unstack_layers(jax.device_get(sp))
+    assert isinstance(sp_unstacked["layers"], list)
+
+    ids = jnp.asarray(
+        np.random.default_rng(5).integers(3, CFG.vocab_size, (2, 6)), jnp.int32
+    )
+    # uncached
+    want, _ = stages.stage_forward(sp, CFG, spec, ids, None, jnp.int32(0))
+    got, _ = stages.stage_forward(sp_unstacked, CFG, spec, ids, None, jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+
+    # cached with per-row offsets and a write mask (the session contract)
+    cache_a = stages.init_stage_cache(CFG, spec, 2, 16, jnp.float32)
+    cache_b = stages.init_stage_cache(CFG, spec, 2, 16, jnp.float32)
+    offsets = jnp.asarray([0, 3], jnp.int32)
+    mask = jnp.asarray([True, False])
+    want, cache_a = stages.stage_forward(
+        sp, CFG, spec, ids, cache_a, offsets, write_mask=mask
+    )
+    got, cache_b = stages.stage_forward(
+        sp_unstacked, CFG, spec, ids, cache_b, offsets, write_mask=mask
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(cache_b["k"]), np.asarray(cache_a["k"]), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(cache_b["v"]), np.asarray(cache_a["v"]), atol=1e-6
+    )
+
+
 # ------------------------------------------------- cross-peer serving flow
 
 
