@@ -130,8 +130,9 @@ def cli():
 @click.option("--model", default="distilgpt2", help="model name or config key")
 @click.option("--checkpoint", default=None, help="local checkpoint dir (HF or native)")
 @click.option("--mesh-shape", default=None, help='e.g. "data:1,model:8" or "seq:4,model:2"')
-@click.option("--attention", type=click.Choice(["dense", "flash", "sp"]), default=None,
-              help="dense | flash (pallas) | sp (seq-sharded long-context cache)")
+@click.option("--attention", type=click.Choice(["auto", "dense", "flash", "sp"]), default=None,
+              help="auto (flash on TPU when supported) | dense | flash (pallas)"
+                   " | sp (seq-sharded long-context cache)")
 @click.option("--quantize", type=click.Choice(["none", "int8"]), default=None,
               help="weight-only quantization (int8 halves decode HBM traffic)")
 @click.option("--publish-weights", is_flag=True,

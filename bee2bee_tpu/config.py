@@ -62,8 +62,10 @@ class NodeConfig:
     # compute (TPU-native additions)
     mesh_shape: str = ""  # e.g. "data:1,model:8" — empty = all devices on model axis
     dtype: str = "bfloat16"
-    # attention impl: dense | flash (pallas kernel) | sp (sequence-parallel
-    # serving over a seq-sharded KV cache; needs seq>1 in mesh_shape)
+    # attention impl: auto (flash on TPU when the layout supports the
+    # kernel, else dense) | dense | flash (pallas kernel) | sp (sequence-
+    # parallel serving over a seq-sharded KV cache; needs seq>1 in
+    # mesh_shape)
     attention: str = "dense"
     # chunked prefill size (0 = whole-prompt buckets); bounds dense
     # prefill score memory for long prompts (EngineConfig.prefill_chunk)

@@ -24,6 +24,8 @@ callback) minus the transcript parsing, which lives in the service layer.
 
 from __future__ import annotations
 
+import dataclasses
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
@@ -39,6 +41,8 @@ from ..models import core, partition
 from ..parallel.mesh import local_mesh
 from ..utils import MetricsAggregator
 from .tokenizer import load_tokenizer
+
+logger = logging.getLogger("bee2bee_tpu.engine")
 
 DEFAULT_BUCKETS = (64, 128, 256, 512, 1024, 2048, 4096, 8192)
 
@@ -76,6 +80,10 @@ class EngineConfig:
     # cache's capacity dim is sharded over the mesh's `seq` axis and
     # attention merges per-shard online-softmax partials via psum; cache
     # HBM and the quadratic prefill term scale 1/seq. Needs seq > 1.
+    # "auto": flash when on TPU and the head layout supports the kernel
+    # (ops.flash.validate_flash_mesh), dense otherwise — resolved once at
+    # engine build (interpret-mode pallas off-TPU would be far slower
+    # than XLA's fused dense path).
     attention: str = "dense"
     # chunked prefill: process the prompt in fixed chunks of this many
     # tokens instead of one whole-prompt bucket. Bounds dense-attention
@@ -135,6 +143,12 @@ class InferenceEngine:
         # an explicit mesh (the model must divide its axes — validated below)
         self.mesh = mesh if mesh is not None else local_mesh()
         partition.validate_divisibility(self.model_cfg, self.mesh)
+        if self.engine_cfg.attention == "auto":
+            # replace, don't mutate: the caller may share one EngineConfig
+            # across engines on different backends/meshes
+            self.engine_cfg = dataclasses.replace(
+                self.engine_cfg, attention=self._resolve_auto_attention()
+            )
         self._validate_attention_impl()
         if self.engine_cfg.quantize not in ("none", "int8", "", None):
             # fail BEFORE the (multi-GB) checkpoint load, like the other
@@ -217,6 +231,37 @@ class InferenceEngine:
 
             return make_sp_attn_fn(self.mesh)
         return None
+
+    def _resolve_auto_attention(self) -> str:
+        """attention='auto' → 'flash' when THIS engine's mesh devices are
+        TPU and the head layout supports the kernel, else 'dense'.
+        Measured rationale (docs/PERF.md r4): flash's whole-graph compile
+        is ~2x faster than dense's, and its per-row causal block skip
+        reads only the live prefix of the KV cache during decode where
+        dense reads every slot. On non-TPU devices the kernel runs in
+        pallas interpret mode — orders of magnitude slower than XLA's
+        fused dense einsum — so those resolve to dense. The platform
+        comes from the mesh, not jax.devices(): an explicit CPU mesh on
+        a TPU-default host must not pick flash."""
+        from ..ops.flash import validate_flash_mesh
+
+        if self.mesh.shape.get("seq", 1) > 1:
+            # a seq axis exists for exactly one reason: sequence-parallel
+            # cache sharding. flash/dense would leave the cache replicated
+            # across the seq group (cache_spec seq-shards only under "sp")
+            # — silent 1/seq HBM-scaling loss on the long-context mesh
+            logger.info("attention=auto -> sp (mesh has a seq axis)")
+            return "sp"
+        if self.mesh.devices.flat[0].platform != "tpu":
+            logger.info("attention=auto -> dense (mesh devices are not TPU)")
+            return "dense"
+        try:
+            validate_flash_mesh(self.model_cfg, self.mesh)
+        except ValueError as e:  # unsupported head layout
+            logger.info("attention=auto -> dense (%s)", e)
+            return "dense"
+        logger.info("attention=auto -> flash")
+        return "flash"
 
     def _validate_attention_impl(self):
         if self.engine_cfg.attention == "flash":

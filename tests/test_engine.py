@@ -179,3 +179,97 @@ def test_engine_on_data_axis_mesh_does_not_crash():
         engine_config=EngineConfig(max_seq_len=64, prefill_buckets=(16,), dtype="float32", cache_dtype="float32"),
     )
     assert eng.generate("data axis", max_new_tokens=4).new_tokens > 0
+
+
+class TestAutoAttention:
+    """attention='auto' resolves at engine build: flash on a supporting TPU
+    layout, dense everywhere else (EngineConfig.attention docstring)."""
+
+    def test_auto_resolves_to_dense_on_cpu(self):
+        eng = InferenceEngine(
+            "tiny-llama",
+            engine_config=EngineConfig(
+                max_seq_len=64, dtype="float32", cache_dtype="float32",
+                attention="auto",
+            ),
+        )
+        assert eng.engine_cfg.attention == "dense"
+        # and the engine actually works after resolution
+        r = eng.generate([1, 2, 3], max_new_tokens=4, temperature=0.0)
+        assert r.new_tokens == 4
+        eng.close()
+
+    @staticmethod
+    def _fake_tpu_mesh(shape=None):
+        """A mesh stand-in whose devices report platform='tpu'. Resolution
+        reads the MESH's devices (not jax.devices()): an explicit CPU mesh
+        on a TPU-default host must resolve to dense, so the platform
+        source of truth is the mesh itself."""
+        import types
+
+        dev = types.SimpleNamespace(platform="tpu")
+        return types.SimpleNamespace(
+            devices=np.array([dev]), shape=dict(shape or {})
+        )
+
+    def test_auto_resolves_to_flash_on_tpu_mesh(self):
+        # resolution must consult the real layout validator: tiny-llama's
+        # 4 heads on a 1-device mesh pass it
+        eng = InferenceEngine.__new__(InferenceEngine)
+        from bee2bee_tpu.models.config import get_config
+
+        eng.model_cfg = get_config("tiny-llama")
+        eng.engine_cfg = EngineConfig(attention="auto")
+        eng.mesh = self._fake_tpu_mesh()
+        assert eng._resolve_auto_attention() == "flash"
+
+    def test_auto_falls_back_to_dense_on_unsupported_layout(self):
+        from bee2bee_tpu.models.config import get_config
+
+        eng = InferenceEngine.__new__(InferenceEngine)
+        # tiny-llama has n_kv_heads=2 (GQA): replicated KV over model=4
+        # is the layout validate_flash_mesh rejects
+        eng.model_cfg = get_config("tiny-llama")
+        eng.engine_cfg = EngineConfig(attention="auto")
+        eng.mesh = self._fake_tpu_mesh(shape={"model": 4})
+        assert eng._resolve_auto_attention() == "dense"
+
+    def test_auto_ignores_default_backend_when_mesh_is_cpu(self, monkeypatch):
+        # TPU-default host, explicit CPU mesh: flash would run the pallas
+        # kernel in interpret mode — auto must pick dense
+        import types
+
+        from bee2bee_tpu.models.config import get_config
+        from bee2bee_tpu.parallel.mesh import local_mesh
+
+        cpu_mesh = local_mesh()  # real CPU devices, built pre-monkeypatch
+        monkeypatch.setattr(
+            jax, "devices",
+            lambda *a, **k: [types.SimpleNamespace(platform="tpu")],
+        )
+        eng = InferenceEngine.__new__(InferenceEngine)
+        eng.model_cfg = get_config("tiny-llama")
+        eng.engine_cfg = EngineConfig(attention="auto")
+        eng.mesh = cpu_mesh
+        assert eng._resolve_auto_attention() == "dense"
+
+    def test_auto_does_not_mutate_callers_config(self):
+        shared = EngineConfig(
+            max_seq_len=64, dtype="float32", cache_dtype="float32",
+            attention="auto",
+        )
+        eng = InferenceEngine("tiny-llama", engine_config=shared)
+        assert shared.attention == "auto"  # caller's object untouched
+        assert eng.engine_cfg.attention in ("dense", "flash")
+        eng.close()
+
+    def test_auto_resolves_to_sp_on_seq_mesh(self):
+        # a seq axis exists only for sequence-parallel cache sharding;
+        # flash/dense would silently replicate the cache across it
+        from bee2bee_tpu.models.config import get_config
+
+        eng = InferenceEngine.__new__(InferenceEngine)
+        eng.model_cfg = get_config("tiny-llama")
+        eng.engine_cfg = EngineConfig(attention="auto")
+        eng.mesh = self._fake_tpu_mesh(shape={"seq": 4, "model": 1})
+        assert eng._resolve_auto_attention() == "sp"
