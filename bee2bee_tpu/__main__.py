@@ -95,6 +95,7 @@ def _serve(backend: str, model: str, **kw):
                 cfg=cfg,
                 bootstrap=kw.get("bootstrap"),
                 checkpoint_path=kw.get("checkpoint"),
+                lora_path=kw.get("lora"),
                 ollama_host=kw.get("ollama_host"),
                 publish_weights=kw.get("publish_weights", False),
                 from_mesh=kw.get("from_mesh", False),
@@ -129,6 +130,9 @@ def cli():
 @cli.command("serve-tpu")
 @click.option("--model", default="distilgpt2", help="model name or config key")
 @click.option("--checkpoint", default=None, help="local checkpoint dir (HF or native)")
+@click.option("--lora", default=None, type=click.Path(exists=True),
+              help="LoRA adapters .npz to merge over the base (bee2bee-tpu "
+                   "train --lora-rank)")
 @click.option("--mesh-shape", default=None, help='e.g. "data:1,model:8" or "seq:4,model:2"')
 @click.option("--attention", type=click.Choice(["auto", "dense", "flash", "sp"]), default=None,
               help="auto (flash on TPU when supported) | dense | flash (pallas)"
@@ -141,11 +145,11 @@ def cli():
               help="fetch weights from mesh providers via the DHT "
                    "(zero local checkpoint)")
 @_common_opts
-def serve_tpu(model, checkpoint, mesh_shape, attention, quantize,
+def serve_tpu(model, checkpoint, lora, mesh_shape, attention, quantize,
               publish_weights, from_mesh, **kw):
     """Serve a model on TPU via the jit engine (the flagship entrypoint)."""
     _serve(
-        "tpu", model, checkpoint=checkpoint, mesh_shape=mesh_shape,
+        "tpu", model, checkpoint=checkpoint, lora=lora, mesh_shape=mesh_shape,
         attention=attention, quantize=quantize,
         publish_weights=publish_weights, from_mesh=from_mesh, **kw
     )
@@ -399,8 +403,21 @@ def register(bootstrap):
 @click.option("--zero1", is_flag=True,
               help="shard optimizer state over the data axis (ZeRO-1): "
                    "saves ~2x params of HBM per replica")
+@click.option("--checkpoint", "base_ckpt", default=None,
+              help="base checkpoint dir (HF or native) to start from — "
+                   "required context for --lora-rank finetuning")
+@click.option("--lora-rank", type=int, default=0,
+              help=">0: LoRA finetuning — train rank-r adapters over the "
+                   "frozen base instead of full weights (train/lora.py)")
+@click.option("--lora-alpha", type=float, default=16.0)
+@click.option("--lora-targets", default="wq,wv",
+              help="comma list from wq,wk,wv,wo,w_gate,w_up,w_down")
+@click.option("--lora-out", default="lora_adapters.npz",
+              help="where the trained adapters land (serve with "
+                   "serve-tpu --lora PATH)")
 def train(model, data_path, steps, batch_size, seq_len, lr, ckpt_dir, ckpt_every,
-          mesh_shape, coordinator, num_hosts, host_id, zero1):
+          mesh_shape, coordinator, num_hosts, host_id, zero1, base_ckpt,
+          lora_rank, lora_alpha, lora_targets, lora_out):
     """Train a causal LM on a local text corpus (checkpoint/resume-able).
 
     The SPMD realization of the reference's per-layer WS training protocol
@@ -446,8 +463,66 @@ def train(model, data_path, steps, batch_size, seq_len, lr, ckpt_dir, ckpt_every
     if data.n_batches == 0:
         raise click.ClickException("corpus too small for one batch")
 
+    lcfg = None
+    if lora_rank > 0:
+        # config errors (bad targets for THIS model) must surface before
+        # the multi-GB base checkpoint load below
+        from .train.lora import LoraConfig, validate_targets
+
+        try:
+            lcfg = LoraConfig(rank=lora_rank, alpha=lora_alpha,
+                              targets=tuple(lora_targets.split(",")))
+            validate_targets(cfg, lcfg)
+        except ValueError as e:
+            raise click.ClickException(str(e))
+
+    base_params = None
+    if base_ckpt:
+        import jax.numpy as jnp
+
+        from .models.loader import load_checkpoint
+
+        # the trainer's master-param dtype, NOT the serving default (bf16
+        # masters round away ~1e-4-relative Adam updates — loss plateaus)
+        base_params = load_checkpoint(
+            base_ckpt, cfg, dtype=jnp.dtype(tcfg.param_dtype)
+        )
+
+    if lora_rank > 0:
+        from .train.lora import LoraTrainer, save_adapters
+
+        if ckpt_dir or zero1:
+            # fail loudly: discovering after a 5000-step run that --ckpt-dir
+            # did nothing is worse than re-running the command without it
+            raise click.ClickException(
+                "--ckpt-dir/--zero1 do not apply to LoRA runs; adapters "
+                "are checkpointed to --lora-out every --ckpt-every steps"
+            )
+        if base_params is None:
+            from .models import core as _core
+
+            import jax as _jax
+
+            click.echo("warning: --lora-rank without --checkpoint trains "
+                       "adapters over a RANDOM base (test runs only)")
+            base_params = _core.init_params(cfg, _jax.random.key(0))
+        ltr = LoraTrainer(cfg, base_params, lcfg, tcfg, mesh=mesh)
+        it = data.repeat()
+        while int(ltr.state.step) < steps:
+            metrics = ltr.train_step(next(it))
+            s = int(ltr.state.step)
+            if s % 10 == 0 or s == steps:
+                click.echo(f"step {s:5d} loss {metrics['loss']:.4f} "
+                           f"acc {metrics['accuracy']:.3f}")
+            if ckpt_every > 0 and s % ckpt_every == 0 and s < steps:
+                save_adapters(lora_out, ltr.adapters, lcfg)
+        save_adapters(lora_out, ltr.adapters, lcfg)
+        click.echo(f"adapters -> {lora_out} (serve: bee2bee-tpu serve-tpu "
+                   f"--model {model} --lora {lora_out})")
+        return
+
     ckpt = None
-    trainer = Trainer(cfg, tcfg, mesh=mesh)
+    trainer = Trainer(cfg, tcfg, mesh=mesh, params=base_params)
     if ckpt_dir:
         from .train.checkpoint import TrainCheckpointer
 

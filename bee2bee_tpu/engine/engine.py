@@ -134,6 +134,7 @@ class InferenceEngine:
         engine_config: EngineConfig | None = None,
         tokenizer=None,
         checkpoint_path: str | None = None,
+        lora_path: str | None = None,
     ):
         self.model_cfg = (
             model if isinstance(model, model_config.ModelConfig) else model_config.get_config(model)
@@ -173,6 +174,13 @@ class InferenceEngine:
             params = core.init_params(
                 self.model_cfg, jax.random.key(self.engine_cfg.rng_seed), dtype=self.dtype
             )
+        if lora_path:
+            # base + trained low-rank deltas, merged BEFORE quantization so
+            # int8 scales see the finetuned weights (train/lora.py)
+            from ..train.lora import load_adapters, merge_lora
+
+            adapters, lcfg = load_adapters(lora_path)
+            params = merge_lora(params, adapters, lcfg)
         if quantized:
             from ..models.quant import quantize_params
 

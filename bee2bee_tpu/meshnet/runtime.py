@@ -33,6 +33,7 @@ def build_service(backend: str, model: str, cfg: NodeConfig, **kw):
             mesh=mesh,
             checkpoint_path=kw.get("checkpoint_path"),
             engine_config=cfg.engine_config(),
+            lora_path=kw.get("lora_path"),
         )
     if backend == "ollama":
         from ..services.ollama import OllamaService
@@ -91,6 +92,7 @@ async def run_p2p_node(
     serve_api: bool = True,
     registry_sync: bool = True,
     checkpoint_path: str | None = None,
+    lora_path: str | None = None,  # LoRA adapters .npz (train/lora.py)
     ollama_host: str | None = None,
     ready_event: asyncio.Event | None = None,
     shutdown_event: asyncio.Event | None = None,
@@ -183,6 +185,14 @@ async def run_p2p_node(
             await dht.start(_parse_dht_bootstrap(cfg.dht_bootstrap) or None)
 
         if backend == "tpu" and from_mesh:
+            if lora_path:
+                # silently serving the base while the operator believes the
+                # adapters are applied would be wrong outputs with no signal
+                raise ValueError(
+                    "--lora is not supported with --from-mesh (mesh-fetched "
+                    "weights + local adapters): serve from a local "
+                    "--checkpoint, or publish the merged weights"
+                )
             # the zero-local-checkpoint join: manifest + pieces come from
             # mesh providers via the DHT (meshnet/weights.py)
             from .weights import serve_model_from_mesh
@@ -203,7 +213,8 @@ async def run_p2p_node(
         elif backend is not None:
             svc = build_service(
                 backend, model, cfg,
-                checkpoint_path=checkpoint_path, ollama_host=ollama_host,
+                checkpoint_path=checkpoint_path, lora_path=lora_path,
+                ollama_host=ollama_host,
             )
             loop = asyncio.get_running_loop()
             if hasattr(svc, "load_sync"):

@@ -27,6 +27,7 @@ class TPUService(BaseService):
         mesh=None,
         checkpoint_path: str | None = None,
         engine_config=None,
+        lora_path: str | None = None,
     ):
         super().__init__("tpu")
         self.model_name = model_name
@@ -36,6 +37,7 @@ class TPUService(BaseService):
         self._mesh = mesh
         self._checkpoint_path = checkpoint_path
         self._engine_config = engine_config
+        self._lora_path = lora_path
 
     # loading is split from construction so nodes can announce before the
     # (slow) compile finishes — same shape as the reference's load_sync/
@@ -49,6 +51,7 @@ class TPUService(BaseService):
                 mesh=self._mesh,
                 checkpoint_path=self._checkpoint_path,
                 engine_config=self._engine_config,
+                lora_path=self._lora_path,
             )
         return self
 

@@ -7,3 +7,11 @@ from .trainer import (  # noqa: F401
     make_train_state,
     make_train_step,
 )
+from .lora import (  # noqa: F401
+    LoraConfig,
+    LoraTrainer,
+    init_lora,
+    load_adapters,
+    merge_lora,
+    save_adapters,
+)
