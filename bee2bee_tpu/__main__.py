@@ -475,6 +475,14 @@ def train(model, data_path, steps, batch_size, seq_len, lr, ckpt_dir, ckpt_every
             validate_targets(cfg, lcfg)
         except ValueError as e:
             raise click.ClickException(str(e))
+        if ckpt_dir or zero1:
+            # fail loudly AND before the multi-GB base load below:
+            # discovering after a 5000-step run (or a minutes-long load)
+            # that --ckpt-dir did nothing is worse than re-running
+            raise click.ClickException(
+                "--ckpt-dir/--zero1 do not apply to LoRA runs; adapters "
+                "are checkpointed to --lora-out every --ckpt-every steps"
+            )
 
     base_params = None
     if base_ckpt:
@@ -491,13 +499,6 @@ def train(model, data_path, steps, batch_size, seq_len, lr, ckpt_dir, ckpt_every
     if lora_rank > 0:
         from .train.lora import LoraTrainer, save_adapters
 
-        if ckpt_dir or zero1:
-            # fail loudly: discovering after a 5000-step run that --ckpt-dir
-            # did nothing is worse than re-running the command without it
-            raise click.ClickException(
-                "--ckpt-dir/--zero1 do not apply to LoRA runs; adapters "
-                "are checkpointed to --lora-out every --ckpt-every steps"
-            )
         if base_params is None:
             from .models import core as _core
 

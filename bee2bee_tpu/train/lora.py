@@ -20,12 +20,12 @@ Usage:
     trainer = LoraTrainer(model_cfg, base_params, lcfg, mesh=mesh)
     trainer.train_step(batch)                  # updates adapters only
     params = trainer.merged_params()           # serve/export (engine-ready)
-    save_adapters(path, trainer.adapters)      # ~MBs, not GBs
+    save_adapters(path, trainer.adapters, lcfg)  # ~MBs, not GBs
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
@@ -219,7 +219,11 @@ class LoraTrainer:
         return self.state.params
 
     def train_step(self, batch: dict) -> dict:
-        self.state, metrics = self._step(self.state, batch)
+        from .trainer import globalize_batch
+
+        self.state, metrics = self._step(
+            self.state, globalize_batch(batch, self.mesh)
+        )
         return {k: float(v) for k, v in metrics.items()}
 
     def merged_params(self):

@@ -245,6 +245,29 @@ def make_train_step(
     )
 
 
+def globalize_batch(batch: dict, mesh: Mesh | None) -> dict:
+    """Multi-process: every host loads the SAME global batch (same
+    corpus + shuffle seed) and materializes its addressable shards —
+    jit under jax.distributed only accepts process-spanning inputs
+    built this way. Sharding-driven (make_array_from_callback), so it
+    stays correct even when the mesh's data axis does not span the
+    processes (pure-TP meshes replicate the batch). Shared by Trainer
+    and LoraTrainer — a trainer that skips this crashes on the first
+    multi-host step."""
+    if mesh is None or jax.process_count() == 1:
+        return batch
+    import numpy as np
+
+    from ..parallel.multihost import global_array
+
+    out = {}
+    for k, v in batch.items():
+        arr = np.asarray(v)
+        spec = P("data", "seq") if arr.ndim >= 2 else P("data")
+        out[k] = global_array(arr, mesh, spec)
+    return out
+
+
 class Trainer:
     """Stateful convenience wrapper: holds TrainState, steps on batches.
 
@@ -276,24 +299,7 @@ class Trainer:
         )
 
     def _globalize(self, batch: dict) -> dict:
-        """Multi-process: every host loads the SAME global batch (same
-        corpus + shuffle seed) and materializes its addressable shards —
-        jit under jax.distributed only accepts process-spanning inputs
-        built this way. Sharding-driven (make_array_from_callback), so it
-        stays correct even when the mesh's data axis does not span the
-        processes (pure-TP meshes replicate the batch)."""
-        if self.mesh is None or jax.process_count() == 1:
-            return batch
-        import numpy as np
-
-        from ..parallel.multihost import global_array
-
-        out = {}
-        for k, v in batch.items():
-            arr = np.asarray(v)
-            spec = P("data", "seq") if arr.ndim >= 2 else P("data")
-            out[k] = global_array(arr, self.mesh, spec)
-        return out
+        return globalize_batch(batch, self.mesh)
 
     def train_step(self, batch: dict) -> dict:
         self.state, metrics = self._step(self.state, self._globalize(batch))
