@@ -358,7 +358,8 @@ class InferenceEngine:
 
     def _make_request(
         self, prompt, max_new_tokens, temperature, top_k, top_p, stop_tokens,
-        stream: bool = False,
+        stream: bool = False, repetition_penalty: float = 1.0,
+        presence_penalty: float = 0.0, frequency_penalty: float = 0.0,
     ):
         from .scheduler import Request
 
@@ -379,10 +380,17 @@ class InferenceEngine:
         budget = self.max_seq_len - 1 - max(max_new_tokens, 1)
         if len(ids) > budget:
             ids = ids[-budget:]
+        if repetition_penalty is not None and repetition_penalty <= 0:
+            raise ValueError(
+                f"repetition_penalty must be > 0, got {repetition_penalty}"
+            )
         stop, eos = self._stop_set(stop_tokens)
         return Request(
             ids, max_new_tokens, temperature, top_k, top_p, stop, eos,
             self.tokenizer, stream=stream,
+            repetition_penalty=repetition_penalty,
+            presence_penalty=presence_penalty,
+            frequency_penalty=frequency_penalty,
         )
 
     def _build_result(self, req) -> GenerationResult:
@@ -417,6 +425,9 @@ class InferenceEngine:
         top_k: int = 0,
         top_p: float = 1.0,
         stop_tokens: list[int] | None = None,
+        repetition_penalty: float = 1.0,
+        presence_penalty: float = 0.0,
+        frequency_penalty: float = 0.0,
     ) -> Iterator[dict]:
         """Yield {"token": last_id, "tokens": ids, "text": piece} per decode
         chunk, then {"done": True, "result": GenerationResult}. Streaming
@@ -425,7 +436,9 @@ class InferenceEngine:
         is admission order; rows decode together."""
         req = self._make_request(
             prompt, max_new_tokens, temperature, top_k, top_p, stop_tokens,
-            stream=True,
+            stream=True, repetition_penalty=repetition_penalty,
+            presence_penalty=presence_penalty,
+            frequency_penalty=frequency_penalty,
         )
         if req.max_new_tokens <= 0:
             req.timing.t_first = req.timing.t_done = time.perf_counter()
@@ -458,6 +471,9 @@ class InferenceEngine:
             kw.get("top_k", 0),
             kw.get("top_p", 1.0),
             stop_tokens,
+            repetition_penalty=kw.get("repetition_penalty", 1.0),
+            presence_penalty=kw.get("presence_penalty", 0.0),
+            frequency_penalty=kw.get("frequency_penalty", 0.0),
         )
         if req.max_new_tokens <= 0:
             req.timing.t_first = req.timing.t_done = time.perf_counter()

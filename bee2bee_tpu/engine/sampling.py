@@ -47,22 +47,54 @@ def sample(
     return jax.random.categorical(key, logits, axis=-1)
 
 
+def apply_penalties(
+    logits,  # [B, V] float32
+    counts,  # [B, 2, V] int32: [:, 0] prompt occurrences, [:, 1] generated
+    repetition,  # [B] float32; 1.0 = off (HF-style multiplicative)
+    presence,  # [B] float32; 0.0 = off (flat tax on any generated token)
+    frequency,  # [B] float32; 0.0 = off (per-generated-occurrence tax)
+):
+    """Occurrence penalties, applied BEFORE temperature/argmax so greedy
+    decoding benefits too (greedy + repetition_penalty is the classic
+    'stop the loop' config). The two count channels carry the two
+    conventions faithfully: repetition follows HF's
+    RepetitionPenaltyLogitsProcessor (divide positive logits, multiply
+    negative ones, over PROMPT + generated tokens); presence/frequency
+    follow OpenAI (generated tokens ONLY — taxing prompt words would
+    make a summarizer avoid its own article's subject)."""
+    gen = counts[:, 1]
+    seen_any = (counts[:, 0] > 0) | (gen > 0)
+    rep = repetition[:, None]
+    logits = jnp.where(
+        seen_any, jnp.where(logits > 0, logits / rep, logits * rep), logits
+    )
+    logits = logits - presence[:, None] * (gen > 0).astype(logits.dtype)
+    logits = logits - frequency[:, None] * gen.astype(logits.dtype)
+    return logits
+
+
 def sample_batched(
     logits,  # [B, V] float32
     key,
     temperature,  # [B] float32; <= 0 → greedy for that row
     top_k,  # [B] int32; <= 0 → no top-k restriction
     top_p,  # [B] float32; >= 1 → no nucleus restriction
+    counts=None,  # optional [B, V] int32 → apply_penalties first
+    repetition=None,  # [B] float32 (with counts)
+    presence=None,  # [B] float32 (with counts)
+    frequency=None,  # [B] float32 (with counts)
 ):
     """Per-row sampling for continuous batching: every knob is a traced
     [B] array, so ONE compiled decode step serves any mix of concurrent
     requests' sampling settings (the scalar `sample` compiles one variant
     per signature — fine for a single stream, wrong for a shared batch).
 
-    Semantics per row match `sample`: temperature scale → top-k mask →
-    nucleus mask over the already-masked logits → categorical; greedy rows
-    short-circuit to argmax via a final where.
+    Semantics per row match `sample`: [penalties →] temperature scale →
+    top-k mask → nucleus mask over the already-masked logits →
+    categorical; greedy rows short-circuit to argmax via a final where.
     """
+    if counts is not None:
+        logits = apply_penalties(logits, counts, repetition, presence, frequency)
     V = logits.shape[-1]
     greedy = jnp.argmax(logits, axis=-1)
 

@@ -78,6 +78,9 @@ class Request:
         eos: int | None,
         tokenizer,
         stream: bool = False,
+        repetition_penalty: float = 1.0,
+        presence_penalty: float = 0.0,
+        frequency_penalty: float = 0.0,
     ):
         self.stream = stream
         # set by an abandoning consumer (generate_stream closed early);
@@ -91,6 +94,9 @@ class Request:
         self.top_p = float(top_p if top_p is not None else 1.0)
         self.stop = stop
         self.eos = eos
+        self.repetition_penalty = float(repetition_penalty or 1.0)
+        self.presence_penalty = float(presence_penalty or 0.0)
+        self.frequency_penalty = float(frequency_penalty or 0.0)
         self.tokenizer = tokenizer
         self.events: queue.Queue = queue.Queue()
         self.out_ids: list[int] = []
@@ -132,6 +138,16 @@ class Request:
     @property
     def done(self) -> bool:
         return self.finish is not None
+
+    @property
+    def penalized(self) -> bool:
+        """True when any occurrence penalty is active — such rows route
+        through the scheduler's counts-carrying decode variant."""
+        return (
+            self.repetition_penalty != 1.0
+            or self.presence_penalty != 0.0
+            or self.frequency_penalty != 0.0
+        )
 
 
 @dataclass
@@ -213,6 +229,15 @@ class BatchScheduler:
         self._rows: list[Request | None] = [None] * self._bsz
         self._row_params_dirty = True
         self._temps = self._topps = self._topks = None
+        self._reps = self._press = self._freqs = None
+        # occurrence counts [bsz, V] int32 for penalty sampling — allocated
+        # lazily on the first penalized admission so the common (bench)
+        # path never allocates or threads it. Rows of non-penalized
+        # requests may hold stale counts; they are never read (rep=1/
+        # pres=0/freq=0 rows pass through apply_penalties unchanged) and
+        # every admission overwrites its row with a fresh prompt bincount.
+        self._counts = None
+        self._vocab = e.model_cfg.vocab_size
 
         # splice a batch-1 prefill cache into batch row b (donate the big
         # cache so XLA updates it in place in HBM)
@@ -247,13 +272,37 @@ class BatchScheduler:
         def shrink(src, n):
             return jax.tree.map(lambda s: s[:, :n], src)
 
+        # counts live [B, 2, V] (batch leading, unlike the [L, B, ...]
+        # cache; channel 0 = prompt occurrences, 1 = generated), so they
+        # get their own row helpers
+        V = self._vocab
+
+        def c_insert(c, row, b):
+            return jax.lax.dynamic_update_slice(c, row, (b, 0, 0))
+
+        def c_move(c, src, dst):
+            row = jax.lax.dynamic_slice(c, (src, 0, 0), (1, 2, V))
+            return jax.lax.dynamic_update_slice(c, row, (dst, 0, 0))
+
         from .sampling import sample_batched
 
         self._insert = jax.jit(insert, donate_argnums=(0,))
         self._move_row = jax.jit(move_row, donate_argnums=(0,))
         self._grow = jax.jit(grow, donate_argnums=(0,))
         self._shrink = jax.jit(shrink, static_argnums=(1,))
+        self._counts_zeros = jax.jit(
+            lambda b: jnp.zeros((b, 2, V), jnp.int32), static_argnums=0
+        )
+        self._counts_insert = jax.jit(c_insert, donate_argnums=(0,))
+        self._counts_move = jax.jit(c_move, donate_argnums=(0,))
+        self._counts_bump = jax.jit(
+            lambda c, b, t: c.at[b, 1, t].add(1), donate_argnums=(0,)
+        )
+        self._counts_shrink = jax.jit(
+            lambda c, n: c[:n], static_argnums=(1,)
+        )
         self._decode = jax.jit(self._decode_fn, donate_argnums=(2,))
+        self._decode_pen = jax.jit(self._decode_pen_fn, donate_argnums=(2, 4))
         # jitted: sample_batched run eagerly is ~15 tiny ops = ~15 round
         # trips through a tunneled chip per admission
         self._sample_first = jax.jit(sample_batched)
@@ -313,6 +362,38 @@ class BatchScheduler:
         (cur, cache, offsets), toks = jax.lax.scan(step, (cur, cache, offsets), keys)
         return cur, cache, offsets, jnp.moveaxis(toks, 0, 1)
 
+    def _decode_pen_fn(
+        self, params, cur, cache, offsets, counts,
+        temps, topks, topps, reps, press, freqs, key,
+    ):
+        """Penalty-carrying decode chunk: counts ride the scan carry and
+        every sampled token scatters into its row. Compiled only when a
+        penalized row is active — the fast path keeps the counts-free
+        graph."""
+        from ..models import core
+        from .sampling import sample_batched
+
+        e = self.engine
+        B = cur.shape[0]
+
+        def step(carry, key_t):
+            cur, cache, off, counts = carry
+            logits, cache = core.forward(
+                params, e.model_cfg, cur[:, None], cache, off, attn_fn=e._attn_fn()
+            )
+            nxt = sample_batched(
+                logits[:, -1, :], key_t, temps, topks, topps,
+                counts, reps, press, freqs,
+            )
+            counts = counts.at[jnp.arange(B), 1, nxt].add(1)
+            return (nxt, cache, off + 1, counts), nxt
+
+        keys = jax.random.split(key, e.engine_cfg.decode_chunk)
+        (cur, cache, offsets, counts), toks = jax.lax.scan(
+            step, (cur, cache, offsets, counts), keys
+        )
+        return cur, cache, offsets, counts, jnp.moveaxis(toks, 0, 1)
+
     # ------------------------------------------------------------ loop
 
     def _loop(self):
@@ -364,6 +445,7 @@ class BatchScheduler:
         self._cur = np.zeros((1,), np.int32)
         self._offsets = np.zeros((1,), np.int32)
         self._rows = [None]
+        self._counts = None  # lazily reallocated by the next penalized admit
         self._row_params_dirty = True
 
     # ------------------------------------------------------- batch resizing
@@ -377,8 +459,14 @@ class BatchScheduler:
         if new_bsz > old:
             fresh = self.engine.new_cache(new_bsz)
             self._cache = self._grow(fresh, self._cache)
+            if self._counts is not None:
+                self._counts = self._grow(
+                    self._counts_zeros(new_bsz), self._counts
+                )
         else:
             self._cache = self._shrink(self._cache, new_bsz)
+            if self._counts is not None:
+                self._counts = self._counts_shrink(self._counts, new_bsz)
         cur = np.zeros((new_bsz,), np.int32)
         offs = np.zeros((new_bsz,), np.int32)
         keep = min(old, new_bsz)
@@ -406,6 +494,10 @@ class BatchScheduler:
             self._cache = self._move_row(
                 self._cache, np.int32(last), np.int32(hole)
             )
+            if self._counts is not None:
+                self._counts = self._counts_move(
+                    self._counts, np.int32(last), np.int32(hole)
+                )
             self._cur[hole] = self._cur[last]
             self._offsets[hole] = self._offsets[last]
             self._rows[hole] = self._rows[last]
@@ -506,13 +598,39 @@ class BatchScheduler:
                         self._prefix_cache.put(
                             req.ids, self._copy_cache(row_cache)
                         )
-                    first = self._sample_first(
+                    # one arg tuple for both branches: a marshalling
+                    # change must hit penalized and plain rows identically
+                    sample_args = [
                         last_logits,
                         e._next_key(),
                         np.asarray([req.temperature], np.float32),
                         np.asarray([req.top_k], np.int32),
                         np.asarray([req.top_p], np.float32),
-                    )
+                    ]
+                    if req.penalized:
+                        # prompt occurrences host-side (bincount is O(n+V)
+                        # in numpy — no device round trip), shipped as the
+                        # row's fresh counts; the first sample sees them.
+                        # Channel 0: prompt (repetition's "seen"); channel
+                        # 1: generated, fresh at zero (presence/frequency)
+                        if self._counts is None:
+                            self._counts = self._counts_zeros(self._bsz)
+                        prompt_counts = np.bincount(
+                            np.asarray(req.ids, np.int64), minlength=self._vocab
+                        )[:self._vocab].astype(np.int32)
+                        row_counts = np.stack(
+                            [prompt_counts, np.zeros_like(prompt_counts)]
+                        )[None]
+                        self._counts = self._counts_insert(
+                            self._counts, row_counts, np.int32(b)
+                        )
+                        sample_args += [
+                            row_counts,
+                            np.asarray([req.repetition_penalty], np.float32),
+                            np.asarray([req.presence_penalty], np.float32),
+                            np.asarray([req.frequency_penalty], np.float32),
+                        ]
+                    first = self._sample_first(*sample_args)
                     self._cache = self._insert(self._cache, row_cache, np.int32(b))
             except Exception as err:
                 # the popped request is in neither _queue nor _rows: fail it
@@ -549,6 +667,12 @@ class BatchScheduler:
                 self._rows[b] = None
                 self._retire(req)
                 continue
+            if req.penalized and self._counts is not None:
+                # the first token was sampled AFTER the prompt bincount
+                # shipped; it must count toward later penalties too
+                self._counts = self._counts_bump(
+                    self._counts, np.int32(b), np.int32(tok)
+                )
             self._cur[b] = tok
             self._row_params_dirty = True
             self.stats.peak_active = max(self.stats.peak_active, self.active)
@@ -563,6 +687,18 @@ class BatchScheduler:
             self._temps = np.asarray(temps, np.float32)
             self._topks = np.asarray(topks, np.int32)
             self._topps = np.asarray(topps, np.float32)
+            self._reps = np.asarray(
+                [r.repetition_penalty if r else 1.0 for r in self._rows],
+                np.float32,
+            )
+            self._press = np.asarray(
+                [r.presence_penalty if r else 0.0 for r in self._rows],
+                np.float32,
+            )
+            self._freqs = np.asarray(
+                [r.frequency_penalty if r else 0.0 for r in self._rows],
+                np.float32,
+            )
             self._row_params_dirty = False
         return self._temps, self._topks, self._topps
 
@@ -593,6 +729,9 @@ class BatchScheduler:
         temps, topks, topps = self._row_sampling_arrays()
         W = self._window_size()
         K = e.engine_cfg.decode_chunk
+        pen = self._counts is not None and any(
+            r is not None and r.penalized for r in self._rows
+        )
         with get_tracer().span("engine.decode_window", active=self.active, chunks=W):
             # host mirrors go in as the first call's args; chunks chain on
             # the returned DEVICE arrays; the host mirrors then advance
@@ -601,10 +740,20 @@ class BatchScheduler:
             cur_d, off_d = self._cur, self._offsets
             toks_parts = []
             for _ in range(W):
-                cur_d, self._cache, off_d, toks = self._decode(
-                    e.params, cur_d, self._cache, off_d,
-                    temps, topks, topps, e._next_key(),
-                )
+                if pen:
+                    cur_d, self._cache, off_d, self._counts, toks = (
+                        self._decode_pen(
+                            e.params, cur_d, self._cache, off_d, self._counts,
+                            temps, topks, topps,
+                            self._reps, self._press, self._freqs,
+                            e._next_key(),
+                        )
+                    )
+                else:
+                    cur_d, self._cache, off_d, toks = self._decode(
+                        e.params, cur_d, self._cache, off_d,
+                        temps, topks, topps, e._next_key(),
+                    )
                 toks_parts.append(toks)
             parts_host = [np.asarray(x) for x in jax.device_get(toks_parts)]
             toks_host = (
