@@ -79,7 +79,7 @@ def sample_batched(
     temperature,  # [B] float32; <= 0 → greedy for that row
     top_k,  # [B] int32; <= 0 → no top-k restriction
     top_p,  # [B] float32; >= 1 → no nucleus restriction
-    counts=None,  # optional [B, V] int32 → apply_penalties first
+    counts=None,  # optional [B, 2, V] int32 (see apply_penalties) → penalties first
     repetition=None,  # [B] float32 (with counts)
     presence=None,  # [B] float32 (with counts)
     frequency=None,  # [B] float32 (with counts)

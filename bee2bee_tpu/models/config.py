@@ -33,6 +33,10 @@ class ModelConfig:
     logits_softcap: float | None = None
     embedding_scale: bool = False  # gemma multiplies embeds by sqrt(d_model)
     norm_plus_one: bool = False  # gemma checkpoints store rmsnorm as (1 + w)
+    # phi/gpt-neox-style switches
+    rotary_pct: float = 1.0  # fraction of head_dim that rotates (phi-2: 0.4)
+    parallel_block: bool = False  # x + attn(ln(x)) + mlp(ln(x)), ONE shared
+    # pre-norm per block (phi); sequential pre-norm blocks otherwise
     # MoE
     n_experts: int = 0  # 0 = dense
     n_experts_per_tok: int = 2
@@ -162,6 +166,22 @@ CONFIGS: dict[str, ModelConfig] = {
 
 # zephyr IS mistral-7b architecture — one definition, two names (drift-proof)
 CONFIGS["mistral-7b"] = replace(CONFIGS["zephyr-7b"], name="mistral-7b")
+
+CONFIGS["tiny-phi"] = ModelConfig(  # parallel blocks + partial rotary
+    name="tiny-phi", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+    n_kv_heads=4, d_ff=128, max_seq_len=256, activation="gelu",
+    norm="layernorm", use_bias=True, tie_embeddings=False,
+    rotary_pct=0.4, parallel_block=True,
+)
+CONFIGS["phi-2"] = ModelConfig(
+    # microsoft/phi-2: 2.7B, parallel attn+mlp blocks sharing one
+    # layernorm, partial rotary over the first 32 of 80 head dims,
+    # untied lm_head with bias
+    name="phi-2", vocab_size=51200, d_model=2560, n_layers=32, n_heads=32,
+    n_kv_heads=32, d_ff=10240, max_seq_len=2048, activation="gelu",
+    norm="layernorm", use_bias=True, tie_embeddings=False,
+    rotary_pct=0.4, parallel_block=True,
+)
 
 
 def get_config(name: str, **overrides) -> ModelConfig:

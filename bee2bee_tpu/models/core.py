@@ -74,7 +74,6 @@ def init_params(cfg: ModelConfig, key, dtype=jnp.bfloat16) -> Params:
 
     layers: Params = {
         "ln1": {"scale": jnp.ones((L, D), dtype)},
-        "ln2": {"scale": jnp.ones((L, D), dtype)},
         "attn": {
             "wq": dense((L, D, H * hd)),
             "wk": dense((L, D, Hkv * hd)),
@@ -82,9 +81,12 @@ def init_params(cfg: ModelConfig, key, dtype=jnp.bfloat16) -> Params:
             "wo": dense((L, H * hd, D), scale=1.0 / math.sqrt(H * hd)),
         },
     }
+    if not cfg.parallel_block:  # phi's parallel blocks share ln1
+        layers["ln2"] = {"scale": jnp.ones((L, D), dtype)}
     if cfg.norm == "layernorm":
         layers["ln1"]["bias"] = jnp.zeros((L, D), dtype)
-        layers["ln2"]["bias"] = jnp.zeros((L, D), dtype)
+        if "ln2" in layers:
+            layers["ln2"]["bias"] = jnp.zeros((L, D), dtype)
     if cfg.use_bias or cfg.qkv_bias:
         layers["attn"]["bq"] = jnp.zeros((L, H * hd), dtype)
         layers["attn"]["bk"] = jnp.zeros((L, Hkv * hd), dtype)
@@ -121,6 +123,8 @@ def init_params(cfg: ModelConfig, key, dtype=jnp.bfloat16) -> Params:
         params["final_norm"]["bias"] = jnp.zeros((D,), dtype)
     if not cfg.tie_embeddings:
         params["lm_head"] = dense((D, V))
+        if cfg.use_bias:  # phi: untied head carries a bias
+            params["lm_head_bias"] = jnp.zeros((V,), dtype)
     return params
 
 
@@ -141,16 +145,23 @@ def _norm(x, p, cfg: ModelConfig):
     return out
 
 
-def _rope(x, positions, theta: float):
-    """Rotary embedding. x: [B, T, H, hd]; positions: [B, T]."""
+def _rope(x, positions, theta: float, pct: float = 1.0):
+    """Rotary embedding. x: [B, T, H, hd]; positions: [B, T].
+
+    pct < 1 rotates only the FIRST floor-to-even pct*hd dims (matching
+    HF's int() truncation) and passes the tail through unchanged
+    (phi/gpt-neox partial rotary)."""
     hd = x.shape[-1]
-    freqs = 1.0 / (theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
-    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, T, hd/2]
+    rot = hd if pct >= 1.0 else max(2, int(hd * pct) // 2 * 2)
+    xr, tail = x[..., :rot], x[..., rot:]
+    freqs = 1.0 / (theta ** (jnp.arange(0, rot, 2, dtype=jnp.float32) / rot))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, T, rot/2]
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    x1, x2 = jnp.split(xr.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
-    return out.astype(x.dtype)
+    out = out.astype(x.dtype)
+    return out if rot == hd else jnp.concatenate([out, tail], axis=-1)
 
 
 def _activate(up, gate, cfg: ModelConfig):
@@ -354,8 +365,8 @@ def transformer_block(
     k = k.reshape(B, T, Hkv, hd)
     v = v.reshape(B, T, Hkv, hd)
     if cfg.pos_embedding == "rope":
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
+        q = _rope(q, positions, cfg.rope_theta, cfg.rotary_pct)
+        k = _rope(k, positions, cfg.rope_theta, cfg.rotary_pct)
     if kv_hook is not None:
         k, v = kv_hook(k, v)
     if attn_fn is None:
@@ -365,6 +376,10 @@ def transformer_block(
     attn_out = matmul(attn_out, lp["attn"]["wo"])
     if "bo" in lp["attn"]:
         attn_out = attn_out + lp["attn"]["bo"]
+    if cfg.parallel_block:
+        # phi: attention and MLP both read the SAME normed input and sum
+        # into the residual — one norm, two parallel branches
+        return x + attn_out + _mlp(h, lp["mlp"], cfg)
     x = x + attn_out
 
     h2 = _norm(x, lp["ln2"], cfg)
@@ -380,6 +395,8 @@ def final_logits(params: Params, cfg: ModelConfig, x):
         logits = x @ params["tok_embed"].T
     else:
         logits = x @ params["lm_head"]
+        if "lm_head_bias" in params:
+            logits = logits + params["lm_head_bias"]
     logits = logits.astype(jnp.float32)
     if cfg.logits_softcap:
         c = cfg.logits_softcap

@@ -152,6 +152,34 @@ def _export_llama_state(params, cfg: ModelConfig, dtype) -> dict[str, np.ndarray
     return state
 
 
+def _export_phi_state(params, cfg: ModelConfig, dtype) -> dict[str, np.ndarray]:
+    """Inverse of loader._convert_phi."""
+    layers = params["layers"]
+    t = lambda a: _np(a, dtype).T
+    state = {
+        "model.embed_tokens.weight": _np(params["tok_embed"], dtype),
+        "model.final_layernorm.weight": _np(params["final_norm"]["scale"], dtype),
+        "model.final_layernorm.bias": _np(params["final_norm"]["bias"], dtype),
+        "lm_head.weight": t(params["lm_head"]),
+        "lm_head.bias": _np(params["lm_head_bias"], dtype),
+    }
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        state[p + "input_layernorm.weight"] = _np(layers["ln1"]["scale"][i], dtype)
+        state[p + "input_layernorm.bias"] = _np(layers["ln1"]["bias"][i], dtype)
+        a = layers["attn"]
+        for ours, hf in (("wq", "q_proj"), ("wk", "k_proj"), ("wv", "v_proj"), ("wo", "dense")):
+            state[p + f"self_attn.{hf}.weight"] = t(a[ours][i])
+        for ours, hf in (("bq", "q_proj"), ("bk", "k_proj"), ("bv", "v_proj"), ("bo", "dense")):
+            state[p + f"self_attn.{hf}.bias"] = _np(a[ours][i], dtype)
+        m = layers["mlp"]
+        state[p + "mlp.fc1.weight"] = t(m["w_up"][i])
+        state[p + "mlp.fc1.bias"] = _np(m["b_up"][i], dtype)
+        state[p + "mlp.fc2.weight"] = t(m["w_down"][i])
+        state[p + "mlp.fc2.bias"] = _np(m["b_down"][i], dtype)
+    return state
+
+
 def hf_config_dict(cfg: ModelConfig, qkv_bias: bool | None = None) -> dict:
     """A transformers-compatible config.json for the exported checkpoint.
 
@@ -171,6 +199,23 @@ def hf_config_dict(cfg: ModelConfig, qkv_bias: bool | None = None) -> dict:
             "n_inner": cfg.d_ff,
             "layer_norm_epsilon": cfg.norm_eps,
             "tie_word_embeddings": True,
+        }
+    if cfg.parallel_block:  # phi family
+        return {
+            "model_type": "phi",
+            "architectures": ["PhiForCausalLM"],
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.d_model,
+            "num_hidden_layers": cfg.n_layers,
+            "num_attention_heads": cfg.n_heads,
+            "num_key_value_heads": cfg.n_kv_heads,
+            "intermediate_size": cfg.d_ff,
+            "max_position_embeddings": cfg.max_seq_len,
+            "rope_theta": cfg.rope_theta,
+            "layer_norm_eps": cfg.norm_eps,
+            "partial_rotary_factor": cfg.rotary_pct,
+            "tie_word_embeddings": False,
+            "hidden_act": "gelu_new",
         }
     base = {
         "vocab_size": cfg.vocab_size,
@@ -220,6 +265,8 @@ def export_hf(params, cfg: ModelConfig, out_dir: str | Path,
     np_dtype = np.dtype(dtype) if dtype != "bfloat16" else _bf16_dtype()
     if cfg.pos_embedding == "learned":
         state = _export_gpt2_state(params, cfg, np_dtype)
+    elif cfg.parallel_block:
+        state = _export_phi_state(params, cfg, np_dtype)
     else:
         state = _export_llama_state(params, cfg, np_dtype)
     write_safetensors(

@@ -113,6 +113,49 @@ def _convert_gpt2(state, cfg: ModelConfig) -> dict:
     }
 
 
+def _convert_phi(state, cfg: ModelConfig) -> dict:
+    """HF phi-2 names → our layout (microsoft/phi-2: parallel blocks with
+    one input_layernorm, q/k/v/dense + fc1/fc2 all biased, untied
+    lm_head with bias, final_layernorm). HF linear is [out, in] → ours
+    [in, out]."""
+    pre = "model." if any(k.startswith("model.") for k in state) else ""
+    g = lambda k: state[pre + k]
+    t = lambda a: np.ascontiguousarray(a.T)
+    L = cfg.n_layers
+    layers = {
+        "ln1": {
+            "scale": _stack([g(f"layers.{i}.input_layernorm.weight") for i in range(L)]),
+            "bias": _stack([g(f"layers.{i}.input_layernorm.bias") for i in range(L)]),
+        },
+        "attn": {
+            "wq": _stack([t(g(f"layers.{i}.self_attn.q_proj.weight")) for i in range(L)]),
+            "wk": _stack([t(g(f"layers.{i}.self_attn.k_proj.weight")) for i in range(L)]),
+            "wv": _stack([t(g(f"layers.{i}.self_attn.v_proj.weight")) for i in range(L)]),
+            "wo": _stack([t(g(f"layers.{i}.self_attn.dense.weight")) for i in range(L)]),
+            "bq": _stack([g(f"layers.{i}.self_attn.q_proj.bias") for i in range(L)]),
+            "bk": _stack([g(f"layers.{i}.self_attn.k_proj.bias") for i in range(L)]),
+            "bv": _stack([g(f"layers.{i}.self_attn.v_proj.bias") for i in range(L)]),
+            "bo": _stack([g(f"layers.{i}.self_attn.dense.bias") for i in range(L)]),
+        },
+        "mlp": {
+            "w_up": _stack([t(g(f"layers.{i}.mlp.fc1.weight")) for i in range(L)]),
+            "b_up": _stack([g(f"layers.{i}.mlp.fc1.bias") for i in range(L)]),
+            "w_down": _stack([t(g(f"layers.{i}.mlp.fc2.weight")) for i in range(L)]),
+            "b_down": _stack([g(f"layers.{i}.mlp.fc2.bias") for i in range(L)]),
+        },
+    }
+    return {
+        "tok_embed": g("embed_tokens.weight"),
+        "layers": layers,
+        "final_norm": {
+            "scale": g("final_layernorm.weight"),
+            "bias": g("final_layernorm.bias"),
+        },
+        "lm_head": t(state["lm_head.weight"]),
+        "lm_head_bias": state["lm_head.bias"],
+    }
+
+
 def _convert_llama(state, cfg: ModelConfig) -> dict:
     """HF Llama/Mistral names → our layout (weights transpose: HF linear is
     [out, in]; ours is [in, out])."""
@@ -199,6 +242,8 @@ def load_checkpoint(
     state = _load_hf_state(path)
     if any(".c_attn." in k for k in state):
         params = _convert_gpt2(state, cfg)
+    elif any(".mlp.fc1." in k for k in state):
+        params = _convert_phi(state, cfg)
     else:
         params = _convert_llama(state, cfg)
     return _materialize(params, dtype, host)

@@ -96,6 +96,8 @@ def extract_stage_params(params: Params, cfg: ModelConfig, spec: StageSpec) -> P
             out["tok_embed"] = params["tok_embed"]
         elif "lm_head" in params:
             out["lm_head"] = params["lm_head"]
+            if "lm_head_bias" in params:  # phi: untied head carries a bias
+                out["lm_head_bias"] = params["lm_head_bias"]
     return out
 
 

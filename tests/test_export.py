@@ -50,7 +50,9 @@ def test_write_safetensors_roundtrip_dtypes(tmp_path):
 
 
 @pytest.mark.parametrize(
-    "name", ["tiny-gpt2", "tiny-llama", "tiny-mixtral", "tiny-gemma", "tiny-qwen"]
+    "name",
+    ["tiny-gpt2", "tiny-llama", "tiny-mixtral", "tiny-gemma", "tiny-qwen",
+     "tiny-phi"],
 )
 def test_export_hf_roundtrips_through_loader(tmp_path, name):
     """export_hf must be the exact inverse of the loader's HF conversion
@@ -129,6 +131,31 @@ def test_torch_loads_export_and_logits_match(tmp_path):
     model = transformers.GPT2LMHeadModel.from_pretrained(out)
     model.eval()
 
+    ids = np.array([[1, 7, 42, 99, 3, 250, 8, 11]], np.int32)
+    ours, _ = core.forward(params, cfg, jnp.asarray(ids), None, jnp.int32(0))
+    with torch.no_grad():
+        theirs = model(torch.from_numpy(ids.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(
+        np.asarray(ours, np.float32), theirs, atol=2e-4, rtol=1e-3
+    )
+
+
+def test_torch_loads_phi_export_and_logits_match(tmp_path):
+    """phi family conformance: PhiForCausalLM.from_pretrained(our export)
+    matches our forward — the parallel attn+mlp block and the PARTIAL
+    rotary (rotary_pct 0.4) must agree with the HF implementation
+    exactly, or the family claim is hollow."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    if not hasattr(transformers, "PhiForCausalLM"):
+        pytest.skip("transformers too old for phi")
+
+    cfg = get_config("tiny-phi")
+    params = core.init_params(cfg, jax.random.key(8), dtype=jnp.float32)
+    out = export_hf(params, cfg, tmp_path / "hf_phi", dtype="float32")
+
+    model = transformers.PhiForCausalLM.from_pretrained(out)
+    model.eval()
     ids = np.array([[1, 7, 42, 99, 3, 250, 8, 11]], np.int32)
     ours, _ = core.forward(params, cfg, jnp.asarray(ids), None, jnp.int32(0))
     with torch.no_grad():

@@ -290,3 +290,23 @@ async def test_coordinator_clamps_overlong_prompt():
         assert len(got) == 4
         # zero budget returns empty instead of one stray token
         assert await pc.generate([5, 6, 7], max_new_tokens=0) == []
+
+
+def test_stage_chain_phi_carries_lm_head_bias():
+    """phi's untied lm_head bias must survive stage extraction: a 2-stage
+    chain over tiny-phi equals the monolithic forward exactly (the bias
+    lives only on the LAST stage)."""
+    cfg = get_config("tiny-phi")
+    params = core.init_params(cfg, jax.random.key(3), dtype=jnp.float32)
+    ids = jnp.asarray(
+        np.random.default_rng(3).integers(3, cfg.vocab_size, (2, 10)), jnp.int32
+    )
+    want, _ = core.forward(params, cfg, ids, None, jnp.int32(0))
+    x = ids
+    for s in range(2):
+        spec = stages.StageSpec.build(cfg, 2, s)
+        sp = stages.extract_stage_params(params, cfg, spec)
+        if s == 1:
+            assert "lm_head_bias" in sp
+        x, _ = stages.stage_forward(sp, cfg, spec, x, None, jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(x), np.asarray(want), rtol=2e-5, atol=2e-5)
