@@ -24,7 +24,8 @@ class ModelConfig:
     # architecture switches
     pos_embedding: str = "rope"  # "rope" | "learned"
     norm: str = "rmsnorm"  # "rmsnorm" | "layernorm"
-    activation: str = "silu"  # "silu" (gated) | "gelu" (gpt2 mlp) | "geglu"
+    activation: str = "silu"  # "silu" (gated) | "gelu" (tanh approx, gpt2/
+    # phi) | "gelu_exact" (erf — gpt-neox) | "geglu"
     use_bias: bool = False  # attn/mlp biases (gpt2 style)
     qkv_bias: bool = False  # bias on q/k/v ONLY (qwen2 style; no bo/mlp bias)
     tie_embeddings: bool = True
@@ -35,8 +36,11 @@ class ModelConfig:
     norm_plus_one: bool = False  # gemma checkpoints store rmsnorm as (1 + w)
     # phi/gpt-neox-style switches
     rotary_pct: float = 1.0  # fraction of head_dim that rotates (phi-2: 0.4)
-    parallel_block: bool = False  # x + attn(ln(x)) + mlp(ln(x)), ONE shared
-    # pre-norm per block (phi); sequential pre-norm blocks otherwise
+    lm_head_bias: bool = False  # untied lm_head carries a bias (phi)
+    parallel_block: bool = False  # x + attn(ln(x)) + mlp(ln'(x)) parallel
+    # residual (phi/gpt-neox); sequential pre-norm blocks otherwise
+    parallel_norms: int = 1  # parallel blocks only: 1 = attn and mlp share
+    # ln1 (phi); 2 = mlp gets its own ln2 (gpt-neox use_parallel_residual)
     # MoE
     n_experts: int = 0  # 0 = dense
     n_experts_per_tok: int = 2
@@ -171,7 +175,29 @@ CONFIGS["tiny-phi"] = ModelConfig(  # parallel blocks + partial rotary
     name="tiny-phi", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
     n_kv_heads=4, d_ff=128, max_seq_len=256, activation="gelu",
     norm="layernorm", use_bias=True, tie_embeddings=False,
-    rotary_pct=0.4, parallel_block=True,
+    rotary_pct=0.4, parallel_block=True, lm_head_bias=True,
+)
+CONFIGS["tiny-neox"] = ModelConfig(  # dual-norm parallel residual
+    name="tiny-neox", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+    n_kv_heads=4, d_ff=128, max_seq_len=256, activation="gelu_exact",
+    norm="layernorm", use_bias=True, tie_embeddings=False,
+    rotary_pct=0.25, parallel_block=True, parallel_norms=2,
+)
+CONFIGS["pythia-1.4b"] = ModelConfig(
+    # EleutherAI/pythia-1.4b (GPT-NeoX arch): parallel residual with
+    # separate attn/mlp norms, rotary over the first quarter of head dims
+    name="pythia-1.4b", vocab_size=50304, d_model=2048, n_layers=24,
+    n_heads=16, n_kv_heads=16, d_ff=8192, max_seq_len=2048,
+    activation="gelu_exact", norm="layernorm", use_bias=True,
+    tie_embeddings=False, rotary_pct=0.25, parallel_block=True,
+    parallel_norms=2,
+)
+CONFIGS["gpt-neox-20b"] = ModelConfig(
+    name="gpt-neox-20b", vocab_size=50432, d_model=6144, n_layers=44,
+    n_heads=64, n_kv_heads=64, d_ff=24576, max_seq_len=2048,
+    activation="gelu_exact", norm="layernorm", use_bias=True,
+    tie_embeddings=False, rotary_pct=0.25, parallel_block=True,
+    parallel_norms=2,
 )
 CONFIGS["phi-2"] = ModelConfig(
     # microsoft/phi-2: 2.7B, parallel attn+mlp blocks sharing one
@@ -180,7 +206,7 @@ CONFIGS["phi-2"] = ModelConfig(
     name="phi-2", vocab_size=51200, d_model=2560, n_layers=32, n_heads=32,
     n_kv_heads=32, d_ff=10240, max_seq_len=2048, activation="gelu",
     norm="layernorm", use_bias=True, tie_embeddings=False,
-    rotary_pct=0.4, parallel_block=True,
+    rotary_pct=0.4, parallel_block=True, lm_head_bias=True,
 )
 
 

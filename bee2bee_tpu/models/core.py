@@ -48,12 +48,13 @@ def init_params(cfg: ModelConfig, key, dtype=jnp.bfloat16) -> Params:
 
     Schema (leading L = n_layers stacked dim):
       tok_embed [V, D]; pos_embed [P, D] (learned-pos only);
-      final_norm {scale[D], (bias[D])}; lm_head [D, V] (untied only)
+      final_norm {scale[D], (bias[D])}; lm_head [D, V] (untied only,
+      + lm_head_bias [V] when cfg.lm_head_bias — phi)
       layers/
         ln1.scale|bias [L, D]
         attn: wq [L, D, H*hd], wk|wv [L, D, Hkv*hd], wo [L, H*hd, D]
               (+ bq, bk, bv [L, ...], bo [L, D] when use_bias)
-        ln2.scale|bias [L, D]
+        ln2.scale|bias [L, D] (absent for shared-norm parallel blocks — phi)
         dense mlp: w_up [L, D, F], w_down [L, F, D], (w_gate [L, D, F])
                    (+ b_up [L, F], b_down [L, D])
         moe: router [L, D, E], experts w_up|w_gate [L, E, D, F],
@@ -81,7 +82,9 @@ def init_params(cfg: ModelConfig, key, dtype=jnp.bfloat16) -> Params:
             "wo": dense((L, H * hd, D), scale=1.0 / math.sqrt(H * hd)),
         },
     }
-    if not cfg.parallel_block:  # phi's parallel blocks share ln1
+    if not cfg.parallel_block or cfg.parallel_norms == 2:
+        # sequential blocks AND neox-style dual-norm parallel blocks have
+        # ln2; only phi's shared-norm parallel blocks drop it
         layers["ln2"] = {"scale": jnp.ones((L, D), dtype)}
     if cfg.norm == "layernorm":
         layers["ln1"]["bias"] = jnp.zeros((L, D), dtype)
@@ -123,7 +126,7 @@ def init_params(cfg: ModelConfig, key, dtype=jnp.bfloat16) -> Params:
         params["final_norm"]["bias"] = jnp.zeros((D,), dtype)
     if not cfg.tie_embeddings:
         params["lm_head"] = dense((D, V))
-        if cfg.use_bias:  # phi: untied head carries a bias
+        if cfg.lm_head_bias:  # phi: untied head carries a bias
             params["lm_head_bias"] = jnp.zeros((V,), dtype)
     return params
 
@@ -169,6 +172,8 @@ def _activate(up, gate, cfg: ModelConfig):
         return jax.nn.silu(gate) * up
     if cfg.activation == "geglu":
         return jax.nn.gelu(gate, approximate=True) * up
+    if cfg.activation == "gelu_exact":  # gpt-neox: erf, not tanh approx
+        return jax.nn.gelu(up, approximate=False)
     return jax.nn.gelu(up, approximate=True)
 
 
@@ -377,9 +382,11 @@ def transformer_block(
     if "bo" in lp["attn"]:
         attn_out = attn_out + lp["attn"]["bo"]
     if cfg.parallel_block:
-        # phi: attention and MLP both read the SAME normed input and sum
-        # into the residual — one norm, two parallel branches
-        return x + attn_out + _mlp(h, lp["mlp"], cfg)
+        # parallel residual: attention and MLP branches sum into x. phi
+        # (parallel_norms=1) feeds both from ln1's output; gpt-neox
+        # (parallel_norms=2) norms the mlp branch separately with ln2
+        h_mlp = h if cfg.parallel_norms == 1 else _norm(x, lp["ln2"], cfg)
+        return x + attn_out + _mlp(h_mlp, lp["mlp"], cfg)
     x = x + attn_out
 
     h2 = _norm(x, lp["ln2"], cfg)

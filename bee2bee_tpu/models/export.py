@@ -7,7 +7,7 @@ formats make no sense for a jax/XLA stack, so the interchange story is:
 - **HF-layout safetensors** (`export_hf`): the exact inverse of
   models/loader's name mapping, plus a matching HF ``config.json`` — any
   torch/transformers stack loads the result with ``from_pretrained``.
-  Covers the GPT-2 and Llama/Mistral/Mixtral/Gemma families, like the
+  Covers the GPT-2, Llama/Mistral/Mixtral/Gemma, Phi, and GPT-NeoX families, like the
   loader.
 - **Native piece format** (loader.save_native): content-addressed shard
   pieces + manifest — the mesh-distribution and checkpoint/resume format.
@@ -180,6 +180,44 @@ def _export_phi_state(params, cfg: ModelConfig, dtype) -> dict[str, np.ndarray]:
     return state
 
 
+def _export_neox_state(params, cfg: ModelConfig, dtype) -> dict[str, np.ndarray]:
+    """Inverse of loader._convert_neox (re-interleaves the fused QKV)."""
+    layers = params["layers"]
+    t = lambda a: _np(a, dtype).T
+    H, hd, D = cfg.n_heads, cfg.head_dim, cfg.d_model
+    state = {
+        "gpt_neox.embed_in.weight": _np(params["tok_embed"], dtype),
+        "gpt_neox.final_layer_norm.weight": _np(params["final_norm"]["scale"], dtype),
+        "gpt_neox.final_layer_norm.bias": _np(params["final_norm"]["bias"], dtype),
+        "embed_out.weight": t(params["lm_head"]),
+    }
+    a = layers["attn"]
+    for i in range(cfg.n_layers):
+        p = f"gpt_neox.layers.{i}."
+        for ln, hf in (("ln1", "input_layernorm"), ("ln2", "post_attention_layernorm")):
+            state[p + f"{hf}.weight"] = _np(layers[ln]["scale"][i], dtype)
+            state[p + f"{hf}.bias"] = _np(layers[ln]["bias"][i], dtype)
+        # ours [D, H*hd] -> HF fused [H, 3, hd, D] -> [3D, D]
+        w3 = np.stack(
+            [_np(a[k][i], dtype).T.reshape(H, hd, D) for k in ("wq", "wk", "wv")],
+            axis=1,
+        )
+        b3 = np.stack(
+            [_np(a[k][i], dtype).reshape(H, hd) for k in ("bq", "bk", "bv")],
+            axis=1,
+        )
+        state[p + "attention.query_key_value.weight"] = w3.reshape(3 * D, D)
+        state[p + "attention.query_key_value.bias"] = b3.reshape(3 * D)
+        state[p + "attention.dense.weight"] = t(a["wo"][i])
+        state[p + "attention.dense.bias"] = _np(a["bo"][i], dtype)
+        m = layers["mlp"]
+        state[p + "mlp.dense_h_to_4h.weight"] = t(m["w_up"][i])
+        state[p + "mlp.dense_h_to_4h.bias"] = _np(m["b_up"][i], dtype)
+        state[p + "mlp.dense_4h_to_h.weight"] = t(m["w_down"][i])
+        state[p + "mlp.dense_4h_to_h.bias"] = _np(m["b_down"][i], dtype)
+    return state
+
+
 def hf_config_dict(cfg: ModelConfig, qkv_bias: bool | None = None) -> dict:
     """A transformers-compatible config.json for the exported checkpoint.
 
@@ -199,6 +237,23 @@ def hf_config_dict(cfg: ModelConfig, qkv_bias: bool | None = None) -> dict:
             "n_inner": cfg.d_ff,
             "layer_norm_epsilon": cfg.norm_eps,
             "tie_word_embeddings": True,
+        }
+    if cfg.parallel_block and cfg.parallel_norms == 2:  # gpt-neox family
+        return {
+            "model_type": "gpt_neox",
+            "architectures": ["GPTNeoXForCausalLM"],
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.d_model,
+            "num_hidden_layers": cfg.n_layers,
+            "num_attention_heads": cfg.n_heads,
+            "intermediate_size": cfg.d_ff,
+            "max_position_embeddings": cfg.max_seq_len,
+            "rotary_emb_base": cfg.rope_theta,
+            "rotary_pct": cfg.rotary_pct,
+            "layer_norm_eps": cfg.norm_eps,
+            "use_parallel_residual": True,
+            "tie_word_embeddings": False,
+            "hidden_act": "gelu",
         }
     if cfg.parallel_block:  # phi family
         return {
@@ -265,6 +320,8 @@ def export_hf(params, cfg: ModelConfig, out_dir: str | Path,
     np_dtype = np.dtype(dtype) if dtype != "bfloat16" else _bf16_dtype()
     if cfg.pos_embedding == "learned":
         state = _export_gpt2_state(params, cfg, np_dtype)
+    elif cfg.parallel_block and cfg.parallel_norms == 2:
+        state = _export_neox_state(params, cfg, np_dtype)
     elif cfg.parallel_block:
         state = _export_phi_state(params, cfg, np_dtype)
     else:

@@ -156,6 +156,66 @@ def _convert_phi(state, cfg: ModelConfig) -> dict:
     }
 
 
+def _convert_neox(state, cfg: ModelConfig) -> dict:
+    """HF GPT-NeoX/Pythia names → our layout. The fused query_key_value
+    weight is [3*D, D] with rows ordered HEAD-MAJOR and q/k/v INTERLEAVED
+    per head ([H, 3, hd] on the out dim — HF splits it after a
+    view(B, T, H, 3*hd)); a naive thirds split would scramble heads."""
+    pre = "gpt_neox." if any(k.startswith("gpt_neox.") for k in state) else ""
+    g = lambda k: state[pre + k]
+    t = lambda a: np.ascontiguousarray(a.T)
+    L, D = cfg.n_layers, cfg.d_model
+    H, hd = cfg.n_heads, cfg.head_dim
+
+    def split_qkv(w, b):
+        # w [3D, D] -> [H, 3, hd, D]; b [3D] -> [H, 3, hd]
+        wr = w.reshape(H, 3, hd, D)
+        br = b.reshape(H, 3, hd)
+        ws = [np.ascontiguousarray(wr[:, i].reshape(H * hd, D).T) for i in range(3)]
+        bs = [np.ascontiguousarray(br[:, i].reshape(H * hd)) for i in range(3)]
+        return ws, bs
+
+    qw, kw, vw, qb, kb, vb = [], [], [], [], [], []
+    for i in range(L):
+        ws, bs = split_qkv(
+            g(f"layers.{i}.attention.query_key_value.weight"),
+            g(f"layers.{i}.attention.query_key_value.bias"),
+        )
+        qw.append(ws[0]); kw.append(ws[1]); vw.append(ws[2])
+        qb.append(bs[0]); kb.append(bs[1]); vb.append(bs[2])
+    layers = {
+        "ln1": {
+            "scale": _stack([g(f"layers.{i}.input_layernorm.weight") for i in range(L)]),
+            "bias": _stack([g(f"layers.{i}.input_layernorm.bias") for i in range(L)]),
+        },
+        "ln2": {
+            "scale": _stack([g(f"layers.{i}.post_attention_layernorm.weight") for i in range(L)]),
+            "bias": _stack([g(f"layers.{i}.post_attention_layernorm.bias") for i in range(L)]),
+        },
+        "attn": {
+            "wq": _stack(qw), "wk": _stack(kw), "wv": _stack(vw),
+            "bq": _stack(qb), "bk": _stack(kb), "bv": _stack(vb),
+            "wo": _stack([t(g(f"layers.{i}.attention.dense.weight")) for i in range(L)]),
+            "bo": _stack([g(f"layers.{i}.attention.dense.bias") for i in range(L)]),
+        },
+        "mlp": {
+            "w_up": _stack([t(g(f"layers.{i}.mlp.dense_h_to_4h.weight")) for i in range(L)]),
+            "b_up": _stack([g(f"layers.{i}.mlp.dense_h_to_4h.bias") for i in range(L)]),
+            "w_down": _stack([t(g(f"layers.{i}.mlp.dense_4h_to_h.weight")) for i in range(L)]),
+            "b_down": _stack([g(f"layers.{i}.mlp.dense_4h_to_h.bias") for i in range(L)]),
+        },
+    }
+    return {
+        "tok_embed": g("embed_in.weight"),
+        "layers": layers,
+        "final_norm": {
+            "scale": g("final_layer_norm.weight"),
+            "bias": g("final_layer_norm.bias"),
+        },
+        "lm_head": t(state["embed_out.weight"]),
+    }
+
+
 def _convert_llama(state, cfg: ModelConfig) -> dict:
     """HF Llama/Mistral names → our layout (weights transpose: HF linear is
     [out, in]; ours is [in, out])."""
@@ -244,6 +304,8 @@ def load_checkpoint(
         params = _convert_gpt2(state, cfg)
     elif any(".mlp.fc1." in k for k in state):
         params = _convert_phi(state, cfg)
+    elif any(".attention.query_key_value." in k for k in state):
+        params = _convert_neox(state, cfg)
     else:
         params = _convert_llama(state, cfg)
     return _materialize(params, dtype, host)

@@ -275,12 +275,14 @@ class TestAutoAttention:
         assert eng._resolve_auto_attention() == "sp"
 
 
-def test_tiny_phi_serves():
-    """phi family (parallel blocks + partial rotary) through the cached
-    decode path: prefill positions and per-row decode offsets must agree
-    with the no-cache forward (greedy continuation check)."""
+@pytest.mark.parametrize("family", ["tiny-phi", "tiny-neox"])
+def test_parallel_block_families_serve(family):
+    """parallel-block families (phi: shared norm; neox: dual norm +
+    interleaved-QKV heritage) through the cached decode path: prefill
+    positions and per-row decode offsets must agree with the no-cache
+    forward (greedy continuation check)."""
     eng = InferenceEngine(
-        "tiny-phi",
+        family,
         engine_config=EngineConfig(
             max_seq_len=64, prefill_buckets=(16,), dtype="float32",
             cache_dtype="float32",
@@ -288,12 +290,10 @@ def test_tiny_phi_serves():
     )
     r = eng.generate([1, 7, 42, 9], max_new_tokens=6, temperature=0.0)
     assert r.new_tokens == 6
-    # cached decode == full forward: replay prompt+output through score()
-    # and check each generated token was the argmax at its position
-    import numpy as np
-    full = [1, 7, 42, 9] + r.token_ids
+    # cached decode == full forward: replay prompt+output through the
+    # no-cache forward and check each generated token was the argmax
     from bee2bee_tpu.models import core
-    import jax.numpy as jnp
+    full = [1, 7, 42, 9] + r.token_ids
     logits, _ = core.forward(
         eng.params, eng.model_cfg, jnp.asarray([full], jnp.int32), None,
         jnp.int32(0),

@@ -52,7 +52,7 @@ def test_write_safetensors_roundtrip_dtypes(tmp_path):
 @pytest.mark.parametrize(
     "name",
     ["tiny-gpt2", "tiny-llama", "tiny-mixtral", "tiny-gemma", "tiny-qwen",
-     "tiny-phi"],
+     "tiny-phi", "tiny-neox"],
 )
 def test_export_hf_roundtrips_through_loader(tmp_path, name):
     """export_hf must be the exact inverse of the loader's HF conversion
@@ -155,6 +155,39 @@ def test_torch_loads_phi_export_and_logits_match(tmp_path):
     out = export_hf(params, cfg, tmp_path / "hf_phi", dtype="float32")
 
     model = transformers.PhiForCausalLM.from_pretrained(out)
+    model.eval()
+    ids = np.array([[1, 7, 42, 99, 3, 250, 8, 11]], np.int32)
+    ours, _ = core.forward(params, cfg, jnp.asarray(ids), None, jnp.int32(0))
+    with torch.no_grad():
+        theirs = model(torch.from_numpy(ids.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(
+        np.asarray(ours, np.float32), theirs, atol=2e-4, rtol=1e-3
+    )
+
+
+def test_torch_loads_neox_export_and_logits_match(tmp_path):
+    """gpt-neox family conformance: GPTNeoXForCausalLM.from_pretrained(our
+    export) matches our forward — exercises the INTERLEAVED fused-QKV
+    layout ([H, 3, hd] out-dim order, where a naive thirds split would
+    scramble heads), the dual-norm parallel residual, and rotary_pct
+    0.25."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    if not hasattr(transformers, "GPTNeoXForCausalLM"):
+        pytest.skip("transformers too old for gpt-neox")
+
+    cfg = get_config("tiny-neox")
+    params = core.init_params(cfg, jax.random.key(11), dtype=jnp.float32)
+    # non-zero biases so the interleaved bias layout is exercised too
+    attn = dict(params["layers"]["attn"])
+    k = jax.random.key(12)
+    for b in ("bq", "bk", "bv", "bo"):
+        k, sub = jax.random.split(k)
+        attn[b] = 0.1 * jax.random.normal(sub, attn[b].shape, jnp.float32)
+    params = {**params, "layers": {**params["layers"], "attn": attn}}
+    out = export_hf(params, cfg, tmp_path / "hf_neox", dtype="float32")
+
+    model = transformers.GPTNeoXForCausalLM.from_pretrained(out)
     model.eval()
     ids = np.array([[1, 7, 42, 99, 3, 250, 8, 11]], np.int32)
     ours, _ = core.forward(params, cfg, jnp.asarray(ids), None, jnp.int32(0))
