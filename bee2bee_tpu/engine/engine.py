@@ -143,6 +143,9 @@ class InferenceEngine:
         # default to the degenerate 1-device mesh; multi-chip serving passes
         # an explicit mesh (the model must divide its axes — validated below)
         self.mesh = mesh if mesh is not None else local_mesh()
+        # effective context BEFORE attention validation: _window_binds
+        # and the validation error message both read it
+        self.max_seq_len = min(self.engine_cfg.max_seq_len, self.model_cfg.max_seq_len)
         partition.validate_divisibility(self.model_cfg, self.mesh)
         if self.engine_cfg.attention == "auto":
             # replace, don't mutate: the caller may share one EngineConfig
@@ -158,7 +161,6 @@ class InferenceEngine:
                 f"quantize={self.engine_cfg.quantize!r}: only 'int8' or 'none'"
             )
         self.dtype = jnp.dtype(self.engine_cfg.dtype)
-        self.max_seq_len = min(self.engine_cfg.max_seq_len, self.model_cfg.max_seq_len)
         self.metrics = MetricsAggregator()
 
         quantized = self.engine_cfg.quantize == "int8"
@@ -253,6 +255,19 @@ class InferenceEngine:
         a TPU-default host must not pick flash."""
         from ..ops.flash import validate_flash_mesh
 
+        if self._window_binds():
+            if self.mesh.shape.get("seq", 1) > 1:
+                # no impl supports seq-sharded cache + sliding window:
+                # silently-dense would replicate the cache across the seq
+                # group — the exact loss the seq axis exists to avoid
+                raise ValueError(
+                    f"no attention impl supports sliding_window="
+                    f"{self.model_cfg.sliding_window} on a seq-sharded mesh; "
+                    "drop the seq axis or serve full-causal"
+                )
+            logger.info("attention=auto -> dense (sliding window binds at "
+                        "this context; flash/sp do not implement it)")
+            return "dense"
         if self.mesh.shape.get("seq", 1) > 1:
             # a seq axis exists for exactly one reason: sequence-parallel
             # cache sharding. flash/dense would leave the cache replicated
@@ -271,7 +286,25 @@ class InferenceEngine:
         logger.info("attention=auto -> flash")
         return "flash"
 
+    def _window_binds(self) -> bool:
+        """True iff the model's sliding window can actually mask a cache
+        position at THIS engine's context length. zephyr/mistral ship
+        window == max context (4096): with cache capacity <= 4096 the
+        window clause is always true and full-causal kernels are exact —
+        rejecting flash/sp there would be a pure perf regression."""
+        w = self.model_cfg.sliding_window
+        return bool(w) and w < self.max_seq_len
+
     def _validate_attention_impl(self):
+        if self.engine_cfg.attention in ("flash", "sp") and self._window_binds():
+            raise ValueError(
+                f"attention={self.engine_cfg.attention!r} does not implement "
+                f"sliding_window={self.model_cfg.sliding_window} at context "
+                f"{self.max_seq_len} ({self.model_cfg.name!r}); "
+                "use attention='dense' (the "
+                "kernels derive causal masks internally and would silently "
+                "attend beyond the window)"
+            )
         if self.engine_cfg.attention == "flash":
             from ..ops.flash import validate_flash_mesh
 

@@ -37,6 +37,10 @@ class ModelConfig:
     # phi/gpt-neox-style switches
     rotary_pct: float = 1.0  # fraction of head_dim that rotates (phi-2: 0.4)
     lm_head_bias: bool = False  # untied lm_head carries a bias (phi)
+    # sliding-window attention (mistral): each query attends to at most
+    # the last `sliding_window` positions. None = full causal. Supported
+    # by the dense attention path (engine validates flash/sp against it).
+    sliding_window: int | None = None
     parallel_block: bool = False  # x + attn(ln(x)) + mlp(ln'(x)) parallel
     # residual (phi/gpt-neox); sequential pre-norm blocks otherwise
     parallel_norms: int = 1  # parallel blocks only: 1 = attn and mlp share
@@ -133,6 +137,7 @@ CONFIGS: dict[str, ModelConfig] = {
     ),
     "zephyr-7b": ModelConfig(  # mistral-7b architecture (HuggingFaceH4/zephyr-7b-beta)
         name="zephyr-7b", vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
+        sliding_window=4096,
         n_kv_heads=8, d_ff=14336, max_seq_len=4096, tie_embeddings=False,
     ),
     "mixtral-8x7b": ModelConfig(

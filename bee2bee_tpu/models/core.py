@@ -414,6 +414,30 @@ def final_logits(params: Params, cfg: ModelConfig, x):
 # ---------------------------------------------------------------- forward
 
 
+def attn_mask(cfg: ModelConfig, positions, T: int, S: int | None = None):
+    """THE attention mask builder (sliding window included) — core.forward
+    and stages.stage_forward must agree or a pipeline-split model diverges
+    from the monolithic one.
+
+    Cached (S given): [B, 1, T, S] over cache positions — s visible to
+    query t iff s <= pos(t), and with cfg.sliding_window only the last W
+    positions (s > pos(t) - W). Uncached: causal [1, 1, T, T] with the
+    same window restriction."""
+    if S is not None:
+        s_idx = jnp.arange(S, dtype=jnp.int32)[None, None, :]  # [1,1,S]
+        q_pos = positions[:, :, None]  # [B,T,1]
+        mask = s_idx <= q_pos  # [B,T,S]
+        if cfg.sliding_window:
+            mask = mask & (s_idx > q_pos - cfg.sliding_window)
+        return mask[:, None, :, :]
+    causal = jnp.tril(jnp.ones((T, T), bool))
+    if cfg.sliding_window:
+        qi = jnp.arange(T, dtype=jnp.int32)[:, None]
+        ki = jnp.arange(T, dtype=jnp.int32)[None, :]
+        causal = causal & (qi - ki < cfg.sliding_window)
+    return causal[None, None, :, :]
+
+
 def forward(
     params: Params,
     cfg: ModelConfig,
@@ -438,15 +462,9 @@ def forward(
 
     x = embed_tokens(params, cfg, input_ids, positions)
 
-    if cache is not None:
-        S = cache["k"].shape[2]
-        # mask over cache: position s visible to query t iff s <= off + t
-        s_idx = jnp.arange(S, dtype=jnp.int32)[None, None, :]  # [1,1,S]
-        q_pos = positions[:, :, None]  # [B,T,1]
-        mask = (s_idx <= q_pos)[:, None, :, :]  # [B,1,T,S]
-    else:
-        causal = jnp.tril(jnp.ones((T, T), bool))
-        mask = causal[None, None, :, :]
+    mask = attn_mask(
+        cfg, positions, T, cache["k"].shape[2] if cache is not None else None
+    )
 
     def layer(carry, xs):
         x, cache_k, cache_v = carry

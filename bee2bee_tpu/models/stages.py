@@ -145,10 +145,9 @@ def stage_forward(
 
     if cache is not None:
         S = cache["k"].shape[2]
-        s_idx = jnp.arange(S, dtype=jnp.int32)[None, None, :]
-        mask = (s_idx <= positions[:, :, None])[:, None, :, :]
+        mask = core.attn_mask(cfg, positions, T, S)
     else:
-        mask = jnp.tril(jnp.ones((T, T), bool))[None, None, :, :]
+        mask = core.attn_mask(cfg, positions, T)
 
     def layer(carry, xs):
         h, ck, cv = carry

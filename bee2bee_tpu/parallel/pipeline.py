@@ -89,6 +89,12 @@ def pipeline_apply(staged_params, cfg: ModelConfig, mesh: Mesh, x_mbs):
     S = mesh.shape[PIPE_AXIS]
     M = x_mbs.shape[0]
     T = x_mbs.shape[2]
+    if cfg.sliding_window and T > cfg.sliding_window:
+        raise ValueError(
+            f"pipeline trunk builds plain-causal masks; sliding_window="
+            f"{cfg.sliding_window} binds at T={T} — train at <= window "
+            "length or use the dense trainer"
+        )
 
     in_specs = (
         jax.tree.map(lambda _: P(PIPE_AXIS), staged_params),

@@ -166,13 +166,28 @@ def make_sp_forward(cfg: ModelConfig, mesh: Mesh, remat: bool = False):
         lambda: core.init_params(cfg, jax.random.key(0))
     ))
 
-    return jax.shard_map(
+    mapped = jax.shard_map(
         local_fn,
         mesh=mesh,
         in_specs=(param_specs, P("data", "seq")),
         out_specs=P("data", "seq", None),
         check_vma=False,
     )
+
+    def sp_forward(params, ids):
+        # guard at the PUBLIC surface (shape is static here): ring
+        # attention builds plain-causal block masks, so a windowed model
+        # past its window would silently attend beyond it and diverge
+        # from core.forward inference
+        if cfg.sliding_window and ids.shape[1] > cfg.sliding_window:
+            raise ValueError(
+                f"ring-SP does not implement sliding_window="
+                f"{cfg.sliding_window} (seq len {ids.shape[1]} exceeds it); "
+                "train/score at <= window length or use the dense path"
+            )
+        return mapped(params, ids)
+
+    return sp_forward
 
 
 def make_sp_train_step(cfg: ModelConfig, tcfg, mesh: Mesh, donate: bool = True):
