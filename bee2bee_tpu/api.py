@@ -52,7 +52,12 @@ def _int_param(body: dict, keys: tuple[str, ...], default: int) -> int:
 
 def _auth_ok(request: web.Request, api_key: str | None) -> bool:
     if api_key:
-        return request.headers.get("X-API-KEY") == api_key
+        if request.headers.get("X-API-KEY") == api_key:
+            return True
+        # standard OpenAI SDKs send the key as a Bearer token — the /v1
+        # surface is useless off-loopback without accepting it
+        auth = request.headers.get("Authorization", "")
+        return auth == f"Bearer {api_key}"
     # no key configured: loopback only (safer than the reference's open
     # default, per SURVEY §7 "what NOT to carry over")
     peer = request.remote or ""
@@ -142,6 +147,13 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
             "max_new_tokens": _int_param(body, ("max_new_tokens", "max_tokens"), 2048),
             "temperature": float(body.get("temperature", 0.7)),
         }
+        # the full sampling surface rides through to the service layer —
+        # silently dropping a requested penalty would be wrong output, not
+        # a degraded default
+        for k in ("top_k", "top_p", "repetition_penalty", "presence_penalty",
+                  "frequency_penalty"):
+            if body.get(k) is not None:
+                params[k] = body[k]
         svc = node.local_service_for(model)
         stream = bool(body.get("stream"))
 
@@ -167,6 +179,7 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
             model=model,
             max_new_tokens=params["max_new_tokens"],
             temperature=params["temperature"],
+            extra=_sampling_extra(params),
         )
         return web.json_response(result)
 
@@ -226,6 +239,120 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
             charset="utf-8",
         )
 
+    # ---- OpenAI-compatible surface (/v1): standard SDKs and tools can
+    # point at a mesh node unchanged (base_url="http://node:4002/v1").
+    # Completions/chat map onto the same local-first + P2P-fallback path
+    # as /chat; streaming uses SSE with OpenAI chunk objects.
+
+    async def v1_models(request):
+        names = set()
+        # list_providers(None) already includes every LOCAL service's
+        # metadata alongside mesh providers — one matching rule, one loop
+        for prov in node.list_providers(None):
+            names.update(prov.get("models") or [])
+        return web.json_response({
+            "object": "list",
+            "data": [
+                {"id": n, "object": "model", "owned_by": "bee2bee-tpu"}
+                for n in sorted(names)
+            ],
+        })
+
+    def _openai_params(body, prompt):
+        params = {
+            "prompt": prompt,
+            "max_new_tokens": _int_param(body, ("max_tokens", "max_new_tokens"), 256),
+            "temperature": float(body.get("temperature", 1.0)),
+        }
+        for ours, theirs in (
+            ("top_p", "top_p"), ("top_k", "top_k"),
+            ("presence_penalty", "presence_penalty"),
+            ("frequency_penalty", "frequency_penalty"),
+            ("repetition_penalty", "repetition_penalty"),
+        ):
+            if body.get(theirs) is not None:
+                params[ours] = body[theirs]
+        return params
+
+    def _openai_response(result, model, chat: bool):
+        text = result.get("text", "")
+        completion_tokens = int(result.get("tokens", 0))
+        prompt_tokens = int(result.get("prompt_tokens", 0))
+        choice = {
+            "index": 0,
+            "finish_reason": result.get("finish_reason", "stop"),
+        }
+        if chat:
+            choice["message"] = {"role": "assistant", "content": text}
+        else:
+            choice["text"] = text
+        return {
+            "id": f"cmpl-{os.urandom(8).hex()}",
+            "object": "chat.completion" if chat else "text_completion",
+            "model": model or "",
+            "choices": [choice],
+            "usage": {
+                "prompt_tokens": prompt_tokens,
+                "completion_tokens": completion_tokens,
+                "total_tokens": prompt_tokens + completion_tokens,
+            },
+        }
+
+    async def _v1_generate(request, body, prompt, chat: bool):
+        model = body.get("model")
+        params = _openai_params(body, prompt)
+        svc = node.local_service_for(model)
+        sse = ("chat" if chat else "text", model or "")
+        if svc is not None:
+            if bool(body.get("stream")):
+                return await _stream_service(request, node, svc, params, cors, sse=sse)
+            result = await node._execute_local(svc, params, stream=False, on_chunk=None)
+        else:
+            provider = node.pick_provider(model)
+            if provider is None or provider["local"]:
+                return web.json_response(
+                    {"error": {"message": f"model {model!r} not found",
+                               "type": "invalid_request_error"}}, status=404)
+            if bool(body.get("stream")):
+                return await _stream_p2p(
+                    request, node, provider, params, model, cors, sse=sse
+                )
+            result = await node.request_generation(
+                provider["provider_id"], prompt, model=model,
+                max_new_tokens=params["max_new_tokens"],
+                temperature=params["temperature"],
+                extra=_sampling_extra(params),
+            )
+        return web.json_response(_openai_response(result, model, chat))
+
+    async def v1_completions(request):
+        body = await _json_body(request)
+        prompt = body.get("prompt")
+        if isinstance(prompt, list):  # OpenAI allows a list of prompts
+            if len(prompt) != 1:
+                return web.json_response(
+                    {"error": {"message": "only a single prompt is supported",
+                               "type": "invalid_request_error"}}, status=400)
+            prompt = prompt[0]
+        if not prompt:
+            return web.json_response(
+                {"error": {"message": "prompt required",
+                           "type": "invalid_request_error"}}, status=400)
+        with get_tracer().span("api.v1.completions", model=body.get("model")):
+            return await _v1_generate(request, body, prompt, chat=False)
+
+    async def v1_chat_completions(request):
+        body = await _json_body(request)
+        prompt = _prompt_from_messages(body.get("messages"))
+        if not prompt:
+            return web.json_response(
+                {"error": {"message": "messages required",
+                           "type": "invalid_request_error"}}, status=400)
+        # no assistant cue here: services that parse transcripts append it
+        # themselves (TPUService._gen_args) — adding one would double it
+        with get_tracer().span("api.v1.chat", model=body.get("model")):
+            return await _v1_generate(request, body, prompt, chat=True)
+
     app.router.add_get("/", home)
     app.router.add_get("/peers", peers)
     app.router.add_get("/providers", providers)
@@ -234,8 +361,19 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
     app.router.add_post("/connect", connect)
     app.router.add_post("/chat", chat)
     app.router.add_post("/generate", chat)  # alias (reference api.py:190-191)
+    app.router.add_get("/v1/models", v1_models)
+    app.router.add_post("/v1/completions", v1_completions)
+    app.router.add_post("/v1/chat/completions", v1_chat_completions)
     app.router.add_route("OPTIONS", "/{tail:.*}", lambda r: web.Response(headers=cors))
     return app
+
+
+_SAMPLING_KEYS = ("top_k", "top_p", "repetition_penalty",
+                  "presence_penalty", "frequency_penalty")
+
+
+def _sampling_extra(params: dict) -> dict:
+    return {k: params[k] for k in _SAMPLING_KEYS if k in params}
 
 
 async def _json_body(request: web.Request) -> dict[str, Any]:
@@ -253,14 +391,61 @@ def _prompt_from_messages(messages) -> str | None:
     return "\n".join(f"{m.get('role', 'user')}: {m.get('content', '')}" for m in messages)
 
 
-async def _stream_service(request, node: P2PNode, svc, params, cors=()) -> web.StreamResponse:
-    """JSON-lines streaming from a local service (chunked response)."""
+def _make_frame(sse):
+    """Line framer for the two stream transports: identity (ndjson) or an
+    OpenAI SSE encoder when sse=("chat"|"text", model). Service error
+    lines become an SSE error event + [DONE] — a swallowed error would be
+    indistinguishable from a short completion."""
+    if sse is None:
+        return lambda line: line.encode("utf-8")
+    kind, model = sse
+    sse_id = f"cmpl-{os.urandom(8).hex()}"
+    obj_name = "chat.completion.chunk" if kind == "chat" else "text_completion"
+
+    def frame(line: str) -> bytes:
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            return b""
+        if obj.get("status") == "error" or obj.get("error"):
+            err = {"error": {"message": obj.get("message") or obj.get("error")
+                             or "generation failed", "type": "server_error"}}
+            return (f"data: {json.dumps(err)}\n\ndata: [DONE]\n\n").encode()
+        if obj.get("done"):
+            fin = {"index": 0, "finish_reason": obj.get("finish_reason", "stop")}
+            fin["delta" if kind == "chat" else "text"] = {} if kind == "chat" else ""
+            payload = {"id": sse_id, "model": model, "object": obj_name,
+                       "choices": [fin]}
+            return (f"data: {json.dumps(payload)}\n\ndata: [DONE]\n\n").encode()
+        text = obj.get("text")
+        if not text:
+            return b""
+        ch = {"index": 0, "finish_reason": None}
+        if kind == "chat":
+            ch["delta"] = {"content": text}
+        else:
+            ch["text"] = text
+        payload = {"id": sse_id, "model": model, "object": obj_name,
+                   "choices": [ch]}
+        return f"data: {json.dumps(payload)}\n\n".encode()
+
+    return frame
+
+
+async def _stream_service(
+    request, node: P2PNode, svc, params, cors=(), sse=None
+) -> web.StreamResponse:
+    """Streaming from a local service: JSON-lines by default, or OpenAI
+    SSE chunks when sse=("chat"|"text", model) (the /v1 surface)."""
     import asyncio
     import contextvars
     import threading
 
+    ctype = "text/event-stream" if sse else "application/x-ndjson"
+    frame = _make_frame(sse)
+
     resp = web.StreamResponse(
-        headers={"Content-Type": "application/x-ndjson", **dict(cors)}
+        headers={"Content-Type": ctype, **dict(cors)}
     )
     await resp.prepare(request)
     loop = asyncio.get_running_loop()
@@ -300,7 +485,7 @@ async def _stream_service(request, node: P2PNode, svc, params, cors=()) -> web.S
                     # metrics must never kill a stream: non-object lines or
                     # non-string "text" from custom services pass through
                     pass
-                await resp.write(item.encode("utf-8"))
+                await resp.write(frame(item))
             await resp.write_eof()
         except (ConnectionResetError, asyncio.CancelledError):
             logger.info("stream client disconnected; aborting generation pump")
@@ -315,15 +500,20 @@ async def _stream_service(request, node: P2PNode, svc, params, cors=()) -> web.S
     return resp
 
 
-async def _stream_p2p(request, node: P2PNode, provider, params, model, cors=()) -> web.StreamResponse:
+async def _stream_p2p(
+    request, node: P2PNode, provider, params, model, cors=(), sse=None
+) -> web.StreamResponse:
     import asyncio
 
+    frame = _make_frame(sse)
     resp = web.StreamResponse(
-        headers={"Content-Type": "application/x-ndjson", **dict(cors)}
+        headers={
+            "Content-Type": "text/event-stream" if sse else "application/x-ndjson",
+            **dict(cors),
+        }
     )
     await resp.prepare(request)
     q: asyncio.Queue = asyncio.Queue()
-    loop = asyncio.get_running_loop()
 
     def on_chunk(text):
         q.put_nowait(json.dumps({"text": text}) + "\n")
@@ -337,23 +527,24 @@ async def _stream_p2p(request, node: P2PNode, provider, params, model, cors=()) 
             temperature=params["temperature"],
             stream=True,
             on_chunk=on_chunk,
+            extra=_sampling_extra(params),
         )
     )
     while True:
         getter = asyncio.create_task(q.get())
         done, _ = await asyncio.wait({getter, gen_task}, return_when=asyncio.FIRST_COMPLETED)
         if getter in done:
-            await resp.write(getter.result().encode("utf-8"))
+            await resp.write(frame(getter.result()))
             continue
         getter.cancel()
         try:
             await gen_task
             while not q.empty():
-                await resp.write(q.get_nowait().encode("utf-8"))
-            await resp.write((json.dumps({"done": True}) + "\n").encode("utf-8"))
+                await resp.write(frame(q.get_nowait()))
+            await resp.write(frame(json.dumps({"done": True}) + "\n"))
         except Exception as e:
             await resp.write(
-                (json.dumps({"status": "error", "message": str(e)}) + "\n").encode("utf-8")
+                frame(json.dumps({"status": "error", "message": str(e)}) + "\n")
             )
         break
     await resp.write_eof()

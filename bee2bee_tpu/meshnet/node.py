@@ -593,11 +593,15 @@ class P2PNode(StageTaskMixin):
         stream: bool = False,
         on_chunk: Callable[[str], None] | None = None,
         timeout: float = REQUEST_TIMEOUT_S,
+        extra: dict | None = None,  # sampling knobs (top_k/top_p/penalties):
+        # ride the wire as plain message keys — the reference ignores
+        # unknown keys, so the frame stays wire-compatible
     ) -> dict:
         params = {
             "prompt": prompt,
             "max_new_tokens": max_new_tokens,
             "temperature": temperature,
+            **(extra or {}),
         }
         # self-request shortcut (reference p2p_runtime.py:761-787)
         if provider_id == self.peer_id:
@@ -635,6 +639,7 @@ class P2PNode(StageTaskMixin):
                         max_tokens=max_new_tokens,  # reference reads this key
                         temperature=temperature,
                         stream=bool(stream or on_chunk),
+                        **(extra or {}),
                     ),
                 )
                 result = await asyncio.wait_for(fut, timeout=timeout)
@@ -759,6 +764,10 @@ class P2PNode(StageTaskMixin):
             "max_new_tokens": 2048 if mnt is None else int(mnt),
             "temperature": data.get("temperature", 0.7),
         }
+        for k in ("top_k", "top_p", "repetition_penalty",
+                  "presence_penalty", "frequency_penalty"):
+            if data.get(k) is not None:
+                params[k] = data[k]
         if svc is not None:
             try:
                 if data.get("stream"):

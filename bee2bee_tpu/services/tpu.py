@@ -100,6 +100,7 @@ class TPUService(BaseService):
         out["tokens_per_sec"] = result.tokens_per_sec
         out["ttft_ms"] = int(result.ttft_s * 1000)
         out["finish_reason"] = result.finish_reason
+        out["prompt_tokens"] = result.prompt_tokens  # /v1 usage accounting
         return out
 
     def execute_stream(self, params: dict[str, Any]) -> Iterator[str]:

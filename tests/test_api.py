@@ -193,3 +193,198 @@ async def test_metrics_prometheus_exposition():
             assert float(lines["bee2bee_total_tokens"]) >= 1
         finally:
             await client.close()
+
+
+async def test_chat_forwards_all_sampling_knobs():
+    """top_k/top_p/penalties must reach the service — a dropped penalty is
+    silently-wrong output, not a degraded default."""
+    async with mesh(1) as (node,):
+        svc = FakeService("m", reply="x")
+        node.add_service(svc)
+        client = await _client(node)
+        try:
+            r = await client.post("/chat", json={
+                "prompt": "p", "model": "m", "temperature": 0.0,
+                "top_k": 5, "top_p": 0.9, "repetition_penalty": 1.3,
+                "presence_penalty": 0.5, "frequency_penalty": 0.25,
+            })
+            assert r.status == 200
+            call = svc.calls[-1]
+            assert call["top_k"] == 5 and call["top_p"] == 0.9
+            assert call["repetition_penalty"] == 1.3
+            assert call["presence_penalty"] == 0.5
+            assert call["frequency_penalty"] == 0.25
+        finally:
+            await client.close()
+
+
+async def test_v1_models_lists_local_models():
+    async with mesh(1) as (node,):
+        node.add_service(FakeService("my-model"))
+        client = await _client(node)
+        try:
+            r = await client.get("/v1/models")
+            assert r.status == 200
+            body = await r.json()
+            assert body["object"] == "list"
+            assert any(m["id"] == "my-model" for m in body["data"])
+        finally:
+            await client.close()
+
+
+async def test_v1_completions():
+    async with mesh(1) as (node,):
+        svc = FakeService("m", reply="v1 text")
+        node.add_service(svc)
+        client = await _client(node)
+        try:
+            r = await client.post("/v1/completions", json={
+                "model": "m", "prompt": "hello", "max_tokens": 16,
+                "temperature": 0.0, "frequency_penalty": 0.5,
+            })
+            assert r.status == 200
+            body = await r.json()
+            assert body["object"] == "text_completion"
+            assert body["choices"][0]["text"] == "v1 text"
+            assert body["choices"][0]["finish_reason"]
+            assert body["usage"]["completion_tokens"] > 0
+            assert svc.calls[-1]["frequency_penalty"] == 0.5
+            assert svc.calls[-1]["max_new_tokens"] == 16
+        finally:
+            await client.close()
+
+
+async def test_v1_chat_completions():
+    async with mesh(1) as (node,):
+        svc = FakeService("m", reply="chat reply")
+        node.add_service(svc)
+        client = await _client(node)
+        try:
+            r = await client.post("/v1/chat/completions", json={
+                "model": "m",
+                "messages": [{"role": "user", "content": "hi there"}],
+            })
+            assert r.status == 200
+            body = await r.json()
+            assert body["object"] == "chat.completion"
+            msg = body["choices"][0]["message"]
+            assert msg["role"] == "assistant" and msg["content"] == "chat reply"
+            # the gateway hands the service the PLAIN transcript — the cue
+            # is service-layer policy (TPUService appends it when parsing;
+            # doubling it here degraded real outputs)
+            assert svc.calls[-1]["prompt"] == "user: hi there"
+        finally:
+            await client.close()
+
+
+async def test_v1_streaming_sse():
+    async with mesh(1) as (node,):
+        node.add_service(FakeService("m", reply="stream me please", chunk_size=5))
+        client = await _client(node)
+        try:
+            r = await client.post("/v1/chat/completions", json={
+                "model": "m", "stream": True,
+                "messages": [{"role": "user", "content": "go"}],
+            })
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/event-stream")
+            raw = (await r.read()).decode()
+            events = [l[6:] for l in raw.splitlines() if l.startswith("data: ")]
+            assert events[-1] == "[DONE]"
+            text = ""
+            for e in events[:-1]:
+                obj = json.loads(e)
+                assert obj["object"] == "chat.completion.chunk"
+                delta = obj["choices"][0].get("delta") or {}
+                text += delta.get("content") or "" if isinstance(delta, dict) else ""
+            assert text == "stream me please"
+        finally:
+            await client.close()
+
+
+async def test_v1_unknown_model_404():
+    async with mesh(1) as (node,):
+        client = await _client(node)
+        try:
+            r = await client.post("/v1/completions", json={"model": "nope", "prompt": "x"})
+            assert r.status == 404
+            assert (await r.json())["error"]["type"] == "invalid_request_error"
+        finally:
+            await client.close()
+
+
+async def test_v1_p2p_fallback_carries_knobs_and_streams():
+    """A model hosted only on a peer: /v1 works non-stream AND stream, and
+    the sampling knobs ride the wire to the remote service."""
+    async with mesh(2) as (node, provider):
+        remote = FakeService("peer-model", reply="from the mesh", chunk_size=6)
+        provider.add_service(remote)
+        await node.connect_bootstrap(provider.addr)
+        assert await _settle(lambda: node.providers)
+        client = await _client(node)
+        try:
+            r = await client.post("/v1/completions", json={
+                "model": "peer-model", "prompt": "x", "max_tokens": 8,
+                "frequency_penalty": 0.7, "top_p": 0.8,
+            })
+            assert r.status == 200
+            body = await r.json()
+            assert body["choices"][0]["text"] == "from the mesh"
+            call = remote.calls[-1]
+            assert call["frequency_penalty"] == 0.7 and call["top_p"] == 0.8
+
+            r = await client.post("/v1/chat/completions", json={
+                "model": "peer-model", "stream": True,
+                "messages": [{"role": "user", "content": "go"}],
+            })
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/event-stream")
+            raw = (await r.read()).decode()
+            events = [l[6:] for l in raw.splitlines() if l.startswith("data: ")]
+            assert events[-1] == "[DONE]"
+            text = "".join(
+                (json.loads(e)["choices"][0].get("delta") or {}).get("content") or ""
+                for e in events[:-1]
+            )
+            assert text == "from the mesh"
+        finally:
+            await client.close()
+
+
+async def test_v1_bearer_auth():
+    """Stock OpenAI SDKs send Authorization: Bearer — it must work."""
+    async with mesh(1) as (node,):
+        node.add_service(FakeService("m", reply="ok"))
+        client = await _client(node, api_key="sk-test")
+        try:
+            r = await client.post(
+                "/v1/completions",
+                json={"model": "m", "prompt": "x"},
+                headers={"Authorization": "Bearer sk-test"},
+            )
+            assert r.status == 200
+            r = await client.post(
+                "/v1/completions",
+                json={"model": "m", "prompt": "x"},
+                headers={"Authorization": "Bearer wrong"},
+            )
+            assert r.status == 401
+        finally:
+            await client.close()
+
+
+async def test_v1_stream_error_becomes_sse_error_event():
+    async with mesh(1) as (node,):
+        node.add_service(FakeService("m", fail_with="engine exploded"))
+        client = await _client(node)
+        try:
+            r = await client.post("/v1/completions", json={
+                "model": "m", "prompt": "x", "stream": True,
+            })
+            raw = (await r.read()).decode()
+            events = [l[6:] for l in raw.splitlines() if l.startswith("data: ")]
+            assert events[-1] == "[DONE]"
+            errs = [json.loads(e) for e in events[:-1] if "error" in e]
+            assert errs and "engine exploded" in errs[-1]["error"]["message"]
+        finally:
+            await client.close()
