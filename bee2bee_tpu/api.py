@@ -20,6 +20,7 @@ from aiohttp import web
 
 from . import __version__
 from .meshnet.node import P2PNode
+from .protocol import copy_sampling
 from .tracing import get_tracer
 
 logger = logging.getLogger("bee2bee_tpu.api")
@@ -37,7 +38,7 @@ def _cors_headers(api_key: str | None) -> dict[str, str]:
     return {
         "Access-Control-Allow-Origin": origin,
         "Access-Control-Allow-Methods": "GET, POST, OPTIONS",
-        "Access-Control-Allow-Headers": "Content-Type, X-API-KEY",
+        "Access-Control-Allow-Headers": "Content-Type, X-API-KEY, Authorization",
     }
 
 
@@ -150,10 +151,7 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
         # the full sampling surface rides through to the service layer —
         # silently dropping a requested penalty would be wrong output, not
         # a degraded default
-        for k in ("top_k", "top_p", "repetition_penalty", "presence_penalty",
-                  "frequency_penalty"):
-            if body.get(k) is not None:
-                params[k] = body[k]
+        copy_sampling(body, params)
         svc = node.local_service_for(model)
         stream = bool(body.get("stream"))
 
@@ -264,14 +262,7 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
             "max_new_tokens": _int_param(body, ("max_tokens", "max_new_tokens"), 256),
             "temperature": float(body.get("temperature", 1.0)),
         }
-        for ours, theirs in (
-            ("top_p", "top_p"), ("top_k", "top_k"),
-            ("presence_penalty", "presence_penalty"),
-            ("frequency_penalty", "frequency_penalty"),
-            ("repetition_penalty", "repetition_penalty"),
-        ):
-            if body.get(theirs) is not None:
-                params[ours] = body[theirs]
+        copy_sampling(body, params)
         return params
 
     def _openai_response(result, model, chat: bool):
@@ -368,12 +359,8 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
     return app
 
 
-_SAMPLING_KEYS = ("top_k", "top_p", "repetition_penalty",
-                  "presence_penalty", "frequency_penalty")
-
-
 def _sampling_extra(params: dict) -> dict:
-    return {k: params[k] for k in _SAMPLING_KEYS if k in params}
+    return copy_sampling(params, {})
 
 
 async def _json_body(request: web.Request) -> dict[str, Any]:
@@ -385,10 +372,23 @@ async def _json_body(request: web.Request) -> dict[str, Any]:
 
 def _prompt_from_messages(messages) -> str | None:
     """OpenAI-style messages → user:/assistant: transcript (the format the
-    reference UI sends, App.jsx:994-998)."""
+    reference UI sends, App.jsx:994-998). Content may be the standard
+    content-parts array — the text parts are joined (feeding the model a
+    list repr would be silent garbage)."""
     if not messages:
         return None
-    return "\n".join(f"{m.get('role', 'user')}: {m.get('content', '')}" for m in messages)
+
+    def text_of(content) -> str:
+        if isinstance(content, list):
+            return "".join(
+                p.get("text", "") for p in content
+                if isinstance(p, dict) and p.get("type") in (None, "text")
+            )
+        return "" if content is None else str(content)
+
+    return "\n".join(
+        f"{m.get('role', 'user')}: {text_of(m.get('content'))}" for m in messages
+    )
 
 
 def _make_frame(sse):

@@ -106,8 +106,14 @@ class NodeClient(_Base):
         model: str | None = None,
         max_new_tokens: int | None = None,
         temperature: float | None = None,
+        **sampling,  # top_k/top_p/repetition_penalty/presence_penalty/
+        # frequency_penalty — forwarded verbatim (api.py passes them to
+        # the service layer and over the P2P wire)
     ) -> dict:
-        body = {"prompt": prompt, "model": model, "stream": False}
+        # sampling spreads FIRST: reserved keys (prompt/model/stream)
+        # always win, so a typo'd or malicious kwarg can't flip the
+        # request shape out from under the response parser
+        body = {**sampling, "prompt": prompt, "model": model, "stream": False}
         if max_new_tokens is not None:
             body["max_new_tokens"] = max_new_tokens
         if temperature is not None:
@@ -120,10 +126,11 @@ class NodeClient(_Base):
         model: str | None = None,
         max_new_tokens: int | None = None,
         temperature: float | None = None,
+        **sampling,
     ) -> AsyncIterator[dict]:
         """Yield the JSON-lines objects of a streamed generation
         ({"text": piece} chunks, then {"done": true, ...})."""
-        body = {"prompt": prompt, "model": model, "stream": True}
+        body = {**sampling, "prompt": prompt, "model": model, "stream": True}
         if max_new_tokens is not None:
             body["max_new_tokens"] = max_new_tokens
         if temperature is not None:

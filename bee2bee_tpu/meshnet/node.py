@@ -764,10 +764,7 @@ class P2PNode(StageTaskMixin):
             "max_new_tokens": 2048 if mnt is None else int(mnt),
             "temperature": data.get("temperature", 0.7),
         }
-        for k in ("top_k", "top_p", "repetition_penalty",
-                  "presence_penalty", "frequency_penalty"):
-            if data.get(k) is not None:
-                params[k] = data[k]
+        protocol.copy_sampling(data, params)
         if svc is not None:
             try:
                 if data.get("stream"):
@@ -815,13 +812,40 @@ class P2PNode(StageTaskMixin):
             )
             return
         try:
-            result = await self.request_generation(
-                cand["provider_id"],
-                params["prompt"],
-                model=model,
-                max_new_tokens=params["max_new_tokens"],
-                temperature=params["temperature"],
-            )
+            if data.get("stream"):
+                # relay the STREAM too: chunks from the far provider are
+                # re-framed under our rid as they arrive — without this a
+                # relayed stream request returns empty text while the
+                # provider does the full paid generation
+                relay_q: asyncio.Queue = asyncio.Queue()
+                task = asyncio.create_task(
+                    self.request_generation(
+                        cand["provider_id"],
+                        params["prompt"],
+                        model=model,
+                        max_new_tokens=params["max_new_tokens"],
+                        temperature=params["temperature"],
+                        stream=True,
+                        on_chunk=relay_q.put_nowait,
+                        extra=protocol.copy_sampling(params, {}),
+                    )
+                )
+                result = await pump_queue_until(
+                    task,
+                    relay_q,
+                    lambda text: self._send(
+                        ws, protocol.msg(protocol.GEN_CHUNK, rid=rid, text=text)
+                    ),
+                )
+            else:
+                result = await self.request_generation(
+                    cand["provider_id"],
+                    params["prompt"],
+                    model=model,
+                    max_new_tokens=params["max_new_tokens"],
+                    temperature=params["temperature"],
+                    extra=protocol.copy_sampling(params, {}),
+                )
             # the inner result carries its own rid — replace it with ours
             fwd = {k: v for k, v in result.items() if k not in ("rid", "task_id", "type")}
             await self._send(ws, protocol.msg(protocol.GEN_RESULT, rid=rid, **fwd))

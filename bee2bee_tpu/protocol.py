@@ -169,3 +169,24 @@ def decode_binary(raw: bytes) -> tuple[dict, dict]:
     if not is_message(header):
         raise ValueError("not a protocol message")
     return header, tensors
+
+
+# sampling knobs that ride GEN_REQUEST as plain message keys (the
+# reference ignores unknown keys, so frames stay wire-compatible). ONE
+# list: the gateway, the node handler, and the relay all copy from it —
+# a key present here but missing at any hop is a silently-wrong output.
+SAMPLING_KEYS = (
+    "top_k",
+    "top_p",
+    "repetition_penalty",
+    "presence_penalty",
+    "frequency_penalty",
+)
+
+
+def copy_sampling(src: dict, dst: dict) -> dict:
+    """Copy present-and-not-None sampling knobs from src into dst."""
+    for k in SAMPLING_KEYS:
+        if src.get(k) is not None:
+            dst[k] = src[k]
+    return dst

@@ -115,17 +115,22 @@ export class NodeClient {
     return this._post("/connect", { addr: addrOrLink });
   }
 
-  /** Non-streaming chat; resolves to the result object. */
-  chat(prompt, { model = null, maxNewTokens = null, temperature = null } = {}) {
-    const body = { prompt, model, stream: false };
+  /** Non-streaming chat; resolves to the result object. `sampling`
+   *  forwards extra knobs verbatim (top_k, top_p, repetition_penalty,
+   *  presence_penalty, frequency_penalty) — parity with generate(). */
+  chat(prompt, { model = null, maxNewTokens = null, temperature = null, sampling = {} } = {}) {
+    // sampling spreads FIRST so reserved keys always win
+    const body = { ...sampling, prompt, model, stream: false };
     if (maxNewTokens != null) body.max_new_tokens = maxNewTokens;
     if (temperature != null) body.temperature = temperature;
     return this._post("/chat", body);
   }
 
-  /** Streaming generate; onChunk(text) per piece; resolves to full text. */
-  async generate(prompt, { model = null, maxNewTokens = null, temperature = null, onChunk = null } = {}) {
-    const body = { prompt, model, stream: true };
+  /** Streaming generate; onChunk(text) per piece; resolves to full text.
+   *  `sampling` forwards extra knobs verbatim (top_k, top_p,
+   *  repetition_penalty, presence_penalty, frequency_penalty). */
+  async generate(prompt, { model = null, maxNewTokens = null, temperature = null, onChunk = null, sampling = {} } = {}) {
+    const body = { ...sampling, prompt, model, stream: true };
     if (maxNewTokens != null) body.max_new_tokens = maxNewTokens;
     if (temperature != null) body.temperature = temperature;
     const r = await this._post("/chat", body, { stream: true });

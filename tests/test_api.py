@@ -388,3 +388,65 @@ async def test_v1_stream_error_becomes_sse_error_event():
             assert errs and "engine exploded" in errs[-1]["error"]["message"]
         finally:
             await client.close()
+
+
+async def test_v1_content_parts_messages():
+    """OpenAI content-parts arrays must be flattened to their text, never
+    fed to the model as a list repr."""
+    async with mesh(1) as (node,):
+        svc = FakeService("m", reply="ok")
+        node.add_service(svc)
+        client = await _client(node)
+        try:
+            r = await client.post("/v1/chat/completions", json={
+                "model": "m",
+                "messages": [{"role": "user", "content": [
+                    {"type": "text", "text": "part one "},
+                    {"type": "text", "text": "part two"},
+                    {"type": "image_url", "image_url": {"url": "x"}},
+                ]}],
+            })
+            assert r.status == 200
+            assert svc.calls[-1]["prompt"] == "user: part one part two"
+        finally:
+            await client.close()
+
+
+async def test_swarm_relay_carries_sampling_knobs():
+    """3 nodes: A (gateway, no service) -> B (relay, no match) -> C
+    (provider). The penalties must survive BOTH wire hops."""
+    async with mesh(3) as (a, b, c):
+        remote = FakeService("relay-model", reply="relayed")
+        c.add_service(remote)
+        # a knows only b; b knows c (so a's request to b must relay to c)
+        await b.connect_bootstrap(c.addr)
+        assert await _settle(lambda: b.providers)
+        await a.connect_bootstrap(b.addr)
+        assert await _settle(lambda: a.peers)
+        result = await a.request_generation(
+            # ask B (which has no service) for the model C hosts
+            next(iter(a.peers)), "q", model="relay-model",
+            extra={"frequency_penalty": 0.9, "top_k": 7},
+        )
+        assert result.get("text") == "relayed"
+        call = remote.calls[-1]
+        assert call["frequency_penalty"] == 0.9 and call["top_k"] == 7
+
+
+async def test_swarm_relay_streams_chunks():
+    """A relayed STREAM request must forward the provider's chunks hop by
+    hop — not return empty text after a full paid generation."""
+    async with mesh(3) as (a, b, c):
+        c.add_service(FakeService("relay-s", reply="streamed via relay", chunk_size=5))
+        await b.connect_bootstrap(c.addr)
+        assert await _settle(lambda: b.providers)
+        await a.connect_bootstrap(b.addr)
+        assert await _settle(lambda: a.peers)
+        chunks: list[str] = []
+        result = await a.request_generation(
+            next(iter(a.peers)), "q", model="relay-s",
+            stream=True, on_chunk=chunks.append,
+        )
+        assert "".join(chunks) == "streamed via relay"
+        assert len(chunks) > 1  # actually chunked, not one blob
+        assert not result.get("error")

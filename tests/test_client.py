@@ -166,3 +166,19 @@ async def test_gateway_client_against_live_web_tier():
         finally:
             await server.close()
             await bridge.stop()
+
+
+async def test_node_client_forwards_sampling_kwargs():
+    """SDK **sampling kwargs travel the full stack to the service."""
+    async with node_server() as (node, url):
+        svc = next(iter(node.local_services.values()))
+        c = NodeClient(url)
+        r = await c.chat(
+            "p", model="demo-model", temperature=0.0,
+            top_p=0.85, repetition_penalty=1.4, frequency_penalty=0.2,
+        )
+        assert r["text"] == "0123456789"
+        call = svc.calls[-1]
+        assert call["top_p"] == 0.85
+        assert call["repetition_penalty"] == 1.4
+        assert call["frequency_penalty"] == 0.2
