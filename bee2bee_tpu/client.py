@@ -106,7 +106,7 @@ class NodeClient(_Base):
         model: str | None = None,
         max_new_tokens: int | None = None,
         temperature: float | None = None,
-        **sampling,  # top_k/top_p/repetition_penalty/presence_penalty/
+        **sampling,  # top_k/top_p/min_p/repetition_penalty/presence_penalty/
         # frequency_penalty — forwarded verbatim (api.py passes them to
         # the service layer and over the P2P wire)
     ) -> dict:

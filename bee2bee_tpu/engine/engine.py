@@ -393,6 +393,7 @@ class InferenceEngine:
         self, prompt, max_new_tokens, temperature, top_k, top_p, stop_tokens,
         stream: bool = False, repetition_penalty: float = 1.0,
         presence_penalty: float = 0.0, frequency_penalty: float = 0.0,
+        min_p: float = 0.0,
     ):
         from .scheduler import Request
 
@@ -417,6 +418,10 @@ class InferenceEngine:
             raise ValueError(
                 f"repetition_penalty must be > 0, got {repetition_penalty}"
             )
+        if min_p is not None and not (0.0 <= min_p <= 1.0):
+            # min_p > 1 would mask EVERY token (floor above the max prob)
+            # and degenerate to token 0 — reject, don't silently garble
+            raise ValueError(f"min_p must be in [0, 1], got {min_p}")
         stop, eos = self._stop_set(stop_tokens)
         return Request(
             ids, max_new_tokens, temperature, top_k, top_p, stop, eos,
@@ -424,6 +429,7 @@ class InferenceEngine:
             repetition_penalty=repetition_penalty,
             presence_penalty=presence_penalty,
             frequency_penalty=frequency_penalty,
+            min_p=min_p,
         )
 
     def _build_result(self, req) -> GenerationResult:
@@ -461,6 +467,7 @@ class InferenceEngine:
         repetition_penalty: float = 1.0,
         presence_penalty: float = 0.0,
         frequency_penalty: float = 0.0,
+        min_p: float = 0.0,
     ) -> Iterator[dict]:
         """Yield {"token": last_id, "tokens": ids, "text": piece} per decode
         chunk, then {"done": True, "result": GenerationResult}. Streaming
@@ -472,6 +479,7 @@ class InferenceEngine:
             stream=True, repetition_penalty=repetition_penalty,
             presence_penalty=presence_penalty,
             frequency_penalty=frequency_penalty,
+            min_p=min_p,
         )
         if req.max_new_tokens <= 0:
             req.timing.t_first = req.timing.t_done = time.perf_counter()
@@ -507,6 +515,7 @@ class InferenceEngine:
             repetition_penalty=kw.get("repetition_penalty", 1.0),
             presence_penalty=kw.get("presence_penalty", 0.0),
             frequency_penalty=kw.get("frequency_penalty", 0.0),
+            min_p=kw.get("min_p", 0.0),
         )
         if req.max_new_tokens <= 0:
             req.timing.t_first = req.timing.t_done = time.perf_counter()

@@ -1,4 +1,4 @@
-"""On-device token sampling: greedy / temperature / top-k / top-p.
+"""On-device token sampling: greedy / temperature / top-k / top-p / min-p.
 
 Replaces the sampling knobs the reference forwards to torch generate
 (reference services.py:44-59: temperature, max_new_tokens). Everything is
@@ -18,6 +18,7 @@ def sample(
     temperature: float = 0.0,
     top_k: int = 0,
     top_p: float = 1.0,
+    min_p: float = 0.0,
 ):
     """Sample next tokens [B]. temperature<=0 → greedy (argmax).
 
@@ -29,6 +30,11 @@ def sample(
         return jnp.argmax(logits, axis=-1)
 
     logits = logits / jnp.asarray(max(temperature, 1e-6), logits.dtype)
+
+    if min_p and min_p > 0.0:
+        probs = jax.nn.softmax(logits, axis=-1)
+        floor = min_p * jnp.max(probs, axis=-1, keepdims=True)
+        logits = jnp.where(probs >= floor, logits, -jnp.inf)
 
     if top_k and top_k > 0:
         kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
@@ -79,6 +85,10 @@ def sample_batched(
     temperature,  # [B] float32; <= 0 → greedy for that row
     top_k,  # [B] int32; <= 0 → no top-k restriction
     top_p,  # [B] float32; >= 1 → no nucleus restriction
+    min_p=None,  # [B] float32; <= 0 → off. Keeps tokens whose prob (after
+    # temperature) is >= min_p * max prob — a relative floor that adapts
+    # to the distribution's confidence where top_p's absolute mass cut
+    # does not (the "min-p sampling" recipe)
     counts=None,  # optional [B, 2, V] int32 (see apply_penalties) → penalties first
     repetition=None,  # [B] float32 (with counts)
     presence=None,  # [B] float32 (with counts)
@@ -100,6 +110,12 @@ def sample_batched(
 
     def sampled_path(_):
         l = logits / jnp.maximum(temperature, 1e-6)[:, None]
+
+        if min_p is not None:
+            probs0 = jax.nn.softmax(l, axis=-1)
+            floor = min_p[:, None] * jnp.max(probs0, axis=-1, keepdims=True)
+            # the top token always survives (probs0 >= floor there)
+            l = jnp.where(probs0 >= floor, l, -jnp.inf)
 
         sorted_l = jnp.sort(l, axis=-1)[:, ::-1]
         k_eff = jnp.clip(jnp.where(top_k > 0, top_k, V), 1, V)

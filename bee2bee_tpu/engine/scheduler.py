@@ -81,6 +81,7 @@ class Request:
         repetition_penalty: float = 1.0,
         presence_penalty: float = 0.0,
         frequency_penalty: float = 0.0,
+        min_p: float = 0.0,
     ):
         self.stream = stream
         # set by an abandoning consumer (generate_stream closed early);
@@ -92,6 +93,7 @@ class Request:
         self.temperature = float(temperature if temperature is not None else 0.0)
         self.top_k = int(top_k or 0)
         self.top_p = float(top_p if top_p is not None else 1.0)
+        self.min_p = float(min_p or 0.0)
         self.stop = stop
         self.eos = eos
         self.repetition_penalty = float(repetition_penalty or 1.0)
@@ -228,7 +230,7 @@ class BatchScheduler:
         self._offsets = np.zeros((self._bsz,), np.int32)
         self._rows: list[Request | None] = [None] * self._bsz
         self._row_params_dirty = True
-        self._temps = self._topps = self._topks = None
+        self._temps = self._topps = self._topks = self._minps = None
         self._reps = self._press = self._freqs = None
         # occurrence counts [bsz, V] int32 for penalty sampling — allocated
         # lazily on the first penalized admission so the common (bench)
@@ -342,7 +344,8 @@ class BatchScheduler:
 
     # ------------------------------------------------------------ device fns
 
-    def _decode_fn(self, params, cur, cache, offsets, temps, topks, topps, key):
+    def _decode_fn(self, params, cur, cache, offsets, temps, topks, topps,
+                   minps, key):
         """One chunk: decode K tokens for ALL rows. Returns
         (cur', cache', offsets', toks [B, K])."""
         from ..models import core
@@ -355,7 +358,9 @@ class BatchScheduler:
             logits, cache = core.forward(
                 params, e.model_cfg, cur[:, None], cache, off, attn_fn=e._attn_fn()
             )
-            nxt = sample_batched(logits[:, -1, :], key_t, temps, topks, topps)
+            nxt = sample_batched(
+                logits[:, -1, :], key_t, temps, topks, topps, minps
+            )
             return (nxt, cache, off + 1), nxt
 
         keys = jax.random.split(key, e.engine_cfg.decode_chunk)
@@ -364,7 +369,7 @@ class BatchScheduler:
 
     def _decode_pen_fn(
         self, params, cur, cache, offsets, counts,
-        temps, topks, topps, reps, press, freqs, key,
+        temps, topks, topps, minps, reps, press, freqs, key,
     ):
         """Penalty-carrying decode chunk: counts ride the scan carry and
         every sampled token scatters into its row. Compiled only when a
@@ -382,7 +387,7 @@ class BatchScheduler:
                 params, e.model_cfg, cur[:, None], cache, off, attn_fn=e._attn_fn()
             )
             nxt = sample_batched(
-                logits[:, -1, :], key_t, temps, topks, topps,
+                logits[:, -1, :], key_t, temps, topks, topps, minps,
                 counts, reps, press, freqs,
             )
             counts = counts.at[jnp.arange(B), 1, nxt].add(1)
@@ -606,6 +611,8 @@ class BatchScheduler:
                         np.asarray([req.temperature], np.float32),
                         np.asarray([req.top_k], np.int32),
                         np.asarray([req.top_p], np.float32),
+                        (np.asarray([req.min_p], np.float32)
+                         if req.min_p > 0 else None),
                     ]
                     if req.penalized:
                         # prompt occurrences host-side (bincount is O(n+V)
@@ -687,6 +694,9 @@ class BatchScheduler:
             self._temps = np.asarray(temps, np.float32)
             self._topks = np.asarray(topks, np.int32)
             self._topps = np.asarray(topps, np.float32)
+            self._minps = np.asarray(
+                [r.min_p if r else 0.0 for r in self._rows], np.float32
+            )
             self._reps = np.asarray(
                 [r.repetition_penalty if r else 1.0 for r in self._rows],
                 np.float32,
@@ -732,6 +742,13 @@ class BatchScheduler:
         pen = self._counts is not None and any(
             r is not None and r.penalized for r in self._rows
         )
+        # None selects the min_p-free trace: the relative-floor softmax
+        # must cost nothing when no active row asked for it
+        minps = (
+            self._minps
+            if any(r is not None and r.min_p > 0 for r in self._rows)
+            else None
+        )
         with get_tracer().span("engine.decode_window", active=self.active, chunks=W):
             # host mirrors go in as the first call's args; chunks chain on
             # the returned DEVICE arrays; the host mirrors then advance
@@ -744,7 +761,7 @@ class BatchScheduler:
                     cur_d, self._cache, off_d, self._counts, toks = (
                         self._decode_pen(
                             e.params, cur_d, self._cache, off_d, self._counts,
-                            temps, topks, topps,
+                            temps, topks, topps, minps,
                             self._reps, self._press, self._freqs,
                             e._next_key(),
                         )
@@ -752,7 +769,7 @@ class BatchScheduler:
                 else:
                     cur_d, self._cache, off_d, toks = self._decode(
                         e.params, cur_d, self._cache, off_d,
-                        temps, topks, topps, e._next_key(),
+                        temps, topks, topps, minps, e._next_key(),
                     )
                 toks_parts.append(toks)
             parts_host = [np.asarray(x) for x in jax.device_get(toks_parts)]

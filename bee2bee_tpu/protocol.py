@@ -178,6 +178,7 @@ def decode_binary(raw: bytes) -> tuple[dict, dict]:
 SAMPLING_KEYS = (
     "top_k",
     "top_p",
+    "min_p",
     "repetition_penalty",
     "presence_penalty",
     "frequency_penalty",

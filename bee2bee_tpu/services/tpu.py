@@ -84,6 +84,7 @@ class TPUService(BaseService):
             "temperature": float(params.get("temperature", 0.7)),
             "top_k": int(params.get("top_k", 0)),
             "top_p": float(params.get("top_p", 1.0)),
+            "min_p": float(params.get("min_p", 0.0)),
             "repetition_penalty": float(params.get("repetition_penalty", 1.0)),
             "presence_penalty": float(params.get("presence_penalty", 0.0)),
             "frequency_penalty": float(params.get("frequency_penalty", 0.0)),

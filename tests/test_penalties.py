@@ -77,7 +77,8 @@ class TestApplyPenalties:
         counts = jnp.asarray([[[0, 0], [0, 4]]], jnp.int32)
         tok = sample_batched(
             logits, jax.random.key(0), _arr([0.0]), jnp.asarray([0], jnp.int32),
-            _arr([1.0]), counts, _arr([10.0]), _arr([0.0]), _arr([0.0]),
+            _arr([1.0]), counts=counts, repetition=_arr([10.0]),
+            presence=_arr([0.0]), frequency=_arr([0.0]),
         )
         assert int(tok[0]) == 0
 
@@ -151,3 +152,59 @@ class TestEnginePenalties:
     def test_invalid_repetition_penalty_rejected(self, engine):
         with pytest.raises(ValueError, match="repetition_penalty"):
             engine.generate("x", max_new_tokens=4, repetition_penalty=0.0)
+
+
+class TestMinP:
+    def test_min_p_relative_floor(self):
+        # probs ~ [0.64, 0.23, 0.09, 0.03]: min_p=0.2 keeps tokens with
+        # prob >= 0.2 * 0.64 = 0.128 -> only tokens 0 and 1 survive
+        logits = _arr([[2.0, 1.0, 0.0, -1.0]])
+        toks = {
+            int(sample_batched(
+                logits, jax.random.key(s), _arr([1.0]),
+                jnp.asarray([0], jnp.int32), _arr([1.0]), _arr([0.2]),
+            )[0])
+            for s in range(60)
+        }
+        assert toks <= {0, 1}, toks
+        # min_p=0 (off): the tail tokens stay reachable
+        toks_off = {
+            int(sample_batched(
+                logits, jax.random.key(s), _arr([1.0]),
+                jnp.asarray([0], jnp.int32), _arr([1.0]), _arr([0.0]),
+            )[0])
+            for s in range(60)
+        }
+        assert len(toks_off) > 2
+
+    def test_min_p_top_token_always_survives(self):
+        logits = _arr([[5.0, 0.0]])
+        tok = sample_batched(
+            logits, jax.random.key(0), _arr([1.0]),
+            jnp.asarray([0], jnp.int32), _arr([1.0]), _arr([1.0]),
+        )
+        assert int(tok[0]) == 0  # min_p=1: only the argmax remains
+
+    def test_min_p_through_engine(self, engine):
+        # high temperature + min_p=1.0 degrades to greedy: equals the
+        # temperature-0 output (engine-level plumb check)
+        greedy = engine.generate("minp check", max_new_tokens=8, temperature=0.0)
+        pinned = engine.generate(
+            "minp check", max_new_tokens=8, temperature=2.0, min_p=1.0
+        )
+        assert pinned.token_ids == greedy.token_ids
+
+    def test_min_p_out_of_range_rejected(self, engine):
+        with pytest.raises(ValueError, match="min_p"):
+            engine.generate("x", max_new_tokens=4, min_p=1.5)
+        with pytest.raises(ValueError, match="min_p"):
+            engine.generate("x", max_new_tokens=4, min_p=-0.1)
+
+    def test_scalar_sample_min_p_parity(self):
+        # scalar sample() and sample_batched agree on min_p semantics
+        logits = _arr([[2.0, 1.0, 0.0, -1.0]])
+        from bee2bee_tpu.engine.sampling import sample
+        for s_ in range(30):
+            a = int(sample(logits[0][None], jax.random.key(s_),
+                           temperature=1.0, min_p=0.2)[0])
+            assert a in (0, 1)
