@@ -743,12 +743,10 @@ class BatchScheduler:
             r is not None and r.penalized for r in self._rows
         )
         # None selects the min_p-free trace: the relative-floor softmax
-        # must cost nothing when no active row asked for it
-        minps = (
-            self._minps
-            if any(r is not None and r.min_p > 0 for r in self._rows)
-            else None
-        )
+        # must cost nothing when no active row asked for it. Gate on the
+        # SAME array the sampler receives — a row scan could silently
+        # diverge from how _row_sampling_arrays builds _minps
+        minps = self._minps if self._minps.any() else None
         with get_tracer().span("engine.decode_window", active=self.active, chunks=W):
             # host mirrors go in as the first call's args; chunks chain on
             # the returned DEVICE arrays; the host mirrors then advance
