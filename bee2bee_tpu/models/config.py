@@ -36,6 +36,11 @@ class ModelConfig:
     norm_plus_one: bool = False  # gemma checkpoints store rmsnorm as (1 + w)
     # phi/gpt-neox-style switches
     rotary_pct: float = 1.0  # fraction of head_dim that rotates (phi-2: 0.4)
+    rope_style: str = "half"  # "half": rotate (first, second) halves of the
+    # rotary dims as a block (llama/neox/phi); "interleaved": rotate
+    # adjacent pairs (x[2i], x[2i+1]) — gpt-j's rotate_every_two
+    mlp_bias: bool = False  # biases on the MLP matmuls ONLY (gpt-j: fc_in/
+    # fc_out carry biases while the attention projections have none)
     lm_head_bias: bool = False  # untied lm_head carries a bias (phi)
     # sliding-window attention (mistral): each query attends to at most
     # the last `sliding_window` positions. None = full causal. Supported
@@ -60,6 +65,12 @@ class ModelConfig:
     moe_group_size: int = 512
 
     def __post_init__(self):
+        if self.rope_style not in ("half", "interleaved"):
+            # a typo here would silently rotate the wrong way (core._rope
+            # has no else-error) — fail like moe_impl does
+            raise ValueError(
+                f"rope_style={self.rope_style!r} must be 'half' or 'interleaved'"
+            )
         if self.moe_impl not in ("dense", "routed"):
             raise ValueError(
                 f"moe_impl={self.moe_impl!r} must be 'dense' or 'routed'"
@@ -76,6 +87,15 @@ class ModelConfig:
         if self.head_dim_override is not None:
             return self.head_dim_override
         return self.d_model // self.n_heads
+
+    @property
+    def rotary_dim(self) -> int:
+        """Head dims that actually rotate: floor-to-even rotary_pct *
+        head_dim (HF's int() truncation) — THE one formula core._rope and
+        the exporters share."""
+        if self.rotary_pct >= 1.0:
+            return self.head_dim
+        return max(2, int(self.head_dim * self.rotary_pct) // 2 * 2)
 
     @property
     def is_moe(self) -> bool:
@@ -181,6 +201,23 @@ CONFIGS["tiny-phi"] = ModelConfig(  # parallel blocks + partial rotary
     n_kv_heads=4, d_ff=128, max_seq_len=256, activation="gelu",
     norm="layernorm", use_bias=True, tie_embeddings=False,
     rotary_pct=0.4, parallel_block=True, lm_head_bias=True,
+)
+CONFIGS["tiny-gptj"] = ModelConfig(  # interleaved rotary + mlp-only bias
+    name="tiny-gptj", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+    n_kv_heads=4, d_ff=128, max_seq_len=256, activation="gelu",
+    norm="layernorm", tie_embeddings=False, mlp_bias=True,
+    rotary_pct=0.5, rope_style="interleaved", parallel_block=True,
+    lm_head_bias=True,
+)
+CONFIGS["gpt-j-6b"] = ModelConfig(
+    # EleutherAI/gpt-j-6b: parallel block sharing one layernorm,
+    # interleaved rotary over 64 of 256 head dims, bias-free attention
+    # with biased MLP and lm_head
+    name="gpt-j-6b", vocab_size=50400, d_model=4096, n_layers=28,
+    n_heads=16, n_kv_heads=16, d_ff=16384, max_seq_len=2048,
+    activation="gelu", norm="layernorm", tie_embeddings=False,
+    mlp_bias=True, rotary_pct=0.25, rope_style="interleaved",
+    parallel_block=True, lm_head_bias=True,
 )
 CONFIGS["tiny-neox"] = ModelConfig(  # dual-norm parallel residual
     name="tiny-neox", vocab_size=512, d_model=64, n_layers=2, n_heads=4,

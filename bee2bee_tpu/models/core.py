@@ -115,7 +115,7 @@ def init_params(cfg: ModelConfig, key, dtype=jnp.bfloat16) -> Params:
         }
         if gated:
             mlp["w_gate"] = dense((L, D, F))
-        if cfg.use_bias:
+        if cfg.use_bias or cfg.mlp_bias:
             mlp["b_up"] = jnp.zeros((L, F), dtype)
             mlp["b_down"] = jnp.zeros((L, D), dtype)
         layers["mlp"] = mlp
@@ -148,21 +148,33 @@ def _norm(x, p, cfg: ModelConfig):
     return out
 
 
-def _rope(x, positions, theta: float, pct: float = 1.0):
+def _rope(x, positions, theta: float, rot: int | None = None,
+          style: str = "half"):
     """Rotary embedding. x: [B, T, H, hd]; positions: [B, T].
 
-    pct < 1 rotates only the FIRST floor-to-even pct*hd dims (matching
-    HF's int() truncation) and passes the tail through unchanged
-    (phi/gpt-neox partial rotary)."""
+    rot < hd rotates only the FIRST rot dims and passes the tail through
+    unchanged (phi/gpt-neox/gpt-j partial rotary; cfg.rotary_dim is the
+    one place the count is derived). style="half" rotates the (first,
+    second) halves of the rotary block together (llama/neox/phi);
+    "interleaved" rotates adjacent pairs (x[2i], x[2i+1]) — gpt-j's
+    rotate_every_two. Both share the same per-pair frequencies."""
     hd = x.shape[-1]
-    rot = hd if pct >= 1.0 else max(2, int(hd * pct) // 2 * 2)
+    rot = hd if rot is None else rot
     xr, tail = x[..., :rot], x[..., rot:]
     freqs = 1.0 / (theta ** (jnp.arange(0, rot, 2, dtype=jnp.float32) / rot))
     angles = positions[..., None].astype(jnp.float32) * freqs  # [B, T, rot/2]
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]
-    x1, x2 = jnp.split(xr.astype(jnp.float32), 2, axis=-1)
-    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    xf = xr.astype(jnp.float32)
+    if style == "interleaved":
+        x1 = xf[..., 0::2]  # [B, T, H, rot/2]
+        x2 = xf[..., 1::2]
+        r1 = x1 * cos - x2 * sin
+        r2 = x2 * cos + x1 * sin
+        out = jnp.stack([r1, r2], axis=-1).reshape(xf.shape)
+    else:
+        x1, x2 = jnp.split(xf, 2, axis=-1)
+        out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     out = out.astype(x.dtype)
     return out if rot == hd else jnp.concatenate([out, tail], axis=-1)
 
@@ -370,8 +382,8 @@ def transformer_block(
     k = k.reshape(B, T, Hkv, hd)
     v = v.reshape(B, T, Hkv, hd)
     if cfg.pos_embedding == "rope":
-        q = _rope(q, positions, cfg.rope_theta, cfg.rotary_pct)
-        k = _rope(k, positions, cfg.rope_theta, cfg.rotary_pct)
+        q = _rope(q, positions, cfg.rope_theta, cfg.rotary_dim, cfg.rope_style)
+        k = _rope(k, positions, cfg.rope_theta, cfg.rotary_dim, cfg.rope_style)
     if kv_hook is not None:
         k, v = kv_hook(k, v)
     if attn_fn is None:

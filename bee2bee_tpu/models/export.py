@@ -218,6 +218,33 @@ def _export_neox_state(params, cfg: ModelConfig, dtype) -> dict[str, np.ndarray]
     return state
 
 
+def _export_gptj_state(params, cfg: ModelConfig, dtype) -> dict[str, np.ndarray]:
+    """Inverse of loader._convert_gptj."""
+    layers = params["layers"]
+    t = lambda a: _np(a, dtype).T
+    state = {
+        "transformer.wte.weight": _np(params["tok_embed"], dtype),
+        "transformer.ln_f.weight": _np(params["final_norm"]["scale"], dtype),
+        "transformer.ln_f.bias": _np(params["final_norm"]["bias"], dtype),
+        "lm_head.weight": t(params["lm_head"]),
+        "lm_head.bias": _np(params["lm_head_bias"], dtype),
+    }
+    for i in range(cfg.n_layers):
+        p = f"transformer.h.{i}."
+        state[p + "ln_1.weight"] = _np(layers["ln1"]["scale"][i], dtype)
+        state[p + "ln_1.bias"] = _np(layers["ln1"]["bias"][i], dtype)
+        a = layers["attn"]
+        for ours, hf in (("wq", "q_proj"), ("wk", "k_proj"),
+                         ("wv", "v_proj"), ("wo", "out_proj")):
+            state[p + f"attn.{hf}.weight"] = t(a[ours][i])
+        m = layers["mlp"]
+        state[p + "mlp.fc_in.weight"] = t(m["w_up"][i])
+        state[p + "mlp.fc_in.bias"] = _np(m["b_up"][i], dtype)
+        state[p + "mlp.fc_out.weight"] = t(m["w_down"][i])
+        state[p + "mlp.fc_out.bias"] = _np(m["b_down"][i], dtype)
+    return state
+
+
 def hf_config_dict(cfg: ModelConfig, qkv_bias: bool | None = None) -> dict:
     """A transformers-compatible config.json for the exported checkpoint.
 
@@ -237,6 +264,30 @@ def hf_config_dict(cfg: ModelConfig, qkv_bias: bool | None = None) -> dict:
             "n_inner": cfg.d_ff,
             "layer_norm_epsilon": cfg.norm_eps,
             "tie_word_embeddings": True,
+        }
+    if cfg.parallel_block and cfg.rope_style == "interleaved":  # gpt-j
+        if cfg.rope_theta != 10000.0 or cfg.activation != "gelu":
+            # HF's GPTJ hardcodes rotary base 10000 and gelu_new: a
+            # checkpoint exported from an overridden config would load
+            # in transformers WITHOUT warning and silently diverge
+            raise ValueError(
+                f"gpt-j export requires rope_theta=10000/activation='gelu' "
+                f"(transformers hardcodes them); got theta={cfg.rope_theta}, "
+                f"activation={cfg.activation!r}"
+            )
+        return {
+            "model_type": "gptj",
+            "architectures": ["GPTJForCausalLM"],
+            "vocab_size": cfg.vocab_size,
+            "n_embd": cfg.d_model,
+            "n_layer": cfg.n_layers,
+            "n_head": cfg.n_heads,
+            "n_inner": cfg.d_ff,
+            "n_positions": cfg.max_seq_len,
+            "rotary_dim": cfg.rotary_dim,
+            "layer_norm_epsilon": cfg.norm_eps,
+            "tie_word_embeddings": False,
+            "activation_function": "gelu_new",
         }
     if cfg.parallel_block and cfg.parallel_norms == 2:  # gpt-neox family
         return {
@@ -320,6 +371,11 @@ def export_hf(params, cfg: ModelConfig, out_dir: str | Path,
     np_dtype = np.dtype(dtype) if dtype != "bfloat16" else _bf16_dtype()
     if cfg.pos_embedding == "learned":
         state = _export_gpt2_state(params, cfg, np_dtype)
+    elif cfg.parallel_block and cfg.rope_style == "interleaved":
+        # SAME ordering as hf_config_dict: the two dispatch chains must
+        # classify a config identically or the config.json and tensor
+        # names would describe different families
+        state = _export_gptj_state(params, cfg, np_dtype)
     elif cfg.parallel_block and cfg.parallel_norms == 2:
         state = _export_neox_state(params, cfg, np_dtype)
     elif cfg.parallel_block:

@@ -156,6 +156,41 @@ def _convert_phi(state, cfg: ModelConfig) -> dict:
     }
 
 
+def _convert_gptj(state, cfg: ModelConfig) -> dict:
+    """HF GPT-J names → our layout (transformer.h.N.{ln_1, attn.{q,k,v,
+    out}_proj bias-free, mlp.{fc_in,fc_out} biased}, untied lm_head WITH
+    bias). HF linear is [out, in] → ours [in, out]."""
+    pre = "transformer." if any(k.startswith("transformer.") for k in state) else ""
+    g = lambda k: state[pre + k]
+    t = lambda a: np.ascontiguousarray(a.T)
+    L = cfg.n_layers
+    layers = {
+        "ln1": {
+            "scale": _stack([g(f"h.{i}.ln_1.weight") for i in range(L)]),
+            "bias": _stack([g(f"h.{i}.ln_1.bias") for i in range(L)]),
+        },
+        "attn": {
+            "wq": _stack([t(g(f"h.{i}.attn.q_proj.weight")) for i in range(L)]),
+            "wk": _stack([t(g(f"h.{i}.attn.k_proj.weight")) for i in range(L)]),
+            "wv": _stack([t(g(f"h.{i}.attn.v_proj.weight")) for i in range(L)]),
+            "wo": _stack([t(g(f"h.{i}.attn.out_proj.weight")) for i in range(L)]),
+        },
+        "mlp": {
+            "w_up": _stack([t(g(f"h.{i}.mlp.fc_in.weight")) for i in range(L)]),
+            "b_up": _stack([g(f"h.{i}.mlp.fc_in.bias") for i in range(L)]),
+            "w_down": _stack([t(g(f"h.{i}.mlp.fc_out.weight")) for i in range(L)]),
+            "b_down": _stack([g(f"h.{i}.mlp.fc_out.bias") for i in range(L)]),
+        },
+    }
+    return {
+        "tok_embed": g("wte.weight"),
+        "layers": layers,
+        "final_norm": {"scale": g("ln_f.weight"), "bias": g("ln_f.bias")},
+        "lm_head": t(state["lm_head.weight"]),
+        "lm_head_bias": state["lm_head.bias"],
+    }
+
+
 def _convert_neox(state, cfg: ModelConfig) -> dict:
     """HF GPT-NeoX/Pythia names → our layout. The fused query_key_value
     weight is [3*D, D] with rows ordered HEAD-MAJOR and q/k/v INTERLEAVED
@@ -306,6 +341,8 @@ def load_checkpoint(
         params = _convert_phi(state, cfg)
     elif any(".attention.query_key_value." in k for k in state):
         params = _convert_neox(state, cfg)
+    elif any(".mlp.fc_in." in k for k in state):  # gpt-j's unique mlp names
+        params = _convert_gptj(state, cfg)
     else:
         params = _convert_llama(state, cfg)
     return _materialize(params, dtype, host)

@@ -52,7 +52,7 @@ def test_write_safetensors_roundtrip_dtypes(tmp_path):
 @pytest.mark.parametrize(
     "name",
     ["tiny-gpt2", "tiny-llama", "tiny-mixtral", "tiny-gemma", "tiny-qwen",
-     "tiny-phi", "tiny-neox"],
+     "tiny-phi", "tiny-neox", "tiny-gptj"],
 )
 def test_export_hf_roundtrips_through_loader(tmp_path, name):
     """export_hf must be the exact inverse of the loader's HF conversion
@@ -196,3 +196,50 @@ def test_torch_loads_neox_export_and_logits_match(tmp_path):
     np.testing.assert_allclose(
         np.asarray(ours, np.float32), theirs, atol=2e-4, rtol=1e-3
     )
+
+
+def test_torch_loads_gptj_export_and_logits_match(tmp_path):
+    """gpt-j family conformance: GPTJForCausalLM.from_pretrained(our
+    export) matches our forward — exercises the INTERLEAVED rotary
+    (rotate_every_two over the first rotary_dim head dims), the shared-
+    norm parallel block with bias-free attention, and the biased MLP +
+    lm_head."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    if not hasattr(transformers, "GPTJForCausalLM"):
+        pytest.skip("transformers too old for gpt-j")
+
+    cfg = get_config("tiny-gptj")
+    params = core.init_params(cfg, jax.random.key(13), dtype=jnp.float32)
+    out = export_hf(params, cfg, tmp_path / "hf_gptj", dtype="float32")
+
+    model = transformers.GPTJForCausalLM.from_pretrained(out)
+    model.eval()
+    ids = np.array([[1, 7, 42, 99, 3, 250, 8, 11]], np.int32)
+    ours, _ = core.forward(params, cfg, jnp.asarray(ids), None, jnp.int32(0))
+    with torch.no_grad():
+        theirs = model(torch.from_numpy(ids.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(
+        np.asarray(ours, np.float32), theirs, atol=2e-4, rtol=1e-3
+    )
+
+
+def test_gptj_export_rejects_unexportable_overrides():
+    """transformers hardcodes GPT-J's rotary base and activation: a
+    checkpoint exported from an overridden config would silently diverge
+    after from_pretrained — reject at export."""
+    cfg = get_config("tiny-gptj", rope_theta=500000.0)
+    params = core.init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        with pytest.raises(ValueError, match="rope_theta"):
+            export_hf(params, cfg, d + "/x", dtype="float32")
+
+
+def test_rope_style_validated():
+    import pytest as _p
+    from bee2bee_tpu.models.config import ModelConfig
+    with _p.raises(ValueError, match="rope_style"):
+        ModelConfig(name="x", vocab_size=8, d_model=8, n_layers=1,
+                    n_heads=2, n_kv_heads=2, d_ff=16, max_seq_len=32,
+                    rope_style="interleave")
