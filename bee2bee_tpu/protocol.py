@@ -182,6 +182,9 @@ SAMPLING_KEYS = (
     "repetition_penalty",
     "presence_penalty",
     "frequency_penalty",
+    # not a sampler knob, but a generation param with the same contract:
+    # OpenAI `stop` strings (str or list), consumed at the service layer
+    "stop",
 )
 
 

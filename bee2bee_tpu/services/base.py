@@ -89,25 +89,67 @@ STOP_MARKERS = ("\nuser:", "\nassistant:", "\nsystem:", "user:", "assistant:")
 STOP_HOLDBACK = max(len(m) for m in STOP_MARKERS) - 1
 
 
-def scrub_stop_words(text: str) -> str:
-    """Cut generation at a role-marker the model hallucinated (the
-    reference's stop-word scan, hf.py:111-136)."""
+def normalize_stops(stop) -> tuple:
+    """A request's `stop` param (OpenAI: string or list of strings) →
+    tuple of non-empty strings, capped at 4 like OpenAI. Malformed values
+    (ints, dicts, ...) normalize to () — a bad param must not crash the
+    request after the compute is spent."""
+    if not stop:
+        return ()
+    if isinstance(stop, str):
+        stop = [stop]
+    if not isinstance(stop, (list, tuple)):
+        return ()
+    return tuple(s for s in stop if isinstance(s, str) and s)[:4]
+
+
+def role_cut(text: str) -> int:
+    """Cut position for hallucinated role markers (idx > 0 rule: a reply
+    that IS a role line isn't deleted whole — reference hf.py:111-136)."""
     cut = len(text)
     for marker in STOP_MARKERS:
         idx = text.find(marker)
         if idx > 0:
             cut = min(cut, idx)
+    return cut
+
+
+def stop_cut(text: str, stops: tuple) -> int | None:
+    """Earliest caller-stop position (OpenAI semantics: ANY position,
+    including 0), or None when no stop matches."""
+    best = None
+    for stop in stops:
+        idx = text.find(stop)
+        if idx >= 0 and (best is None or idx < best):
+            best = idx
+    return best
+
+
+def scrub_stop_words(text: str, stops: tuple = ()) -> str:
+    """Cut generation at a role-marker or caller stop string, whichever
+    comes first (role_cut / stop_cut hold the two rules)."""
+    cut = role_cut(text)
+    sc = stop_cut(text, stops)
+    if sc is not None:
+        cut = min(cut, sc)
     return text[:cut]
 
 
-def scrub_stream_delta(acc_text: str, emitted: int) -> tuple[str, int, bool]:
+def stop_holdback(stops: tuple = ()) -> int:
+    return max([STOP_HOLDBACK] + [len(s) - 1 for s in stops])
+
+
+def scrub_stream_delta(
+    acc_text: str, emitted: int, stops: tuple = ()
+) -> tuple[str, int, bool]:
     """Streaming stop-scrub step over CUMULATIVE text: returns
-    (delta_to_emit, new_emitted, marker_hit). Holds back STOP_HOLDBACK
-    chars so a marker split across chunk boundaries never leaks its
-    prefix — the streamed bytes must equal what execute()'s full-text
-    scrub produces. Shared by every streaming backend (tpu / pipeline)."""
-    scrubbed = scrub_stop_words(acc_text)
+    (delta_to_emit, new_emitted, marker_hit). Holds back enough chars
+    that a marker or stop string split across chunk boundaries never
+    leaks its prefix — the streamed bytes must equal what execute()'s
+    full-text scrub produces. Shared by every streaming backend
+    (tpu / pipeline)."""
+    scrubbed = scrub_stop_words(acc_text, stops)
     if len(scrubbed) < len(acc_text):  # a marker completed: flush & stop
         return scrubbed[emitted:], len(scrubbed), True
-    safe = max(emitted, len(scrubbed) - STOP_HOLDBACK)
+    safe = max(emitted, len(scrubbed) - stop_holdback(stops))
     return scrubbed[emitted:safe], safe, False

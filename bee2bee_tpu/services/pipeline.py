@@ -27,6 +27,7 @@ from .base import (
     BaseService,
     ServiceError,
     parse_transcript,
+    normalize_stops,
     scrub_stop_words,
     scrub_stream_delta,
 )
@@ -109,7 +110,9 @@ class PipelineService(BaseService):
             out_ids = self._run(self.session.generate(ids, **kw))
         except Exception as e:  # noqa: BLE001 — surface as a service error
             raise ServiceError(f"pipeline generation failed: {e}") from e
-        text = scrub_stop_words(self.tokenizer.decode(out_ids))
+        text = scrub_stop_words(
+            self.tokenizer.decode(out_ids), normalize_stops(params.get("stop"))
+        )
         return self.result_dict(text, len(out_ids), t0, self.price_per_token)
 
     async def execute_async(self, params: dict[str, Any]) -> dict[str, Any]:
@@ -125,11 +128,14 @@ class PipelineService(BaseService):
             )
         except Exception as e:  # noqa: BLE001 — surface as a service error
             raise ServiceError(f"pipeline generation failed: {e}") from e
-        text = scrub_stop_words(self.tokenizer.decode(out_ids))
+        text = scrub_stop_words(
+            self.tokenizer.decode(out_ids), normalize_stops(params.get("stop"))
+        )
         return self.result_dict(text, len(out_ids), t0, self.price_per_token)
 
     async def execute_stream_async(self, params: dict[str, Any]):
         """Async-generator twin of execute_stream for loop-native callers."""
+        stops = normalize_stops(params.get("stop"))
         ids, kw = self._gen_args(params)
         q: asyncio.Queue = asyncio.Queue()
         DONE = object()
@@ -168,7 +174,7 @@ class PipelineService(BaseService):
                     return
                 out_ids.append(item)
                 acc = self.tokenizer.decode(out_ids).rstrip("�")
-                delta, emitted, hit = scrub_stream_delta(acc, emitted)
+                delta, emitted, hit = scrub_stream_delta(acc, emitted, stops)
                 if delta:
                     yield self.stream_line({"text": delta})
                 if hit:
@@ -176,7 +182,7 @@ class PipelineService(BaseService):
         finally:
             if not producer.done():
                 producer.cancel()  # release the row on early exit
-        tail = scrub_stop_words(self.tokenizer.decode(out_ids))
+        tail = scrub_stop_words(self.tokenizer.decode(out_ids), stops)
         if tail[emitted:]:
             yield self.stream_line({"text": tail[emitted:]})
         yield self.stream_line({

@@ -11,9 +11,12 @@ from typing import Any, Iterator
 from .base import (
     BaseService,
     ServiceError,
+    normalize_stops,
     parse_transcript,
+    role_cut,
     scrub_stop_words,
     scrub_stream_delta,
+    stop_cut,
 )
 
 
@@ -94,6 +97,13 @@ class TPUService(BaseService):
         if self.engine is None:
             raise ServiceError("Model not loaded")
         t0 = time.time()
+        stops = normalize_stops(params.get("stop"))
+        if stops:
+            # route through the streaming path: the engine early-exits at
+            # the stop hit (generate_stream's close releases the row), so
+            # a 2048-budget request stopping at token 10 neither computes
+            # nor BILLS the ~2038 discarded tokens (OpenAI semantics)
+            return self._execute_with_stops(params, stops, t0)
         args = self._gen_args(params)
         result = self.engine.generate(**args)
         text = scrub_stop_words(result.text)
@@ -104,9 +114,40 @@ class TPUService(BaseService):
         out["prompt_tokens"] = result.prompt_tokens  # /v1 usage accounting
         return out
 
+    def _execute_with_stops(self, params: dict, stops: tuple, t0: float) -> dict:
+        args = self._gen_args(params)
+        acc, n_seen, hit, result = "", 0, False, None
+        gen = self.engine.generate_stream(**args)
+        try:
+            for ev in gen:
+                if ev.get("done"):
+                    result = ev.get("result")
+                    break
+                acc += ev.get("text", "")
+                n_seen += len(ev.get("tokens") or ([1] if ev.get("token") else []))
+                if stop_cut(acc, stops) is not None:
+                    hit = True  # closing the generator cancels the row
+                    break
+        finally:
+            gen.close()
+        rc, sc = role_cut(acc), stop_cut(acc, stops)
+        text = acc[:rc if sc is None else min(rc, sc)]
+        n_tokens = result.new_tokens if result is not None else n_seen
+        out = self.result_dict(text, n_tokens, t0, self.price_per_token)
+        out["finish_reason"] = (
+            "stop" if hit or (sc is not None and sc <= rc)
+            else (result.finish_reason if result else "stop")
+        )
+        if result is not None:
+            out["tokens_per_sec"] = result.tokens_per_sec
+            out["ttft_ms"] = int(result.ttft_s * 1000)
+            out["prompt_tokens"] = result.prompt_tokens
+        return out
+
     def execute_stream(self, params: dict[str, Any]) -> Iterator[str]:
         if self.engine is None:
             raise ServiceError("Model not loaded")
+        stops = normalize_stops(params.get("stop"))
         args = self._gen_args(params)
         try:
             # scrub_stream_delta holds back chars so a stop marker split
@@ -115,20 +156,24 @@ class TPUService(BaseService):
             acc = ""  # full raw accumulation
             emitted = 0  # chars of scrub(acc) already yielded
             n_new = None  # real token count, when the engine reports it
+            n_seen = 0  # tokens streamed so far (the billable count on a
+            # stop hit — the engine's own total never arrives then)
             for ev in self.engine.generate_stream(**args):
                 if ev.get("done"):  # flush the held-back tail
                     res = ev.get("result")
                     if res is not None:
                         n_new = res.new_tokens
-                    tail = scrub_stop_words(acc)
+                    tail = scrub_stop_words(acc, stops)
                     if tail[emitted:]:
                         yield self.stream_line({"text": tail[emitted:]})
                     break
                 acc += ev.get("text", "")
-                delta, emitted, hit = scrub_stream_delta(acc, emitted)
+                n_seen += len(ev.get("tokens") or ([1] if ev.get("token") else []))
+                delta, emitted, hit = scrub_stream_delta(acc, emitted, stops)
                 if delta:
                     yield self.stream_line({"text": delta})
                 if hit:
+                    n_new = n_seen
                     break
             # the done line carries the node's REAL accounting so mesh
             # peers / the web gateway don't fall back to len/4 estimates

@@ -1,0 +1,164 @@
+"""Per-request OpenAI `stop` strings: scrubber semantics, service-layer
+cut + finish_reason, streaming holdback, and the hop passthrough."""
+
+import json
+import types
+
+import pytest
+
+from bee2bee_tpu.services.base import (
+    normalize_stops,
+    scrub_stop_words,
+    scrub_stream_delta,
+)
+from bee2bee_tpu.services.tpu import TPUService
+
+
+class TestScrubbers:
+    def test_normalize(self):
+        assert normalize_stops(None) == ()
+        assert normalize_stops("END") == ("END",)
+        assert normalize_stops(["a", "", None, "b"]) == ("a", "b")
+        assert len(normalize_stops(["1", "2", "3", "4", "5"])) == 4  # OpenAI cap
+
+    def test_stop_string_cuts_at_any_position(self):
+        assert scrub_stop_words("ENDtail", ("END",)) == ""
+        assert scrub_stop_words("abcENDtail", ("END",)) == "abc"
+        # role markers keep their idx > 0 rule
+        assert scrub_stop_words("user: hi", ()) == "user: hi"
+
+    def test_earliest_cut_wins(self):
+        assert scrub_stop_words("a STOP b END c", ("END", "STOP")) == "a "
+
+    def test_stream_holdback_covers_long_stops(self):
+        """A stop string split across chunks must never leak its prefix:
+        streamed bytes == execute()'s full-text scrub."""
+        stops = ("LONGSTOPMARK",)
+        full = "hello worldLONGSTOPMARK rest"
+        out, emitted = "", 0
+        # feed in adversarial 3-char chunks
+        for i in range(0, len(full), 3):
+            acc = full[: i + 3]
+            delta, emitted, hit = scrub_stream_delta(acc, emitted, stops)
+            out += delta
+            if hit:
+                break
+        assert out == scrub_stop_words(full, stops) == "hello world"
+
+
+class _StubEngine:
+    """Engine double with known text (the real engine's output is random
+    bytes — stop-string behavior needs readable text)."""
+
+    def __init__(self, text="alpha STOP beta"):
+        self.text = text
+
+    def generate(self, **kw):
+        return types.SimpleNamespace(
+            text=self.text, new_tokens=5, tokens_per_sec=1.0, ttft_s=0.01,
+            finish_reason="length", prompt_tokens=3,
+        )
+
+    def generate_stream(self, **kw):
+        for i in range(0, len(self.text), 4):
+            yield {"text": self.text[i:i + 4]}
+        yield {"done": True, "result": types.SimpleNamespace(new_tokens=5)}
+
+
+class TestServiceStops:
+    def test_execute_cuts_and_reports_stop(self):
+        svc = TPUService("m", engine=_StubEngine())
+        out = svc.execute({"prompt": "p", "stop": "STOP"})
+        assert out["text"] == "alpha "
+        assert out["finish_reason"] == "stop"
+        # without the stop param the text is untouched
+        out2 = svc.execute({"prompt": "p"})
+        assert out2["text"] == "alpha STOP beta"
+        assert out2["finish_reason"] == "length"
+
+    def test_stream_cuts_identically(self):
+        svc = TPUService("m", engine=_StubEngine())
+        lines = [json.loads(l) for l in svc.execute_stream(
+            {"prompt": "p", "stop": ["STOP"]}
+        )]
+        text = "".join(l.get("text", "") for l in lines)
+        assert text == "alpha "
+        assert lines[-1]["done"] is True
+
+
+async def test_stop_rides_the_mesh_hops():
+    """`stop` travels like the sampling knobs (SAMPLING_KEYS member)."""
+    from bee2bee_tpu.services.fake import FakeService
+    from tests.test_meshnet import _settle, mesh
+
+    async with mesh(2) as (a, b):
+        remote = FakeService("peer-m", reply="ok")
+        b.add_service(remote)
+        await a.connect_bootstrap(b.addr)
+        assert await _settle(lambda: a.providers)
+        await a.request_generation(
+            next(iter(a.peers)), "q", model="peer-m", extra={"stop": ["END"]}
+        )
+        assert remote.calls[-1]["stop"] == ["END"]
+
+
+class TestStopFixes:
+    def test_malformed_stop_does_not_crash(self):
+        assert normalize_stops(42) == ()
+        assert normalize_stops({"a": 1}) == ()
+        svc = TPUService("m", engine=_StubEngine())
+        out = svc.execute({"prompt": "p", "stop": 42})
+        assert out["text"] == "alpha STOP beta"  # treated as no stops
+
+    def test_stream_stop_hit_still_bills_tokens(self):
+        """The done line must carry tokens/cost on a stop hit (the engine's
+        own total never arrives after the early break)."""
+        svc = TPUService("m", price_per_token=0.5, engine=_StubEngineTokens())
+        lines = [json.loads(l) for l in svc.execute_stream(
+            {"prompt": "p", "stop": ["STOP"]}
+        )]
+        done = lines[-1]
+        assert done["done"] is True
+        assert done["tokens"] > 0
+        assert done["cost"] == 0.5 * done["tokens"]
+
+    def test_nonstream_stop_terminates_early_and_bills_cut(self):
+        """Stop-ful execute() rides the streaming path: generation halts at
+        the hit and bills only the consumed tokens, not the budget."""
+        eng = _StubEngineTokens()
+        svc = TPUService("m", price_per_token=1.0, engine=eng)
+        out = svc.execute({"prompt": "p", "stop": "STOP", "max_new_tokens": 2048})
+        assert out["text"] == "alpha "
+        assert out["finish_reason"] == "stop"
+        assert out["tokens"] < len(eng.text)  # not the full budget
+        assert eng.closed  # the generator (and so the engine row) released
+
+    def test_stop_tied_with_role_marker_reports_stop(self):
+        text = "x\nuser: rest"
+        rc, sc = role_cut(text), stop_cut(text, ("\nuser:",))
+        assert rc == sc == 1  # tie
+        eng = _StubEngine(text)
+        svc = TPUService("m", engine=eng)
+        out = svc.execute({"prompt": "p", "stop": "\nuser:"})
+        assert out["finish_reason"] == "stop"
+
+
+from bee2bee_tpu.services.base import role_cut, stop_cut  # noqa: E402
+
+
+class _StubEngineTokens(_StubEngine):
+    """Stream variant with per-event token lists and close tracking."""
+
+    def __init__(self, text="alpha STOP beta"):
+        super().__init__(text)
+        self.closed = False
+
+    def generate_stream(self, **kw):
+        try:
+            for i in range(0, len(self.text), 4):
+                yield {"text": self.text[i:i + 4], "tokens": [1]}
+            yield {"done": True, "result": types.SimpleNamespace(
+                new_tokens=len(self.text) // 4 + 1, tokens_per_sec=1.0,
+                ttft_s=0.01, finish_reason="length", prompt_tokens=3)}
+        finally:
+            self.closed = True
