@@ -1,0 +1,41 @@
+#!/bin/bash
+# Probe the tunneled chip's COMPILE path (a lease can hand out a device
+# whose first compile then hangs/fails — docs/PERF.md "Known environment
+# hazard"); when healthy, run the outstanding measurement phases.
+#
+# Usage: scripts/chip_watch.sh [probe_count] [phases]
+#   nohup scripts/chip_watch.sh 90 distil_flash,gemma,flash_long &
+#
+# Logs to /tmp/tpu_watch.log; measurement report lands in
+# /tmp/tpu_measurements2.json (incremental — partial phases survive).
+set -u
+N=${1:-90}
+PHASES=${2:-distil_flash,gemma,flash_long}
+cd "$(dirname "$0")/.."
+for i in $(seq 1 "$N"); do
+  if timeout 120 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256))
+jax.jit(lambda a: a @ a)(x).block_until_ready()
+print('probe ok', jax.devices()[0].platform)
+" > /tmp/tpu_probe.log 2>&1; then
+    echo "$(date -u +%H:%M:%S) probe ok on attempt $i; running phases" >> /tmp/tpu_watch.log
+    python scripts/tpu_measurements.py --phases "$PHASES" \
+      --out /tmp/tpu_measurements2.json >> /tmp/tpu_meas2.log 2>&1
+    echo "$(date -u +%H:%M:%S) phases exit rc=$?" >> /tmp/tpu_watch.log
+    if python - <<'EOF'
+import json, sys
+d = json.load(open("/tmp/tpu_measurements2.json"))
+sys.exit(0 if d["phases"].get("gemma_decode_chunk_sweep", {}).get("ok") else 1)
+EOF
+    then
+      echo "$(date -u +%H:%M:%S) gemma phase ok — done" >> /tmp/tpu_watch.log
+      exit 0
+    fi
+  else
+    echo "$(date -u +%H:%M:%S) probe $i failed" >> /tmp/tpu_watch.log
+  fi
+  sleep 300
+done
+echo "$(date -u +%H:%M:%S) gave up after $N probes" >> /tmp/tpu_watch.log
+exit 1
