@@ -128,7 +128,10 @@ def cli():
 
 
 @cli.command("serve-tpu")
-@click.option("--model", default="distilgpt2", help="model name or config key")
+@click.option("--model", default="distilgpt2",
+              help="model name or config key; 'auto' derives the "
+                   "architecture from --checkpoint's config.json (serves "
+                   "checkpoints with no registry entry)")
 @click.option("--checkpoint", default=None, help="local checkpoint dir (HF or native)")
 @click.option("--lora", default=None, type=click.Path(exists=True),
               help="LoRA adapters .npz to merge over the base (bee2bee-tpu "

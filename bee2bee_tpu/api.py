@@ -11,6 +11,7 @@ aiohttp (fastapi/uvicorn are not in this image).
 
 from __future__ import annotations
 
+import hmac
 import json
 import logging
 import os
@@ -53,12 +54,18 @@ def _int_param(body: dict, keys: tuple[str, ...], default: int) -> int:
 
 def _auth_ok(request: web.Request, api_key: str | None) -> bool:
     if api_key:
-        if request.headers.get("X-API-KEY") == api_key:
+        # constant-time comparisons: == leaks matching-prefix length via
+        # timing on the SDK-facing /v1 surface. Compare utf-8 bytes —
+        # compare_digest raises TypeError on non-ASCII str input, which
+        # would turn a bad header into a 500 instead of a 401
+        enc = lambda s: s.encode("utf-8", "surrogateescape")
+        if hmac.compare_digest(enc(request.headers.get("X-API-KEY", "")),
+                               enc(api_key)):
             return True
         # standard OpenAI SDKs send the key as a Bearer token — the /v1
         # surface is useless off-loopback without accepting it
         auth = request.headers.get("Authorization", "")
-        return auth == f"Bearer {api_key}"
+        return hmac.compare_digest(enc(auth), enc(f"Bearer {api_key}"))
     # no key configured: loopback only (safer than the reference's open
     # default, per SURVEY §7 "what NOT to carry over")
     peer = request.remote or ""
@@ -406,7 +413,12 @@ def _make_frame(sse):
         try:
             obj = json.loads(line)
         except ValueError:
-            return b""
+            obj = None
+        if not isinstance(obj, dict):
+            # a custom service streaming plain-text (or scalar-JSON) lines
+            # must not lose output on /v1 — forward the raw line as a
+            # delta chunk
+            obj = {"text": line}
         if obj.get("status") == "error" or obj.get("error"):
             err = {"error": {"message": obj.get("message") or obj.get("error")
                              or "generation failed", "type": "server_error"}}

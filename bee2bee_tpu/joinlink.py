@@ -2,8 +2,11 @@
 
 Capability parity with reference p2p.py (/root/reference/bee2bee/p2p.py:8-52):
 `coithub.org://join?...`-style links with URL-safe-base64 bootstrap addrs,
-sha256 helper, chunking and bitfield helpers. Scheme renamed to
-`bee2bee-tpu://join` but the query keys match so links remain parseable.
+sha256 helper, chunking and bitfield helpers. Links use the
+`bee2bee-tpu://join?node=...&addrs=...` schema natively; the parser ALSO
+accepts a verbatim reference-generated link (`network`/`model`/`hash` plus
+repeated `bootstrap=<b64>` keys, reference p2p.py:8-15) so a node can join
+a swarm advertised by either implementation.
 """
 
 from __future__ import annotations
@@ -36,19 +39,37 @@ def generate_join_link(node_id: str, bootstrap_addrs: list[str], name: str | Non
 
 
 def parse_join_link(link: str) -> dict:
-    """Decode a join link → {node_id, bootstrap_addrs, name}
-    (reference p2p.py:18-36). Tolerates the reference's scheme too."""
+    """Decode a join link → {node_id, bootstrap_addrs, name, ...}
+    (reference p2p.py:18-36).
+
+    Accepts both dialects:
+    - native:    bee2bee-tpu://join?node=ID&addrs=<b64>,<b64>[&name=N]
+    - reference: coithub.org://join?network=NET&model=M&hash=H
+                 &bootstrap=<b64>&bootstrap=<b64>   (repeated keys,
+                 reference p2p.py:8-15; scheme may also be `coithub`)
+    Reference-dialect links surface their extra fields as `network`,
+    `model`, `hash` so callers can route/verify; `node_id` falls back to
+    the network name.
+    """
     parsed = urlparse(link)
-    if parsed.scheme not in (SCHEME, "coithub.org", "https", "http"):
+    if parsed.scheme not in (SCHEME, "coithub", "coithub.org", "https", "http"):
         raise ValueError(f"unrecognized join link scheme: {parsed.scheme!r}")
     qs = parse_qs(parsed.query)  # parse_qs already percent-decodes
-    node = qs.get("node", [""])[0]
-    raw_addrs = qs.get("addrs", [""])[0]
-    addrs = [_b64d(a) for a in raw_addrs.split(",") if a]
+    out: dict = {}
+    if "bootstrap" in qs:  # reference dialect: one b64 addr per repeated key
+        addrs = [_b64d(b) for b in qs["bootstrap"] if b]
+        out["network"] = qs.get("network", [""])[0] or None
+        out["model"] = qs.get("model", [""])[0] or None
+        out["hash"] = qs.get("hash", [""])[0] or None
+        node = qs.get("node", [""])[0] or out["network"] or ""
+    else:
+        raw_addrs = qs.get("addrs", [""])[0]
+        addrs = [_b64d(a) for a in raw_addrs.split(",") if a]
+        node = qs.get("node", [""])[0]
     name = qs.get("name", [""])[0] or None
     if not addrs:
         raise ValueError("join link has no bootstrap addresses")
-    return {"node_id": node, "bootstrap_addrs": addrs, "name": name}
+    return {"node_id": node, "bootstrap_addrs": addrs, "name": name, **out}
 
 
 def chunk_bytes(data: bytes, size: int) -> list[bytes]:

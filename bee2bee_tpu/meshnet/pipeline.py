@@ -278,17 +278,36 @@ class StageTaskMixin:
             await self._send(ws, protocol.encode_binary(msg, {"dx": dx}))
 
     _RING_FIELDS = ("model", "request_id", "offset", "k", "eos", "gather",
-                    "origin_peer", "origin_task_id")
+                    "origin_peer", "origin_task_id", "temperature", "seed")
     BURST_STALE_S = 600.0
 
+    @staticmethod
+    def _ring_sample(logits: np.ndarray, data: dict) -> int:
+        """Last-stage sampling for ring bursts. Greedy is plain argmax;
+        temperature>0 draws from the softmax with an rng keyed on
+        (coordinator seed, token position) — the position makes each
+        draw's stream unique while keeping the whole rollout reproducible
+        from the seed, independent of burst size (same semantics as
+        PipelineCoordinator._sample, just computed where the logits are)."""
+        temp = float(data.get("temperature") or 0.0)
+        if temp <= 0.0:
+            return int(np.argmax(logits))
+        pos = int(np.asarray(data["offset"]).reshape(-1)[0])
+        rng = np.random.default_rng((int(data.get("seed") or 0), pos))
+        z = logits.astype(np.float64) / max(temp, 1e-6)
+        z -= z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(rng.choice(len(p), p=p))
+
     async def _task_decode_run(self, ws, data):
-        """Ring-burst greedy decode (kind=decode_run): the coordinator
-        sends ONE message for up to k tokens. Each token circulates
-        stage0→…→last; the LAST stage samples (greedy — argmax needs no
-        rng state) and feeds the new token straight back to stage 0 over
-        the ring link, accumulating the burst locally; the coordinator
-        hears back once per burst, not once per token. Non-greedy
-        requests use the per-token chain instead (coordinator gates)."""
+        """Ring-burst decode (kind=decode_run): the coordinator sends ONE
+        message for up to k tokens. Each token circulates stage0→…→last;
+        the LAST stage samples (argmax, or a seeded softmax draw when the
+        request carries temperature>0) and feeds the new token straight
+        back to stage 0 over the ring link, accumulating the burst
+        locally; the coordinator hears back once per burst, not once per
+        token."""
         runner = self.stage_runners.get(data.get("model"))
         if runner is None:
             raise RuntimeError(f"no stage loaded for model {data.get('model')!r}")
@@ -315,7 +334,7 @@ class StageTaskMixin:
             ))
             return
         # ---- last stage: sample, accumulate, circulate or answer ----
-        tok = int(np.argmax(out[0]))
+        tok = self._ring_sample(out[0], data)
         otid = data["origin_task_id"]
         now = time.time()
         for stale in [k for k, v in self.stage_bursts.items()
@@ -547,13 +566,17 @@ class PipelineCoordinator:
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :n] = prompt_ids
         out: list[int] = []
-        greedy = temperature is None or temperature <= 0.0
         try:
             logits = await self._chain(rid, padded, offset=0)
             tok = self._sample(logits[0, n - 1], temperature, rng)
-            if self.ring_ok and greedy and max_new_tokens > 1:
+            if self.ring_ok and max_new_tokens > 1:
+                # sampled requests ride the burst path too: the LAST stage
+                # draws with an rng keyed on (seed, position), so K tokens
+                # still cost one coordinator round trip (r4 was greedy-only)
                 return await self._generate_ring(
-                    rid, tok, n, max_new_tokens, eos_token_id, on_token, out
+                    rid, tok, n, max_new_tokens, eos_token_id, on_token, out,
+                    temperature=temperature,
+                    seed=int(rng.integers(2**31)),
                 )
             offset = n
             while True:
@@ -649,12 +672,14 @@ class PipelineCoordinator:
         return loss
 
     async def _generate_ring(
-        self, rid, first_tok, n, max_new_tokens, eos_token_id, on_token, out
+        self, rid, first_tok, n, max_new_tokens, eos_token_id, on_token, out,
+        temperature: float = 0.0, seed: int = 0,
     ) -> list[int]:
-        """Greedy decode in ring bursts: one coordinator round trip per K
-        tokens — tokens circulate stage0→…→last→stage0 with last-stage
-        argmax sampling (TASK_DECODE_RUN). The caller's finally releases
-        the stage caches."""
+        """Decode in ring bursts: one coordinator round trip per K tokens
+        — tokens circulate stage0→…→last→stage0 with last-stage sampling
+        (TASK_DECODE_RUN: argmax, or a (seed, position)-keyed softmax draw
+        for temperature>0). The caller's finally releases the stage
+        caches."""
         if eos_token_id is not None and first_tok == eos_token_id:
             return out
         out.append(first_tok)
@@ -670,6 +695,8 @@ class PipelineCoordinator:
                     "model": self.model, "request_id": rid,
                     "token": int(tok), "offset": int(offset), "k": int(k),
                     "eos": eos_token_id,
+                    "temperature": float(temperature or 0.0),
+                    "seed": int(seed),
                 },
                 timeout=DEFAULT_STEP_TIMEOUT + 2.0 * k,
                 reply_from=self.stage_peers[-1],

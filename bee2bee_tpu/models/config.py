@@ -8,7 +8,9 @@ reference's model-tag matching (reference services.py:136-151).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, replace
+import json
+from dataclasses import dataclass, fields, replace
+from pathlib import Path
 
 
 @dataclass(frozen=True)
@@ -136,6 +138,12 @@ CONFIGS: dict[str, ModelConfig] = {
         n_kv_heads=1, d_ff=128, max_seq_len=256, activation="geglu",
         embedding_scale=True, norm_plus_one=True, norm_eps=1e-6,
     ),
+    "tiny-mistral": ModelConfig(  # llama arch + sliding-window attention,
+        # window deliberately smaller than the test prompts so the windowed
+        # mask is actually exercised against HF's implementation
+        name="tiny-mistral", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+        n_kv_heads=2, d_ff=128, max_seq_len=256, sliding_window=4,
+    ),
     "tiny-qwen": ModelConfig(  # qwen2 style: llama arch + q/k/v-only bias
         name="tiny-qwen", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
         n_kv_heads=2, d_ff=128, max_seq_len=256, qkv_bias=True,
@@ -250,6 +258,146 @@ CONFIGS["phi-2"] = ModelConfig(
     norm="layernorm", use_bias=True, tie_embeddings=False,
     rotary_pct=0.4, parallel_block=True, lm_head_bias=True,
 )
+
+
+def _neox_act(hidden_act: str) -> str:
+    if hidden_act in ("gelu_new", "gelu_pytorch_tanh", "gelu_fast"):
+        return "gelu"
+    if hidden_act == "gelu":
+        return "gelu_exact"
+    raise ValueError(
+        f"gpt_neox hidden_act {hidden_act!r} is not supported by the native "
+        f"core (gelu variants only)"
+    )
+
+
+def config_from_hf(d: dict, name: str | None = None) -> ModelConfig:
+    """Synthesize a ModelConfig from an HF ``config.json`` dict — the
+    any-checkpoint path: a checkpoint whose architecture is NOT in the
+    preset registry can still be served natively, the way the reference
+    serves any HF causal LM via AutoModelForCausalLM (reference
+    services.py:39-52, hf.py:23-32). Inverse of export.hf_config_dict;
+    covers the gpt2 / llama / mistral / qwen2 / gemma / mixtral / phi /
+    gpt-neox / gpt-j layouts (the dominant open-model shapes)."""
+    mt = d.get("model_type")
+    nm = name or d.get("_name_or_path") or f"{mt}-checkpoint"
+    if mt == "gpt2":
+        return ModelConfig(
+            name=nm, vocab_size=d["vocab_size"], d_model=d["n_embd"],
+            n_layers=d["n_layer"], n_heads=d["n_head"], n_kv_heads=d["n_head"],
+            d_ff=d.get("n_inner") or 4 * d["n_embd"],
+            max_seq_len=d.get("n_positions", 1024), pos_embedding="learned",
+            norm="layernorm", activation="gelu", use_bias=True,
+            tie_embeddings=True,
+            norm_eps=d.get("layer_norm_epsilon", 1e-5),
+        )
+    if mt == "gptj":
+        hd = d["n_embd"] // d["n_head"]
+        return ModelConfig(
+            name=nm, vocab_size=d["vocab_size"], d_model=d["n_embd"],
+            n_layers=d["n_layer"], n_heads=d["n_head"], n_kv_heads=d["n_head"],
+            d_ff=d.get("n_inner") or 4 * d["n_embd"],
+            max_seq_len=d.get("n_positions", 2048), activation="gelu",
+            norm="layernorm", tie_embeddings=False, mlp_bias=True,
+            rotary_pct=d.get("rotary_dim", hd) / hd, rope_style="interleaved",
+            parallel_block=True, lm_head_bias=True,
+            norm_eps=d.get("layer_norm_epsilon", 1e-5),
+        )
+    if mt == "gpt_neox":
+        return ModelConfig(
+            name=nm, vocab_size=d["vocab_size"], d_model=d["hidden_size"],
+            n_layers=d["num_hidden_layers"], n_heads=d["num_attention_heads"],
+            n_kv_heads=d["num_attention_heads"], d_ff=d["intermediate_size"],
+            max_seq_len=d.get("max_position_embeddings", 2048),
+            # HF "gelu" is the exact erf form; the tanh approximations are
+            # spelled gelu_new / gelu_pytorch_tanh. Anything else must
+            # fail loudly — a silently substituted nonlinearity serves
+            # garbage with no error
+            activation=_neox_act(d.get("hidden_act", "gelu")),
+            norm="layernorm", use_bias=True,
+            tie_embeddings=d.get("tie_word_embeddings", False),
+            rotary_pct=d.get("rotary_pct", 1.0),
+            rope_theta=d.get("rotary_emb_base", 10000.0),
+            parallel_block=d.get("use_parallel_residual", True),
+            parallel_norms=2, norm_eps=d.get("layer_norm_eps", 1e-5),
+        )
+    if mt == "phi":
+        return ModelConfig(
+            name=nm, vocab_size=d["vocab_size"], d_model=d["hidden_size"],
+            n_layers=d["num_hidden_layers"], n_heads=d["num_attention_heads"],
+            n_kv_heads=d.get("num_key_value_heads") or d["num_attention_heads"],
+            d_ff=d["intermediate_size"],
+            max_seq_len=d.get("max_position_embeddings", 2048),
+            activation="gelu", norm="layernorm", use_bias=True,
+            tie_embeddings=False,
+            rotary_pct=d.get("partial_rotary_factor", 1.0),
+            rope_theta=d.get("rope_theta", 10000.0), parallel_block=True,
+            lm_head_bias=True, norm_eps=d.get("layer_norm_eps", 1e-5),
+        )
+    if mt in ("llama", "mistral", "qwen2", "gemma", "mixtral"):
+        n_heads = d["num_attention_heads"]
+        hd = d.get("head_dim")
+        kw: dict = dict(
+            name=nm, vocab_size=d["vocab_size"], d_model=d["hidden_size"],
+            n_layers=d["num_hidden_layers"], n_heads=n_heads,
+            n_kv_heads=d.get("num_key_value_heads") or n_heads,
+            d_ff=d["intermediate_size"],
+            max_seq_len=d.get("max_position_embeddings", 2048),
+            rope_theta=d.get("rope_theta", 10000.0),
+            norm_eps=d.get("rms_norm_eps", 1e-6 if mt == "gemma" else 1e-5),
+            # HF defaults tie_word_embeddings False for llama-family but
+            # True for gemma
+            tie_embeddings=d.get("tie_word_embeddings", mt == "gemma"),
+            qkv_bias=mt == "qwen2" or bool(d.get("attention_bias")),
+        )
+        if hd and hd != d["hidden_size"] // n_heads:
+            kw["head_dim_override"] = hd
+        if mt in ("mistral", "mixtral") and d.get("sliding_window"):
+            kw["sliding_window"] = d["sliding_window"]
+        if (mt == "qwen2" and d.get("use_sliding_window")
+                and d.get("sliding_window")
+                and int(d.get("max_window_layers") or 0) <= 0):
+            # HF windows only layers >= max_window_layers; our config
+            # windows EVERY layer, so a partial-window checkpoint
+            # (max_window_layers > 0) is served full-attention instead —
+            # exact for prompts within the window and matches HF on the
+            # majority (first) layers, vs. silently wrong everywhere
+            kw["sliding_window"] = d["sliding_window"]
+        if mt == "gemma":
+            act = d.get("hidden_activation") or d.get("hidden_act") or "gelu_pytorch_tanh"
+            kw.update(
+                activation="geglu" if act.startswith("gelu") else act,
+                embedding_scale=True, norm_plus_one=True,
+            )
+        if mt == "mixtral":
+            kw.update(n_experts=d["num_local_experts"],
+                      n_experts_per_tok=d.get("num_experts_per_tok", 2))
+        return ModelConfig(**kw)
+    raise ValueError(
+        f"unsupported model_type {mt!r} in config.json — native serving "
+        f"covers gpt2/llama/mistral/qwen2/gemma/mixtral/phi/gpt_neox/gptj; "
+        f"other architectures can be served via the ollama/remote backends"
+    )
+
+
+def config_for_checkpoint(path: str | Path, name: str | None = None) -> ModelConfig:
+    """Resolve a checkpoint DIRECTORY to a ModelConfig from its own
+    metadata: a native save (model_config.json, our field names) or an HF
+    checkpoint (config.json). This is what lets ``serve-tpu --model auto
+    --checkpoint <dir>`` serve architectures with no registry entry."""
+    path = Path(path)
+    native = path / "model_config.json"
+    if native.exists():
+        d = json.loads(native.read_text())
+        known = {f.name for f in fields(ModelConfig)}
+        return ModelConfig(**{k: v for k, v in d.items() if k in known})
+    hf = path / "config.json"
+    if hf.exists():
+        return config_from_hf(json.loads(hf.read_text()), name=name)
+    raise FileNotFoundError(
+        f"no model_config.json or config.json under {path} — cannot "
+        f"synthesize a model config for this checkpoint"
+    )
 
 
 def get_config(name: str, **overrides) -> ModelConfig:

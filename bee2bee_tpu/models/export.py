@@ -336,6 +336,11 @@ def hf_config_dict(cfg: ModelConfig, qkv_bias: bool | None = None) -> dict:
         "tie_word_embeddings": cfg.tie_embeddings,
         "head_dim": cfg.head_dim,
     }
+    if cfg.sliding_window is not None:
+        # EVERY llama-branch family carries the window when set (mixtral
+        # and qwen2 too, not just the mistral model_type below) — an
+        # export that drops it silently widens attention for HF consumers
+        base["sliding_window"] = cfg.sliding_window
     if cfg.is_moe:
         return {
             "model_type": "mixtral",
@@ -345,15 +350,37 @@ def hf_config_dict(cfg: ModelConfig, qkv_bias: bool | None = None) -> dict:
             **base,
         }
     if cfg.norm_plus_one:  # gemma family
+        act = ("gelu_pytorch_tanh" if cfg.activation == "geglu"
+               else cfg.activation)
         return {
             "model_type": "gemma",
             "architectures": ["GemmaForCausalLM"],
-            "hidden_act": "gelu_pytorch_tanh" if cfg.activation == "geglu" else cfg.activation,
+            # transformers >= 4.39 reads hidden_activation and warns on the
+            # legacy hidden_act key alone — write both so any version loads
+            # the tanh-approx gelu our geglu uses
+            "hidden_act": act,
+            "hidden_activation": act,
             **base,
         }
     is_qwen2 = cfg.qkv_bias if qkv_bias is None else qkv_bias
     if is_qwen2:
-        return {"model_type": "qwen2", "architectures": ["Qwen2ForCausalLM"], **base}
+        out = {"model_type": "qwen2", "architectures": ["Qwen2ForCausalLM"], **base}
+        if cfg.sliding_window is not None:
+            # Qwen2Config defaults use_sliding_window=False, and its
+            # max_window_layers default (28) keeps the FIRST 28 layers on
+            # full attention — our window applies to every layer, so emit
+            # 0 or HF silently ignores the window for <=28-layer models
+            out["use_sliding_window"] = True
+            out["max_window_layers"] = 0
+        return out
+    if cfg.sliding_window is not None:  # mistral family (zephyr-7b etc.):
+        # exporting as plain llama would silently widen the attention
+        # window for any consumer that respects config.json
+        return {
+            "model_type": "mistral",
+            "architectures": ["MistralForCausalLM"],
+            **base,
+        }
     return {"model_type": "llama", "architectures": ["LlamaForCausalLM"], **base}
 
 

@@ -56,6 +56,10 @@ class TPUService(BaseService):
                 engine_config=self._engine_config,
                 lora_path=self._lora_path,
             )
+        if self.model_name in (None, "", "auto"):
+            # `--model auto`: advertise the name the checkpoint's config
+            # resolved to, not the sentinel
+            self.model_name = self.engine.model_cfg.name
         return self
 
     def get_metadata(self) -> dict[str, Any]:
@@ -124,7 +128,7 @@ class TPUService(BaseService):
                     result = ev.get("result")
                     break
                 acc += ev.get("text", "")
-                n_seen += len(ev.get("tokens") or ([1] if ev.get("token") else []))
+                n_seen += len(ev.get("tokens") or ([1] if ev.get("token") is not None else []))
                 if stop_cut(acc, stops) is not None:
                     hit = True  # closing the generator cancels the row
                     break
@@ -168,7 +172,7 @@ class TPUService(BaseService):
                         yield self.stream_line({"text": tail[emitted:]})
                     break
                 acc += ev.get("text", "")
-                n_seen += len(ev.get("tokens") or ([1] if ev.get("token") else []))
+                n_seen += len(ev.get("tokens") or ([1] if ev.get("token") is not None else []))
                 delta, emitted, hit = scrub_stream_delta(acc, emitted, stops)
                 if delta:
                     yield self.stream_line({"text": delta})

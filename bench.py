@@ -295,6 +295,13 @@ def main() -> None:
         # single-stream throughput against true batch-8 numbers silently
         headline_entry = distil["batch1"]
         metric = "serve_tokens_per_sec_distilgpt2_batch1_degraded"
+    elif platform != "tpu":
+        # ANY non-TPU headline carries the suffix, not just the batch-1
+        # fallback: a CPU run that completes batch-8 must not publish into
+        # the frozen TPU trend series (VERDICT r4 weak #5 — r03/r04 mixed
+        # hardware under one metric name; only extras.platform told them
+        # apart)
+        metric += "_degraded"
     headline = headline_entry["tok_per_s"]
     extras["single_stream_tok_per_s"] = distil["batch1"]["tok_per_s"]
     extras["p50_latency_s"] = distil["p50_latency_s_short"]

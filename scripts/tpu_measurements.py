@@ -29,9 +29,38 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 REPORT: dict = {"platform": None, "phases": {}}
 OUT = Path("/tmp/tpu_measurements.json")
 
+# Set when a phase dies on a tunnel-infrastructure error (dead remote-compile
+# service / lost connection): later phases would grind through the same
+# minutes-long failure (r4: gemma spent 1545 s surfacing one UNAVAILABLE),
+# so the run aborts and leaves the retry to the watcher loop.
+_INFRA_ABORT = False
+_INFRA_PATTERNS = ("UNAVAILABLE", "Unavailable", "Connection refused",
+                   "DEADLINE", "compile service unhealthy")
+
 
 def save():
     OUT.write_text(json.dumps(REPORT, indent=2))
+
+
+def check_compile_health(timeout_s: int = 150):
+    """Fail-fast gate before each lease-expensive engine build: compile a
+    small graph in a FRESH subprocess (its own jit cache, so the compile
+    really exercises the tunnel's remote-compile service). Raises within
+    ~timeout_s instead of letting a 2.5B-param engine build grind for
+    25 minutes against a dead service (r4 gemma phase: 1545 s to fail)."""
+    import subprocess
+    probe = ("import jax, jax.numpy as jnp;"
+             "x = jnp.ones((257, 257));"
+             "jax.jit(lambda a: a @ a)(x).block_until_ready();"
+             "print(jax.devices()[0].platform)")
+    try:
+        r = subprocess.run([sys.executable, "-c", probe], timeout=timeout_s,
+                           capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        raise RuntimeError("compile service unhealthy: probe timed out")
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()[-1:] or [""]
+        raise RuntimeError(f"compile service unhealthy: {tail[0][:200]}")
 
 
 _CURRENT_PHASE: str | None = None  # set by the phase decorator's run()
@@ -52,7 +81,7 @@ def save_partial(out: dict):
 def phase(name):
     def deco(fn):
         def run(*a, **kw):
-            global _CURRENT_PHASE
+            global _CURRENT_PHASE, _INFRA_ABORT
             _CURRENT_PHASE = name
             t0 = time.time()
             try:
@@ -66,7 +95,12 @@ def phase(name):
                     "error": f"{type(e).__name__}: {e}",
                     **({"partial": prior["partial"]} if "partial" in prior else {}),
                 }
+                if any(p in str(e) for p in _INFRA_PATTERNS):
+                    _INFRA_ABORT = True
             REPORT["phases"][name]["wall_s"] = round(time.time() - t0, 1)
+            # stamp the hardware per phase: resume keeps only ok-on-TPU
+            # records, and a later CPU smoke run must not taint them
+            REPORT["phases"][name]["platform"] = REPORT.get("platform")
             save()
             print(f"[{name}] {json.dumps(REPORT['phases'][name])[:300]}", flush=True)
         return run
@@ -155,6 +189,7 @@ def gemma_sweep(quick):
     prompts = [[1 + (i * 37 + j) % 500 for j in range(64)] for i in range(32)]
     chunks = (32, 64) if quick else (32, 64, 128)
     for chunk in chunks:
+        check_compile_health()  # fail in ~2 min, not a 25-min engine build
         eng = InferenceEngine(
             "gemma-2b",
             engine_config=EngineConfig(max_seq_len=1024, max_batch=32,
@@ -167,6 +202,7 @@ def gemma_sweep(quick):
         }
         eng.close()
         save_partial(out)
+    check_compile_health()
     eng = InferenceEngine(
         "gemma-2b",
         engine_config=EngineConfig(max_seq_len=1024, max_batch=8,
@@ -246,6 +282,31 @@ PHASES = {
     "flash_long": lambda q: flash_long(q),
 }
 
+# CLI phase key -> report record name (the @phase titles above). The ONE
+# copy — chip_watch.sh gates on it via --check-done
+PHASE_ALIAS = {
+    "compile": "compile_dense_vs_flash",
+    "distil": "distilgpt2_serving",
+    "distil_flash": "distil_flash_serving",
+    "gemma": "gemma_decode_chunk_sweep",
+    "flash_long": "flash_long_context",
+}
+
+
+def check_done(phases: str) -> bool:
+    """True iff every requested phase is recorded ok-on-TPU in OUT."""
+    try:
+        d = json.loads(OUT.read_text())
+    except (OSError, json.JSONDecodeError):
+        return False
+    top = d.get("platform")
+    ph = d.get("phases", {})
+    return all(
+        ph.get(PHASE_ALIAS[p.strip()], {}).get("ok")
+        and ph.get(PHASE_ALIAS[p.strip()], {}).get("platform", top) == "tpu"
+        for p in phases.split(",") if p.strip()
+    )
+
 
 def main():
     ap = argparse.ArgumentParser()
@@ -254,10 +315,33 @@ def main():
     ap.add_argument("--out", default=str(OUT))
     ap.add_argument("--phases", default="compile,distil,distil_flash,gemma,flash_long",
                     help="comma list (CPU smoke: --phases distil --quick)")
+    ap.add_argument("--check-done", action="store_true",
+                    help="exit 0 iff every --phases entry is ok-on-TPU in "
+                         "--out; touches neither jax nor the chip")
     args = ap.parse_args()
     OUT = Path(args.out)
 
+    if args.check_done:
+        sys.exit(0 if check_done(args.phases) else 1)
+
     import jax
+
+    # Resume: keep phases a previous run already completed ON TPU so a retry
+    # with the same --out file never destroys earned lease-minutes (the
+    # watcher loop re-invokes with only the outstanding phases, but a full
+    # phase list must also be safe). Per-phase platform stamps make this
+    # robust to an interleaved CPU run rewriting the top-level platform.
+    prior_ok: set[str] = set()
+    if OUT.exists():
+        try:
+            prev = json.loads(OUT.read_text())
+            top = prev.get("platform")
+            for pname, rec in prev.get("phases", {}).items():
+                if rec.get("ok") and rec.get("platform", top) == "tpu":
+                    REPORT["phases"][pname] = rec
+                    prior_ok.add(pname)
+        except (json.JSONDecodeError, OSError):
+            pass
 
     REPORT["platform"] = jax.devices()[0].platform
     save()
@@ -267,8 +351,18 @@ def main():
               flush=True)
 
     for name in args.phases.split(","):
-        PHASES[name.strip()](args.quick)
+        name = name.strip()
+        if PHASE_ALIAS.get(name) in prior_ok:
+            print(f"[{name}] already ok in {OUT} — skipping", flush=True)
+            continue
+        if _INFRA_ABORT:
+            print(f"[{name}] skipped: infra abort (dead compile service) — "
+                  "watcher will retry", flush=True)
+            continue
+        PHASES[name](args.quick)
     print(json.dumps(REPORT, indent=2))
+    if _INFRA_ABORT:
+        sys.exit(3)
 
 
 if __name__ == "__main__":

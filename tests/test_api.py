@@ -450,3 +450,49 @@ async def test_swarm_relay_streams_chunks():
         assert "".join(chunks) == "streamed via relay"
         assert len(chunks) > 1  # actually chunked, not one blob
         assert not result.get("error")
+
+
+def test_make_frame_plain_text_line_becomes_delta():
+    """A custom service streaming non-JSON lines must not lose output on
+    /v1 (SSE): the raw line is forwarded as a delta chunk."""
+    import json as _json
+
+    from bee2bee_tpu.api import _make_frame
+
+    frame = _make_frame(("chat", "m"))
+    out = frame("plain text from a custom backend")
+    assert out.startswith(b"data: ")
+    payload = _json.loads(out.decode().split("data: ", 1)[1].strip())
+    assert payload["choices"][0]["delta"]["content"] == (
+        "plain text from a custom backend"
+    )
+
+
+def test_make_frame_scalar_json_line_becomes_delta():
+    """Lines that parse as SCALAR JSON (true / 42 / "done") must be
+    forwarded as text too, not crash the SSE encoder."""
+    import json as _json
+
+    from bee2bee_tpu.api import _make_frame
+
+    frame = _make_frame(("chat", "m"))
+    for line in ("true", "42", '"done"'):
+        out = frame(line)
+        payload = _json.loads(out.decode().split("data: ", 1)[1].strip())
+        assert payload["choices"][0]["delta"]["content"] == line
+
+
+def test_auth_non_ascii_header_rejected_not_500():
+    """A non-ASCII key/header must fail auth cleanly (compare_digest
+    raises TypeError on non-ASCII str — would 500 the request)."""
+    from bee2bee_tpu.api import _auth_ok
+
+    class _Req:
+        remote = "203.0.113.9"
+
+        def __init__(self, headers):
+            self.headers = headers
+
+    assert not _auth_ok(_Req({"X-API-KEY": "café"}), "sekrit")
+    assert not _auth_ok(_Req({"Authorization": "Bearer café"}), "sekrit")
+    assert _auth_ok(_Req({"X-API-KEY": "café"}), "café")

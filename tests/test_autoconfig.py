@@ -1,0 +1,133 @@
+"""config.json-driven serving: a checkpoint whose architecture has NO
+registry entry is served natively by synthesizing a ModelConfig from the
+checkpoint's own metadata — the any-model capability the reference gets
+from AutoModelForCausalLM (reference services.py:39-52, hf.py:23-32).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bee2bee_tpu.engine import EngineConfig, InferenceEngine
+from bee2bee_tpu.models import core, get_config
+from bee2bee_tpu.models.config import config_for_checkpoint, config_from_hf
+from bee2bee_tpu.models.export import export_hf, hf_config_dict
+
+
+@pytest.mark.parametrize(
+    "name",
+    ["tiny-gpt2", "tiny-llama", "tiny-mistral", "tiny-mixtral", "tiny-gemma",
+     "tiny-qwen", "tiny-phi", "tiny-neox", "tiny-gptj"],
+)
+def test_config_from_hf_inverts_hf_config_dict(name):
+    """For every supported family: our exported config.json must
+    reconstruct the EXACT ModelConfig it came from (field-for-field
+    dataclass equality) — otherwise `--model auto` on our own exports
+    would serve a subtly different architecture."""
+    cfg = get_config(name)
+    back = config_from_hf(hf_config_dict(cfg), name=cfg.name)
+    assert back == cfg
+
+
+def test_config_from_hf_head_dim_override():
+    """gemma-7b-style attention width != d_model must survive the
+    round-trip via the explicit head_dim key."""
+    cfg = get_config("gemma-7b")
+    back = config_from_hf(hf_config_dict(cfg), name=cfg.name)
+    assert back.head_dim == 256
+    assert back == cfg
+
+
+def test_config_from_hf_rejects_unknown_model_type():
+    with pytest.raises(ValueError, match="model_type"):
+        config_from_hf({"model_type": "mamba", "vocab_size": 8})
+
+
+def test_config_for_checkpoint_native_dir(tmp_path):
+    """A save_native() checkpoint carries model_config.json with our own
+    field names — reconstruct the config directly from it."""
+    from bee2bee_tpu.models.loader import save_native
+
+    cfg = get_config("tiny-qwen")
+    params = core.init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    save_native(params, cfg, tmp_path / "native")
+    back = config_for_checkpoint(tmp_path / "native")
+    assert back == cfg
+
+
+def test_config_for_checkpoint_missing_metadata(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        config_for_checkpoint(tmp_path)
+
+
+def test_engine_serves_unregistered_checkpoint_via_config_json(tmp_path):
+    """The end-to-end claim: export a llama-layout checkpoint under a name
+    and geometry that match NOTHING in the registry, then serve it — the
+    engine must pick up the architecture from config.json and generate."""
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        get_config("tiny-llama"), name="frontier-lab-llm-9x", d_model=48,
+        n_heads=6, n_kv_heads=3, d_ff=80, vocab_size=384, max_seq_len=128,
+    )
+    with pytest.raises(KeyError):
+        get_config("frontier-lab-llm-9x")  # really unregistered
+    params = core.init_params(cfg, jax.random.key(1), dtype=jnp.float32)
+    out = export_hf(params, cfg, tmp_path / "ckpt", dtype="float32")
+
+    eng = InferenceEngine(
+        "frontier-lab-llm-9x",
+        checkpoint_path=str(out),
+        engine_config=EngineConfig(max_seq_len=64, dtype="float32",
+                                   cache_dtype="float32"),
+    )
+    try:
+        assert eng.model_cfg.d_model == 48
+        assert eng.model_cfg.n_kv_heads == 3
+        assert eng.model_cfg.name == "frontier-lab-llm-9x"
+        r = eng.generate([1, 2, 3, 4], max_new_tokens=4, temperature=0.0)
+        assert r.new_tokens == 4
+    finally:
+        eng.close()
+
+
+def test_engine_model_auto_resolves_from_checkpoint(tmp_path):
+    """`--model auto` (the CLI sentinel) must not be treated as a registry
+    name; the TPUService then advertises the resolved name."""
+    from bee2bee_tpu.services.tpu import TPUService
+
+    cfg = get_config("tiny-mistral")
+    params = core.init_params(cfg, jax.random.key(2), dtype=jnp.float32)
+    out = export_hf(params, cfg, tmp_path / "ckpt", dtype="float32")
+
+    svc = TPUService(
+        "auto", checkpoint_path=str(out),
+        engine_config=EngineConfig(max_seq_len=32, dtype="float32",
+                                   cache_dtype="float32"),
+    ).load_sync()
+    try:
+        assert svc.engine.model_cfg.sliding_window == 4
+        assert svc.model_name == "mistral-checkpoint"
+        assert svc.get_metadata()["models"] == ["mistral-checkpoint"]
+    finally:
+        svc.engine.close()
+
+
+def test_engine_unknown_name_without_checkpoint_still_raises():
+    with pytest.raises(KeyError):
+        InferenceEngine("frontier-lab-llm-9x")
+
+
+def test_sliding_window_survives_mixtral_and_qwen2_round_trip():
+    """sliding_window must ride EVERY llama-branch export, not just the
+    mistral model_type — a dropped key silently widens attention for HF
+    consumers of the exported config.json."""
+    import dataclasses
+
+    for base in ("tiny-mixtral", "tiny-qwen"):
+        cfg = dataclasses.replace(get_config(base), sliding_window=4)
+        d = hf_config_dict(cfg)
+        assert d["sliding_window"] == 4, base
+        back = config_from_hf(d, name=cfg.name)
+        assert back.sliding_window == 4, base
+        assert back == cfg, base

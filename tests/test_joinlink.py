@@ -36,6 +36,31 @@ def test_bitfield_roundtrip():
     assert joinlink.pieces_from_bitfield(bf, total=10) == have
 
 
+def test_parse_reference_dialect_link():
+    """A link built EXACTLY the way the reference builds one
+    (reference p2p.py:8-15: network/model/hash query keys + one
+    unpadded-urlsafe-b64 `bootstrap=` key per address) must parse."""
+    import base64
+
+    boots = ["ws://1.2.3.4:4003", "wss://peer.example:443/x"]
+    parts = [
+        "bootstrap=" + base64.urlsafe_b64encode(b.encode()).decode().rstrip("=")
+        for b in boots
+    ]
+    link = ("coithub.org://join?network=swarm1&model=llama&hash=deadbeef&"
+            + "&".join(parts))
+    out = joinlink.parse_join_link(link)
+    assert out["bootstrap_addrs"] == boots
+    assert out["network"] == "swarm1"
+    assert out["model"] == "llama"
+    assert out["hash"] == "deadbeef"
+    assert out["node_id"] == "swarm1"  # falls back to the network name
+
+    # the reference also emits the bare `coithub` scheme variant
+    out2 = joinlink.parse_join_link(link.replace("coithub.org", "coithub"))
+    assert out2["bootstrap_addrs"] == boots
+
+
 def test_percent_in_node_id_survives_roundtrip():
     link = joinlink.generate_join_link("id%41x", ["ws://h:1"], name="50%20off")
     out = joinlink.parse_join_link(link)

@@ -394,3 +394,89 @@ async def test_pipeline_service_streams_through_mesh():
         want = _expected_text("stream it", 6)
         assert "".join(chunks) == want
         assert result.get("streamed") or result.get("text") == want
+
+
+async def test_ring_burst_temperature_sampling():
+    """Sampled requests ride the K-per-round-trip ring path too (round 4
+    was greedy-only): temperature>0 costs ONE decode_run per burst with
+    LAST-stage seeded sampling; near-zero temperature reproduces the
+    greedy rollout exactly; high temperature actually varies."""
+    workers = [P2PNode(host="127.0.0.1", port=0, node_id=f"tstage{i}") for i in range(2)]
+    coord = P2PNode(host="127.0.0.1", port=0, node_id="tcoord")
+    nodes = [*workers, coord]
+    for n in nodes:
+        await n.start()
+    try:
+        for w in workers:
+            await coord.connect_bootstrap(w.addr)
+        await _settle(lambda: len(coord.peers) >= 2)
+        coordinator = PipelineCoordinator(
+            coord, MODEL, stage_peers=[w.peer_id for w in workers],
+            max_seq_len=128, dtype="float32", rng_seed=SEED,
+        )
+        await coordinator.load(timeout=120.0)
+        assert coordinator.ring_ok
+        tok = ByteTokenizer(get_config(MODEL).vocab_size)
+
+        from bee2bee_tpu import protocol as proto
+
+        kinds: list[str] = []
+        orig_run = coord.run_stage_task
+
+        async def counting(peer, kind, *a, **kw):
+            kinds.append(kind)
+            return await orig_run(peer, kind, *a, **kw)
+
+        coord.run_stage_task = counting
+        try:
+            out = await coordinator.generate(
+                tok.encode("sample me"), max_new_tokens=8, temperature=1e-4
+            )
+        finally:
+            coord.run_stage_task = orig_run
+        # the burst path ran (1 decode_run for 8 tokens), and T→0 degrades
+        # to the greedy rollout
+        assert kinds.count(proto.TASK_DECODE_RUN) == 1, kinds
+        assert tok.decode(out) == _expected_text("sample me", 8)
+
+        vocab = get_config(MODEL).vocab_size
+        outs = set()
+        for _ in range(3):
+            o = await coordinator.generate(
+                tok.encode("vary"), max_new_tokens=12, temperature=3.0
+            )
+            assert all(0 <= t < vocab for t in o)
+            outs.add(tuple(o))
+        assert len(outs) > 1, "temperature=3 produced identical rollouts"
+    finally:
+        for n in nodes:
+            await n.stop()
+
+
+def test_ring_sample_distribution_matches_softmax():
+    """The stage-side draw follows softmax(logits/T): empirical frequency
+    over many seeds tracks the analytic distribution (the 'output
+    distribution' bar for moving sampling from coordinator to stage)."""
+    from bee2bee_tpu.meshnet.pipeline import StageTaskMixin
+
+    logits = np.array([2.0, 1.0, 0.0, -1.0], np.float32)
+    temp = 1.0
+    z = logits.astype(np.float64) / temp
+    z -= z.max()
+    p = np.exp(z)
+    p /= p.sum()
+    n = 4000
+    counts = np.zeros(4)
+    for seed in range(n):
+        t = StageTaskMixin._ring_sample(
+            logits, {"temperature": temp, "seed": seed, "offset": 7}
+        )
+        counts[t] += 1
+    freq = counts / n
+    np.testing.assert_allclose(freq, p, atol=0.03)
+    # greedy (temperature absent/0) stays argmax
+    assert StageTaskMixin._ring_sample(logits, {"offset": 0}) == 0
+    # same (seed, position) => same draw; different position => new stream
+    a = StageTaskMixin._ring_sample(logits, {"temperature": 1.0, "seed": 5, "offset": 3})
+    b = StageTaskMixin._ring_sample(logits, {"temperature": 1.0, "seed": 5, "offset": 3})
+    assert a == b
