@@ -106,6 +106,20 @@ def _serve(backend: str, model: str, **kw):
         click.echo("shutting down")
 
 
+def _microbatches_arg(ctx, param, value):
+    """'auto' or an int >= 1 — validated at CLI parse, not minutes later
+    inside the async serve body after the stages compiled."""
+    if value == "auto":
+        return value
+    try:
+        iv = int(value)
+    except (TypeError, ValueError):
+        raise click.BadParameter("must be 'auto' or a positive integer")
+    if iv < 1:
+        raise click.BadParameter("must be >= 1")
+    return iv
+
+
 def _common_opts(f):
     f = click.option("--port", type=int, default=None, help="WS mesh port")(f)
     f = click.option("--api-port", type=int, default=None, help="HTTP gateway port")(f)
@@ -243,9 +257,11 @@ def serve_stage(model, n_stages, stage, checkpoint, max_seq_len, quantize, **kw)
 @click.option("--max-seq-len", type=int, default=2048)
 @click.option("--max-batch", type=int, default=8,
               help="continuous-batching rows in the pipeline session")
-@click.option("--microbatches", type=int, default=1,
-              help=">1 overlaps microbatch groups across stages (GPipe-"
-                   "style over the wire; costs proportionally more hops)")
+@click.option("--microbatches", default="auto", callback=_microbatches_arg,
+              help="'auto' (2 when stages run on distinct hosts, else 1) "
+                   "or an int >= 1; >1 overlaps microbatch groups across "
+                   "stages (GPipe-style over the wire; costs proportionally "
+                   "more hops)")
 @click.option("--quantize", type=click.Choice(["none", "int8"]), default="none",
               help="each stage int8-quantizes its slice at part_load")
 @_common_opts

@@ -23,6 +23,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+from urllib.parse import urlparse
 
 import numpy as np
 
@@ -158,7 +159,12 @@ class StageTaskMixin:
                 # stage's wrap-around link to stage 0 enables burst decode.
                 info={**runner.info,
                       "relay": relay or runner.spec.is_last,
-                      "ring": relay},
+                      "ring": relay,
+                      # this stage's decode_run knows temperature/seed
+                      # fields (round 5) — a coordinator must NOT route
+                      # sampled requests around a ring of older stages
+                      # that would silently argmax them
+                      "ring_sampling": relay},
             ),
         )
 
@@ -424,6 +430,30 @@ class StageTaskMixin:
 # ------------------------------------------------------------- coordinator
 
 
+def resolve_microbatches(stage_addrs: list) -> int:
+    """The `--microbatches auto` heuristic: microbatch overlap pays only
+    when stages compute in PARALLEL, i.e. they run on different hosts —
+    then group g+1's stage-0 compute genuinely overlaps group g's stage-1
+    compute. Stages sharing one host contend for the same cores, so the
+    M× extra wire messages buy nothing (measured on the loopback split:
+    docs/PERF.md "Microbatch overlap"). Unknown topology resolves to 1 —
+    never gamble hop cost on a guess."""
+    hosts = set()
+    for a in stage_addrs:
+        if not a:
+            return 1
+        try:
+            host = urlparse(a).hostname or str(a)
+        except ValueError:
+            return 1
+        if host == "::1" or host.startswith("127."):
+            # loopback aliases are all the same machine — mixed
+            # localhost/127.0.0.1 worker flags must not read as two hosts
+            host = "localhost"
+        hosts.add(host)
+    return 2 if len(hosts) >= 2 else 1
+
+
 class PipelineCoordinator:
     """Drive generation across stage workers (reference contrast:
     node.py:249-277 chains hf_part_forward hops; here the chain carries a
@@ -452,6 +482,8 @@ class PipelineCoordinator:
         # the ring closes (last stage → stage 0): greedy decode can run
         # K-token bursts with last-stage sampling
         self.ring_ok = False
+        # every stage also speaks the burst temperature/seed fields
+        self.ring_sampling_ok = False
         self.ring_burst = 16  # tokens per coordinator round trip
 
     async def load(
@@ -496,6 +528,11 @@ class PipelineCoordinator:
         self.relay_ok = len(infos) > 0 and all(i.get("relay") for i in infos)
         self.ring_ok = (
             len(infos) > 1 and all(i.get("ring") for i in infos)
+        )
+        # sampled bursts need every stage to SPEAK the temperature/seed
+        # fields; an older stage would ignore them and argmax silently
+        self.ring_sampling_ok = (
+            self.ring_ok and all(i.get("ring_sampling") for i in infos)
         )
         return infos
 
@@ -569,10 +606,14 @@ class PipelineCoordinator:
         try:
             logits = await self._chain(rid, padded, offset=0)
             tok = self._sample(logits[0, n - 1], temperature, rng)
-            if self.ring_ok and max_new_tokens > 1:
+            greedy = temperature is None or temperature <= 0.0
+            if (self.ring_ok and max_new_tokens > 1
+                    and (greedy or self.ring_sampling_ok)):
                 # sampled requests ride the burst path too: the LAST stage
                 # draws with an rng keyed on (seed, position), so K tokens
-                # still cost one coordinator round trip (r4 was greedy-only)
+                # still cost one coordinator round trip (r4 was greedy-only).
+                # Gated on ring_sampling_ok — an older stage would ignore
+                # the temperature/seed fields and silently argmax
                 return await self._generate_ring(
                     rid, tok, n, max_new_tokens, eos_token_id, on_token, out,
                     temperature=temperature,
@@ -724,9 +765,18 @@ class PipelineCoordinator:
         return int(rng.choice(len(p), p=p))
 
     def session(
-        self, max_batch: int = 8, n_microbatches: int = 1
+        self, max_batch: int = 8, n_microbatches: int | str = "auto"
     ) -> "PipelineSession":
-        """A continuous-batching session over this coordinator's stages."""
+        """A continuous-batching session over this coordinator's stages.
+        n_microbatches="auto" resolves from the stage topology
+        (resolve_microbatches): 2 when stages live on distinct hosts,
+        else 1."""
+        if n_microbatches in (None, "auto"):
+            addrs = [
+                (self.node.peers.get(pid) or {}).get("addr")
+                for pid in self.stage_peers
+            ]
+            n_microbatches = resolve_microbatches(addrs)
         return PipelineSession(
             self.node,
             self.model,

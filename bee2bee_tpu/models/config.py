@@ -227,6 +227,21 @@ CONFIGS["gpt-j-6b"] = ModelConfig(
     mlp_bias=True, rotary_pct=0.25, rope_style="interleaved",
     parallel_block=True, lm_head_bias=True,
 )
+CONFIGS["tiny-falcon"] = ModelConfig(  # falcon-7b shape: MQA + bias-free
+    # parallel block sharing ONE layernorm, exact-erf gelu, tied head
+    name="tiny-falcon", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+    n_kv_heads=1, d_ff=128, max_seq_len=256, activation="gelu_exact",
+    norm="layernorm", tie_embeddings=True, parallel_block=True,
+)
+CONFIGS["falcon-7b"] = ModelConfig(
+    # tiiuae/falcon-7b: 71 64-dim heads with ONE kv head (multi_query),
+    # parallel attn+mlp sharing input_layernorm, no linear biases, tied
+    # embeddings, full rotary
+    name="falcon-7b", vocab_size=65024, d_model=4544, n_layers=32,
+    n_heads=71, n_kv_heads=1, d_ff=18176, max_seq_len=2048,
+    activation="gelu_exact", norm="layernorm", tie_embeddings=True,
+    parallel_block=True,
+)
 CONFIGS["tiny-neox"] = ModelConfig(  # dual-norm parallel residual
     name="tiny-neox", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
     n_kv_heads=4, d_ff=128, max_seq_len=256, activation="gelu_exact",
@@ -321,6 +336,34 @@ def config_from_hf(d: dict, name: str | None = None) -> ModelConfig:
             parallel_block=d.get("use_parallel_residual", True),
             parallel_norms=2, norm_eps=d.get("layer_norm_eps", 1e-5),
         )
+    if mt == "falcon":
+        if d.get("alibi"):
+            raise ValueError(
+                "falcon alibi checkpoints are not supported by the native "
+                "core (rotary only); serve via the ollama/remote backends"
+            )
+        if d.get("new_decoder_architecture"):
+            raise ValueError(
+                "falcon new_decoder_architecture (grouped-KV interleave, "
+                "falcon-40b/180b) is not supported by the native core yet"
+            )
+        if not d.get("parallel_attn", True):
+            raise ValueError(
+                "falcon parallel_attn=false (sequential blocks) is not "
+                "supported by the native falcon path"
+            )
+        H, D = d["num_attention_heads"], d["hidden_size"]
+        return ModelConfig(
+            name=nm, vocab_size=d["vocab_size"], d_model=D,
+            n_layers=d["num_hidden_layers"], n_heads=H,
+            n_kv_heads=1 if d.get("multi_query", True) else H,
+            d_ff=d.get("ffn_hidden_size") or 4 * D,
+            max_seq_len=d.get("max_position_embeddings", 2048),
+            activation="gelu_exact", norm="layernorm",
+            tie_embeddings=d.get("tie_word_embeddings", True),
+            rope_theta=d.get("rope_theta", 10000.0), parallel_block=True,
+            norm_eps=d.get("layer_norm_epsilon", 1e-5),
+        )
     if mt == "phi":
         return ModelConfig(
             name=nm, vocab_size=d["vocab_size"], d_model=d["hidden_size"],
@@ -348,8 +391,18 @@ def config_from_hf(d: dict, name: str | None = None) -> ModelConfig:
             # HF defaults tie_word_embeddings False for llama-family but
             # True for gemma
             tie_embeddings=d.get("tie_word_embeddings", mt == "gemma"),
-            qkv_bias=mt == "qwen2" or bool(d.get("attention_bias")),
+            qkv_bias=mt == "qwen2",
         )
+        if d.get("attention_bias"):
+            # HF attention_bias puts biases on q/k/v AND o_proj; our
+            # llama-branch layout carries q/k/v biases only (qwen2 style),
+            # so the o_proj bias would be silently dropped — refuse rather
+            # than serve offset logits
+            raise ValueError(
+                "llama-family checkpoints with attention_bias=true are not "
+                "supported by the native core (o_proj bias); serve via the "
+                "ollama/remote backends"
+            )
         if hd and hd != d["hidden_size"] // n_heads:
             kw["head_dim_override"] = hd
         if mt in ("mistral", "mixtral") and d.get("sliding_window"):

@@ -218,6 +218,42 @@ def _export_neox_state(params, cfg: ModelConfig, dtype) -> dict[str, np.ndarray]
     return state
 
 
+def _export_falcon_state(params, cfg: ModelConfig, dtype) -> dict[str, np.ndarray]:
+    """Inverse of loader._convert_falcon (re-fuses q/k/v: multi_query's
+    q-block-then-kv rows for K=1, the per-head [H, 3, hd] interleave for
+    K=H)."""
+    layers = params["layers"]
+    t = lambda a: _np(a, dtype).T
+    H, K, hd, D = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.d_model
+    state = {
+        "transformer.word_embeddings.weight": _np(params["tok_embed"], dtype),
+        "transformer.ln_f.weight": _np(params["final_norm"]["scale"], dtype),
+        "transformer.ln_f.bias": _np(params["final_norm"]["bias"], dtype),
+    }
+    if cfg.tie_embeddings:
+        state["lm_head.weight"] = _np(params["tok_embed"], dtype)
+    else:
+        state["lm_head.weight"] = t(params["lm_head"])
+    a = layers["attn"]
+    for i in range(cfg.n_layers):
+        p = f"transformer.h.{i}."
+        state[p + "input_layernorm.weight"] = _np(layers["ln1"]["scale"][i], dtype)
+        state[p + "input_layernorm.bias"] = _np(layers["ln1"]["bias"][i], dtype)
+        q, k, v = (t(a[key][i]) for key in ("wq", "wk", "wv"))
+        if K == 1:
+            fused = np.concatenate([q, k, v], axis=0)  # [(H+2)*hd, D]
+        else:  # K == H: [H, 3, hd] out-dim interleave
+            fused = np.stack(
+                [w.reshape(H, hd, D) for w in (q, k, v)], axis=1
+            ).reshape(3 * H * hd, D)
+        state[p + "self_attention.query_key_value.weight"] = fused
+        state[p + "self_attention.dense.weight"] = t(a["wo"][i])
+        m = layers["mlp"]
+        state[p + "mlp.dense_h_to_4h.weight"] = t(m["w_up"][i])
+        state[p + "mlp.dense_4h_to_h.weight"] = t(m["w_down"][i])
+    return state
+
+
 def _export_gptj_state(params, cfg: ModelConfig, dtype) -> dict[str, np.ndarray]:
     """Inverse of loader._convert_gptj."""
     layers = params["layers"]
@@ -305,6 +341,39 @@ def hf_config_dict(cfg: ModelConfig, qkv_bias: bool | None = None) -> dict:
             "use_parallel_residual": True,
             "tie_word_embeddings": False,
             "hidden_act": "gelu",
+        }
+    if cfg.parallel_block and not cfg.use_bias:  # falcon family (bias-free
+        # parallel block sharing one layernorm; phi's block is biased)
+        if (cfg.n_kv_heads not in (1, cfg.n_heads) or cfg.mlp_bias
+                or cfg.lm_head_bias or cfg.activation != "gelu_exact"
+                or cfg.rotary_pct < 1.0):
+            # HF Falcon hardcodes full rotary + erf gelu and only speaks
+            # the multi_query / per-head-interleave KV layouts — anything
+            # else would load in transformers and silently diverge
+            raise ValueError(
+                "falcon export requires n_kv_heads in (1, n_heads), full "
+                "rotary, gelu_exact, and no mlp/lm_head biases; got "
+                f"kv={cfg.n_kv_heads}, act={cfg.activation!r}, "
+                f"rotary_pct={cfg.rotary_pct}"
+            )
+        return {
+            "model_type": "falcon",
+            "architectures": ["FalconForCausalLM"],
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.d_model,
+            "num_hidden_layers": cfg.n_layers,
+            "num_attention_heads": cfg.n_heads,
+            "ffn_hidden_size": cfg.d_ff,
+            "max_position_embeddings": cfg.max_seq_len,
+            "rope_theta": cfg.rope_theta,
+            "layer_norm_epsilon": cfg.norm_eps,
+            "multi_query": cfg.n_kv_heads == 1,
+            "parallel_attn": True,
+            "new_decoder_architecture": False,
+            "alibi": False,
+            "bias": False,
+            "tie_word_embeddings": cfg.tie_embeddings,
+            "activation": "gelu",
         }
     if cfg.parallel_block:  # phi family
         return {
@@ -405,6 +474,9 @@ def export_hf(params, cfg: ModelConfig, out_dir: str | Path,
         state = _export_gptj_state(params, cfg, np_dtype)
     elif cfg.parallel_block and cfg.parallel_norms == 2:
         state = _export_neox_state(params, cfg, np_dtype)
+    elif cfg.parallel_block and not cfg.use_bias:  # falcon — same position
+        # in the chain as hf_config_dict's classification
+        state = _export_falcon_state(params, cfg, np_dtype)
     elif cfg.parallel_block:
         state = _export_phi_state(params, cfg, np_dtype)
     else:

@@ -191,6 +191,58 @@ def _convert_gptj(state, cfg: ModelConfig) -> dict:
     }
 
 
+def _convert_falcon(state, cfg: ModelConfig) -> dict:
+    """HF Falcon names → our layout. falcon-7b fuses q/k/v as
+    [(H + 2)*hd, D] with ALL query heads first, then one k head, then one
+    v head (multi_query — HF _split_heads' else branch); falcon-rw-style
+    checkpoints (multi_query=False) use the per-head [H, 3, hd]
+    interleave instead. Parallel attn+mlp share input_layernorm; no
+    linear biases; layernorms keep theirs."""
+    pre = "transformer." if any(k.startswith("transformer.") for k in state) else ""
+    g = lambda k: state[pre + k]
+    t = lambda a: np.ascontiguousarray(a.T)
+    L, D = cfg.n_layers, cfg.d_model
+    H, K, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    qw, kw, vw = [], [], []
+    for i in range(L):
+        w = g(f"h.{i}.self_attention.query_key_value.weight")
+        if K == 1:  # multi_query: q block, then single k + v heads
+            qw.append(t(w[: H * hd]))
+            kw.append(t(w[H * hd: (H + 1) * hd]))
+            vw.append(t(w[(H + 1) * hd:]))
+        elif K == H:  # falcon-rw: [H, 3, hd] on the out dim
+            wr = w.reshape(H, 3, hd, D)
+            for dst, j in ((qw, 0), (kw, 1), (vw, 2)):
+                dst.append(np.ascontiguousarray(wr[:, j].reshape(H * hd, D).T))
+        else:
+            raise ValueError(
+                "falcon grouped-KV (new_decoder_architecture) checkpoints "
+                "are not supported by the native loader"
+            )
+    layers = {
+        "ln1": {
+            "scale": _stack([g(f"h.{i}.input_layernorm.weight") for i in range(L)]),
+            "bias": _stack([g(f"h.{i}.input_layernorm.bias") for i in range(L)]),
+        },
+        "attn": {
+            "wq": _stack(qw), "wk": _stack(kw), "wv": _stack(vw),
+            "wo": _stack([t(g(f"h.{i}.self_attention.dense.weight")) for i in range(L)]),
+        },
+        "mlp": {
+            "w_up": _stack([t(g(f"h.{i}.mlp.dense_h_to_4h.weight")) for i in range(L)]),
+            "w_down": _stack([t(g(f"h.{i}.mlp.dense_4h_to_h.weight")) for i in range(L)]),
+        },
+    }
+    params = {
+        "tok_embed": g("word_embeddings.weight"),
+        "layers": layers,
+        "final_norm": {"scale": g("ln_f.weight"), "bias": g("ln_f.bias")},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = t(state["lm_head.weight"])
+    return params
+
+
 def _convert_neox(state, cfg: ModelConfig) -> dict:
     """HF GPT-NeoX/Pythia names → our layout. The fused query_key_value
     weight is [3*D, D] with rows ordered HEAD-MAJOR and q/k/v INTERLEAVED
@@ -339,6 +391,10 @@ def load_checkpoint(
         params = _convert_gpt2(state, cfg)
     elif any(".mlp.fc1." in k for k in state):
         params = _convert_phi(state, cfg)
+    elif any(".self_attention.query_key_value." in k for k in state):
+        # MUST precede the neox check: ".attention.query_key_value." is a
+        # substring of falcon's ".self_attention.query_key_value."
+        params = _convert_falcon(state, cfg)
     elif any(".attention.query_key_value." in k for k in state):
         params = _convert_neox(state, cfg)
     elif any(".mlp.fc_in." in k for k in state):  # gpt-j's unique mlp names

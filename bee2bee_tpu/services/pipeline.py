@@ -45,7 +45,10 @@ class PipelineService(BaseService):
         price_per_token: float = 0.0,
         max_new_tokens: int = 2048,
         max_batch: int = 8,
-        n_microbatches: int = 1,  # >1: stages overlap microbatch groups
+        # >1: stages overlap microbatch groups; "auto" picks 2 when the
+        # stages run on distinct hosts (parallel compute to unlock), 1 on
+        # a shared host (meshnet.pipeline.resolve_microbatches)
+        n_microbatches: int | str = "auto",
     ):
         super().__init__("pipeline")
         self.coordinator = coordinator

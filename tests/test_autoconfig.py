@@ -17,7 +17,7 @@ from bee2bee_tpu.models.export import export_hf, hf_config_dict
 @pytest.mark.parametrize(
     "name",
     ["tiny-gpt2", "tiny-llama", "tiny-mistral", "tiny-mixtral", "tiny-gemma",
-     "tiny-qwen", "tiny-phi", "tiny-neox", "tiny-gptj"],
+     "tiny-qwen", "tiny-phi", "tiny-neox", "tiny-gptj", "tiny-falcon"],
 )
 def test_config_from_hf_inverts_hf_config_dict(name):
     """For every supported family: our exported config.json must
@@ -131,3 +131,12 @@ def test_sliding_window_survives_mixtral_and_qwen2_round_trip():
         back = config_from_hf(d, name=cfg.name)
         assert back.sliding_window == 4, base
         assert back == cfg, base
+
+
+def test_config_from_hf_rejects_llama_attention_bias():
+    """attention_bias puts a bias on o_proj too — unrepresentable in the
+    qkv-only layout, so it must refuse, not serve offset logits."""
+    d = hf_config_dict(get_config("tiny-llama"))
+    d["attention_bias"] = True
+    with pytest.raises(ValueError, match="attention_bias"):
+        config_from_hf(d)

@@ -275,7 +275,7 @@ class TestAutoAttention:
         assert eng._resolve_auto_attention() == "sp"
 
 
-@pytest.mark.parametrize("family", ["tiny-phi", "tiny-neox", "tiny-gptj"])
+@pytest.mark.parametrize("family", ["tiny-phi", "tiny-neox", "tiny-gptj", "tiny-falcon"])
 def test_parallel_block_families_serve(family):
     """parallel-block families (phi: shared norm; neox: dual norm +
     interleaved-QKV heritage) through the cached decode path: prefill

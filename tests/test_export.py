@@ -52,7 +52,7 @@ def test_write_safetensors_roundtrip_dtypes(tmp_path):
 @pytest.mark.parametrize(
     "name",
     ["tiny-gpt2", "tiny-llama", "tiny-mistral", "tiny-mixtral", "tiny-gemma",
-     "tiny-qwen", "tiny-phi", "tiny-neox", "tiny-gptj"],
+     "tiny-qwen", "tiny-phi", "tiny-neox", "tiny-gptj", "tiny-falcon"],
 )
 def test_export_hf_roundtrips_through_loader(tmp_path, name):
     """export_hf must be the exact inverse of the loader's HF conversion
@@ -305,3 +305,39 @@ def test_torch_loads_mixtral_export_and_logits_match(tmp_path):
     with post-topk softmax renormalization and the w1/w2/w3 expert layout
     against MixtralForCausalLM."""
     _torch_conformance("tiny-mixtral", tmp_path, "MixtralForCausalLM", seed=24)
+
+
+def test_torch_loads_falcon_export_and_logits_match(tmp_path):
+    """falcon family conformance: the multi_query fused-QKV layout (all
+    query heads, then ONE k and ONE v head), the bias-free parallel block
+    sharing input_layernorm, and the tied lm_head against
+    FalconForCausalLM."""
+    _torch_conformance("tiny-falcon", tmp_path, "FalconForCausalLM", seed=31)
+
+
+def test_torch_loads_falcon_rw_export_and_logits_match(tmp_path):
+    """falcon-rw layout (multi_query=False): q/k/v fused as a per-head
+    [H, 3, hd] interleave — a naive thirds split would scramble heads."""
+    import dataclasses
+
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    if not hasattr(transformers, "FalconForCausalLM"):
+        pytest.skip("transformers too old for falcon")
+
+    cfg = dataclasses.replace(get_config("tiny-falcon"), n_kv_heads=4,
+                              name="tiny-falcon-rw")
+    params = core.init_params(cfg, jax.random.key(32), dtype=jnp.float32)
+    out = export_hf(params, cfg, tmp_path / "hf_falcon_rw", dtype="float32")
+    import json as _json
+    assert _json.loads((out / "config.json").read_text())["multi_query"] is False
+
+    model = transformers.FalconForCausalLM.from_pretrained(out)
+    model.eval()
+    ids = np.array([[1, 7, 42, 99, 3, 250, 8, 11]], np.int32)
+    ours, _ = core.forward(params, cfg, jnp.asarray(ids), None, jnp.int32(0))
+    with torch.no_grad():
+        theirs = model(torch.from_numpy(ids.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(
+        np.asarray(ours, np.float32), theirs, atol=2e-4, rtol=1e-3
+    )

@@ -480,3 +480,73 @@ def test_ring_sample_distribution_matches_softmax():
     a = StageTaskMixin._ring_sample(logits, {"temperature": 1.0, "seed": 5, "offset": 3})
     b = StageTaskMixin._ring_sample(logits, {"temperature": 1.0, "seed": 5, "offset": 3})
     assert a == b
+
+
+def test_resolve_microbatches_topology():
+    """'auto' picks overlap only when stages have independent compute
+    (distinct hosts); shared-host and unknown topologies stay at 1."""
+    from bee2bee_tpu.meshnet.pipeline import resolve_microbatches
+
+    assert resolve_microbatches(["ws://127.0.0.1:1", "ws://127.0.0.1:2"]) == 1
+    assert resolve_microbatches(["ws://10.0.0.1:1", "ws://10.0.0.2:1"]) == 2
+    assert resolve_microbatches(["ws://10.0.0.1:1", None]) == 1
+    assert resolve_microbatches([]) == 1
+    # loopback aliases are ONE machine, not two hosts
+    assert resolve_microbatches(["ws://localhost:1", "ws://127.0.0.1:2"]) == 1
+    assert resolve_microbatches(["ws://[::1]:1", "ws://127.0.0.1:2"]) == 1
+
+
+async def test_session_auto_microbatches_resolves_one_on_loopback():
+    async with pipeline_mesh() as (workers, coord, client, svc):
+        # fixture stages are both on 127.0.0.1 → auto must NOT pay 2x hops
+        assert len(svc.session.groups) == 1
+
+
+async def test_sampled_burst_gated_on_ring_sampling_capability():
+    """A ring of stages that do NOT advertise ring_sampling (pre-round-5
+    peers) must serve temperature>0 via the per-token chain — never let
+    an old last stage silently argmax a sampled request."""
+    workers = [P2PNode(host="127.0.0.1", port=0, node_id=f"gstage{i}") for i in range(2)]
+    coord = P2PNode(host="127.0.0.1", port=0, node_id="gcoord")
+    nodes = [*workers, coord]
+    for n in nodes:
+        await n.start()
+    try:
+        for w in workers:
+            await coord.connect_bootstrap(w.addr)
+        await _settle(lambda: len(coord.peers) >= 2)
+        coordinator = PipelineCoordinator(
+            coord, MODEL, stage_peers=[w.peer_id for w in workers],
+            max_seq_len=128, dtype="float32", rng_seed=SEED,
+        )
+        await coordinator.load(timeout=120.0)
+        assert coordinator.ring_ok and coordinator.ring_sampling_ok
+
+        coordinator.ring_sampling_ok = False  # an old-version ring
+        from bee2bee_tpu import protocol as proto
+
+        kinds: list[str] = []
+        orig_run = coord.run_stage_task
+
+        async def counting(peer, kind, *a, **kw):
+            kinds.append(kind)
+            return await orig_run(peer, kind, *a, **kw)
+
+        coord.run_stage_task = counting
+        tok = ByteTokenizer(get_config(MODEL).vocab_size)
+        try:
+            out = await coordinator.generate(
+                tok.encode("old ring"), max_new_tokens=6, temperature=1.0
+            )
+            # greedy must still use the burst
+            out2 = await coordinator.generate(
+                tok.encode("old ring"), max_new_tokens=6, temperature=0.0
+            )
+        finally:
+            coord.run_stage_task = orig_run
+        assert len(out) == 6 and len(out2) == 6
+        # the sampled request sent NO decode_run; the greedy one sent 1
+        assert kinds.count(proto.TASK_DECODE_RUN) == 1, kinds
+    finally:
+        for n in nodes:
+            await n.stop()
