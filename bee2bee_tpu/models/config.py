@@ -149,6 +149,21 @@ CONFIGS: dict[str, ModelConfig] = {
         n_kv_heads=2, d_ff=128, max_seq_len=256, qkv_bias=True,
         rope_theta=1000000.0,
     ),
+    # gpt-bigcode / starcoder style: gpt2 block + MQA, tanh gelu
+    "tiny-bigcode": ModelConfig(
+        name="tiny-bigcode", vocab_size=512, d_model=64, n_layers=2,
+        n_heads=4, n_kv_heads=1, d_ff=128, max_seq_len=256,
+        pos_embedding="learned", norm="layernorm", activation="gelu",
+        use_bias=True, tie_embeddings=True,
+    ),
+    "starcoder-15b": ModelConfig(
+        # bigcode/starcoderbase: 48 128-dim heads with ONE kv head over a
+        # gpt2-style learned-position block, 8k context
+        name="starcoder-15b", vocab_size=49152, d_model=6144, n_layers=40,
+        n_heads=48, n_kv_heads=1, d_ff=24576, max_seq_len=8192,
+        pos_embedding="learned", norm="layernorm", activation="gelu",
+        use_bias=True, tie_embeddings=True,
+    ),
     # -- BASELINE ladder --
     "distilgpt2": _gpt2("distilgpt2", d_model=768, n_layers=6, n_heads=12),
     "gpt2": _gpt2("gpt2", d_model=768, n_layers=12, n_heads=12),
@@ -306,6 +321,18 @@ def config_from_hf(d: dict, name: str | None = None) -> ModelConfig:
             tie_embeddings=True,
             norm_eps=d.get("layer_norm_epsilon", 1e-5),
         )
+    if mt == "gpt_bigcode":
+        H = d["n_head"]
+        return ModelConfig(
+            name=nm, vocab_size=d["vocab_size"], d_model=d["n_embd"],
+            n_layers=d["n_layer"], n_heads=H,
+            n_kv_heads=1 if d.get("multi_query", True) else H,
+            d_ff=d.get("n_inner") or 4 * d["n_embd"],
+            max_seq_len=d.get("n_positions", 1024), pos_embedding="learned",
+            norm="layernorm", activation="gelu", use_bias=True,
+            tie_embeddings=d.get("tie_word_embeddings", True),
+            norm_eps=d.get("layer_norm_epsilon", 1e-5),
+        )
     if mt == "gptj":
         hd = d["n_embd"] // d["n_head"]
         return ModelConfig(
@@ -351,6 +378,14 @@ def config_from_hf(d: dict, name: str | None = None) -> ModelConfig:
             raise ValueError(
                 "falcon parallel_attn=false (sequential blocks) is not "
                 "supported by the native falcon path"
+            )
+        if d.get("bias"):
+            # our falcon layout is bias-free (like every released falcon);
+            # loading a bias=true checkpoint would silently zero every
+            # linear bias — refuse, don't drop
+            raise ValueError(
+                "falcon bias=true checkpoints are not supported by the "
+                "native core; serve via the ollama/remote backends"
             )
         H, D = d["num_attention_heads"], d["hidden_size"]
         return ModelConfig(

@@ -113,6 +113,40 @@ def _export_gpt2_state(params, cfg: ModelConfig, dtype) -> dict[str, np.ndarray]
     return state
 
 
+def _export_bigcode_state(params, cfg: ModelConfig, dtype) -> dict[str, np.ndarray]:
+    """Inverse of loader._convert_bigcode: nn.Linear [out, in] with the
+    fused c_attn packing q block then k then v on the OUT dim."""
+    layers = params["layers"]
+    t = lambda a: _np(a, dtype).T
+    state = {
+        "transformer.wte.weight": _np(params["tok_embed"], dtype),
+        "transformer.wpe.weight": _np(params["pos_embed"], dtype),
+        "transformer.ln_f.weight": _np(params["final_norm"]["scale"], dtype),
+        "transformer.ln_f.bias": _np(params["final_norm"]["bias"], dtype),
+        "lm_head.weight": _np(params["tok_embed"], dtype),  # tied
+    }
+    a = layers["attn"]
+    for i in range(cfg.n_layers):
+        p = f"transformer.h.{i}."
+        for ln, hf in (("ln1", "ln_1"), ("ln2", "ln_2")):
+            state[p + f"{hf}.weight"] = _np(layers[ln]["scale"][i], dtype)
+            state[p + f"{hf}.bias"] = _np(layers[ln]["bias"][i], dtype)
+        state[p + "attn.c_attn.weight"] = np.concatenate(
+            [t(a["wq"][i]), t(a["wk"][i]), t(a["wv"][i])], axis=0
+        )
+        state[p + "attn.c_attn.bias"] = np.concatenate(
+            [_np(a[b][i], dtype) for b in ("bq", "bk", "bv")]
+        )
+        state[p + "attn.c_proj.weight"] = t(a["wo"][i])
+        state[p + "attn.c_proj.bias"] = _np(a["bo"][i], dtype)
+        m = layers["mlp"]
+        state[p + "mlp.c_fc.weight"] = t(m["w_up"][i])
+        state[p + "mlp.c_fc.bias"] = _np(m["b_up"][i], dtype)
+        state[p + "mlp.c_proj.weight"] = t(m["w_down"][i])
+        state[p + "mlp.c_proj.bias"] = _np(m["b_down"][i], dtype)
+    return state
+
+
 def _export_llama_state(params, cfg: ModelConfig, dtype) -> dict[str, np.ndarray]:
     """Inverse of loader._convert_llama: transpose back to HF [out, in] and
     undo the gemma (1 + w) rmsnorm fold."""
@@ -288,6 +322,28 @@ def hf_config_dict(cfg: ModelConfig, qkv_bias: bool | None = None) -> dict:
     present): a checkpoint loaded with biases under a biasless config must
     still export as qwen2, or transformers would silently drop the bias
     tensors the state dict carries."""
+    if cfg.pos_embedding == "learned" and cfg.n_kv_heads != cfg.n_heads:
+        # gpt-bigcode family (starcoder): the only learned-pos MQA layout
+        if cfg.n_kv_heads != 1 or not cfg.tie_embeddings:
+            raise ValueError(
+                "gpt_bigcode export requires n_kv_heads=1 (multi_query) "
+                "and tied embeddings; got "
+                f"kv={cfg.n_kv_heads}, tie={cfg.tie_embeddings}"
+            )
+        return {
+            "model_type": "gpt_bigcode",
+            "architectures": ["GPTBigCodeForCausalLM"],
+            "vocab_size": cfg.vocab_size,
+            "n_positions": cfg.max_seq_len,
+            "n_embd": cfg.d_model,
+            "n_layer": cfg.n_layers,
+            "n_head": cfg.n_heads,
+            "n_inner": cfg.d_ff,
+            "layer_norm_epsilon": cfg.norm_eps,
+            "activation_function": "gelu_pytorch_tanh",
+            "multi_query": True,
+            "tie_word_embeddings": True,
+        }
     if cfg.pos_embedding == "learned":  # gpt2 family
         return {
             "model_type": "gpt2",
@@ -465,7 +521,9 @@ def export_hf(params, cfg: ModelConfig, out_dir: str | Path,
     out = Path(out_dir)
     out.mkdir(parents=True, exist_ok=True)
     np_dtype = np.dtype(dtype) if dtype != "bfloat16" else _bf16_dtype()
-    if cfg.pos_embedding == "learned":
+    if cfg.pos_embedding == "learned" and cfg.n_kv_heads != cfg.n_heads:
+        state = _export_bigcode_state(params, cfg, np_dtype)
+    elif cfg.pos_embedding == "learned":
         state = _export_gpt2_state(params, cfg, np_dtype)
     elif cfg.parallel_block and cfg.rope_style == "interleaved":
         # SAME ordering as hf_config_dict: the two dispatch chains must

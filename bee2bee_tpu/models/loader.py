@@ -113,6 +113,59 @@ def _convert_gpt2(state, cfg: ModelConfig) -> dict:
     }
 
 
+def _convert_bigcode(state, cfg: ModelConfig) -> dict:
+    """HF GPT-BigCode (starcoder/santacoder) names → our layout. Same
+    names as gpt2 but nn.Linear ([out, in]) instead of Conv1D, and the
+    fused c_attn packs [D + 2*kv_dim] on the OUT dim: all query heads,
+    then k, then v (MQA: kv_dim = head_dim)."""
+    pre = "transformer." if any(k.startswith("transformer.") for k in state) else ""
+    g = lambda k: state[pre + k]
+    t = lambda a: np.ascontiguousarray(a.T)
+    L, D = cfg.n_layers, cfg.d_model
+    kv = cfg.n_kv_heads * cfg.head_dim
+    H, hd = cfg.n_heads, cfg.head_dim
+    qw, kw, vw, qb, kb, vb = [], [], [], [], [], []
+    for i in range(L):
+        w = g(f"h.{i}.attn.c_attn.weight")  # [D + 2*kv, D]
+        b = g(f"h.{i}.attn.c_attn.bias")
+        if cfg.n_kv_heads == H:
+            # multi_query=False packs q/k/v PER HEAD ([H, 3*hd] out-dims,
+            # HF view(num_heads, 3*head_dim).split) — a sequential-thirds
+            # split would scramble K/V across heads
+            wr = w.reshape(H, 3, hd, D)
+            br = b.reshape(H, 3, hd)
+            for dst, bst, j in ((qw, qb, 0), (kw, kb, 1), (vw, vb, 2)):
+                dst.append(np.ascontiguousarray(wr[:, j].reshape(H * hd, D).T))
+                bst.append(np.ascontiguousarray(br[:, j].reshape(H * hd)))
+        else:  # multi_query: query block, then one k head, then one v head
+            qw.append(t(w[:D])); kw.append(t(w[D:D + kv])); vw.append(t(w[D + kv:]))
+            qb.append(b[:D]); kb.append(b[D:D + kv]); vb.append(b[D + kv:])
+    layers = {
+        "ln1": {"scale": _stack([g(f"h.{i}.ln_1.weight") for i in range(L)]),
+                "bias": _stack([g(f"h.{i}.ln_1.bias") for i in range(L)])},
+        "ln2": {"scale": _stack([g(f"h.{i}.ln_2.weight") for i in range(L)]),
+                "bias": _stack([g(f"h.{i}.ln_2.bias") for i in range(L)])},
+        "attn": {
+            "wq": _stack(qw), "wk": _stack(kw), "wv": _stack(vw),
+            "bq": _stack(qb), "bk": _stack(kb), "bv": _stack(vb),
+            "wo": _stack([t(g(f"h.{i}.attn.c_proj.weight")) for i in range(L)]),
+            "bo": _stack([g(f"h.{i}.attn.c_proj.bias") for i in range(L)]),
+        },
+        "mlp": {
+            "w_up": _stack([t(g(f"h.{i}.mlp.c_fc.weight")) for i in range(L)]),
+            "b_up": _stack([g(f"h.{i}.mlp.c_fc.bias") for i in range(L)]),
+            "w_down": _stack([t(g(f"h.{i}.mlp.c_proj.weight")) for i in range(L)]),
+            "b_down": _stack([g(f"h.{i}.mlp.c_proj.bias") for i in range(L)]),
+        },
+    }
+    return {
+        "tok_embed": g("wte.weight"),
+        "pos_embed": g("wpe.weight"),
+        "layers": layers,
+        "final_norm": {"scale": g("ln_f.weight"), "bias": g("ln_f.bias")},
+    }
+
+
 def _convert_phi(state, cfg: ModelConfig) -> dict:
     """HF phi-2 names → our layout (microsoft/phi-2: parallel blocks with
     one input_layernorm, q/k/v/dense + fc1/fc2 all biased, untied
@@ -388,7 +441,14 @@ def load_checkpoint(
         return load_native(path, dtype=dtype, host=host)
     state = _load_hf_state(path)
     if any(".c_attn." in k for k in state):
-        params = _convert_gpt2(state, cfg)
+        # gpt2 stores Conv1D [D, 3D]; gpt-bigcode stores Linear
+        # [D + 2*kv_dim, D] — MQA configs and/or the transposed shape
+        # identify the bigcode layout
+        w0 = next(v for k, v in state.items() if k.endswith("attn.c_attn.weight"))
+        if cfg.n_kv_heads != cfg.n_heads or w0.shape[0] != cfg.d_model:
+            params = _convert_bigcode(state, cfg)
+        else:
+            params = _convert_gpt2(state, cfg)
     elif any(".mlp.fc1." in k for k in state):
         params = _convert_phi(state, cfg)
     elif any(".self_attention.query_key_value." in k for k in state):

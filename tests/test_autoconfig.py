@@ -17,7 +17,8 @@ from bee2bee_tpu.models.export import export_hf, hf_config_dict
 @pytest.mark.parametrize(
     "name",
     ["tiny-gpt2", "tiny-llama", "tiny-mistral", "tiny-mixtral", "tiny-gemma",
-     "tiny-qwen", "tiny-phi", "tiny-neox", "tiny-gptj", "tiny-falcon"],
+     "tiny-qwen", "tiny-phi", "tiny-neox", "tiny-gptj", "tiny-falcon",
+     "tiny-bigcode"],
 )
 def test_config_from_hf_inverts_hf_config_dict(name):
     """For every supported family: our exported config.json must
@@ -139,4 +140,13 @@ def test_config_from_hf_rejects_llama_attention_bias():
     d = hf_config_dict(get_config("tiny-llama"))
     d["attention_bias"] = True
     with pytest.raises(ValueError, match="attention_bias"):
+        config_from_hf(d)
+
+
+def test_config_from_hf_rejects_falcon_bias():
+    """bias=true falcon would load with every linear bias silently
+    zeroed — refuse instead."""
+    d = hf_config_dict(get_config("tiny-falcon"))
+    d["bias"] = True
+    with pytest.raises(ValueError, match="bias"):
         config_from_hf(d)
