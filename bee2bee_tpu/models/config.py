@@ -329,7 +329,12 @@ def config_from_hf(d: dict, name: str | None = None) -> ModelConfig:
             n_kv_heads=1 if d.get("multi_query", True) else H,
             d_ff=d.get("n_inner") or 4 * d["n_embd"],
             max_seq_len=d.get("n_positions", 1024), pos_embedding="learned",
-            norm="layernorm", activation="gelu", use_bias=True,
+            norm="layernorm",
+            # same gelu-dialect map (and refusal of non-gelu) as gpt_neox:
+            # an exact-gelu checkpoint must not silently run tanh-approx
+            activation=_neox_act(d.get("activation_function",
+                                       "gelu_pytorch_tanh")),
+            use_bias=True,
             tie_embeddings=d.get("tie_word_embeddings", True),
             norm_eps=d.get("layer_norm_epsilon", 1e-5),
         )

@@ -123,7 +123,10 @@ def _export_bigcode_state(params, cfg: ModelConfig, dtype) -> dict[str, np.ndarr
         "transformer.wpe.weight": _np(params["pos_embed"], dtype),
         "transformer.ln_f.weight": _np(params["final_norm"]["scale"], dtype),
         "transformer.ln_f.bias": _np(params["final_norm"]["bias"], dtype),
-        "lm_head.weight": _np(params["tok_embed"], dtype),  # tied
+        "lm_head.weight": (
+            _np(params["tok_embed"], dtype) if cfg.tie_embeddings
+            else t(params["lm_head"])
+        ),
     }
     a = layers["attn"]
     for i in range(cfg.n_layers):
@@ -324,11 +327,19 @@ def hf_config_dict(cfg: ModelConfig, qkv_bias: bool | None = None) -> dict:
     tensors the state dict carries."""
     if cfg.pos_embedding == "learned" and cfg.n_kv_heads != cfg.n_heads:
         # gpt-bigcode family (starcoder): the only learned-pos MQA layout
-        if cfg.n_kv_heads != 1 or not cfg.tie_embeddings:
+        if cfg.n_kv_heads != 1:
             raise ValueError(
-                "gpt_bigcode export requires n_kv_heads=1 (multi_query) "
-                "and tied embeddings; got "
-                f"kv={cfg.n_kv_heads}, tie={cfg.tie_embeddings}"
+                "gpt_bigcode export requires n_kv_heads=1 (multi_query); "
+                f"got kv={cfg.n_kv_heads}"
+            )
+        # declare the gelu dialect the weights were trained with — a
+        # hardcoded tanh-approx would load in transformers WITHOUT
+        # warning and silently diverge for exact-gelu configs
+        act = {"gelu": "gelu_pytorch_tanh", "gelu_exact": "gelu"}.get(cfg.activation)
+        if act is None:
+            raise ValueError(
+                f"gpt_bigcode export supports gelu activations only; got "
+                f"{cfg.activation!r}"
             )
         return {
             "model_type": "gpt_bigcode",
@@ -340,9 +351,9 @@ def hf_config_dict(cfg: ModelConfig, qkv_bias: bool | None = None) -> dict:
             "n_head": cfg.n_heads,
             "n_inner": cfg.d_ff,
             "layer_norm_epsilon": cfg.norm_eps,
-            "activation_function": "gelu_pytorch_tanh",
+            "activation_function": act,
             "multi_query": True,
-            "tie_word_embeddings": True,
+            "tie_word_embeddings": cfg.tie_embeddings,
         }
     if cfg.pos_embedding == "learned":  # gpt2 family
         return {

@@ -158,12 +158,19 @@ def _convert_bigcode(state, cfg: ModelConfig) -> dict:
             "b_down": _stack([g(f"h.{i}.mlp.c_proj.bias") for i in range(L)]),
         },
     }
-    return {
+    out = {
         "tok_embed": g("wte.weight"),
         "pos_embed": g("wpe.weight"),
         "layers": layers,
         "final_norm": {"scale": g("ln_f.weight"), "bias": g("ln_f.bias")},
     }
+    if not cfg.tie_embeddings:
+        lm = state.get("lm_head.weight")
+        out["lm_head"] = (
+            t(lm) if lm is not None
+            else np.ascontiguousarray(g("wte.weight").T)
+        )
+    return out
 
 
 def _convert_phi(state, cfg: ModelConfig) -> dict:
