@@ -269,6 +269,15 @@ class InferenceEngine:
         a TPU-default host must not pick flash."""
         from ..ops.flash import validate_flash_mesh
 
+        if self.model_cfg.pos_embedding == "alibi":
+            if self.mesh.shape.get("seq", 1) > 1:
+                raise ValueError(
+                    "no attention impl supports ALiBi on a seq-sharded "
+                    "mesh; drop the seq axis"
+                )
+            logger.info("attention=auto -> dense (ALiBi bias: only the "
+                        "dense path implements it)")
+            return "dense"
         if self._window_binds():
             if self.mesh.shape.get("seq", 1) > 1:
                 # no impl supports seq-sharded cache + sliding window:
@@ -310,6 +319,14 @@ class InferenceEngine:
         return bool(w) and w < self.max_seq_len
 
     def _validate_attention_impl(self):
+        if (self.engine_cfg.attention in ("flash", "sp")
+                and self.model_cfg.pos_embedding == "alibi"):
+            raise ValueError(
+                f"attention={self.engine_cfg.attention!r} does not implement "
+                f"the ALiBi score bias ({self.model_cfg.name!r}); use "
+                "attention='dense' (the kernels would silently drop the "
+                "per-head position bias)"
+            )
         if self.engine_cfg.attention in ("flash", "sp") and self._window_binds():
             raise ValueError(
                 f"attention={self.engine_cfg.attention!r} does not implement "

@@ -24,7 +24,8 @@ class ModelConfig:
     d_ff: int
     max_seq_len: int = 2048
     # architecture switches
-    pos_embedding: str = "rope"  # "rope" | "learned"
+    pos_embedding: str = "rope"  # "rope" | "learned" | "alibi" (bloom:
+    # linear attention-score bias per head, no embedding-side positions)
     norm: str = "rmsnorm"  # "rmsnorm" | "layernorm"
     activation: str = "silu"  # "silu" (gated) | "gelu" (tanh approx, gpt2/
     # phi) | "gelu_exact" (erf — gpt-neox) | "geglu"
@@ -66,7 +67,15 @@ class ModelConfig:
     # size), not O(batch*seq). Groups route independently.
     moe_group_size: int = 512
 
+    # bloom: LayerNorm over the embeddings before block 0
+    embedding_norm: bool = False
+
     def __post_init__(self):
+        if self.pos_embedding not in ("rope", "learned", "alibi"):
+            raise ValueError(
+                f"pos_embedding={self.pos_embedding!r} must be 'rope', "
+                f"'learned', or 'alibi'"
+            )
         if self.rope_style not in ("half", "interleaved"):
             # a typo here would silently rotate the wrong way (core._rope
             # has no else-error) — fail like moe_impl does
@@ -242,6 +251,20 @@ CONFIGS["gpt-j-6b"] = ModelConfig(
     mlp_bias=True, rotary_pct=0.25, rope_style="interleaved",
     parallel_block=True, lm_head_bias=True,
 )
+CONFIGS["tiny-bloom"] = ModelConfig(  # ALiBi attention (no rotary/learned
+    # positions), embedding LayerNorm before block 0, biased everything
+    name="tiny-bloom", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+    n_kv_heads=4, d_ff=256, max_seq_len=256, pos_embedding="alibi",
+    norm="layernorm", activation="gelu", use_bias=True,
+    tie_embeddings=True, embedding_norm=True,
+)
+CONFIGS["bloom-7b1"] = ModelConfig(
+    # bigscience/bloom-7b1: 30 layers x 32 heads, ALiBi, 250k vocab
+    name="bloom-7b1", vocab_size=250880, d_model=4096, n_layers=30,
+    n_heads=32, n_kv_heads=32, d_ff=16384, max_seq_len=2048,
+    pos_embedding="alibi", norm="layernorm", activation="gelu",
+    use_bias=True, tie_embeddings=True, embedding_norm=True,
+)
 CONFIGS["tiny-falcon"] = ModelConfig(  # falcon-7b shape: MQA + bias-free
     # parallel block sharing ONE layernorm, exact-erf gelu, tied head
     name="tiny-falcon", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
@@ -367,6 +390,30 @@ def config_from_hf(d: dict, name: str | None = None) -> ModelConfig:
             rope_theta=d.get("rotary_emb_base", 10000.0),
             parallel_block=d.get("use_parallel_residual", True),
             parallel_norms=2, norm_eps=d.get("layer_norm_eps", 1e-5),
+        )
+    if mt == "bloom":
+        if d.get("apply_residual_connection_post_layernorm"):
+            # HF adds the post-LN hidden states to the residual under this
+            # flag; our blocks always use the pre-LN input — serving such
+            # a checkpoint would diverge at every layer, silently
+            raise ValueError(
+                "bloom apply_residual_connection_post_layernorm=true is "
+                "not supported by the native core; serve via the "
+                "ollama/remote backends"
+            )
+        H = d["n_head"]
+        return ModelConfig(
+            name=nm, vocab_size=d["vocab_size"], d_model=d["hidden_size"],
+            n_layers=d["n_layer"], n_heads=H, n_kv_heads=H,
+            d_ff=4 * d["hidden_size"],  # BloomConfig has no n_inner field
+            # ALiBi has no positional table — context is bounded only by
+            # the serving cache; seq_length is the training length the
+            # wild checkpoints carry (2048 for the bloom releases)
+            max_seq_len=d.get("seq_length", 2048),
+            pos_embedding="alibi", norm="layernorm",
+            activation="gelu", use_bias=True,
+            tie_embeddings=d.get("tie_word_embeddings", True),
+            embedding_norm=True, norm_eps=d.get("layer_norm_epsilon", 1e-5),
         )
     if mt == "falcon":
         if d.get("alibi"):

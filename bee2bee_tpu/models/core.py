@@ -72,6 +72,10 @@ def init_params(cfg: ModelConfig, key, dtype=jnp.bfloat16) -> Params:
     }
     if cfg.pos_embedding == "learned":
         params["pos_embed"] = _dense_init(next(keys), (cfg.max_seq_len, D), 0.02, dtype)
+    if cfg.embedding_norm:
+        params["embed_norm"] = {"scale": jnp.ones((D,), dtype)}
+        if cfg.norm == "layernorm":
+            params["embed_norm"]["bias"] = jnp.zeros((D,), dtype)
 
     layers: Params = {
         "ln1": {"scale": jnp.ones((L, D), dtype)},
@@ -189,6 +193,20 @@ def _activate(up, gate, cfg: ModelConfig):
     return jax.nn.gelu(up, approximate=True)
 
 
+def alibi_slopes(n_heads: int) -> list[float]:
+    """Per-head ALiBi slopes (the train-short-test-long bias of bloom/
+    mpt): geometric sequence 2^(-8i/n) for power-of-two head counts, with
+    HF's interpolation for the remainder otherwise — must match
+    transformers' build_alibi_tensor exactly or logits drift."""
+    n = 2 ** math.floor(math.log2(n_heads))
+    base = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+    slopes = [base ** (i + 1) for i in range(n)]
+    if n < n_heads:
+        extra_base = 2.0 ** (-(2.0 ** -(math.log2(2 * n) - 3)))
+        slopes += [extra_base ** (2 * i + 1) for i in range(n_heads - n)]
+    return slopes
+
+
 def _attention(q, k, v, mask, cfg: ModelConfig):
     """q: [B, T, H, hd]; k, v: [B, S, Hkv, hd]; mask: [B, 1, T, S] bool."""
     B, T, H, hd = q.shape
@@ -197,6 +215,14 @@ def _attention(q, k, v, mask, cfg: ModelConfig):
     q = q.reshape(B, T, Hkv, group, hd)
     logits = jnp.einsum("btkgh,bskh->bkgts", q, k).astype(jnp.float32)
     logits = logits / math.sqrt(hd)
+    if cfg.pos_embedding == "alibi":
+        # + slope_h * key_position: softmax is shift-invariant per query
+        # row, so the absolute-position form equals the relative -m*(i-j)
+        # bias (and is exactly what HF bloom adds); masked slots are
+        # overwritten below, so cache positions work unchanged
+        slopes = jnp.asarray(alibi_slopes(H), jnp.float32).reshape(Hkv, group)
+        logits = logits + (slopes[None, :, :, None, None]
+                           * jnp.arange(S, dtype=jnp.float32))
     # mask [B,1,T,S] -> broadcast over (kv_head, group) dims
     logits = jnp.where(mask[:, :, None, :, :], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
@@ -349,6 +375,8 @@ def embed_tokens(params: Params, cfg: ModelConfig, input_ids, positions):
         x = x * jnp.asarray(math.sqrt(cfg.d_model), x.dtype)
     if cfg.pos_embedding == "learned":
         x = x + jnp.take(params["pos_embed"], positions, axis=0)
+    if cfg.embedding_norm:  # bloom: LayerNorm before block 0
+        x = _norm(x, params["embed_norm"], cfg)
     return x
 
 

@@ -255,6 +255,52 @@ def _export_neox_state(params, cfg: ModelConfig, dtype) -> dict[str, np.ndarray]
     return state
 
 
+def _export_bloom_state(params, cfg: ModelConfig, dtype) -> dict[str, np.ndarray]:
+    """Inverse of loader._convert_bloom (re-interleaves the biased fused
+    QKV per head, restores the embedding LayerNorm)."""
+    layers = params["layers"]
+    t = lambda a: _np(a, dtype).T
+    H, hd, D = cfg.n_heads, cfg.head_dim, cfg.d_model
+    state = {
+        "transformer.word_embeddings.weight": _np(params["tok_embed"], dtype),
+        "transformer.word_embeddings_layernorm.weight": _np(
+            params["embed_norm"]["scale"], dtype),
+        "transformer.word_embeddings_layernorm.bias": _np(
+            params["embed_norm"]["bias"], dtype),
+        "transformer.ln_f.weight": _np(params["final_norm"]["scale"], dtype),
+        "transformer.ln_f.bias": _np(params["final_norm"]["bias"], dtype),
+        "lm_head.weight": (
+            _np(params["tok_embed"], dtype) if cfg.tie_embeddings
+            else t(params["lm_head"])
+        ),
+    }
+    a = layers["attn"]
+    for i in range(cfg.n_layers):
+        p = f"transformer.h.{i}."
+        for ln, hf in (("ln1", "input_layernorm"),
+                       ("ln2", "post_attention_layernorm")):
+            state[p + f"{hf}.weight"] = _np(layers[ln]["scale"][i], dtype)
+            state[p + f"{hf}.bias"] = _np(layers[ln]["bias"][i], dtype)
+        w3 = np.stack(
+            [_np(a[k][i], dtype).T.reshape(H, hd, D) for k in ("wq", "wk", "wv")],
+            axis=1,
+        )
+        b3 = np.stack(
+            [_np(a[k][i], dtype).reshape(H, hd) for k in ("bq", "bk", "bv")],
+            axis=1,
+        )
+        state[p + "self_attention.query_key_value.weight"] = w3.reshape(3 * H * hd, D)
+        state[p + "self_attention.query_key_value.bias"] = b3.reshape(3 * H * hd)
+        state[p + "self_attention.dense.weight"] = t(a["wo"][i])
+        state[p + "self_attention.dense.bias"] = _np(a["bo"][i], dtype)
+        m = layers["mlp"]
+        state[p + "mlp.dense_h_to_4h.weight"] = t(m["w_up"][i])
+        state[p + "mlp.dense_h_to_4h.bias"] = _np(m["b_up"][i], dtype)
+        state[p + "mlp.dense_4h_to_h.weight"] = t(m["w_down"][i])
+        state[p + "mlp.dense_4h_to_h.bias"] = _np(m["b_down"][i], dtype)
+    return state
+
+
 def _export_falcon_state(params, cfg: ModelConfig, dtype) -> dict[str, np.ndarray]:
     """Inverse of loader._convert_falcon (re-fuses q/k/v: multi_query's
     q-block-then-kv rows for K=1, the per-head [H, 3, hd] interleave for
@@ -325,6 +371,35 @@ def hf_config_dict(cfg: ModelConfig, qkv_bias: bool | None = None) -> dict:
     present): a checkpoint loaded with biases under a biasless config must
     still export as qwen2, or transformers would silently drop the bias
     tensors the state dict carries."""
+    if cfg.pos_embedding == "alibi":  # bloom family
+        if (cfg.n_kv_heads != cfg.n_heads or not cfg.use_bias
+                or cfg.norm != "layernorm" or cfg.activation != "gelu"
+                or not cfg.embedding_norm):
+            # HF Bloom hardcodes MHA, biased linears, tanh gelu, and the
+            # embedding LayerNorm — anything else would load in
+            # transformers WITHOUT warning and silently diverge
+            raise ValueError(
+                "bloom export requires MHA, use_bias, layernorm, gelu, "
+                f"and embedding_norm; got kv={cfg.n_kv_heads}, "
+                f"act={cfg.activation!r}, bias={cfg.use_bias}, "
+                f"norm={cfg.norm!r}, embedding_norm={cfg.embedding_norm}"
+            )
+        return {
+            "model_type": "bloom",
+            "architectures": ["BloomForCausalLM"],
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.d_model,
+            "n_layer": cfg.n_layers,
+            "n_head": cfg.n_heads,
+            "layer_norm_epsilon": cfg.norm_eps,
+            "apply_residual_connection_post_layernorm": False,
+            "slow_but_exact": False,
+            "tie_word_embeddings": cfg.tie_embeddings,
+            # BloomConfig has no position-table size (ALiBi); the wild
+            # checkpoints carry training length as seq_length — keep it
+            # so the config round-trips
+            "seq_length": cfg.max_seq_len,
+        }
     if cfg.pos_embedding == "learned" and cfg.n_kv_heads != cfg.n_heads:
         # gpt-bigcode family (starcoder): the only learned-pos MQA layout
         if cfg.n_kv_heads != 1:
@@ -531,8 +606,20 @@ def export_hf(params, cfg: ModelConfig, out_dir: str | Path,
     # unstacked list — the exporters index stacked [L, ...] arrays
     out = Path(out_dir)
     out.mkdir(parents=True, exist_ok=True)
+    # key the family choice on the ACTUAL params: a bias-carrying tree
+    # under a biasless config must still export as qwen2 (see hf_config_dict)
+    has_qkv_bias = (
+        None if cfg.pos_embedding == "learned"
+        else "bq" in params["layers"].get("attn", {})
+    )
+    # validate the config BEFORE building the state dict: unsupported
+    # combos must die with hf_config_dict's explanation, not a KeyError
+    # halfway through a tensor conversion
+    cfg_json = hf_config_dict(cfg, qkv_bias=has_qkv_bias)
     np_dtype = np.dtype(dtype) if dtype != "bfloat16" else _bf16_dtype()
-    if cfg.pos_embedding == "learned" and cfg.n_kv_heads != cfg.n_heads:
+    if cfg.pos_embedding == "alibi":
+        state = _export_bloom_state(params, cfg, np_dtype)
+    elif cfg.pos_embedding == "learned" and cfg.n_kv_heads != cfg.n_heads:
         state = _export_bigcode_state(params, cfg, np_dtype)
     elif cfg.pos_embedding == "learned":
         state = _export_gpt2_state(params, cfg, np_dtype)
@@ -554,15 +641,7 @@ def export_hf(params, cfg: ModelConfig, out_dir: str | Path,
         out / "model.safetensors", state,
         metadata={"format": "pt", "exported_by": "bee2bee_tpu"},
     )
-    # key the family choice on the ACTUAL params: a bias-carrying tree
-    # under a biasless config must still export as qwen2 (see hf_config_dict)
-    has_qkv_bias = (
-        None if cfg.pos_embedding == "learned"
-        else "bq" in params["layers"].get("attn", {})
-    )
-    (out / "config.json").write_text(
-        json.dumps(hf_config_dict(cfg, qkv_bias=has_qkv_bias), indent=2)
-    )
+    (out / "config.json").write_text(json.dumps(cfg_json, indent=2))
     return out
 
 

@@ -251,6 +251,65 @@ def _convert_gptj(state, cfg: ModelConfig) -> dict:
     }
 
 
+def _convert_bloom(state, cfg: ModelConfig) -> dict:
+    """HF BLOOM names → our layout: word_embeddings + its LayerNorm,
+    per-head [H, 3, hd] interleaved fused QKV WITH biases (same packing
+    as gpt-neox), biased dense/mlp, sequential pre-norm blocks, ALiBi
+    (no positional tensors at all)."""
+    pre = "transformer." if any(k.startswith("transformer.") for k in state) else ""
+    g = lambda k: state[pre + k]
+    t = lambda a: np.ascontiguousarray(a.T)
+    L, D = cfg.n_layers, cfg.d_model
+    H, hd = cfg.n_heads, cfg.head_dim
+    qw, kw, vw, qb, kb, vb = [], [], [], [], [], []
+    for i in range(L):
+        w = g(f"h.{i}.self_attention.query_key_value.weight")  # [3D, D]
+        b = g(f"h.{i}.self_attention.query_key_value.bias")
+        wr = w.reshape(H, 3, hd, D)
+        br = b.reshape(H, 3, hd)
+        for dst, bst, j in ((qw, qb, 0), (kw, kb, 1), (vw, vb, 2)):
+            dst.append(np.ascontiguousarray(wr[:, j].reshape(H * hd, D).T))
+            bst.append(np.ascontiguousarray(br[:, j].reshape(H * hd)))
+    layers = {
+        "ln1": {
+            "scale": _stack([g(f"h.{i}.input_layernorm.weight") for i in range(L)]),
+            "bias": _stack([g(f"h.{i}.input_layernorm.bias") for i in range(L)]),
+        },
+        "ln2": {
+            "scale": _stack([g(f"h.{i}.post_attention_layernorm.weight") for i in range(L)]),
+            "bias": _stack([g(f"h.{i}.post_attention_layernorm.bias") for i in range(L)]),
+        },
+        "attn": {
+            "wq": _stack(qw), "wk": _stack(kw), "wv": _stack(vw),
+            "bq": _stack(qb), "bk": _stack(kb), "bv": _stack(vb),
+            "wo": _stack([t(g(f"h.{i}.self_attention.dense.weight")) for i in range(L)]),
+            "bo": _stack([g(f"h.{i}.self_attention.dense.bias") for i in range(L)]),
+        },
+        "mlp": {
+            "w_up": _stack([t(g(f"h.{i}.mlp.dense_h_to_4h.weight")) for i in range(L)]),
+            "b_up": _stack([g(f"h.{i}.mlp.dense_h_to_4h.bias") for i in range(L)]),
+            "w_down": _stack([t(g(f"h.{i}.mlp.dense_4h_to_h.weight")) for i in range(L)]),
+            "b_down": _stack([g(f"h.{i}.mlp.dense_4h_to_h.bias") for i in range(L)]),
+        },
+    }
+    out = {
+        "tok_embed": g("word_embeddings.weight"),
+        "embed_norm": {
+            "scale": g("word_embeddings_layernorm.weight"),
+            "bias": g("word_embeddings_layernorm.bias"),
+        },
+        "layers": layers,
+        "final_norm": {"scale": g("ln_f.weight"), "bias": g("ln_f.bias")},
+    }
+    if not cfg.tie_embeddings:
+        lm = state.get("lm_head.weight")
+        out["lm_head"] = (
+            t(lm) if lm is not None
+            else np.ascontiguousarray(g("word_embeddings.weight").T)
+        )
+    return out
+
+
 def _convert_falcon(state, cfg: ModelConfig) -> dict:
     """HF Falcon names → our layout. falcon-7b fuses q/k/v as
     [(H + 2)*hd, D] with ALL query heads first, then one k head, then one
@@ -458,6 +517,8 @@ def load_checkpoint(
             params = _convert_gpt2(state, cfg)
     elif any(".mlp.fc1." in k for k in state):
         params = _convert_phi(state, cfg)
+    elif any("word_embeddings_layernorm" in k for k in state):
+        params = _convert_bloom(state, cfg)  # bloom's unique embed-LN key
     elif any(".self_attention.query_key_value." in k for k in state):
         # MUST precede the neox check: ".attention.query_key_value." is a
         # substring of falcon's ".self_attention.query_key_value."

@@ -53,7 +53,7 @@ def test_write_safetensors_roundtrip_dtypes(tmp_path):
     "name",
     ["tiny-gpt2", "tiny-llama", "tiny-mistral", "tiny-mixtral", "tiny-gemma",
      "tiny-qwen", "tiny-phi", "tiny-neox", "tiny-gptj", "tiny-falcon",
-     "tiny-bigcode"],
+     "tiny-bigcode", "tiny-bloom"],
 )
 def test_export_hf_roundtrips_through_loader(tmp_path, name):
     """export_hf must be the exact inverse of the loader's HF conversion
@@ -418,3 +418,69 @@ def test_hf_bigcode_mha_checkpoint_loads_and_logits_match(tmp_path):
     np.testing.assert_allclose(
         np.asarray(ours, np.float32), theirs, atol=2e-4, rtol=1e-3
     )
+
+
+def test_torch_loads_bloom_export_and_logits_match(tmp_path):
+    """bloom family conformance: ALiBi per-head score bias (slopes must
+    match HF build_alibi_tensor exactly), embedding LayerNorm, and the
+    biased per-head interleaved fused QKV against BloomForCausalLM."""
+    _torch_conformance("tiny-bloom", tmp_path, "BloomForCausalLM", seed=51)
+
+
+def test_alibi_cached_decode_matches_uncached_forward():
+    """The ALiBi bias under the KV cache: absolute key positions must
+    line up between bucketed prefill and per-step decode — greedy engine
+    continuation equals the no-cache rollout."""
+    from bee2bee_tpu.engine import EngineConfig, InferenceEngine
+
+    eng = InferenceEngine(
+        "tiny-bloom",
+        engine_config=EngineConfig(max_seq_len=64, prefill_buckets=(16,),
+                                   dtype="float32", cache_dtype="float32"),
+    )
+    try:
+        assert eng.engine_cfg.attention == "dense"
+        prompt = [1, 7, 42, 99, 3]
+        r = eng.generate(prompt, max_new_tokens=6, temperature=0.0)
+        cfg = eng.model_cfg
+        import jax as _jax
+
+        restacked = core.restack_layers(_jax.device_get(dict(eng.params)))
+        ids, want = list(prompt), []
+        for _ in range(6):
+            logits, _ = core.forward(
+                restacked, cfg, jnp.asarray([ids], jnp.int32), None,
+                jnp.int32(0),
+            )
+            t = int(np.argmax(np.asarray(logits[0, -1])))
+            ids.append(t)
+            want.append(t)
+        assert r.token_ids == want
+    finally:
+        eng.close()
+
+
+def test_alibi_rejects_flash_attention():
+    from bee2bee_tpu.engine import EngineConfig, InferenceEngine
+
+    with pytest.raises(ValueError, match="ALiBi"):
+        InferenceEngine(
+            "tiny-bloom",
+            engine_config=EngineConfig(max_seq_len=64, attention="flash",
+                                       dtype="float32",
+                                       cache_dtype="float32"),
+        )
+
+
+def test_alibi_slopes_match_transformers():
+    """Our slope formula against HF's build_alibi_tensor, incl. a
+    NON-power-of-two head count (the interpolated branch)."""
+    torch = pytest.importorskip("torch")
+    from transformers.models.bloom.modeling_bloom import build_alibi_tensor
+
+    for H in (4, 8, 6, 12, 71):
+        mask = torch.ones(1, 5)
+        alibi = build_alibi_tensor(mask, H, torch.float32)  # [H, 1, 5]
+        hf_slopes = (alibi[:, 0, -1] / 4.0).tolist()  # position 4 * slope
+        np.testing.assert_allclose(hf_slopes, core.alibi_slopes(H),
+                                   rtol=1e-6)

@@ -74,6 +74,9 @@ CASES = {
     "gpt_neox": ("GPTNeoXConfig", "GPTNeoXForCausalLM",
                  dict(TINY, rotary_pct=0.25, use_parallel_residual=True,
                       attention_dropout=0.0, hidden_dropout=0.0)),
+    "bloom": ("BloomConfig", "BloomForCausalLM",
+              dict(vocab_size=512, hidden_size=64, n_layer=2, n_head=4,
+                   hidden_dropout=0.0, attention_dropout=0.0)),
 }
 
 
