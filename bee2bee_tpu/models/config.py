@@ -33,6 +33,11 @@ class ModelConfig:
     qkv_bias: bool = False  # bias on q/k/v ONLY (qwen2 style; no bo/mlp bias)
     tie_embeddings: bool = True
     rope_theta: float = 10000.0
+    # frequency-domain RoPE scaling, encoded as a hashable tuple:
+    #   ("linear", factor)  — position-interpolation fine-tunes
+    #   ("llama3", factor, low_freq_factor, high_freq_factor,
+    #    original_max_position_embeddings)  — llama-3.1+ checkpoints
+    rope_scaling: tuple | None = None
     norm_eps: float = 1e-5
     logits_softcap: float | None = None
     embedding_scale: bool = False  # gemma multiplies embeds by sqrt(d_model)
@@ -71,6 +76,18 @@ class ModelConfig:
     embedding_norm: bool = False
 
     def __post_init__(self):
+        if self.rope_scaling is not None:
+            # normalize a json list back to the hashable tuple form (the
+            # native-checkpoint model_config.json round-trip)
+            object.__setattr__(self, "rope_scaling", tuple(self.rope_scaling))
+            kind = self.rope_scaling[0]
+            want = {"linear": 2, "llama3": 5}.get(kind)
+            if want is None or len(self.rope_scaling) != want:
+                raise ValueError(
+                    f"rope_scaling={self.rope_scaling!r}: expected "
+                    f"('linear', factor) or ('llama3', factor, low_freq, "
+                    f"high_freq, original_max_pos)"
+                )
         if self.pos_embedding not in ("rope", "learned", "alibi"):
             raise ValueError(
                 f"pos_embedding={self.pos_embedding!r} must be 'rope', "
@@ -227,6 +244,12 @@ CONFIGS: dict[str, ModelConfig] = {
 
 # zephyr IS mistral-7b architecture — one definition, two names (drift-proof)
 CONFIGS["mistral-7b"] = replace(CONFIGS["zephyr-7b"], name="mistral-7b")
+# llama-3.1: same weights-shape as llama-3 + the llama3 rope-scaling
+# schedule over a 128k window (config.json: rope_scaling.rope_type=llama3)
+CONFIGS["llama-3.1-8b"] = replace(
+    CONFIGS["llama-3-8b"], name="llama-3.1-8b", max_seq_len=131072,
+    rope_scaling=("llama3", 8.0, 1.0, 4.0, 8192),
+)
 
 CONFIGS["tiny-phi"] = ModelConfig(  # parallel blocks + partial rotary
     name="tiny-phi", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
@@ -324,6 +347,30 @@ def _neox_act(hidden_act: str) -> str:
     )
 
 
+def _parse_rope_scaling(d: dict) -> tuple | None:
+    """HF rope_scaling dict → cfg.rope_scaling tuple, or raise for
+    schedules the core doesn't implement (yarn/longrope/dynamic) — every
+    rotary family must route through this, or an extended-context
+    fine-tune serves with unscaled rotations, silently wrong at every
+    position."""
+    rs = d.get("rope_scaling")
+    if not rs:
+        return None
+    rtype = rs.get("rope_type") or rs.get("type")
+    if rtype == "llama3":
+        return ("llama3", float(rs["factor"]), float(rs["low_freq_factor"]),
+                float(rs["high_freq_factor"]),
+                int(rs["original_max_position_embeddings"]))
+    if rtype == "linear":
+        return ("linear", float(rs["factor"]))
+    if rtype in ("default", None):
+        return None
+    raise ValueError(
+        f"rope_scaling type {rtype!r} is not supported by the native core "
+        f"(llama3/linear only); serve via the ollama/remote backends"
+    )
+
+
 def config_from_hf(d: dict, name: str | None = None) -> ModelConfig:
     """Synthesize a ModelConfig from an HF ``config.json`` dict — the
     any-checkpoint path: a checkpoint whose architecture is NOT in the
@@ -388,6 +435,7 @@ def config_from_hf(d: dict, name: str | None = None) -> ModelConfig:
             tie_embeddings=d.get("tie_word_embeddings", False),
             rotary_pct=d.get("rotary_pct", 1.0),
             rope_theta=d.get("rotary_emb_base", 10000.0),
+            rope_scaling=_parse_rope_scaling(d),
             parallel_block=d.get("use_parallel_residual", True),
             parallel_norms=2, norm_eps=d.get("layer_norm_eps", 1e-5),
         )
@@ -448,7 +496,8 @@ def config_from_hf(d: dict, name: str | None = None) -> ModelConfig:
             max_seq_len=d.get("max_position_embeddings", 2048),
             activation="gelu_exact", norm="layernorm",
             tie_embeddings=d.get("tie_word_embeddings", True),
-            rope_theta=d.get("rope_theta", 10000.0), parallel_block=True,
+            rope_theta=d.get("rope_theta", 10000.0),
+            rope_scaling=_parse_rope_scaling(d), parallel_block=True,
             norm_eps=d.get("layer_norm_epsilon", 1e-5),
         )
     if mt == "phi":
@@ -461,7 +510,8 @@ def config_from_hf(d: dict, name: str | None = None) -> ModelConfig:
             activation="gelu", norm="layernorm", use_bias=True,
             tie_embeddings=False,
             rotary_pct=d.get("partial_rotary_factor", 1.0),
-            rope_theta=d.get("rope_theta", 10000.0), parallel_block=True,
+            rope_theta=d.get("rope_theta", 10000.0),
+            rope_scaling=_parse_rope_scaling(d), parallel_block=True,
             lm_head_bias=True, norm_eps=d.get("layer_norm_eps", 1e-5),
         )
     if mt in ("llama", "mistral", "qwen2", "gemma", "mixtral"):
@@ -480,6 +530,8 @@ def config_from_hf(d: dict, name: str | None = None) -> ModelConfig:
             tie_embeddings=d.get("tie_word_embeddings", mt == "gemma"),
             qkv_bias=mt == "qwen2",
         )
+        if (scaling := _parse_rope_scaling(d)) is not None:
+            kw["rope_scaling"] = scaling
         if d.get("attention_bias"):
             # HF attention_bias puts biases on q/k/v AND o_proj; our
             # llama-branch layout carries q/k/v biases only (qwen2 style),

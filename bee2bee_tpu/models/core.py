@@ -152,8 +152,33 @@ def _norm(x, p, cfg: ModelConfig):
     return out
 
 
+def scale_rope_freqs(freqs, scaling: tuple | None):
+    """Frequency-domain RoPE scaling (cfg.rope_scaling).
+
+    "linear": all frequencies divided by the factor — position
+    interpolation. "llama3" (llama-3.1+): long wavelengths (> original
+    context / low_freq_factor) get the full division, short wavelengths
+    (< original / high_freq_factor) stay untouched, the band between
+    interpolates — must match transformers' _compute_llama3_parameters
+    exactly or every position's rotation drifts."""
+    if scaling is None:
+        return freqs
+    if scaling[0] == "linear":
+        return freqs / scaling[1]
+    _, factor, low_f, high_f, orig = scaling
+    low_wavelen = orig / low_f
+    high_wavelen = orig / high_f
+    wavelen = 2.0 * math.pi / freqs
+    smooth = (orig / wavelen - low_f) / (high_f - low_f)
+    smoothed = (1.0 - smooth) * freqs / factor + smooth * freqs
+    return jnp.where(
+        wavelen > low_wavelen, freqs / factor,
+        jnp.where(wavelen < high_wavelen, freqs, smoothed),
+    )
+
+
 def _rope(x, positions, theta: float, rot: int | None = None,
-          style: str = "half"):
+          style: str = "half", scaling: tuple | None = None):
     """Rotary embedding. x: [B, T, H, hd]; positions: [B, T].
 
     rot < hd rotates only the FIRST rot dims and passes the tail through
@@ -166,6 +191,7 @@ def _rope(x, positions, theta: float, rot: int | None = None,
     rot = hd if rot is None else rot
     xr, tail = x[..., :rot], x[..., rot:]
     freqs = 1.0 / (theta ** (jnp.arange(0, rot, 2, dtype=jnp.float32) / rot))
+    freqs = scale_rope_freqs(freqs, scaling)
     angles = positions[..., None].astype(jnp.float32) * freqs  # [B, T, rot/2]
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]
@@ -410,8 +436,10 @@ def transformer_block(
     k = k.reshape(B, T, Hkv, hd)
     v = v.reshape(B, T, Hkv, hd)
     if cfg.pos_embedding == "rope":
-        q = _rope(q, positions, cfg.rope_theta, cfg.rotary_dim, cfg.rope_style)
-        k = _rope(k, positions, cfg.rope_theta, cfg.rotary_dim, cfg.rope_style)
+        q = _rope(q, positions, cfg.rope_theta, cfg.rotary_dim,
+                  cfg.rope_style, cfg.rope_scaling)
+        k = _rope(k, positions, cfg.rope_theta, cfg.rotary_dim,
+                  cfg.rope_style, cfg.rope_scaling)
     if kv_hook is not None:
         k, v = kv_hook(k, v)
     if attn_fn is None:

@@ -371,6 +371,14 @@ def hf_config_dict(cfg: ModelConfig, qkv_bias: bool | None = None) -> dict:
     present): a checkpoint loaded with biases under a biasless config must
     still export as qwen2, or transformers would silently drop the bias
     tensors the state dict carries."""
+    if cfg.rope_scaling is not None and (cfg.pos_embedding != "rope"
+                                         or cfg.parallel_block):
+        # only the llama-branch config schema carries rope_scaling; any
+        # other family would drop it on export and diverge in transformers
+        raise ValueError(
+            f"rope_scaling export is only supported for llama-branch "
+            f"families; {cfg.name!r} would silently lose it"
+        )
     if cfg.pos_embedding == "alibi":  # bloom family
         if (cfg.n_kv_heads != cfg.n_heads or not cfg.use_bias
                 or cfg.norm != "layernorm" or cfg.activation != "gelu"
@@ -552,6 +560,17 @@ def hf_config_dict(cfg: ModelConfig, qkv_bias: bool | None = None) -> dict:
         # and qwen2 too, not just the mistral model_type below) — an
         # export that drops it silently widens attention for HF consumers
         base["sliding_window"] = cfg.sliding_window
+    if cfg.rope_scaling is not None:
+        if cfg.rope_scaling[0] == "linear":
+            base["rope_scaling"] = {"rope_type": "linear",
+                                    "factor": cfg.rope_scaling[1]}
+        else:  # llama3
+            _, f, lo, hi, orig = cfg.rope_scaling
+            base["rope_scaling"] = {
+                "rope_type": "llama3", "factor": f,
+                "low_freq_factor": lo, "high_freq_factor": hi,
+                "original_max_position_embeddings": orig,
+            }
     if cfg.is_moe:
         return {
             "model_type": "mixtral",

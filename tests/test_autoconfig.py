@@ -150,3 +150,23 @@ def test_config_from_hf_rejects_falcon_bias():
     d["bias"] = True
     with pytest.raises(ValueError, match="bias"):
         config_from_hf(d)
+
+
+def test_rope_scaling_round_trips_and_rejects_yarn():
+    """llama3 + linear rope scaling survive export->import; yarn (not
+    implemented) refuses instead of serving drifted rotations."""
+    import dataclasses
+
+    cfg = get_config("llama-3.1-8b")
+    assert cfg.rope_scaling == ("llama3", 8.0, 1.0, 4.0, 8192)
+    back = config_from_hf(hf_config_dict(cfg), name=cfg.name)
+    assert back == cfg
+
+    lin = dataclasses.replace(get_config("tiny-llama"),
+                              rope_scaling=("linear", 4.0))
+    assert config_from_hf(hf_config_dict(lin), name=lin.name) == lin
+
+    d = hf_config_dict(get_config("tiny-llama"))
+    d["rope_scaling"] = {"rope_type": "yarn", "factor": 4.0}
+    with pytest.raises(ValueError, match="yarn"):
+        config_from_hf(d)

@@ -77,6 +77,25 @@ CASES = {
     "bloom": ("BloomConfig", "BloomForCausalLM",
               dict(vocab_size=512, hidden_size=64, n_layer=2, n_head=4,
                    hidden_dropout=0.0, attention_dropout=0.0)),
+    # llama-3.1-style rope scaling: frequency schedule must match HF's
+    # _compute_llama3_parameters or every position's rotation drifts
+    "llama_rope_llama3": (
+        "LlamaConfig", "LlamaForCausalLM",
+        dict(TINY, num_key_value_heads=2, tie_word_embeddings=False,
+             rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                           "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                           "original_max_position_embeddings": 32})),
+    # linear scaling through a PARTIAL-rotary family (the shared parser
+    # must reach the phi/falcon/neox branches too)
+    "phi_rope_linear": (
+        "PhiConfig", "PhiForCausalLM",
+        dict(TINY, partial_rotary_factor=0.4, resid_pdrop=0.0,
+             embd_pdrop=0.0, attention_dropout=0.0,
+             rope_scaling={"rope_type": "linear", "factor": 2.0})),
+    "llama_rope_linear": (
+        "LlamaConfig", "LlamaForCausalLM",
+        dict(TINY, num_key_value_heads=2, tie_word_embeddings=False,
+             rope_scaling={"rope_type": "linear", "factor": 4.0})),
 }
 
 
