@@ -31,6 +31,8 @@ class ModelConfig:
     # phi) | "gelu_exact" (erf — gpt-neox) | "geglu"
     use_bias: bool = False  # attn/mlp biases (gpt2 style)
     qkv_bias: bool = False  # bias on q/k/v ONLY (qwen2 style; no bo/mlp bias)
+    qk_norm: bool = False  # per-head RMSNorm on q and k before rope
+    # (qwen3 style; learned [head_dim] scales)
     tie_embeddings: bool = True
     rope_theta: float = 10000.0
     # frequency-domain RoPE scaling, encoded as a hashable tuple:
@@ -164,6 +166,11 @@ CONFIGS: dict[str, ModelConfig] = {
         n_kv_heads=1, d_ff=128, max_seq_len=256, activation="geglu",
         embedding_scale=True, norm_plus_one=True, norm_eps=1e-6,
     ),
+    "tiny-qwen3": ModelConfig(  # llama arch + per-head q/k RMSNorm
+        name="tiny-qwen3", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+        n_kv_heads=2, d_ff=128, max_seq_len=256, qk_norm=True,
+        rope_theta=1000000.0, norm_eps=1e-6, tie_embeddings=False,
+    ),
     "tiny-mistral": ModelConfig(  # llama arch + sliding-window attention,
         # window deliberately smaller than the test prompts so the windowed
         # mask is actually exercised against HF's implementation
@@ -224,6 +231,13 @@ CONFIGS: dict[str, ModelConfig] = {
         name="qwen2-7b", vocab_size=152064, d_model=3584, n_layers=28,
         n_heads=28, n_kv_heads=4, d_ff=18944, max_seq_len=32768,
         qkv_bias=True, rope_theta=1000000.0, norm_eps=1e-6,
+        tie_embeddings=False,
+    ),
+    # -- qwen3 family (llama arch + per-head q/k RMSNorm, no qkv bias) --
+    "qwen3-8b": ModelConfig(
+        name="qwen3-8b", vocab_size=151936, d_model=4096, n_layers=36,
+        n_heads=32, n_kv_heads=8, d_ff=12288, max_seq_len=40960,
+        qk_norm=True, rope_theta=1000000.0, norm_eps=1e-6,
         tie_embeddings=False,
     ),
     # -- larger members of the already-supported families --
@@ -514,7 +528,7 @@ def config_from_hf(d: dict, name: str | None = None) -> ModelConfig:
             rope_scaling=_parse_rope_scaling(d), parallel_block=True,
             lm_head_bias=True, norm_eps=d.get("layer_norm_eps", 1e-5),
         )
-    if mt in ("llama", "mistral", "qwen2", "gemma", "mixtral"):
+    if mt in ("llama", "mistral", "qwen2", "qwen3", "gemma", "mixtral"):
         n_heads = d["num_attention_heads"]
         hd = d.get("head_dim")
         kw: dict = dict(
@@ -529,6 +543,7 @@ def config_from_hf(d: dict, name: str | None = None) -> ModelConfig:
             # True for gemma
             tie_embeddings=d.get("tie_word_embeddings", mt == "gemma"),
             qkv_bias=mt == "qwen2",
+            qk_norm=mt == "qwen3",
         )
         if (scaling := _parse_rope_scaling(d)) is not None:
             kw["rope_scaling"] = scaling
@@ -546,7 +561,7 @@ def config_from_hf(d: dict, name: str | None = None) -> ModelConfig:
             kw["head_dim_override"] = hd
         if mt in ("mistral", "mixtral") and d.get("sliding_window"):
             kw["sliding_window"] = d["sliding_window"]
-        if (mt == "qwen2" and d.get("use_sliding_window")
+        if (mt in ("qwen2", "qwen3") and d.get("use_sliding_window")
                 and d.get("sliding_window")
                 and int(d.get("max_window_layers") or 0) <= 0):
             # HF windows only layers >= max_window_layers; our config

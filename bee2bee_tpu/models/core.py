@@ -98,6 +98,9 @@ def init_params(cfg: ModelConfig, key, dtype=jnp.bfloat16) -> Params:
         layers["attn"]["bq"] = jnp.zeros((L, H * hd), dtype)
         layers["attn"]["bk"] = jnp.zeros((L, Hkv * hd), dtype)
         layers["attn"]["bv"] = jnp.zeros((L, Hkv * hd), dtype)
+    if cfg.qk_norm:  # qwen3: per-head q/k RMSNorm scales
+        layers["attn"]["q_norm"] = jnp.ones((L, hd), dtype)
+        layers["attn"]["k_norm"] = jnp.ones((L, hd), dtype)
     if cfg.use_bias:  # qwen2 (qkv_bias) has NO output-projection bias
         layers["attn"]["bo"] = jnp.zeros((L, D), dtype)
 
@@ -175,6 +178,14 @@ def scale_rope_freqs(freqs, scaling: tuple | None):
         wavelen > low_wavelen, freqs / factor,
         jnp.where(wavelen < high_wavelen, freqs, smoothed),
     )
+
+
+def _qk_rmsnorm(x, scale, eps: float):
+    """Per-head RMSNorm over head_dim (qwen3's q_norm/k_norm).
+    x: [B, T, H, hd]; scale: [hd] (shared across heads)."""
+    xf = x.astype(jnp.float32)
+    xf = xf * lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return xf.astype(x.dtype) * scale
 
 
 def _rope(x, positions, theta: float, rot: int | None = None,
@@ -435,6 +446,9 @@ def transformer_block(
     q = q.reshape(B, T, H, hd)
     k = k.reshape(B, T, Hkv, hd)
     v = v.reshape(B, T, Hkv, hd)
+    if "q_norm" in lp["attn"]:  # qwen3: head-wise RMSNorm BEFORE rope
+        q = _qk_rmsnorm(q, lp["attn"]["q_norm"], cfg.norm_eps)
+        k = _qk_rmsnorm(k, lp["attn"]["k_norm"], cfg.norm_eps)
     if cfg.pos_embedding == "rope":
         q = _rope(q, positions, cfg.rope_theta, cfg.rotary_dim,
                   cfg.rope_style, cfg.rope_scaling)

@@ -173,6 +173,9 @@ def _export_llama_state(params, cfg: ModelConfig, dtype) -> dict[str, np.ndarray
         if "bq" in a:  # qwen2: q/k/v-only bias
             for ours, hf in (("bq", "q_proj"), ("bk", "k_proj"), ("bv", "v_proj")):
                 state[p + f"self_attn.{hf}.bias"] = _np(a[ours][i], dtype)
+        if "q_norm" in a:  # qwen3: per-head q/k RMSNorm scales
+            state[p + "self_attn.q_norm.weight"] = _np(a["q_norm"][i], dtype)
+            state[p + "self_attn.k_norm.weight"] = _np(a["k_norm"][i], dtype)
         if cfg.is_moe:
             moe = layers["moe"]
             state[p + "block_sparse_moe.gate.weight"] = t(moe["router"][i])
@@ -364,7 +367,8 @@ def _export_gptj_state(params, cfg: ModelConfig, dtype) -> dict[str, np.ndarray]
     return state
 
 
-def hf_config_dict(cfg: ModelConfig, qkv_bias: bool | None = None) -> dict:
+def hf_config_dict(cfg: ModelConfig, qkv_bias: bool | None = None,
+                   qk_norm: bool | None = None) -> dict:
     """A transformers-compatible config.json for the exported checkpoint.
 
     `qkv_bias` overrides cfg.qkv_bias from the ACTUAL params ("bq" leaves
@@ -592,6 +596,14 @@ def hf_config_dict(cfg: ModelConfig, qkv_bias: bool | None = None) -> dict:
             "hidden_activation": act,
             **base,
         }
+    is_qwen3 = cfg.qk_norm if qk_norm is None else qk_norm
+    if is_qwen3:  # qwen3: per-head q/k RMSNorm (no qkv biases)
+        out = {"model_type": "qwen3", "architectures": ["Qwen3ForCausalLM"],
+               **base}
+        if cfg.sliding_window is not None:
+            out["use_sliding_window"] = True
+            out["max_window_layers"] = 0
+        return out
     is_qwen2 = cfg.qkv_bias if qkv_bias is None else qkv_bias
     if is_qwen2:
         out = {"model_type": "qwen2", "architectures": ["Qwen2ForCausalLM"], **base}
@@ -631,10 +643,17 @@ def export_hf(params, cfg: ModelConfig, out_dir: str | Path,
         None if cfg.pos_embedding == "learned"
         else "bq" in params["layers"].get("attn", {})
     )
+    # qwen3 keyed on the ACTUAL params too: config.json and the state
+    # dict must describe the same family, or from_pretrained silently
+    # random-inits (or drops) the q/k norm tensors
+    has_qk_norm = (
+        None if cfg.pos_embedding == "learned"
+        else "q_norm" in params["layers"].get("attn", {})
+    )
     # validate the config BEFORE building the state dict: unsupported
     # combos must die with hf_config_dict's explanation, not a KeyError
     # halfway through a tensor conversion
-    cfg_json = hf_config_dict(cfg, qkv_bias=has_qkv_bias)
+    cfg_json = hf_config_dict(cfg, qkv_bias=has_qkv_bias, qk_norm=has_qk_norm)
     np_dtype = np.dtype(dtype) if dtype != "bfloat16" else _bf16_dtype()
     if cfg.pos_embedding == "alibi":
         state = _export_bloom_state(params, cfg, np_dtype)

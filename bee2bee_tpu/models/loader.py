@@ -448,6 +448,11 @@ def _convert_llama(state, cfg: ModelConfig) -> dict:
             layers["attn"][ours] = _stack(
                 [g(f"layers.{i}.self_attn.{theirs}.bias") for i in range(L)]
             )
+    if pre + "layers.0.self_attn.q_norm.weight" in state:  # qwen3 qk-norm
+        for ours, theirs in (("q_norm", "q_norm"), ("k_norm", "k_norm")):
+            layers["attn"][ours] = _stack(
+                [g(f"layers.{i}.self_attn.{theirs}.weight") for i in range(L)]
+            )
     if cfg.is_moe:
         E = cfg.n_experts
         layers["moe"] = {

@@ -53,7 +53,7 @@ def test_write_safetensors_roundtrip_dtypes(tmp_path):
     "name",
     ["tiny-gpt2", "tiny-llama", "tiny-mistral", "tiny-mixtral", "tiny-gemma",
      "tiny-qwen", "tiny-phi", "tiny-neox", "tiny-gptj", "tiny-falcon",
-     "tiny-bigcode", "tiny-bloom"],
+     "tiny-bigcode", "tiny-bloom", "tiny-qwen3"],
 )
 def test_export_hf_roundtrips_through_loader(tmp_path, name):
     """export_hf must be the exact inverse of the loader's HF conversion
@@ -484,3 +484,10 @@ def test_alibi_slopes_match_transformers():
         hf_slopes = (alibi[:, 0, -1] / 4.0).tolist()  # position 4 * slope
         np.testing.assert_allclose(hf_slopes, core.alibi_slopes(H),
                                    rtol=1e-6)
+
+
+def test_torch_loads_qwen3_export_and_logits_match(tmp_path):
+    """qwen3 family conformance: per-head q/k RMSNorm applied BEFORE rope
+    (order matters — the norm changes what gets rotated), GQA, untied
+    head, against Qwen3ForCausalLM."""
+    _torch_conformance("tiny-qwen3", tmp_path, "Qwen3ForCausalLM", seed=61)

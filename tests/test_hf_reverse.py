@@ -35,6 +35,11 @@ CASES = {
                      tie_word_embeddings=True)),
     "qwen2": ("Qwen2Config", "Qwen2ForCausalLM",
               dict(TINY, num_key_value_heads=2, tie_word_embeddings=True)),
+    # per-head q/k RMSNorm before rope; head_dim=32 != hidden/heads (16)
+    # actually exercises the head_dim_override path (real for qwen3-0.6b)
+    "qwen3": ("Qwen3Config", "Qwen3ForCausalLM",
+              dict(TINY, num_key_value_heads=2, head_dim=32,
+                   tie_word_embeddings=False)),
     "gemma": ("GemmaConfig", "GemmaForCausalLM",
               dict(TINY, num_key_value_heads=1, head_dim=16,
                    hidden_activation="gelu_pytorch_tanh")),
