@@ -278,6 +278,16 @@ class InferenceEngine:
             logger.info("attention=auto -> dense (ALiBi bias: only the "
                         "dense path implements it)")
             return "dense"
+        if self._gemma2_score_math():
+            if self.mesh.shape.get("seq", 1) > 1:
+                raise ValueError(
+                    "no attention impl supports gemma-2 score math "
+                    "(softcap / attn_scale / alternating windows) on a "
+                    "seq-sharded mesh; drop the seq axis"
+                )
+            logger.info("attention=auto -> dense (gemma-2 score math: "
+                        "only the dense path implements it)")
+            return "dense"
         if self._window_binds():
             if self.mesh.shape.get("seq", 1) > 1:
                 # no impl supports seq-sharded cache + sliding window:
@@ -309,6 +319,17 @@ class InferenceEngine:
         logger.info("attention=auto -> flash")
         return "flash"
 
+    def _gemma2_score_math(self) -> bool:
+        """True when the model needs score math only the dense path
+        implements: attention-logit softcap, a non-head_dim score scale,
+        or per-layer window alternation (gemma-2)."""
+        cfg = self.model_cfg
+        return bool(
+            cfg.attn_logit_softcap
+            or (cfg.attn_scale and cfg.attn_scale != cfg.head_dim)
+            or (cfg.sliding_window and cfg.sliding_window_every > 1)
+        )
+
     def _window_binds(self) -> bool:
         """True iff the model's sliding window can actually mask a cache
         position at THIS engine's context length. zephyr/mistral ship
@@ -326,6 +347,15 @@ class InferenceEngine:
                 f"the ALiBi score bias ({self.model_cfg.name!r}); use "
                 "attention='dense' (the kernels would silently drop the "
                 "per-head position bias)"
+            )
+        if (self.engine_cfg.attention in ("flash", "sp")
+                and self._gemma2_score_math()):
+            raise ValueError(
+                f"attention={self.engine_cfg.attention!r} does not implement "
+                f"gemma-2's score math ({self.model_cfg.name!r}: attention "
+                "softcap / query_pre_attn_scalar / alternating windows); "
+                "use attention='dense' — the kernels hardcode 1/sqrt(hd) "
+                "and no tanh cap, so logits would silently diverge"
             )
         if self.engine_cfg.attention in ("flash", "sp") and self._window_binds():
             raise ValueError(

@@ -56,6 +56,16 @@ class ModelConfig:
     # the last `sliding_window` positions. None = full causal. Supported
     # by the dense attention path (engine validates flash/sp against it).
     sliding_window: int | None = None
+    # with sliding_window set: layers where layer_idx % N == 0 window,
+    # the rest attend fully. 1 = every layer (mistral); 2 = gemma-2's
+    # alternating local/global pattern
+    sliding_window_every: int = 1
+    # gemma-2 attention extras
+    attn_logit_softcap: float | None = None  # tanh cap on attention scores
+    attn_scale: float | None = None  # score denominator becomes
+    # sqrt(attn_scale) instead of sqrt(head_dim) (query_pre_attn_scalar)
+    post_norms: bool = False  # gemma-2: extra norms on the attn and mlp
+    # OUTPUTS before they join the residual (4 norms per block)
     parallel_block: bool = False  # x + attn(ln(x)) + mlp(ln'(x)) parallel
     # residual (phi/gpt-neox); sequential pre-norm blocks otherwise
     parallel_norms: int = 1  # parallel blocks only: 1 = attn and mlp share
@@ -177,6 +187,16 @@ CONFIGS: dict[str, ModelConfig] = {
         name="tiny-mistral", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
         n_kv_heads=2, d_ff=128, max_seq_len=256, sliding_window=4,
     ),
+    "tiny-gemma2": ModelConfig(  # gemma-2: post-norms, attn softcap,
+        # query scale override, ALTERNATING local/global attention
+        # (window 4 < the 8-token test prompts, every 2nd layer)
+        name="tiny-gemma2", vocab_size=512, d_model=64, n_layers=2,
+        n_heads=4, n_kv_heads=2, d_ff=128, max_seq_len=256,
+        activation="geglu", embedding_scale=True, norm_plus_one=True,
+        norm_eps=1e-6, post_norms=True, attn_logit_softcap=50.0,
+        logits_softcap=30.0, attn_scale=32.0, sliding_window=4,
+        sliding_window_every=2,
+    ),
     "tiny-qwen": ModelConfig(  # qwen2 style: llama arch + q/k/v-only bias
         name="tiny-qwen", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
         n_kv_heads=2, d_ff=128, max_seq_len=256, qkv_bias=True,
@@ -241,6 +261,16 @@ CONFIGS: dict[str, ModelConfig] = {
         tie_embeddings=False,
     ),
     # -- larger members of the already-supported families --
+    "gemma-2-9b": ModelConfig(
+        # google/gemma-2-9b: 16 256-dim heads over d_model 3584 (override),
+        # alternating 4096-window/global layers, softcapped scores+logits
+        name="gemma-2-9b", vocab_size=256000, d_model=3584, n_layers=42,
+        n_heads=16, n_kv_heads=8, d_ff=14336, max_seq_len=8192,
+        activation="geglu", embedding_scale=True, norm_plus_one=True,
+        norm_eps=1e-6, head_dim_override=256, post_norms=True,
+        attn_logit_softcap=50.0, logits_softcap=30.0, attn_scale=256.0,
+        sliding_window=4096, sliding_window_every=2,
+    ),
     "gemma-7b": ModelConfig(
         # attention width 4096 != d_model 3072: heads are 256-dim like
         # gemma-2b's, hence the explicit head_dim_override
@@ -528,7 +558,8 @@ def config_from_hf(d: dict, name: str | None = None) -> ModelConfig:
             rope_scaling=_parse_rope_scaling(d), parallel_block=True,
             lm_head_bias=True, norm_eps=d.get("layer_norm_eps", 1e-5),
         )
-    if mt in ("llama", "mistral", "qwen2", "qwen3", "gemma", "mixtral"):
+    if mt in ("llama", "mistral", "qwen2", "qwen3", "gemma", "gemma2",
+              "mixtral"):
         n_heads = d["num_attention_heads"]
         hd = d.get("head_dim")
         kw: dict = dict(
@@ -570,11 +601,26 @@ def config_from_hf(d: dict, name: str | None = None) -> ModelConfig:
             # exact for prompts within the window and matches HF on the
             # majority (first) layers, vs. silently wrong everywhere
             kw["sliding_window"] = d["sliding_window"]
-        if mt == "gemma":
+        if mt in ("gemma", "gemma2"):
             act = d.get("hidden_activation") or d.get("hidden_act") or "gelu_pytorch_tanh"
             kw.update(
                 activation="geglu" if act.startswith("gelu") else act,
                 embedding_scale=True, norm_plus_one=True,
+            )
+        if mt == "gemma2":
+            # transformers serializes config.json as a DIFF against class
+            # defaults — an absent key means the Gemma2Config DEFAULT
+            # (50/30/256/4096), NOT disabled; an explicit null stays None
+            window = d.get("sliding_window", 4096)
+            kw.update(
+                post_norms=True,
+                attn_logit_softcap=d.get("attn_logit_softcapping", 50.0),
+                logits_softcap=d.get("final_logit_softcapping", 30.0),
+                attn_scale=d.get("query_pre_attn_scalar", 256),
+                # HF Gemma2: is_sliding = not bool(layer_idx % 2) — even
+                # layers window, odd attend fully
+                sliding_window=window,
+                sliding_window_every=2 if window else 1,
             )
         if mt == "mixtral":
             kw.update(n_experts=d["num_local_experts"],

@@ -90,10 +90,16 @@ def init_params(cfg: ModelConfig, key, dtype=jnp.bfloat16) -> Params:
         # sequential blocks AND neox-style dual-norm parallel blocks have
         # ln2; only phi's shared-norm parallel blocks drop it
         layers["ln2"] = {"scale": jnp.ones((L, D), dtype)}
+    if cfg.post_norms:  # gemma-2: norms on the attn/mlp outputs too
+        layers["ln1_post"] = {"scale": jnp.ones((L, D), dtype)}
+        layers["ln2_post"] = {"scale": jnp.ones((L, D), dtype)}
     if cfg.norm == "layernorm":
         layers["ln1"]["bias"] = jnp.zeros((L, D), dtype)
         if "ln2" in layers:
             layers["ln2"]["bias"] = jnp.zeros((L, D), dtype)
+        for extra in ("ln1_post", "ln2_post"):
+            if extra in layers:
+                layers[extra]["bias"] = jnp.zeros((L, D), dtype)
     if cfg.use_bias or cfg.qkv_bias:
         layers["attn"]["bq"] = jnp.zeros((L, H * hd), dtype)
         layers["attn"]["bk"] = jnp.zeros((L, Hkv * hd), dtype)
@@ -251,7 +257,11 @@ def _attention(q, k, v, mask, cfg: ModelConfig):
     group = H // Hkv
     q = q.reshape(B, T, Hkv, group, hd)
     logits = jnp.einsum("btkgh,bskh->bkgts", q, k).astype(jnp.float32)
-    logits = logits / math.sqrt(hd)
+    # gemma-2 overrides the score denominator (query_pre_attn_scalar)
+    logits = logits / math.sqrt(cfg.attn_scale or hd)
+    if cfg.attn_logit_softcap:  # gemma-2: tanh cap BEFORE masking
+        c = cfg.attn_logit_softcap
+        logits = jnp.tanh(logits / c) * c
     if cfg.pos_embedding == "alibi":
         # + slope_h * key_position: softmax is shift-invariant per query
         # row, so the absolute-position form equals the relative -m*(i-j)
@@ -469,12 +479,15 @@ def transformer_block(
         # (parallel_norms=2) norms the mlp branch separately with ln2
         h_mlp = h if cfg.parallel_norms == 1 else _norm(x, lp["ln2"], cfg)
         return x + attn_out + _mlp(h_mlp, lp["mlp"], cfg)
+    if cfg.post_norms:  # gemma-2: norm the attn OUTPUT before the residual
+        attn_out = _norm(attn_out, lp["ln1_post"], cfg)
     x = x + attn_out
 
     h2 = _norm(x, lp["ln2"], cfg)
-    if cfg.is_moe:
-        return x + _moe(h2, lp["moe"], cfg)
-    return x + _mlp(h2, lp["mlp"], cfg)
+    mlp_out = _moe(h2, lp["moe"], cfg) if cfg.is_moe else _mlp(h2, lp["mlp"], cfg)
+    if cfg.post_norms:
+        mlp_out = _norm(mlp_out, lp["ln2_post"], cfg)
+    return x + mlp_out
 
 
 def final_logits(params: Params, cfg: ModelConfig, x):
@@ -496,27 +509,31 @@ def final_logits(params: Params, cfg: ModelConfig, x):
 # ---------------------------------------------------------------- forward
 
 
-def attn_mask(cfg: ModelConfig, positions, T: int, S: int | None = None):
+def attn_mask(cfg: ModelConfig, positions, T: int, S: int | None = None,
+              window: int | None | str = "cfg"):
     """THE attention mask builder (sliding window included) — core.forward
     and stages.stage_forward must agree or a pipeline-split model diverges
     from the monolithic one.
 
     Cached (S given): [B, 1, T, S] over cache positions — s visible to
-    query t iff s <= pos(t), and with cfg.sliding_window only the last W
+    query t iff s <= pos(t), and with a sliding window only the last W
     positions (s > pos(t) - W). Uncached: causal [1, 1, T, T] with the
-    same window restriction."""
+    same window restriction. `window` overrides cfg.sliding_window
+    (None = full causal) — the gemma-2 alternating pattern builds both
+    variants from the same config."""
+    w = cfg.sliding_window if window == "cfg" else window
     if S is not None:
         s_idx = jnp.arange(S, dtype=jnp.int32)[None, None, :]  # [1,1,S]
         q_pos = positions[:, :, None]  # [B,T,1]
         mask = s_idx <= q_pos  # [B,T,S]
-        if cfg.sliding_window:
-            mask = mask & (s_idx > q_pos - cfg.sliding_window)
+        if w:
+            mask = mask & (s_idx > q_pos - w)
         return mask[:, None, :, :]
     causal = jnp.tril(jnp.ones((T, T), bool))
-    if cfg.sliding_window:
+    if w:
         qi = jnp.arange(T, dtype=jnp.int32)[:, None]
         ki = jnp.arange(T, dtype=jnp.int32)[None, :]
-        causal = causal & (qi - ki < cfg.sliding_window)
+        causal = causal & (qi - ki < w)
     return causal[None, None, :, :]
 
 
@@ -544,9 +561,18 @@ def forward(
 
     x = embed_tokens(params, cfg, input_ids, positions)
 
-    mask = attn_mask(
-        cfg, positions, T, cache["k"].shape[2] if cache is not None else None
-    )
+    S = cache["k"].shape[2] if cache is not None else None
+    mask = attn_mask(cfg, positions, T, S)
+    # gemma-2 alternation: only every Nth layer windows — build the full-
+    # causal variant once and select per layer inside the scan
+    alternating = bool(cfg.sliding_window) and cfg.sliding_window_every > 1
+    mask_full = attn_mask(cfg, positions, T, S, window=None) if alternating else None
+
+    def layer_mask(layer_idx):
+        if not alternating:
+            return mask
+        return jnp.where((layer_idx % cfg.sliding_window_every) == 0,
+                         mask, mask_full)
 
     def layer(carry, xs):
         x, cache_k, cache_v = carry
@@ -554,7 +580,8 @@ def forward(
 
         if cache_k is None:  # training/scoring path: plain block
             return (
-                transformer_block(lp, cfg, x, positions, mask, attn_fn=attn_fn),
+                transformer_block(lp, cfg, x, positions,
+                                  layer_mask(layer_idx), attn_fn=attn_fn),
                 None,
                 None,
             ), None
@@ -576,7 +603,8 @@ def forward(
             return ck, cv
 
         x = transformer_block(
-            lp, cfg, x, positions, mask, kv_hook=kv_hook, attn_fn=attn_fn
+            lp, cfg, x, positions, layer_mask(layer_idx),
+            kv_hook=kv_hook, attn_fn=attn_fn
         )
         return (x, cache_k, cache_v), None
 

@@ -166,7 +166,12 @@ def _export_llama_state(params, cfg: ModelConfig, dtype) -> dict[str, np.ndarray
     for i in range(cfg.n_layers):
         p = f"model.layers.{i}."
         state[p + "input_layernorm.weight"] = norm(layers["ln1"]["scale"][i])
-        state[p + "post_attention_layernorm.weight"] = norm(layers["ln2"]["scale"][i])
+        if cfg.post_norms:  # gemma-2 norm names (see loader._convert_llama)
+            state[p + "post_attention_layernorm.weight"] = norm(layers["ln1_post"]["scale"][i])
+            state[p + "pre_feedforward_layernorm.weight"] = norm(layers["ln2"]["scale"][i])
+            state[p + "post_feedforward_layernorm.weight"] = norm(layers["ln2_post"]["scale"][i])
+        else:
+            state[p + "post_attention_layernorm.weight"] = norm(layers["ln2"]["scale"][i])
         a = layers["attn"]
         for ours, hf in (("wq", "q_proj"), ("wk", "k_proj"), ("wv", "v_proj"), ("wo", "o_proj")):
             state[p + f"self_attn.{hf}.weight"] = t(a[ours][i])
@@ -586,6 +591,27 @@ def hf_config_dict(cfg: ModelConfig, qkv_bias: bool | None = None,
     if cfg.norm_plus_one:  # gemma family
         act = ("gelu_pytorch_tanh" if cfg.activation == "geglu"
                else cfg.activation)
+        if cfg.post_norms:  # gemma-2
+            if cfg.sliding_window is None or cfg.sliding_window_every != 2:
+                # HF Gemma2 HARDCODES the every-2nd-layer alternation and
+                # defaults an omitted sliding_window to 4096 — any other
+                # windowing would load in transformers and silently
+                # mismatch our per-layer masks
+                raise ValueError(
+                    "gemma2 export requires sliding_window set with "
+                    f"sliding_window_every=2; got window="
+                    f"{cfg.sliding_window}, every={cfg.sliding_window_every}"
+                )
+            return {
+                "model_type": "gemma2",
+                "architectures": ["Gemma2ForCausalLM"],
+                "hidden_act": act,
+                "hidden_activation": act,
+                "attn_logit_softcapping": cfg.attn_logit_softcap,
+                "final_logit_softcapping": cfg.logits_softcap,
+                "query_pre_attn_scalar": cfg.attn_scale or cfg.head_dim,
+                **base,
+            }
         return {
             "model_type": "gemma",
             "architectures": ["GemmaForCausalLM"],

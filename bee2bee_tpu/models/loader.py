@@ -433,15 +433,25 @@ def _convert_llama(state, cfg: ModelConfig) -> dict:
     norm_off = 1.0 if cfg.norm_plus_one else 0.0
     raw = lambda k: state[pre + k]
     g = lambda k: (raw(k) + norm_off) if "layernorm.weight" in k or k == "norm.weight" else raw(k)
-    layers = {
-        "ln1": {"scale": _stack([g(f"layers.{i}.input_layernorm.weight") for i in range(L)])},
-        "ln2": {"scale": _stack([g(f"layers.{i}.post_attention_layernorm.weight") for i in range(L)])},
-        "attn": {
-            "wq": _stack([t(g(f"layers.{i}.self_attn.q_proj.weight")) for i in range(L)]),
-            "wk": _stack([t(g(f"layers.{i}.self_attn.k_proj.weight")) for i in range(L)]),
-            "wv": _stack([t(g(f"layers.{i}.self_attn.v_proj.weight")) for i in range(L)]),
-            "wo": _stack([t(g(f"layers.{i}.self_attn.o_proj.weight")) for i in range(L)]),
-        },
+    if cfg.post_norms:
+        # gemma-2 names: post_attention_layernorm is the POST-attn output
+        # norm (ours ln1_post); the pre-mlp norm is pre_feedforward_…
+        layers = {
+            "ln1": {"scale": _stack([g(f"layers.{i}.input_layernorm.weight") for i in range(L)])},
+            "ln1_post": {"scale": _stack([g(f"layers.{i}.post_attention_layernorm.weight") for i in range(L)])},
+            "ln2": {"scale": _stack([g(f"layers.{i}.pre_feedforward_layernorm.weight") for i in range(L)])},
+            "ln2_post": {"scale": _stack([g(f"layers.{i}.post_feedforward_layernorm.weight") for i in range(L)])},
+        }
+    else:
+        layers = {
+            "ln1": {"scale": _stack([g(f"layers.{i}.input_layernorm.weight") for i in range(L)])},
+            "ln2": {"scale": _stack([g(f"layers.{i}.post_attention_layernorm.weight") for i in range(L)])},
+        }
+    layers["attn"] = {
+        "wq": _stack([t(g(f"layers.{i}.self_attn.q_proj.weight")) for i in range(L)]),
+        "wk": _stack([t(g(f"layers.{i}.self_attn.k_proj.weight")) for i in range(L)]),
+        "wv": _stack([t(g(f"layers.{i}.self_attn.v_proj.weight")) for i in range(L)]),
+        "wo": _stack([t(g(f"layers.{i}.self_attn.o_proj.weight")) for i in range(L)]),
     }
     if pre + "layers.0.self_attn.q_proj.bias" in state:  # qwen2: q/k/v-only bias
         for ours, theirs in (("bq", "q_proj"), ("bk", "k_proj"), ("bv", "v_proj")):

@@ -143,18 +143,29 @@ def stage_forward(
     else:
         h = x
 
-    if cache is not None:
-        S = cache["k"].shape[2]
-        mask = core.attn_mask(cfg, positions, T, S)
-    else:
-        mask = core.attn_mask(cfg, positions, T)
+    S = cache["k"].shape[2] if cache is not None else None
+    mask = core.attn_mask(cfg, positions, T, S)
+    # gemma-2 alternation by GLOBAL layer index (spec.start + local idx):
+    # the split model must window exactly the layers the monolith windows
+    alternating = bool(cfg.sliding_window) and cfg.sliding_window_every > 1
+    mask_full = (core.attn_mask(cfg, positions, T, S, window=None)
+                 if alternating else None)
+
+    def layer_mask(local_idx):
+        if not alternating:
+            return mask
+        return jnp.where(
+            ((spec.start + local_idx) % cfg.sliding_window_every) == 0,
+            mask, mask_full,
+        )
 
     def layer(carry, xs):
         h, ck, cv = carry
         lp, idx = xs
         if ck is None:
             return (
-                core.transformer_block(lp, cfg, h, positions, mask),
+                core.transformer_block(lp, cfg, h, positions,
+                                       layer_mask(idx)),
                 None,
                 None,
             ), None
@@ -179,7 +190,8 @@ def stage_forward(
             cv = cv.at[idx].set(wv)
             return wk, wv
 
-        h = core.transformer_block(lp, cfg, h, positions, mask, kv_hook=kv_hook)
+        h = core.transformer_block(lp, cfg, h, positions, layer_mask(idx),
+                                   kv_hook=kv_hook)
         return (h, ck, cv), None
 
     n_local = spec.end - spec.start

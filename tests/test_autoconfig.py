@@ -18,7 +18,7 @@ from bee2bee_tpu.models.export import export_hf, hf_config_dict
     "name",
     ["tiny-gpt2", "tiny-llama", "tiny-mistral", "tiny-mixtral", "tiny-gemma",
      "tiny-qwen", "tiny-phi", "tiny-neox", "tiny-gptj", "tiny-falcon",
-     "tiny-bigcode", "tiny-bloom", "tiny-qwen3"],
+     "tiny-bigcode", "tiny-bloom", "tiny-qwen3", "tiny-gemma2"],
 )
 def test_config_from_hf_inverts_hf_config_dict(name):
     """For every supported family: our exported config.json must
@@ -170,3 +170,26 @@ def test_rope_scaling_round_trips_and_rejects_yarn():
     d["rope_scaling"] = {"rope_type": "yarn", "factor": 4.0}
     with pytest.raises(ValueError, match="yarn"):
         config_from_hf(d)
+
+
+def test_gemma2_diff_config_uses_hf_defaults():
+    """transformers writes config.json as a DIFF against class defaults:
+    omitted gemma-2 keys mean 50/30/256/4096, not disabled."""
+    cfg = config_from_hf({
+        "model_type": "gemma2", "vocab_size": 512, "hidden_size": 64,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "intermediate_size": 128,
+    })
+    assert cfg.attn_logit_softcap == 50.0
+    assert cfg.logits_softcap == 30.0
+    assert cfg.attn_scale == 256
+    assert cfg.sliding_window == 4096 and cfg.sliding_window_every == 2
+
+
+def test_gemma2_export_requires_alternating_window():
+    import dataclasses
+
+    cfg = dataclasses.replace(get_config("tiny-gemma2"), sliding_window=None,
+                              sliding_window_every=1)
+    with pytest.raises(ValueError, match="sliding_window"):
+        hf_config_dict(cfg)

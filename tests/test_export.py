@@ -53,7 +53,7 @@ def test_write_safetensors_roundtrip_dtypes(tmp_path):
     "name",
     ["tiny-gpt2", "tiny-llama", "tiny-mistral", "tiny-mixtral", "tiny-gemma",
      "tiny-qwen", "tiny-phi", "tiny-neox", "tiny-gptj", "tiny-falcon",
-     "tiny-bigcode", "tiny-bloom", "tiny-qwen3"],
+     "tiny-bigcode", "tiny-bloom", "tiny-qwen3", "tiny-gemma2"],
 )
 def test_export_hf_roundtrips_through_loader(tmp_path, name):
     """export_hf must be the exact inverse of the loader's HF conversion
@@ -491,3 +491,68 @@ def test_torch_loads_qwen3_export_and_logits_match(tmp_path):
     (order matters — the norm changes what gets rotated), GQA, untied
     head, against Qwen3ForCausalLM."""
     _torch_conformance("tiny-qwen3", tmp_path, "Qwen3ForCausalLM", seed=61)
+
+
+def test_torch_loads_gemma2_export_and_logits_match(tmp_path):
+    """gemma-2 family conformance: post-norms (4 per block), attention
+    and final logit softcaps, query_pre_attn_scalar score scaling, and
+    the ALTERNATING local/global window pattern (window 4 < seq 8; even
+    layers window) against Gemma2ForCausalLM."""
+    _torch_conformance("tiny-gemma2", tmp_path, "Gemma2ForCausalLM",
+                       seed=71)
+
+
+def test_gemma2_cached_decode_matches_uncached_forward():
+    """Alternating per-layer masks under the KV cache: the decode step's
+    cache-position mask must window exactly the layers the uncached
+    forward windows — greedy engine continuation equals the no-cache
+    rollout across a window-binding context."""
+    from bee2bee_tpu.engine import EngineConfig, InferenceEngine
+
+    eng = InferenceEngine(
+        "tiny-gemma2",
+        engine_config=EngineConfig(max_seq_len=64, prefill_buckets=(16,),
+                                   dtype="float32", cache_dtype="float32"),
+    )
+    try:
+        prompt = [1, 7, 42, 99, 3, 250, 8]  # 7 > window 4: binding
+        r = eng.generate(prompt, max_new_tokens=6, temperature=0.0)
+        cfg = eng.model_cfg
+        import jax as _jax
+
+        restacked = core.restack_layers(_jax.device_get(dict(eng.params)))
+        ids, want = list(prompt), []
+        for _ in range(6):
+            logits, _ = core.forward(
+                restacked, cfg, jnp.asarray([ids], jnp.int32), None,
+                jnp.int32(0),
+            )
+            t = int(np.argmax(np.asarray(logits[0, -1])))
+            ids.append(t)
+            want.append(t)
+        assert r.token_ids == want
+    finally:
+        eng.close()
+
+
+def test_gemma2_rejects_flash_and_auto_resolves_dense():
+    """flash/sp hardcode 1/sqrt(hd) with no softcap — gemma-2 configs
+    must refuse them loudly and resolve attention=auto to dense."""
+    from bee2bee_tpu.engine import EngineConfig, InferenceEngine
+
+    with pytest.raises(ValueError, match="score math"):
+        InferenceEngine(
+            "tiny-gemma2",
+            engine_config=EngineConfig(max_seq_len=64, attention="flash",
+                                       dtype="float32",
+                                       cache_dtype="float32"),
+        )
+    eng = InferenceEngine(
+        "tiny-gemma2",
+        engine_config=EngineConfig(max_seq_len=64, attention="auto",
+                                   dtype="float32", cache_dtype="float32"),
+    )
+    try:
+        assert eng.engine_cfg.attention == "dense"
+    finally:
+        eng.close()

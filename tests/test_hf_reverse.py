@@ -43,6 +43,14 @@ CASES = {
     "gemma": ("GemmaConfig", "GemmaForCausalLM",
               dict(TINY, num_key_value_heads=1, head_dim=16,
                    hidden_activation="gelu_pytorch_tanh")),
+    # gemma-2: post-norms, softcaps, query scale override, ALTERNATING
+    # local/global attention (window 4 < the 8-token probe: layer 0
+    # windows, layer 1 attends fully — parity must match HF exactly)
+    "gemma2": ("Gemma2Config", "Gemma2ForCausalLM",
+               dict(TINY, num_key_value_heads=2, head_dim=16,
+                    sliding_window=4, query_pre_attn_scalar=32,
+                    attn_logit_softcapping=50.0,
+                    final_logit_softcapping=30.0, attention_dropout=0.0)),
     "mixtral": ("MixtralConfig", "MixtralForCausalLM",
                 dict(TINY, num_key_value_heads=2, num_local_experts=4,
                      num_experts_per_tok=2, tie_word_embeddings=False)),

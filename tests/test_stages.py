@@ -310,3 +310,25 @@ def test_stage_chain_phi_carries_lm_head_bias():
             assert "lm_head_bias" in sp
         x, _ = stages.stage_forward(sp, cfg, spec, x, None, jnp.int32(0))
     np.testing.assert_allclose(np.asarray(x), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_gemma2_stage_chain_alternating_window_matches_monolith():
+    """Split a gemma-2-style model (alternating local/global layers)
+    across 2 stages: each stage must window by GLOBAL layer index
+    (spec.start + local idx) or the split model diverges from the
+    monolith exactly at the stage boundary."""
+    cfg = get_config("tiny-gemma2")
+    params = core.init_params(cfg, jax.random.key(9), dtype=jnp.float32)
+    ids = jnp.asarray(
+        np.random.default_rng(2).integers(3, cfg.vocab_size, (1, 8)),
+        jnp.int32,
+    )  # 8 > window 4: the alternation actually masks
+    want, _ = core.forward(params, cfg, ids, None, jnp.int32(0))
+
+    x = ids
+    for s in range(2):
+        spec = stages.StageSpec.build(cfg, 2, s)
+        sp = stages.extract_stage_params(params, cfg, spec)
+        x, _ = stages.stage_forward(sp, cfg, spec, x, None, jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(x), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
