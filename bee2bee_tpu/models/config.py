@@ -369,6 +369,15 @@ CONFIGS["gpt-neox-20b"] = ModelConfig(
     tie_embeddings=False, rotary_pct=0.25, parallel_block=True,
     parallel_norms=2,
 )
+CONFIGS["phi-3-mini"] = ModelConfig(
+    # microsoft/Phi-3-mini-4k-instruct: llama-branch arch behind fused
+    # qkv_proj/gate_up_proj tensors (loader._convert_phi3 un-fuses),
+    # 2047-token sliding window on every layer. The 128k variants use
+    # longrope scaling, which config_from_hf refuses (unimplemented).
+    name="phi-3-mini", vocab_size=32064, d_model=3072, n_layers=32,
+    n_heads=32, n_kv_heads=32, d_ff=8192, max_seq_len=4096,
+    tie_embeddings=False, sliding_window=2047,
+)
 CONFIGS["phi-2"] = ModelConfig(
     # microsoft/phi-2: 2.7B, parallel attn+mlp blocks sharing one
     # layernorm, partial rotary over the first 32 of 80 head dims,
@@ -558,21 +567,43 @@ def config_from_hf(d: dict, name: str | None = None) -> ModelConfig:
             rope_scaling=_parse_rope_scaling(d), parallel_block=True,
             lm_head_bias=True, norm_eps=d.get("layer_norm_eps", 1e-5),
         )
+    if mt == "phi3":
+        # architecturally a llama-branch model (the loader un-fuses
+        # qkv_proj / gate_up_proj); partial rotary + optional window
+        H = d["num_attention_heads"]
+        return ModelConfig(
+            name=nm, vocab_size=d["vocab_size"], d_model=d["hidden_size"],
+            n_layers=d["num_hidden_layers"], n_heads=H,
+            n_kv_heads=d.get("num_key_value_heads") or H,
+            d_ff=d["intermediate_size"],
+            max_seq_len=d.get("max_position_embeddings", 4096),
+            rope_theta=d.get("rope_theta", 10000.0),
+            rope_scaling=_parse_rope_scaling(d),  # longrope refuses here
+            rotary_pct=d.get("partial_rotary_factor", 1.0),
+            norm_eps=d.get("rms_norm_eps", 1e-5),
+            tie_embeddings=d.get("tie_word_embeddings", False),
+            sliding_window=d.get("sliding_window"),
+        )
     if mt in ("llama", "mistral", "qwen2", "qwen3", "gemma", "gemma2",
               "mixtral"):
         n_heads = d["num_attention_heads"]
-        hd = d.get("head_dim")
+        # transformers serializes config.json as a DIFF against each
+        # Config class's defaults — absent keys mean the FAMILY default,
+        # which differs per family (Gemma/Gemma2Config: head_dim 256, 8k
+        # positions, 1e-6 eps, tied embeddings; Qwen3Config: head_dim 128)
+        gemma_like = mt in ("gemma", "gemma2")
+        hd = d.get("head_dim",
+                   {"gemma": 256, "gemma2": 256, "qwen3": 128}.get(mt))
         kw: dict = dict(
             name=nm, vocab_size=d["vocab_size"], d_model=d["hidden_size"],
             n_layers=d["num_hidden_layers"], n_heads=n_heads,
             n_kv_heads=d.get("num_key_value_heads") or n_heads,
             d_ff=d["intermediate_size"],
-            max_seq_len=d.get("max_position_embeddings", 2048),
+            max_seq_len=d.get("max_position_embeddings",
+                              8192 if gemma_like else 2048),
             rope_theta=d.get("rope_theta", 10000.0),
-            norm_eps=d.get("rms_norm_eps", 1e-6 if mt == "gemma" else 1e-5),
-            # HF defaults tie_word_embeddings False for llama-family but
-            # True for gemma
-            tie_embeddings=d.get("tie_word_embeddings", mt == "gemma"),
+            norm_eps=d.get("rms_norm_eps", 1e-6 if gemma_like else 1e-5),
+            tie_embeddings=d.get("tie_word_embeddings", gemma_like),
             qkv_bias=mt == "qwen2",
             qk_norm=mt == "qwen3",
         )

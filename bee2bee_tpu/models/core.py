@@ -537,6 +537,23 @@ def attn_mask(cfg: ModelConfig, positions, T: int, S: int | None = None,
     return causal[None, None, :, :]
 
 
+def make_layer_mask(cfg: ModelConfig, positions, T: int, S: int | None = None,
+                    start: int = 0):
+    """Per-layer mask selector — THE one implementation of the gemma-2
+    local/global alternation, shared by core.forward (start=0) and
+    stages.stage_forward (start=spec.start): layers where the GLOBAL
+    index % sliding_window_every == 0 window, the rest attend fully.
+    Non-alternating configs get the single attn_mask back for every
+    layer."""
+    mask = attn_mask(cfg, positions, T, S)
+    if not (cfg.sliding_window and cfg.sliding_window_every > 1):
+        return lambda idx: mask
+    mask_full = attn_mask(cfg, positions, T, S, window=None)
+    every = cfg.sliding_window_every
+    return lambda idx: jnp.where(((start + idx) % every) == 0,
+                                 mask, mask_full)
+
+
 def forward(
     params: Params,
     cfg: ModelConfig,
@@ -562,17 +579,7 @@ def forward(
     x = embed_tokens(params, cfg, input_ids, positions)
 
     S = cache["k"].shape[2] if cache is not None else None
-    mask = attn_mask(cfg, positions, T, S)
-    # gemma-2 alternation: only every Nth layer windows — build the full-
-    # causal variant once and select per layer inside the scan
-    alternating = bool(cfg.sliding_window) and cfg.sliding_window_every > 1
-    mask_full = attn_mask(cfg, positions, T, S, window=None) if alternating else None
-
-    def layer_mask(layer_idx):
-        if not alternating:
-            return mask
-        return jnp.where((layer_idx % cfg.sliding_window_every) == 0,
-                         mask, mask_full)
+    layer_mask = make_layer_mask(cfg, positions, T, S)
 
     def layer(carry, xs):
         x, cache_k, cache_v = carry

@@ -551,6 +551,13 @@ def hf_config_dict(cfg: ModelConfig, qkv_bias: bool | None = None,
             "tie_word_embeddings": False,
             "hidden_act": "gelu_new",
         }
+    if cfg.rotary_pct < 1.0:
+        # none of the llama-branch config schemas carry partial rotary —
+        # transformers would rotate every head dim and silently diverge
+        raise ValueError(
+            f"partial rotary (rotary_pct={cfg.rotary_pct}) is not "
+            f"representable in the llama-branch export schemas"
+        )
     base = {
         "vocab_size": cfg.vocab_size,
         "hidden_size": cfg.d_model,

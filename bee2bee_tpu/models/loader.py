@@ -422,6 +422,30 @@ def _convert_neox(state, cfg: ModelConfig) -> dict:
     }
 
 
+def _convert_phi3(state, cfg: ModelConfig) -> dict:
+    """HF Phi-3 names → our layout. Architecturally phi-3 IS a llama-
+    style model (rmsnorm, gated silu, GQA, rope) — only the tensor
+    packing differs: qkv_proj fuses [q | k | v] on the out dim and
+    gate_up_proj fuses [gate | up]. Un-fuse into llama key names and
+    DELEGATE to _convert_llama, so every llama-branch behavior (norm
+    folds, biases, future fixes) applies identically."""
+    H, K, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    F = cfg.d_ff
+    unfused: dict[str, np.ndarray] = {}
+    for k, v in state.items():
+        if k.endswith(".self_attn.qkv_proj.weight"):
+            base = k.replace("qkv_proj", "{}")
+            unfused[base.format("q_proj")] = v[: H * hd]
+            unfused[base.format("k_proj")] = v[H * hd: (H + K) * hd]
+            unfused[base.format("v_proj")] = v[(H + K) * hd:]
+        elif k.endswith(".mlp.gate_up_proj.weight"):
+            unfused[k.replace("gate_up_proj", "gate_proj")] = v[:F]
+            unfused[k.replace("gate_up_proj", "up_proj")] = v[F:]
+        else:
+            unfused[k] = v
+    return _convert_llama(unfused, cfg)
+
+
 def _convert_llama(state, cfg: ModelConfig) -> dict:
     """HF Llama/Mistral names → our layout (weights transpose: HF linear is
     [out, in]; ours is [in, out])."""
@@ -540,6 +564,8 @@ def load_checkpoint(
         params = _convert_falcon(state, cfg)
     elif any(".attention.query_key_value." in k for k in state):
         params = _convert_neox(state, cfg)
+    elif any(".self_attn.qkv_proj." in k for k in state):  # phi-3's fused
+        params = _convert_phi3(state, cfg)
     elif any(".mlp.fc_in." in k for k in state):  # gpt-j's unique mlp names
         params = _convert_gptj(state, cfg)
     else:

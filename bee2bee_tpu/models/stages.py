@@ -144,20 +144,9 @@ def stage_forward(
         h = x
 
     S = cache["k"].shape[2] if cache is not None else None
-    mask = core.attn_mask(cfg, positions, T, S)
     # gemma-2 alternation by GLOBAL layer index (spec.start + local idx):
     # the split model must window exactly the layers the monolith windows
-    alternating = bool(cfg.sliding_window) and cfg.sliding_window_every > 1
-    mask_full = (core.attn_mask(cfg, positions, T, S, window=None)
-                 if alternating else None)
-
-    def layer_mask(local_idx):
-        if not alternating:
-            return mask
-        return jnp.where(
-            ((spec.start + local_idx) % cfg.sliding_window_every) == 0,
-            mask, mask_full,
-        )
+    layer_mask = core.make_layer_mask(cfg, positions, T, S, start=spec.start)
 
     def layer(carry, xs):
         h, ck, cv = carry

@@ -193,3 +193,25 @@ def test_gemma2_export_requires_alternating_window():
                               sliding_window_every=1)
     with pytest.raises(ValueError, match="sliding_window"):
         hf_config_dict(cfg)
+
+
+def test_phi3_longrope_refused():
+    """phi-3 128k variants carry longrope scaling the core doesn't
+    implement — refuse, don't serve drifted rotations."""
+    d = {"model_type": "phi3", "vocab_size": 512, "hidden_size": 64,
+         "num_hidden_layers": 2, "num_attention_heads": 4,
+         "intermediate_size": 128,
+         "rope_scaling": {"type": "longrope", "short_factor": [1.0],
+                          "long_factor": [1.0]}}
+    with pytest.raises(ValueError, match="longrope"):
+        config_from_hf(d)
+
+
+def test_llama_branch_export_refuses_partial_rotary():
+    """a partial-rotary config has no representation in the llama-branch
+    schemas — exporting would rotate every head dim in transformers."""
+    import dataclasses
+
+    cfg = dataclasses.replace(get_config("tiny-llama"), rotary_pct=0.5)
+    with pytest.raises(ValueError, match="rotary"):
+        hf_config_dict(cfg)

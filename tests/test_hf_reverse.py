@@ -77,6 +77,13 @@ CASES = {
              n_head=4, n_inner=128, multi_query=True,
              activation_function="gelu", tie_word_embeddings=False,
              resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)),
+    # llama-branch arch behind FUSED qkv_proj / gate_up_proj tensors —
+    # the un-fuse split must be exact; window 4 < seq 8 binds
+    "phi3": ("Phi3Config", "Phi3ForCausalLM",
+             dict(TINY, num_key_value_heads=2, tie_word_embeddings=False,
+                  sliding_window=4, resid_pdrop=0.0, embd_pdrop=0.0,
+                  attention_dropout=0.0, pad_token_id=0, bos_token_id=1,
+                  eos_token_id=2)),
     "phi": ("PhiConfig", "PhiForCausalLM",
             dict(TINY, partial_rotary_factor=0.4,
                  resid_pdrop=0.0, embd_pdrop=0.0, attention_dropout=0.0)),
