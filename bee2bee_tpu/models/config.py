@@ -27,6 +27,7 @@ class ModelConfig:
     pos_embedding: str = "rope"  # "rope" | "learned" | "alibi" (bloom:
     # linear attention-score bias per head, no embedding-side positions)
     norm: str = "rmsnorm"  # "rmsnorm" | "layernorm"
+    norm_bias: bool = True  # layernorm only: mpt ships weight-only norms
     activation: str = "silu"  # "silu" (gated) | "gelu" (tanh approx, gpt2/
     # phi) | "gelu_exact" (erf — gpt-neox) | "geglu"
     use_bias: bool = False  # attn/mlp biases (gpt2 style)
@@ -332,6 +333,21 @@ CONFIGS["bloom-7b1"] = ModelConfig(
     pos_embedding="alibi", norm="layernorm", activation="gelu",
     use_bias=True, tie_embeddings=True, embedding_norm=True,
 )
+CONFIGS["tiny-mpt"] = ModelConfig(  # mpt style: ALiBi + weight-only
+    # layernorms + zero linear biases + exact gelu, sequential blocks
+    name="tiny-mpt", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+    n_kv_heads=4, d_ff=256, max_seq_len=256, pos_embedding="alibi",
+    norm="layernorm", norm_bias=False, activation="gelu_exact",
+    tie_embeddings=True,
+)
+CONFIGS["mpt-7b"] = ModelConfig(
+    # mosaicml/mpt-7b: 32 heads (power of two — the bloom slope formula
+    # applies exactly), expansion ratio 4, no biases anywhere
+    name="mpt-7b", vocab_size=50432, d_model=4096, n_layers=32,
+    n_heads=32, n_kv_heads=32, d_ff=16384, max_seq_len=2048,
+    pos_embedding="alibi", norm="layernorm", norm_bias=False,
+    activation="gelu_exact", tie_embeddings=True,
+)
 CONFIGS["tiny-falcon"] = ModelConfig(  # falcon-7b shape: MQA + bias-free
     # parallel block sharing ONE layernorm, exact-erf gelu, tied head
     name="tiny-falcon", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
@@ -492,6 +508,36 @@ def config_from_hf(d: dict, name: str | None = None) -> ModelConfig:
             parallel_block=d.get("use_parallel_residual", True),
             parallel_norms=2, norm_eps=d.get("layer_norm_eps", 1e-5),
         )
+    if mt == "mpt":
+        ac = d.get("attn_config") or {}
+        if not ac.get("alibi", True):
+            raise ValueError(
+                "mpt without alibi (learned-pos variant) is not supported "
+                "by the native core; serve via the ollama/remote backends"
+            )
+        if ac.get("clip_qkv") or ac.get("softmax_scale"):
+            raise ValueError(
+                "mpt clip_qkv / custom softmax_scale are not supported by "
+                "the native core"
+            )
+        H = d["n_heads"]
+        if H & (H - 1):
+            # MPT's non-power-of-two slope interleave differs from the
+            # bloom formula core.alibi_slopes implements — refuse rather
+            # than attend with wrong biases
+            raise ValueError(
+                f"mpt with non-power-of-two n_heads={H} is not supported "
+                f"(ALiBi slope schedule differs)"
+            )
+        return ModelConfig(
+            name=nm, vocab_size=d["vocab_size"], d_model=d["d_model"],
+            n_layers=d["n_layers"], n_heads=H, n_kv_heads=H,
+            d_ff=int(d.get("expansion_ratio", 4)) * d["d_model"],
+            max_seq_len=d.get("max_seq_len", 2048), pos_embedding="alibi",
+            norm="layernorm", norm_bias=False, activation="gelu_exact",
+            tie_embeddings=d.get("tie_word_embeddings", True),
+            norm_eps=d.get("layer_norm_epsilon", 1e-5),
+        )
     if mt == "bloom":
         if d.get("apply_residual_connection_post_layernorm"):
             # HF adds the post-LN hidden states to the residual under this
@@ -588,21 +634,26 @@ def config_from_hf(d: dict, name: str | None = None) -> ModelConfig:
               "mixtral"):
         n_heads = d["num_attention_heads"]
         # transformers serializes config.json as a DIFF against each
-        # Config class's defaults — absent keys mean the FAMILY default,
-        # which differs per family (Gemma/Gemma2Config: head_dim 256, 8k
-        # positions, 1e-6 eps, tied embeddings; Qwen3Config: head_dim 128)
+        # Config class's defaults — absent keys mean the FAMILY default
+        # (values introspected from the installed transformers; a wrong
+        # fallback here silently drifts every norm / truncates context)
         gemma_like = mt in ("gemma", "gemma2")
         hd = d.get("head_dim",
                    {"gemma": 256, "gemma2": 256, "qwen3": 128}.get(mt))
+        default_maxpos = {"llama": 2048, "mistral": 131072,
+                          "mixtral": 131072, "qwen2": 32768,
+                          "qwen3": 32768, "gemma": 8192, "gemma2": 8192}[mt]
         kw: dict = dict(
             name=nm, vocab_size=d["vocab_size"], d_model=d["hidden_size"],
             n_layers=d["num_hidden_layers"], n_heads=n_heads,
             n_kv_heads=d.get("num_key_value_heads") or n_heads,
             d_ff=d["intermediate_size"],
-            max_seq_len=d.get("max_position_embeddings",
-                              8192 if gemma_like else 2048),
-            rope_theta=d.get("rope_theta", 10000.0),
-            norm_eps=d.get("rms_norm_eps", 1e-6 if gemma_like else 1e-5),
+            max_seq_len=d.get("max_position_embeddings", default_maxpos),
+            rope_theta=d.get("rope_theta",
+                             1000000.0 if mt == "mixtral" else 10000.0),
+            # every family defaults rms_norm_eps=1e-6 EXCEPT mixtral (1e-5)
+            norm_eps=d.get("rms_norm_eps",
+                           1e-5 if mt == "mixtral" else 1e-6),
             tie_embeddings=d.get("tie_word_embeddings", gemma_like),
             qkv_bias=mt == "qwen2",
             qk_norm=mt == "qwen3",

@@ -74,7 +74,7 @@ def init_params(cfg: ModelConfig, key, dtype=jnp.bfloat16) -> Params:
         params["pos_embed"] = _dense_init(next(keys), (cfg.max_seq_len, D), 0.02, dtype)
     if cfg.embedding_norm:
         params["embed_norm"] = {"scale": jnp.ones((D,), dtype)}
-        if cfg.norm == "layernorm":
+        if cfg.norm == "layernorm" and cfg.norm_bias:
             params["embed_norm"]["bias"] = jnp.zeros((D,), dtype)
 
     layers: Params = {
@@ -93,7 +93,7 @@ def init_params(cfg: ModelConfig, key, dtype=jnp.bfloat16) -> Params:
     if cfg.post_norms:  # gemma-2: norms on the attn/mlp outputs too
         layers["ln1_post"] = {"scale": jnp.ones((L, D), dtype)}
         layers["ln2_post"] = {"scale": jnp.ones((L, D), dtype)}
-    if cfg.norm == "layernorm":
+    if cfg.norm == "layernorm" and cfg.norm_bias:
         layers["ln1"]["bias"] = jnp.zeros((L, D), dtype)
         if "ln2" in layers:
             layers["ln2"]["bias"] = jnp.zeros((L, D), dtype)
@@ -135,7 +135,7 @@ def init_params(cfg: ModelConfig, key, dtype=jnp.bfloat16) -> Params:
 
     params["layers"] = layers
     params["final_norm"] = {"scale": jnp.ones((D,), dtype)}
-    if cfg.norm == "layernorm":
+    if cfg.norm == "layernorm" and cfg.norm_bias:
         params["final_norm"]["bias"] = jnp.zeros((D,), dtype)
     if not cfg.tie_embeddings:
         params["lm_head"] = dense((D, V))

@@ -263,6 +263,33 @@ def _export_neox_state(params, cfg: ModelConfig, dtype) -> dict[str, np.ndarray]
     return state
 
 
+def _export_mpt_state(params, cfg: ModelConfig, dtype) -> dict[str, np.ndarray]:
+    """Inverse of loader._convert_mpt (re-fuses the plain-thirds Wqkv)."""
+    layers = params["layers"]
+    t = lambda a: _np(a, dtype).T
+    state = {
+        "transformer.wte.weight": _np(params["tok_embed"], dtype),
+        "transformer.norm_f.weight": _np(params["final_norm"]["scale"], dtype),
+        "lm_head.weight": (
+            _np(params["tok_embed"], dtype) if cfg.tie_embeddings
+            else t(params["lm_head"])
+        ),
+    }
+    a = layers["attn"]
+    for i in range(cfg.n_layers):
+        p = f"transformer.blocks.{i}."
+        state[p + "norm_1.weight"] = _np(layers["ln1"]["scale"][i], dtype)
+        state[p + "norm_2.weight"] = _np(layers["ln2"]["scale"][i], dtype)
+        state[p + "attn.Wqkv.weight"] = np.concatenate(
+            [t(a[k][i]) for k in ("wq", "wk", "wv")], axis=0
+        )
+        state[p + "attn.out_proj.weight"] = t(a["wo"][i])
+        m = layers["mlp"]
+        state[p + "ffn.up_proj.weight"] = t(m["w_up"][i])
+        state[p + "ffn.down_proj.weight"] = t(m["w_down"][i])
+    return state
+
+
 def _export_bloom_state(params, cfg: ModelConfig, dtype) -> dict[str, np.ndarray]:
     """Inverse of loader._convert_bloom (re-interleaves the biased fused
     QKV per head, restores the embedding LayerNorm)."""
@@ -388,6 +415,35 @@ def hf_config_dict(cfg: ModelConfig, qkv_bias: bool | None = None,
             f"rope_scaling export is only supported for llama-branch "
             f"families; {cfg.name!r} would silently lose it"
         )
+    if cfg.pos_embedding == "alibi" and not cfg.use_bias:  # mpt family
+        H = cfg.n_heads
+        if (cfg.n_kv_heads != H or (H & (H - 1)) or cfg.embedding_norm
+                or cfg.norm != "layernorm" or cfg.norm_bias
+                or cfg.activation != "gelu_exact"
+                or cfg.d_ff != 4 * cfg.d_model):
+            # transformers' MptMLP HARDCODES 4*hidden — any other ratio
+            # would shape-mismatch (or silently re-init) on from_pretrained
+            raise ValueError(
+                "mpt export requires MHA with power-of-two heads, weight-"
+                "only layernorms, no biases, exact gelu, and expansion "
+                f"ratio 4 (transformers hardcodes it); got "
+                f"kv={cfg.n_kv_heads}, heads={H}, act={cfg.activation!r}, "
+                f"norm_bias={cfg.norm_bias}, d_ff={cfg.d_ff}"
+            )
+        return {
+            "model_type": "mpt",
+            "architectures": ["MptForCausalLM"],
+            "vocab_size": cfg.vocab_size,
+            "d_model": cfg.d_model,
+            "n_layers": cfg.n_layers,
+            "n_heads": cfg.n_heads,
+            "expansion_ratio": cfg.d_ff // cfg.d_model,
+            "max_seq_len": cfg.max_seq_len,
+            "no_bias": True,
+            "layer_norm_epsilon": cfg.norm_eps,
+            "attn_config": {"alibi": True},
+            "tie_word_embeddings": cfg.tie_embeddings,
+        }
     if cfg.pos_embedding == "alibi":  # bloom family
         if (cfg.n_kv_heads != cfg.n_heads or not cfg.use_bias
                 or cfg.norm != "layernorm" or cfg.activation != "gelu"
@@ -688,7 +744,9 @@ def export_hf(params, cfg: ModelConfig, out_dir: str | Path,
     # halfway through a tensor conversion
     cfg_json = hf_config_dict(cfg, qkv_bias=has_qkv_bias, qk_norm=has_qk_norm)
     np_dtype = np.dtype(dtype) if dtype != "bfloat16" else _bf16_dtype()
-    if cfg.pos_embedding == "alibi":
+    if cfg.pos_embedding == "alibi" and not cfg.use_bias:  # mpt
+        state = _export_mpt_state(params, cfg, np_dtype)
+    elif cfg.pos_embedding == "alibi":
         state = _export_bloom_state(params, cfg, np_dtype)
     elif cfg.pos_embedding == "learned" and cfg.n_kv_heads != cfg.n_heads:
         state = _export_bigcode_state(params, cfg, np_dtype)

@@ -251,6 +251,44 @@ def _convert_gptj(state, cfg: ModelConfig) -> dict:
     }
 
 
+def _convert_mpt(state, cfg: ModelConfig) -> dict:
+    """HF MPT names → our layout: transformer.blocks.N.{norm_1, attn.Wqkv
+    (sequential q|k|v thirds), attn.out_proj, norm_2, ffn.{up,down}_proj},
+    weight-only norms, zero biases, tied head, ALiBi."""
+    pre = "transformer." if any(k.startswith("transformer.") for k in state) else ""
+    g = lambda k: state[pre + k]
+    t = lambda a: np.ascontiguousarray(a.T)
+    L, D = cfg.n_layers, cfg.d_model
+    qw, kw, vw = [], [], []
+    for i in range(L):
+        w = g(f"blocks.{i}.attn.Wqkv.weight")  # [3D, D], plain thirds
+        qw.append(t(w[:D])); kw.append(t(w[D:2 * D])); vw.append(t(w[2 * D:]))
+    layers = {
+        "ln1": {"scale": _stack([g(f"blocks.{i}.norm_1.weight") for i in range(L)])},
+        "ln2": {"scale": _stack([g(f"blocks.{i}.norm_2.weight") for i in range(L)])},
+        "attn": {
+            "wq": _stack(qw), "wk": _stack(kw), "wv": _stack(vw),
+            "wo": _stack([t(g(f"blocks.{i}.attn.out_proj.weight")) for i in range(L)]),
+        },
+        "mlp": {
+            "w_up": _stack([t(g(f"blocks.{i}.ffn.up_proj.weight")) for i in range(L)]),
+            "w_down": _stack([t(g(f"blocks.{i}.ffn.down_proj.weight")) for i in range(L)]),
+        },
+    }
+    out = {
+        "tok_embed": g("wte.weight"),
+        "layers": layers,
+        "final_norm": {"scale": g("norm_f.weight")},
+    }
+    if not cfg.tie_embeddings:
+        lm = state.get("lm_head.weight")
+        out["lm_head"] = (
+            t(lm) if lm is not None
+            else np.ascontiguousarray(g("wte.weight").T)
+        )
+    return out
+
+
 def _convert_bloom(state, cfg: ModelConfig) -> dict:
     """HF BLOOM names → our layout: word_embeddings + its LayerNorm,
     per-head [H, 3, hd] interleaved fused QKV WITH biases (same packing
@@ -558,6 +596,8 @@ def load_checkpoint(
         params = _convert_phi(state, cfg)
     elif any("word_embeddings_layernorm" in k for k in state):
         params = _convert_bloom(state, cfg)  # bloom's unique embed-LN key
+    elif any(".attn.Wqkv." in k for k in state):  # mpt's unique fused name
+        params = _convert_mpt(state, cfg)
     elif any(".self_attention.query_key_value." in k for k in state):
         # MUST precede the neox check: ".attention.query_key_value." is a
         # substring of falcon's ".self_attention.query_key_value."

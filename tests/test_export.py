@@ -53,7 +53,8 @@ def test_write_safetensors_roundtrip_dtypes(tmp_path):
     "name",
     ["tiny-gpt2", "tiny-llama", "tiny-mistral", "tiny-mixtral", "tiny-gemma",
      "tiny-qwen", "tiny-phi", "tiny-neox", "tiny-gptj", "tiny-falcon",
-     "tiny-bigcode", "tiny-bloom", "tiny-qwen3", "tiny-gemma2"],
+     "tiny-bigcode", "tiny-bloom", "tiny-qwen3", "tiny-gemma2",
+     "tiny-mpt"],
 )
 def test_export_hf_roundtrips_through_loader(tmp_path, name):
     """export_hf must be the exact inverse of the loader's HF conversion
@@ -556,3 +557,10 @@ def test_gemma2_rejects_flash_and_auto_resolves_dense():
         assert eng.engine_cfg.attention == "dense"
     finally:
         eng.close()
+
+
+def test_torch_loads_mpt_export_and_logits_match(tmp_path):
+    """mpt family conformance: ALiBi (power-of-two slope schedule shared
+    with bloom), weight-only layernorms, zero linear biases, the plain-
+    thirds fused Wqkv, exact-erf gelu against MptForCausalLM."""
+    _torch_conformance("tiny-mpt", tmp_path, "MptForCausalLM", seed=81)

@@ -97,6 +97,10 @@ CASES = {
     "bloom": ("BloomConfig", "BloomForCausalLM",
               dict(vocab_size=512, hidden_size=64, n_layer=2, n_head=4,
                    hidden_dropout=0.0, attention_dropout=0.0)),
+    # ALiBi with weight-only norms, zero biases, plain-thirds fused Wqkv
+    "mpt": ("MptConfig", "MptForCausalLM",
+            dict(vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+                 max_seq_len=64, resid_pdrop=0.0, emb_pdrop=0.0)),
     # llama-3.1-style rope scaling: frequency schedule must match HF's
     # _compute_llama3_parameters or every position's rotation drifts
     "llama_rope_llama3": (
