@@ -385,6 +385,20 @@ CONFIGS["gpt-neox-20b"] = ModelConfig(
     tie_embeddings=False, rotary_pct=0.25, parallel_block=True,
     parallel_norms=2,
 )
+CONFIGS["tiny-stablelm"] = ModelConfig(
+    # stablelm-2 style: llama tensor layout with BIASED layernorms,
+    # partial rotary 0.25, gated silu, untied head
+    name="tiny-stablelm", vocab_size=512, d_model=64, n_layers=2,
+    n_heads=4, n_kv_heads=2, d_ff=128, max_seq_len=256, norm="layernorm",
+    rotary_pct=0.25, tie_embeddings=False,
+)
+CONFIGS["stablelm-2-1.6b"] = ModelConfig(
+    # stabilityai/stablelm-2-1_6b ships use_qkv_bias=true (the qwen-style
+    # per-projection q/k/v biases are a defining stablelm-2 feature)
+    name="stablelm-2-1.6b", vocab_size=100352, d_model=2048, n_layers=24,
+    n_heads=32, n_kv_heads=32, d_ff=5632, max_seq_len=4096,
+    norm="layernorm", rotary_pct=0.25, qkv_bias=True, tie_embeddings=False,
+)
 CONFIGS["phi-3-mini"] = ModelConfig(
     # microsoft/Phi-3-mini-4k-instruct: llama-branch arch behind fused
     # qkv_proj/gate_up_proj tensors (loader._convert_phi3 un-fuses),
@@ -612,6 +626,32 @@ def config_from_hf(d: dict, name: str | None = None) -> ModelConfig:
             rope_theta=d.get("rope_theta", 10000.0),
             rope_scaling=_parse_rope_scaling(d), parallel_block=True,
             lm_head_bias=True, norm_eps=d.get("layer_norm_eps", 1e-5),
+        )
+    if mt == "stablelm":
+        if d.get("use_parallel_residual"):
+            raise ValueError(
+                "stablelm use_parallel_residual=true is not supported by "
+                "the native core's stablelm path"
+            )
+        if d.get("qk_layernorm"):
+            raise ValueError(
+                "stablelm qk_layernorm=true (per-head LayerNorm) is not "
+                "supported by the native core"
+            )
+        H = d["num_attention_heads"]
+        return ModelConfig(
+            name=nm, vocab_size=d["vocab_size"], d_model=d["hidden_size"],
+            n_layers=d["num_hidden_layers"], n_heads=H,
+            n_kv_heads=d.get("num_key_value_heads") or H,
+            d_ff=d["intermediate_size"],
+            max_seq_len=d.get("max_position_embeddings", 4096),
+            norm="layernorm",  # biased LNs over the llama tensor layout
+            rotary_pct=d.get("partial_rotary_factor", 0.25),
+            rope_theta=d.get("rope_theta", 10000.0),
+            rope_scaling=_parse_rope_scaling(d),
+            qkv_bias=d.get("use_qkv_bias", False),
+            tie_embeddings=d.get("tie_word_embeddings", False),
+            norm_eps=d.get("layer_norm_eps", 1e-5),
         )
     if mt == "phi3":
         # architecturally a llama-branch model (the loader un-fuses

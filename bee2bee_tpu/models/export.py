@@ -161,6 +161,8 @@ def _export_llama_state(params, cfg: ModelConfig, dtype) -> dict[str, np.ndarray
         "model.embed_tokens.weight": _np(params["tok_embed"], dtype),
         "model.norm.weight": norm(params["final_norm"]["scale"]),
     }
+    if "bias" in params["final_norm"]:  # stablelm: biased layernorms
+        state["model.norm.bias"] = _np(params["final_norm"]["bias"], dtype)
     if not cfg.tie_embeddings:
         state["lm_head.weight"] = t(params["lm_head"])
     for i in range(cfg.n_layers):
@@ -172,6 +174,9 @@ def _export_llama_state(params, cfg: ModelConfig, dtype) -> dict[str, np.ndarray
             state[p + "post_feedforward_layernorm.weight"] = norm(layers["ln2_post"]["scale"][i])
         else:
             state[p + "post_attention_layernorm.weight"] = norm(layers["ln2"]["scale"][i])
+        if "bias" in layers["ln1"]:  # stablelm: biased layernorms
+            state[p + "input_layernorm.bias"] = _np(layers["ln1"]["bias"][i], dtype)
+            state[p + "post_attention_layernorm.bias"] = _np(layers["ln2"]["bias"][i], dtype)
         a = layers["attn"]
         for ours, hf in (("wq", "q_proj"), ("wk", "k_proj"), ("wv", "v_proj"), ("wo", "o_proj")):
             state[p + f"self_attn.{hf}.weight"] = t(a[ours][i])
@@ -607,6 +612,52 @@ def hf_config_dict(cfg: ModelConfig, qkv_bias: bool | None = None,
             "tie_word_embeddings": False,
             "hidden_act": "gelu_new",
         }
+    if cfg.norm == "layernorm":  # stablelm: the one llama-layout family
+        # with biased LayerNorms (and a partial_rotary_factor field)
+        if (cfg.norm_plus_one or cfg.is_moe or cfg.post_norms
+                or cfg.qk_norm or cfg.sliding_window
+                or cfg.activation != "silu" or cfg.rope_style != "half"
+                or cfg.use_bias or cfg.mlp_bias or not cfg.norm_bias
+                or cfg.embedding_scale or cfg.attn_logit_softcap
+                or cfg.attn_scale or cfg.logits_softcap):
+            # StableLmForCausalLM hardcodes silu / half rotary / biased
+            # LNs with bias-free mlp — anything else would load in
+            # transformers WITHOUT warning and silently diverge
+            raise ValueError(
+                f"stablelm export requires silu + half rotary + biased "
+                f"layernorms and none of moe/post_norms/qk_norm/window/"
+                f"softcaps ({cfg.name!r} doesn't fit)"
+            )
+        if cfg.head_dim != cfg.d_model // cfg.n_heads:
+            raise ValueError(
+                "stablelm export cannot carry head_dim overrides "
+                f"(StableLmConfig has no head_dim field); got "
+                f"{cfg.head_dim}"
+            )
+        out = {
+            "model_type": "stablelm",
+            "architectures": ["StableLmForCausalLM"],
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.d_model,
+            "num_hidden_layers": cfg.n_layers,
+            "num_attention_heads": cfg.n_heads,
+            "num_key_value_heads": cfg.n_kv_heads,
+            "intermediate_size": cfg.d_ff,
+            "max_position_embeddings": cfg.max_seq_len,
+            "rope_theta": cfg.rope_theta,
+            "layer_norm_eps": cfg.norm_eps,
+            "partial_rotary_factor": cfg.rotary_pct,
+            "use_qkv_bias": bool(cfg.qkv_bias if qkv_bias is None else qkv_bias),
+            "tie_word_embeddings": cfg.tie_embeddings,
+        }
+        if cfg.rope_scaling is not None:
+            if cfg.rope_scaling[0] != "linear":
+                raise ValueError(
+                    "stablelm export supports linear rope_scaling only"
+                )
+            out["rope_scaling"] = {"rope_type": "linear",
+                                   "factor": cfg.rope_scaling[1]}
+        return out
     if cfg.rotary_pct < 1.0:
         # none of the llama-branch config schemas carry partial rotary —
         # transformers would rotate every head dim and silently diverge

@@ -509,6 +509,11 @@ def _convert_llama(state, cfg: ModelConfig) -> dict:
             "ln1": {"scale": _stack([g(f"layers.{i}.input_layernorm.weight") for i in range(L)])},
             "ln2": {"scale": _stack([g(f"layers.{i}.post_attention_layernorm.weight") for i in range(L)])},
         }
+        if cfg.norm == "layernorm" and cfg.norm_bias:  # stablelm: biased LNs
+            layers["ln1"]["bias"] = _stack(
+                [raw(f"layers.{i}.input_layernorm.bias") for i in range(L)])
+            layers["ln2"]["bias"] = _stack(
+                [raw(f"layers.{i}.post_attention_layernorm.bias") for i in range(L)])
     layers["attn"] = {
         "wq": _stack([t(g(f"layers.{i}.self_attn.q_proj.weight")) for i in range(L)]),
         "wk": _stack([t(g(f"layers.{i}.self_attn.k_proj.weight")) for i in range(L)]),
@@ -553,6 +558,8 @@ def _convert_llama(state, cfg: ModelConfig) -> dict:
         "layers": layers,
         "final_norm": {"scale": g("norm.weight")},
     }
+    if cfg.norm == "layernorm" and cfg.norm_bias:
+        params["final_norm"]["bias"] = raw("norm.bias")
     if not cfg.tie_embeddings:
         lm = state.get("lm_head.weight")
         params["lm_head"] = t(lm) if lm is not None else np.ascontiguousarray(g("embed_tokens.weight").T)

@@ -90,6 +90,8 @@ def extract_stage_params(params: Params, cfg: ModelConfig, spec: StageSpec) -> P
         out["tok_embed"] = params["tok_embed"]
         if "pos_embed" in params:
             out["pos_embed"] = params["pos_embed"]
+        if "embed_norm" in params:  # bloom's embedding LayerNorm
+            out["embed_norm"] = params["embed_norm"]
     if spec.is_last:
         out["final_norm"] = params["final_norm"]
         if cfg.tie_embeddings:

@@ -19,7 +19,7 @@ from bee2bee_tpu.models.export import export_hf, hf_config_dict
     ["tiny-gpt2", "tiny-llama", "tiny-mistral", "tiny-mixtral", "tiny-gemma",
      "tiny-qwen", "tiny-phi", "tiny-neox", "tiny-gptj", "tiny-falcon",
      "tiny-bigcode", "tiny-bloom", "tiny-qwen3", "tiny-gemma2",
-     "tiny-mpt"],
+     "tiny-mpt", "tiny-stablelm"],
 )
 def test_config_from_hf_inverts_hf_config_dict(name):
     """For every supported family: our exported config.json must

@@ -54,7 +54,7 @@ def test_write_safetensors_roundtrip_dtypes(tmp_path):
     ["tiny-gpt2", "tiny-llama", "tiny-mistral", "tiny-mixtral", "tiny-gemma",
      "tiny-qwen", "tiny-phi", "tiny-neox", "tiny-gptj", "tiny-falcon",
      "tiny-bigcode", "tiny-bloom", "tiny-qwen3", "tiny-gemma2",
-     "tiny-mpt"],
+     "tiny-mpt", "tiny-stablelm"],
 )
 def test_export_hf_roundtrips_through_loader(tmp_path, name):
     """export_hf must be the exact inverse of the loader's HF conversion
@@ -564,3 +564,11 @@ def test_torch_loads_mpt_export_and_logits_match(tmp_path):
     with bloom), weight-only layernorms, zero linear biases, the plain-
     thirds fused Wqkv, exact-erf gelu against MptForCausalLM."""
     _torch_conformance("tiny-mpt", tmp_path, "MptForCausalLM", seed=81)
+
+
+def test_torch_loads_stablelm_export_and_logits_match(tmp_path):
+    """stablelm family conformance: llama tensor layout with BIASED
+    LayerNorms (incl. the final norm) and partial rotary 0.25 against
+    StableLmForCausalLM."""
+    _torch_conformance("tiny-stablelm", tmp_path, "StableLmForCausalLM",
+                       seed=91)

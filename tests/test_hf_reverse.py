@@ -97,6 +97,11 @@ CASES = {
     "bloom": ("BloomConfig", "BloomForCausalLM",
               dict(vocab_size=512, hidden_size=64, n_layer=2, n_head=4,
                    hidden_dropout=0.0, attention_dropout=0.0)),
+    # llama tensor layout with BIASED layernorms + partial rotary 0.25
+    "stablelm": ("StableLmConfig", "StableLmForCausalLM",
+                 dict(TINY, num_key_value_heads=2, use_qkv_bias=True,
+                      tie_word_embeddings=False, hidden_dropout=0.0,
+                      attention_dropout=0.0)),
     # ALiBi with weight-only norms, zero biases, plain-thirds fused Wqkv
     "mpt": ("MptConfig", "MptForCausalLM",
             dict(vocab_size=512, d_model=64, n_layers=2, n_heads=4,

@@ -332,3 +332,25 @@ def test_gemma2_stage_chain_alternating_window_matches_monolith():
         x, _ = stages.stage_forward(sp, cfg, spec, x, None, jnp.int32(0))
     np.testing.assert_allclose(np.asarray(x), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("family", ["tiny-bloom", "tiny-mpt"])
+def test_alibi_stage_chain_matches_monolith(family):
+    """ALiBi families split across stages: the embedding LayerNorm
+    (bloom) must ride the FIRST stage and the per-head score bias must
+    agree layer-for-layer with the monolith."""
+    cfg = get_config(family)
+    params = core.init_params(cfg, jax.random.key(10), dtype=jnp.float32)
+    ids = jnp.asarray(
+        np.random.default_rng(3).integers(3, cfg.vocab_size, (1, 8)),
+        jnp.int32,
+    )
+    want, _ = core.forward(params, cfg, ids, None, jnp.int32(0))
+
+    x = ids
+    for s in range(2):
+        spec = stages.StageSpec.build(cfg, 2, s)
+        sp = stages.extract_stage_params(params, cfg, spec)
+        x, _ = stages.stage_forward(sp, cfg, spec, x, None, jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(x), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
