@@ -11,7 +11,7 @@ Heavy submodules (engine, models, mesh runtime) are imported lazily so that
 `import bee2bee_tpu` stays cheap for CLI/metadata use.
 """
 
-__version__ = "0.4.0"
+__version__ = "0.5.0"
 
 _LAZY = {
     "P2PNode": ("bee2bee_tpu.meshnet.node", "P2PNode"),
