@@ -57,10 +57,15 @@ class ModelConfig:
     # the last `sliding_window` positions. None = full causal. Supported
     # by the dense attention path (engine validates flash/sp against it).
     sliding_window: int | None = None
-    # with sliding_window set: layers where layer_idx % N == 0 window,
-    # the rest attend fully. 1 = every layer (mistral); 2 = gemma-2's
-    # alternating local/global pattern
+    # with sliding_window set: layers whose layer_idx % sliding_window_every
+    # falls in sliding_window_residues window, the rest attend fully.
+    # 1 = every layer (mistral); every=2/residues=(0,) = gemma-2's
+    # alternation; every=6/residues=(0,1,2,3,4) = gemma-3's 5-local-1-global
     sliding_window_every: int = 1
+    sliding_window_residues: tuple = (0,)
+    # gemma-3: SLIDING layers rotate with this theta and NO rope_scaling;
+    # global layers use rope_theta + rope_scaling. None = one rope for all
+    local_rope_theta: float | None = None
     # gemma-2 attention extras
     attn_logit_softcap: float | None = None  # tanh cap on attention scores
     attn_scale: float | None = None  # score denominator becomes
@@ -89,6 +94,9 @@ class ModelConfig:
     embedding_norm: bool = False
 
     def __post_init__(self):
+        if self.sliding_window_residues != (0,):
+            object.__setattr__(self, "sliding_window_residues",
+                               tuple(self.sliding_window_residues))
         if self.rope_scaling is not None:
             # normalize a json list back to the hashable tuple form (the
             # native-checkpoint model_config.json round-trip)
@@ -198,6 +206,18 @@ CONFIGS: dict[str, ModelConfig] = {
         logits_softcap=30.0, attn_scale=32.0, sliding_window=4,
         sliding_window_every=2,
     ),
+    "tiny-gemma3": ModelConfig(  # gemma-3: gemma-2 post-norms + (1+w)
+        # per-head qk-norm + DUAL rope (local 10k on sliding layers,
+        # global theta + linear scaling on the rest) + 2-local-1-global
+        # pattern (period 3 keeps a 3-layer tiny model exercising both)
+        name="tiny-gemma3", vocab_size=512, d_model=64, n_layers=3,
+        n_heads=4, n_kv_heads=2, d_ff=128, max_seq_len=256,
+        activation="geglu", embedding_scale=True, norm_plus_one=True,
+        norm_eps=1e-6, post_norms=True, qk_norm=True, attn_scale=32.0,
+        rope_theta=1000000.0, local_rope_theta=10000.0,
+        rope_scaling=("linear", 8.0), sliding_window=4,
+        sliding_window_every=3, sliding_window_residues=(0, 1),
+    ),
     "tiny-qwen": ModelConfig(  # qwen2 style: llama arch + q/k/v-only bias
         name="tiny-qwen", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
         n_kv_heads=2, d_ff=128, max_seq_len=256, qkv_bias=True,
@@ -271,6 +291,19 @@ CONFIGS: dict[str, ModelConfig] = {
         norm_eps=1e-6, head_dim_override=256, post_norms=True,
         attn_logit_softcap=50.0, logits_softcap=30.0, attn_scale=256.0,
         sliding_window=4096, sliding_window_every=2,
+    ),
+    "gemma-3-4b": ModelConfig(
+        # google/gemma-3-4b (text config): 8 256-dim heads over d_model
+        # 2304, 5-local-1-global 1024-token windows, dual rope (local 10k;
+        # global 1M with linear-8 scaling), 128k context
+        name="gemma-3-4b", vocab_size=262208, d_model=2304, n_layers=34,
+        n_heads=8, n_kv_heads=4, d_ff=9216, max_seq_len=131072,
+        activation="geglu", embedding_scale=True, norm_plus_one=True,
+        norm_eps=1e-6, head_dim_override=256, post_norms=True,
+        qk_norm=True, attn_scale=256.0, rope_theta=1000000.0,
+        local_rope_theta=10000.0, rope_scaling=("linear", 8.0),
+        sliding_window=1024, sliding_window_every=6,
+        sliding_window_residues=(0, 1, 2, 3, 4),
     ),
     "gemma-7b": ModelConfig(
         # attention width 4096 != d_model 3072: heads are 256-dim like
@@ -669,6 +702,66 @@ def config_from_hf(d: dict, name: str | None = None) -> ModelConfig:
             norm_eps=d.get("rms_norm_eps", 1e-5),
             tie_embeddings=d.get("tie_word_embeddings", False),
             sliding_window=d.get("sliding_window"),
+        )
+    if mt == "gemma3":
+        raise ValueError(
+            "gemma3 multimodal configs are not supported; extract the "
+            "text_config (model_type gemma3_text) or serve via the "
+            "ollama/remote backends"
+        )
+    if mt == "gemma3_text":
+        L = d["num_hidden_layers"]
+        types = d.get("layer_types")
+        if types:
+            sliding = {i for i, t in enumerate(types)
+                       if t == "sliding_attention"}
+            # recover a periodic (every, residues) description; gemma-3
+            # ships 5-local-1-global (period 6)
+            for p in range(1, min(len(types), 12) + 1):
+                residues = tuple(sorted({i % p for i in sliding}))
+                if all((i % p in residues) == (i in sliding)
+                       for i in range(len(types))):
+                    every, res = p, residues
+                    break
+            else:
+                raise ValueError(
+                    "gemma3 layer_types pattern is not periodic; cannot "
+                    "represent it"
+                )
+        else:
+            every, res = 6, (0, 1, 2, 3, 4)
+        window = d.get("sliding_window", 4096)
+        if not res:
+            # no sliding layers at all (e.g. a long-context fine-tune):
+            # every-1 + the window set would make make_layer_mask window
+            # EVERY layer — disable the window instead
+            window, every, res = None, 1, ()
+        return ModelConfig(
+            name=nm, vocab_size=d["vocab_size"], d_model=d["hidden_size"],
+            n_layers=L, n_heads=d["num_attention_heads"],
+            n_kv_heads=d.get("num_key_value_heads")
+            or d["num_attention_heads"],
+            d_ff=d["intermediate_size"],
+            max_seq_len=d.get("max_position_embeddings", 131072),
+            activation="geglu", embedding_scale=True, norm_plus_one=True,
+            post_norms=True, qk_norm=True,
+            attn_scale=d.get("query_pre_attn_scalar", 256),
+            attn_logit_softcap=d.get("attn_logit_softcapping"),
+            logits_softcap=d.get("final_logit_softcapping"),
+            rope_theta=d.get("rope_theta", 1000000.0),
+            local_rope_theta=d.get("rope_local_base_freq", 10000.0),
+            rope_scaling=_parse_rope_scaling(d),
+            norm_eps=d.get("rms_norm_eps", 1e-6),
+            tie_embeddings=d.get("tie_word_embeddings", True),
+            # every/residues stay decoupled from the window: even with the
+            # window disabled they still drive the local/global ROPE split
+            sliding_window=window,
+            sliding_window_every=every,
+            sliding_window_residues=res,
+            **({"head_dim_override": hd} if (
+                hd := d.get("head_dim", 256)
+            ) and hd != d["hidden_size"] // d["num_attention_heads"]
+               else {}),
         )
     if mt in ("llama", "mistral", "qwen2", "qwen3", "gemma", "gemma2",
               "mixtral"):

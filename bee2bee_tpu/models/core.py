@@ -428,7 +428,8 @@ def embed_tokens(params: Params, cfg: ModelConfig, input_ids, positions):
 
 
 def transformer_block(
-    lp: Params, cfg: ModelConfig, x, positions, mask, kv_hook=None, attn_fn=None
+    lp: Params, cfg: ModelConfig, x, positions, mask, kv_hook=None,
+    attn_fn=None, rope_local=None,
 ):
     """One block. lp: a single layer's params (no leading L dim). x [B,T,D].
 
@@ -460,10 +461,23 @@ def transformer_block(
         q = _qk_rmsnorm(q, lp["attn"]["q_norm"], cfg.norm_eps)
         k = _qk_rmsnorm(k, lp["attn"]["k_norm"], cfg.norm_eps)
     if cfg.pos_embedding == "rope":
-        q = _rope(q, positions, cfg.rope_theta, cfg.rotary_dim,
-                  cfg.rope_style, cfg.rope_scaling)
-        k = _rope(k, positions, cfg.rope_theta, cfg.rotary_dim,
-                  cfg.rope_style, cfg.rope_scaling)
+        if cfg.local_rope_theta is not None and rope_local is not None:
+            # gemma-3: SLIDING layers rotate with the local theta and no
+            # scaling; global layers use rope_theta + rope_scaling.
+            # rope_local is the (traced) is-sliding flag for this layer
+            def rot2(v):
+                g_ = _rope(v, positions, cfg.rope_theta, cfg.rotary_dim,
+                           cfg.rope_style, cfg.rope_scaling)
+                l_ = _rope(v, positions, cfg.local_rope_theta,
+                           cfg.rotary_dim, cfg.rope_style, None)
+                return jnp.where(rope_local, l_, g_)
+
+            q, k = rot2(q), rot2(k)
+        else:
+            q = _rope(q, positions, cfg.rope_theta, cfg.rotary_dim,
+                      cfg.rope_style, cfg.rope_scaling)
+            k = _rope(k, positions, cfg.rope_theta, cfg.rotary_dim,
+                      cfg.rope_style, cfg.rope_scaling)
     if kv_hook is not None:
         k, v = kv_hook(k, v)
     if attn_fn is None:
@@ -537,20 +551,25 @@ def attn_mask(cfg: ModelConfig, positions, T: int, S: int | None = None,
     return causal[None, None, :, :]
 
 
+def is_sliding_layer(cfg: ModelConfig, global_idx):
+    """Traced bool: does the layer at GLOBAL index window? THE one
+    implementation of the local/global layer pattern (gemma-2: residue 0
+    mod 2; gemma-3: residues 0..4 mod 6)."""
+    res = jnp.asarray(cfg.sliding_window_residues, jnp.int32)
+    return jnp.any(res == (global_idx % cfg.sliding_window_every))
+
+
 def make_layer_mask(cfg: ModelConfig, positions, T: int, S: int | None = None,
                     start: int = 0):
-    """Per-layer mask selector — THE one implementation of the gemma-2
+    """Per-layer mask selector — THE one implementation of the gemma-2/3
     local/global alternation, shared by core.forward (start=0) and
-    stages.stage_forward (start=spec.start): layers where the GLOBAL
-    index % sliding_window_every == 0 window, the rest attend fully.
-    Non-alternating configs get the single attn_mask back for every
-    layer."""
+    stages.stage_forward (start=spec.start). Non-alternating configs get
+    the single attn_mask back for every layer."""
     mask = attn_mask(cfg, positions, T, S)
     if not (cfg.sliding_window and cfg.sliding_window_every > 1):
         return lambda idx: mask
     mask_full = attn_mask(cfg, positions, T, S, window=None)
-    every = cfg.sliding_window_every
-    return lambda idx: jnp.where(((start + idx) % every) == 0,
+    return lambda idx: jnp.where(is_sliding_layer(cfg, start + idx),
                                  mask, mask_full)
 
 
@@ -581,6 +600,11 @@ def forward(
     S = cache["k"].shape[2] if cache is not None else None
     layer_mask = make_layer_mask(cfg, positions, T, S)
 
+    def rope_flag(layer_idx):
+        if cfg.local_rope_theta is None:
+            return None
+        return is_sliding_layer(cfg, layer_idx)
+
     def layer(carry, xs):
         x, cache_k, cache_v = carry
         lp, layer_idx = xs
@@ -588,7 +612,8 @@ def forward(
         if cache_k is None:  # training/scoring path: plain block
             return (
                 transformer_block(lp, cfg, x, positions,
-                                  layer_mask(layer_idx), attn_fn=attn_fn),
+                                  layer_mask(layer_idx), attn_fn=attn_fn,
+                                  rope_local=rope_flag(layer_idx)),
                 None,
                 None,
             ), None
@@ -611,7 +636,8 @@ def forward(
 
         x = transformer_block(
             lp, cfg, x, positions, layer_mask(layer_idx),
-            kv_hook=kv_hook, attn_fn=attn_fn
+            kv_hook=kv_hook, attn_fn=attn_fn,
+            rope_local=rope_flag(layer_idx)
         )
         return (x, cache_k, cache_v), None
 

@@ -183,9 +183,10 @@ def _export_llama_state(params, cfg: ModelConfig, dtype) -> dict[str, np.ndarray
         if "bq" in a:  # qwen2: q/k/v-only bias
             for ours, hf in (("bq", "q_proj"), ("bk", "k_proj"), ("bv", "v_proj")):
                 state[p + f"self_attn.{hf}.bias"] = _np(a[ours][i], dtype)
-        if "q_norm" in a:  # qwen3: per-head q/k RMSNorm scales
-            state[p + "self_attn.q_norm.weight"] = _np(a["q_norm"][i], dtype)
-            state[p + "self_attn.k_norm.weight"] = _np(a["k_norm"][i], dtype)
+        if "q_norm" in a:  # qwen3/gemma3: per-head q/k RMSNorm scales
+            # (gemma-3 stores them zero-centered — undo the (1+w) fold)
+            state[p + "self_attn.q_norm.weight"] = norm(a["q_norm"][i])
+            state[p + "self_attn.k_norm.weight"] = norm(a["k_norm"][i])
         if cfg.is_moe:
             moe = layers["moe"]
             state[p + "block_sparse_moe.gate.weight"] = t(moe["router"][i])
@@ -705,8 +706,41 @@ def hf_config_dict(cfg: ModelConfig, qkv_bias: bool | None = None,
     if cfg.norm_plus_one:  # gemma family
         act = ("gelu_pytorch_tanh" if cfg.activation == "geglu"
                else cfg.activation)
+        has_qk_norm = cfg.qk_norm if qk_norm is None else qk_norm
+        if cfg.post_norms and has_qk_norm:  # gemma-3 (text) — keyed on
+            # the ACTUAL params like the qwen3 branch, so config.json and
+            # the state dict can never describe different families
+            if cfg.sliding_window is None or cfg.local_rope_theta is None:
+                raise ValueError(
+                    "gemma3 export requires sliding_window and "
+                    "local_rope_theta (Gemma3TextConfig hardcodes the "
+                    "dual-rope local/global structure)"
+                )
+            out = {
+                "model_type": "gemma3_text",
+                "architectures": ["Gemma3ForCausalLM"],
+                "hidden_activation": act,
+                "query_pre_attn_scalar": cfg.attn_scale or cfg.head_dim,
+                "rope_local_base_freq": cfg.local_rope_theta,
+                # explicit per-layer types: the periodic pattern written
+                # out the way transformers stores it
+                "layer_types": [
+                    ("sliding_attention"
+                     if (i % cfg.sliding_window_every)
+                     in cfg.sliding_window_residues
+                     else "full_attention")
+                    for i in range(cfg.n_layers)
+                ],
+                **base,
+            }
+            if cfg.attn_logit_softcap:
+                out["attn_logit_softcapping"] = cfg.attn_logit_softcap
+            if cfg.logits_softcap:
+                out["final_logit_softcapping"] = cfg.logits_softcap
+            return out
         if cfg.post_norms:  # gemma-2
-            if cfg.sliding_window is None or cfg.sliding_window_every != 2:
+            if (cfg.sliding_window is None or cfg.sliding_window_every != 2
+                    or cfg.sliding_window_residues != (0,)):
                 # HF Gemma2 HARDCODES the every-2nd-layer alternation and
                 # defaults an omitted sliding_window to 4096 — any other
                 # windowing would load in transformers and silently

@@ -525,10 +525,13 @@ def _convert_llama(state, cfg: ModelConfig) -> dict:
             layers["attn"][ours] = _stack(
                 [g(f"layers.{i}.self_attn.{theirs}.bias") for i in range(L)]
             )
-    if pre + "layers.0.self_attn.q_norm.weight" in state:  # qwen3 qk-norm
+    if pre + "layers.0.self_attn.q_norm.weight" in state:  # qwen3/gemma3
+        # gemma-3's qk norms are zero-centered like its other norms —
+        # fold the +1 here too (qwen3: norm_off is 0)
         for ours, theirs in (("q_norm", "q_norm"), ("k_norm", "k_norm")):
             layers["attn"][ours] = _stack(
-                [g(f"layers.{i}.self_attn.{theirs}.weight") for i in range(L)]
+                [raw(f"layers.{i}.self_attn.{theirs}.weight") + norm_off
+                 for i in range(L)]
             )
     if cfg.is_moe:
         E = cfg.n_experts

@@ -150,13 +150,19 @@ def stage_forward(
     # the split model must window exactly the layers the monolith windows
     layer_mask = core.make_layer_mask(cfg, positions, T, S, start=spec.start)
 
+    def rope_flag(idx):
+        if cfg.local_rope_theta is None:
+            return None
+        return core.is_sliding_layer(cfg, spec.start + idx)
+
     def layer(carry, xs):
         h, ck, cv = carry
         lp, idx = xs
         if ck is None:
             return (
                 core.transformer_block(lp, cfg, h, positions,
-                                       layer_mask(idx)),
+                                       layer_mask(idx),
+                                       rope_local=rope_flag(idx)),
                 None,
                 None,
             ), None
@@ -182,7 +188,8 @@ def stage_forward(
             return wk, wv
 
         h = core.transformer_block(lp, cfg, h, positions, layer_mask(idx),
-                                   kv_hook=kv_hook)
+                                   kv_hook=kv_hook,
+                                   rope_local=rope_flag(idx))
         return (h, ck, cv), None
 
     n_local = spec.end - spec.start

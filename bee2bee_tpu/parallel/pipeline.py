@@ -95,6 +95,14 @@ def pipeline_apply(staged_params, cfg: ModelConfig, mesh: Mesh, x_mbs):
             f"{cfg.sliding_window} binds at T={T} — train at <= window "
             "length or use the dense trainer"
         )
+    if cfg.local_rope_theta is not None:
+        # the trunk calls transformer_block without the per-layer rope
+        # flag — gemma-3's sliding layers would silently rotate with the
+        # GLOBAL theta/scaling
+        raise ValueError(
+            "pipeline trunk does not implement per-layer dual rope "
+            f"(local_rope_theta, {cfg.name!r}); use the dense trainer"
+        )
 
     in_specs = (
         jax.tree.map(lambda _: P(PIPE_AXIS), staged_params),

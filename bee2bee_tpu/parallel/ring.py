@@ -185,6 +185,13 @@ def make_sp_forward(cfg: ModelConfig, mesh: Mesh, remat: bool = False):
                 f"{cfg.sliding_window} (seq len {ids.shape[1]} exceeds it); "
                 "train/score at <= window length or use the dense path"
             )
+        if cfg.local_rope_theta is not None:
+            # the ring trunk calls transformer_block without the per-layer
+            # rope flag — sliding layers would rotate with the global theta
+            raise ValueError(
+                "ring-SP does not implement per-layer dual rope "
+                f"(local_rope_theta, {cfg.name!r}); use the dense path"
+            )
         return mapped(params, ids)
 
     return sp_forward

@@ -19,7 +19,7 @@ from bee2bee_tpu.models.export import export_hf, hf_config_dict
     ["tiny-gpt2", "tiny-llama", "tiny-mistral", "tiny-mixtral", "tiny-gemma",
      "tiny-qwen", "tiny-phi", "tiny-neox", "tiny-gptj", "tiny-falcon",
      "tiny-bigcode", "tiny-bloom", "tiny-qwen3", "tiny-gemma2",
-     "tiny-mpt", "tiny-stablelm"],
+     "tiny-mpt", "tiny-stablelm", "tiny-gemma3"],
 )
 def test_config_from_hf_inverts_hf_config_dict(name):
     """For every supported family: our exported config.json must
@@ -216,3 +216,30 @@ def test_llama_branch_export_refuses_partial_rotary():
     cfg = dataclasses.replace(get_config("tiny-llama"), rotary_pct=0.5)
     with pytest.raises(ValueError, match="rotary"):
         hf_config_dict(cfg)
+
+
+def test_gemma3_degenerate_layer_types():
+    """All-full layer_types (a long-context fine-tune) must disable the
+    window entirely — NOT window every layer; and the residues keep
+    driving the rope split even with the window off."""
+    base = {"model_type": "gemma3_text", "vocab_size": 512,
+            "hidden_size": 64, "num_hidden_layers": 4,
+            "num_attention_heads": 4, "num_key_value_heads": 2,
+            "head_dim": 16, "intermediate_size": 128}
+    cfg = config_from_hf({**base, "layer_types": ["full_attention"] * 4})
+    assert cfg.sliding_window is None
+    assert cfg.sliding_window_residues == ()
+
+    # mixed types with the window explicitly disabled: masks are full
+    # everywhere but sliding layers still rotate with the LOCAL theta
+    cfg2 = config_from_hf({
+        **base, "sliding_window": None,
+        "layer_types": ["sliding_attention", "full_attention"] * 2,
+    })
+    assert cfg2.sliding_window is None
+    assert cfg2.sliding_window_every == 2
+    assert cfg2.sliding_window_residues == (0,)
+    import jax.numpy as _jnp
+
+    from bee2bee_tpu.models.core import is_sliding_layer
+    assert bool(is_sliding_layer(cfg2, 0)) and not bool(is_sliding_layer(cfg2, 1))

@@ -54,7 +54,7 @@ def test_write_safetensors_roundtrip_dtypes(tmp_path):
     ["tiny-gpt2", "tiny-llama", "tiny-mistral", "tiny-mixtral", "tiny-gemma",
      "tiny-qwen", "tiny-phi", "tiny-neox", "tiny-gptj", "tiny-falcon",
      "tiny-bigcode", "tiny-bloom", "tiny-qwen3", "tiny-gemma2",
-     "tiny-mpt", "tiny-stablelm"],
+     "tiny-mpt", "tiny-stablelm", "tiny-gemma3"],
 )
 def test_export_hf_roundtrips_through_loader(tmp_path, name):
     """export_hf must be the exact inverse of the loader's HF conversion
@@ -572,3 +572,13 @@ def test_torch_loads_stablelm_export_and_logits_match(tmp_path):
     StableLmForCausalLM."""
     _torch_conformance("tiny-stablelm", tmp_path, "StableLmForCausalLM",
                        seed=91)
+
+
+def test_torch_loads_gemma3_export_and_logits_match(tmp_path):
+    """gemma-3 family conformance: gemma-2's post-norms plus (1+w)
+    per-head qk-norms, DUAL rope (local theta on sliding layers, global
+    theta + linear scaling on full layers), and an explicit layer_types
+    pattern against Gemma3ForCausalLM — period 3 over 3 layers so both
+    layer types run."""
+    _torch_conformance("tiny-gemma3", tmp_path, "Gemma3ForCausalLM",
+                       seed=101)

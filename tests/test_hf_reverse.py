@@ -51,6 +51,17 @@ CASES = {
                     sliding_window=4, query_pre_attn_scalar=32,
                     attn_logit_softcapping=50.0,
                     final_logit_softcapping=30.0, attention_dropout=0.0)),
+    # gemma-3: dual rope (local 10k / global 1M + linear-8 scaling),
+    # (1+w) qk-norms, 6 layers so the default 5-local-1-global pattern
+    # exercises BOTH layer types
+    "gemma3": ("Gemma3TextConfig", "Gemma3ForCausalLM",
+               dict(vocab_size=512, hidden_size=64, num_hidden_layers=6,
+                    num_attention_heads=4, num_key_value_heads=2,
+                    head_dim=16, intermediate_size=128,
+                    max_position_embeddings=64, sliding_window=4,
+                    query_pre_attn_scalar=32,
+                    rope_scaling={"rope_type": "linear", "factor": 8.0},
+                    attention_dropout=0.0)),
     "mixtral": ("MixtralConfig", "MixtralForCausalLM",
                 dict(TINY, num_key_value_heads=2, num_local_experts=4,
                      num_experts_per_tok=2, tie_word_embeddings=False)),

@@ -312,6 +312,27 @@ def test_stage_chain_phi_carries_lm_head_bias():
     np.testing.assert_allclose(np.asarray(x), np.asarray(want), rtol=2e-5, atol=2e-5)
 
 
+def test_gemma3_stage_chain_dual_rope_matches_monolith():
+    """gemma-3 split across stages: BOTH the alternating mask AND the
+    per-layer rope theta must select by GLOBAL index — a stage that
+    restarted the pattern at its local index would rotate its layers
+    with the wrong frequencies."""
+    cfg = get_config("tiny-gemma3")
+    params = core.init_params(cfg, jax.random.key(11), dtype=jnp.float32)
+    ids = jnp.asarray(
+        np.random.default_rng(4).integers(3, cfg.vocab_size, (1, 8)),
+        jnp.int32,
+    )
+    want, _ = core.forward(params, cfg, ids, None, jnp.int32(0))
+    x = ids
+    for s in range(2):
+        spec = stages.StageSpec.build(cfg, 2, s)
+        sp = stages.extract_stage_params(params, cfg, spec)
+        x, _ = stages.stage_forward(sp, cfg, spec, x, None, jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(x), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_gemma2_stage_chain_alternating_window_matches_monolith():
     """Split a gemma-2-style model (alternating local/global layers)
     across 2 stages: each stage must window by GLOBAL layer index

@@ -6,6 +6,7 @@ never verified."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from bee2bee_tpu.models import core, get_config
 from bee2bee_tpu.parallel import MeshSpec, build_mesh
@@ -90,3 +91,21 @@ def test_moe_training_on_expert_mesh():
     for _ in range(5):
         last = tr.train_step(batch)
     assert last["loss"] < first
+
+
+@pytest.mark.parametrize("family", ["tiny-bloom", "tiny-gemma2", "tiny-qwen3",
+                                    "tiny-mpt", "tiny-stablelm",
+                                    "tiny-gemma3"])
+def test_new_architecture_classes_train(family):
+    """Gradients flow through every round-5 architecture switch — ALiBi
+    score bias + embedding norm (bloom/mpt), post-norms + tanh softcaps +
+    alternating windows (gemma-2), per-head qk-norm (qwen3), biased LNs
+    with partial rotary (stablelm) — and loss decreases."""
+    cfg = get_config(family)
+    tr = Trainer(cfg, TrainConfig(learning_rate=1e-2))
+    batch = _batch(cfg, B=2, T=16)
+    first = tr.train_step(batch)["loss"]
+    for _ in range(8):
+        last = tr.train_step(batch)
+    assert np.isfinite(last["loss"])
+    assert last["loss"] < first, family
