@@ -209,3 +209,25 @@ def test_flash_rejects_replicated_gqa():
     with pytest.raises(ValueError, match="flash"):
         validate_flash_mesh(gqa, mesh)
     validate_flash_mesh(get_config("tiny-gemma"), mesh)  # MQA: fine
+
+
+@pytest.mark.parametrize("family", ["tiny-gemma3", "tiny-gemma2",
+                                    "tiny-qwen3", "tiny-bloom"])
+def test_new_families_sharded_forward_matches_single_device(family):
+    """Round-5 architecture switches under TP sharding: per-layer mask/
+    rope selection (jnp.where over sharded logits), softcaps, qk-norms,
+    and the ALiBi constant must all partition cleanly and match the
+    single-device forward."""
+    cfg = get_config(family)
+    mesh = build_mesh(MeshSpec(model=2))
+    params = core.init_params(cfg, jax.random.key(5), dtype=jnp.float32)
+    ids = jnp.asarray(
+        np.random.default_rng(5).integers(3, cfg.vocab_size, (2, 8)),
+        jnp.int32,
+    )
+    ref_logits, _ = core.forward(params, cfg, ids, None, 0)
+    sharded = partition.shard_params(params, mesh, cfg=cfg)
+    fwd = jax.jit(lambda p, x: core.forward(p, cfg, x, None, 0)[0])
+    got = fwd(sharded, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref_logits),
+                               rtol=2e-4, atol=2e-4)

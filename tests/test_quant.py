@@ -215,3 +215,44 @@ def test_quantized_mqa_replication():
     r = eng.generate([5, 17, 99], max_new_tokens=4, temperature=0.0)
     eng.close()
     assert r.new_tokens == 4
+
+
+@pytest.mark.parametrize("family", ["tiny-gemma3", "tiny-bloom"])
+def test_int8_serving_new_architecture_classes(family):
+    """int8 weight-only quant through the round-5 trees: the allowlist
+    must leave qk-norms / post-norms / embed-norm / alibi constants
+    untouched — the quantized engine's greedy rollout must MATCH the
+    rollout over the dequantized weights (catches NaN logits and any
+    corrupted excluded leaf)."""
+    cfg = get_config(family)
+    params = core.init_params(cfg, jax.random.key(3), dtype=jnp.float32)
+    eng = InferenceEngine(
+        family, params=jax.tree.map(lambda a: a, params),
+        engine_config=EngineConfig(**KW, prefill_buckets=(16,),
+                                   quantize="int8"),
+    )
+    try:
+        r = eng.generate([1, 7, 42, 99], max_new_tokens=5, temperature=0.0)
+        assert r.new_tokens == 5
+    finally:
+        eng.close()
+    # reference rollout over the DEQUANTIZED weights — exact same math
+    deq = jax.tree.map(lambda a: a, quantize_params(jax.device_get(params)))
+
+    def undo(node):
+        if isinstance(node, dict) and "q" in node and "s" in node:
+            return jnp.asarray(dequantize_weight(node), jnp.float32)
+        if isinstance(node, dict):
+            return {k: undo(v) for k, v in node.items()}
+        return jnp.asarray(node, jnp.float32)
+
+    deq = undo(deq)
+    ids, want = [1, 7, 42, 99], []
+    for _ in range(5):
+        logits, _ = core.forward(deq, cfg, jnp.asarray([ids], jnp.int32),
+                                 None, jnp.int32(0))
+        assert bool(jnp.all(jnp.isfinite(logits)))
+        t = int(jnp.argmax(logits[0, -1]))
+        ids.append(t)
+        want.append(t)
+    assert r.token_ids == want
