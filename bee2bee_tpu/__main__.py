@@ -190,7 +190,9 @@ def serve_hf_remote(model, **kw):
 
 
 @cli.command("serve-stage")
-@click.option("--model", required=True, help="model name or config key")
+@click.option("--model", required=True,
+              help="model name or config key; 'auto' derives the "
+                   "architecture from --checkpoint's config.json")
 @click.option("--n-stages", type=int, default=None,
               help="preload this stage now (otherwise wait for part_load)")
 @click.option("--stage", type=int, default=0, help="0-based stage index")
@@ -313,6 +315,7 @@ def serve_pipeline(model, stage_peers, checkpoint, max_seq_len,
                 price_per_token=cfg.price_per_token,
                 max_new_tokens=cfg.max_new_tokens,
                 max_batch=max_batch, n_microbatches=microbatches,
+                checkpoint_path=checkpoint,
             )
             await node.announce_service(svc)
             click.echo(f"pipeline model {model} serving; join link: {node.join_link()}")

@@ -136,23 +136,11 @@ class InferenceEngine:
         checkpoint_path: str | None = None,
         lora_path: str | None = None,
     ):
-        if isinstance(model, model_config.ModelConfig):
-            self.model_cfg = model
-        else:
-            try:
-                self.model_cfg = model_config.get_config(model or "auto")
-            except KeyError:
-                # unregistered architecture: the checkpoint's own config.json
-                # (or a native save's model_config.json) is the authority —
-                # the any-model capability the reference gets from AutoModel
-                # (reference services.py:39-52). `--model auto` lands here
-                # deliberately.
-                if not checkpoint_path:
-                    raise
-                self.model_cfg = model_config.config_for_checkpoint(
-                    checkpoint_path,
-                    name=None if model in (None, "", "auto") else model,
-                )
+        # registry name, 'auto' sentinel, or checkpoint-config fallback —
+        # one shared rule (models/config.resolve_model_config; the
+        # reference's AutoModel any-checkpoint capability,
+        # reference services.py:39-52)
+        self.model_cfg = model_config.resolve_model_config(model, checkpoint_path)
         self.engine_cfg = engine_config or EngineConfig()
         # default to the degenerate 1-device mesh; multi-chip serving passes
         # an explicit mesh (the model must divide its axes — validated below)

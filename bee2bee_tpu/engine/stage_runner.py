@@ -48,11 +48,13 @@ class StageRunner:
         quantize: str = "none",  # "int8": weight-only quant of THIS stage's
         # slice — a 7B half per peer is exactly where halved weight HBM pays
     ):
-        self.model_cfg = (
-            model
-            if isinstance(model, model_config.ModelConfig)
-            else model_config.get_config(model)
-        )
+        # same any-checkpoint rule as the engine
+        # (`serve-stage --model auto --checkpoint <dir>`)
+        self.model_cfg = model_config.resolve_model_config(model, checkpoint_path)
+        # the mesh addresses runners by the COORDINATOR'S model string —
+        # remember what the caller asked for so add_stage_runner can alias
+        # it to the resolved config name
+        self.requested_model = model if isinstance(model, str) else self.model_cfg.name
         self.spec = stages.StageSpec.build(self.model_cfg, n_stages, stage)
         self.dtype = jnp.dtype(dtype)
         self.max_seq_len = min(max_seq_len, self.model_cfg.max_seq_len)

@@ -45,8 +45,14 @@ class StageTaskMixin:
     into its dispatch table)."""
 
     def add_stage_runner(self, runner) -> None:
-        """Host a pipeline stage (StageRunner) on this node."""
+        """Host a pipeline stage (StageRunner) on this node. The mesh
+        addresses runners by the COORDINATOR'S model string, which under
+        `--model auto` differs from the resolved config name — register
+        both so part_forward/decode_run find the runner either way."""
         self.stage_runners[runner.model_cfg.name] = runner
+        requested = getattr(runner, "requested_model", None)
+        if requested and requested != runner.model_cfg.name:
+            self.stage_runners[requested] = runner
 
     async def _peer_ws(self, peer_id: str | None, what: str):
         """Resolve a peer's live ws or raise — the relay/ring handlers'

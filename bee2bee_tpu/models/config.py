@@ -868,6 +868,26 @@ def config_for_checkpoint(path: str | Path, name: str | None = None) -> ModelCon
     )
 
 
+def resolve_model_config(model, checkpoint_path: str | None = None) -> ModelConfig:
+    """THE model-resolution rule shared by the engine and the pipeline
+    stage runner: a ModelConfig passes through; a registry name resolves
+    via get_config; an unknown name (or the 'auto' sentinel) with a
+    checkpoint falls back to the checkpoint's own config
+    (config_for_checkpoint) — the reference's AutoModel any-checkpoint
+    capability."""
+    if isinstance(model, ModelConfig):
+        return model
+    try:
+        return get_config(model or "auto")
+    except KeyError:
+        if not checkpoint_path:
+            raise
+        return config_for_checkpoint(
+            checkpoint_path,
+            name=None if model in (None, "", "auto") else model,
+        )
+
+
 def get_config(name: str, **overrides) -> ModelConfig:
     """Resolve a model name to a config, with the reference's both-ways fuzzy
     match (`services.py:136-151`): exact key, else substring either way."""

@@ -49,6 +49,9 @@ class PipelineService(BaseService):
         # stages run on distinct hosts (parallel compute to unlock), 1 on
         # a shared host (meshnet.pipeline.resolve_microbatches)
         n_microbatches: int | str = "auto",
+        # lets `--model auto` resolve the tokenizer/vocab + advertised
+        # name from the checkpoint's own config
+        checkpoint_path: str | None = None,
     ):
         super().__init__("pipeline")
         self.coordinator = coordinator
@@ -60,11 +63,19 @@ class PipelineService(BaseService):
         )
         self.loop = loop
         self.model_name = model_name
-        if tokenizer is None:
+        if tokenizer is None or model_name in (None, "", "auto"):
+            # resolve via the same any-checkpoint rule as the workers so
+            # `serve-pipeline --model auto` gets the right vocab AND
+            # advertises the resolved name (the coordinator keeps sending
+            # the requested string; workers alias it — add_stage_runner)
             from ..engine.tokenizer import load_tokenizer
-            from ..models import get_config
+            from ..models.config import resolve_model_config
 
-            tokenizer = load_tokenizer(None, get_config(model_name).vocab_size)
+            cfg = resolve_model_config(model_name, checkpoint_path)
+            if tokenizer is None:
+                tokenizer = load_tokenizer(checkpoint_path, cfg.vocab_size)
+            if model_name in (None, "", "auto"):
+                self.model_name = cfg.name
         self.tokenizer = tokenizer
         self.price_per_token = price_per_token
         self.max_new_tokens = max_new_tokens

@@ -243,3 +243,78 @@ def test_gemma3_degenerate_layer_types():
 
     from bee2bee_tpu.models.core import is_sliding_layer
     assert bool(is_sliding_layer(cfg2, 0)) and not bool(is_sliding_layer(cfg2, 1))
+
+
+def test_stage_runner_serves_unregistered_checkpoint(tmp_path):
+    """serve-stage --model auto: a pipeline stage worker resolves an
+    unregistered architecture from the checkpoint's config.json, same as
+    the monolithic engine."""
+    import dataclasses
+
+    from bee2bee_tpu.engine.stage_runner import StageRunner
+
+    cfg = dataclasses.replace(
+        get_config("tiny-llama"), name="unregistered-split-llm", d_model=48,
+        n_heads=6, n_kv_heads=3, d_ff=80, vocab_size=384, max_seq_len=128,
+    )
+    params = core.init_params(cfg, jax.random.key(4), dtype=jnp.float32)
+    out = export_hf(params, cfg, tmp_path / "ckpt", dtype="float32")
+
+    r = StageRunner("auto", n_stages=2, stage=0, checkpoint_path=str(out),
+                    max_seq_len=64, dtype="float32")
+    assert r.model_cfg.d_model == 48
+    assert r.spec.start == 0 and r.spec.end == 1
+
+
+async def test_pipeline_auto_model_end_to_end(tmp_path):
+    """The full cross-peer `--model auto` flow: workers part_load an
+    unregistered checkpoint (aliasing the coordinator's 'auto' string to
+    the resolved name), the coordinator generates through the ring, and
+    the PipelineService advertises the resolved name with the
+    checkpoint's tokenizer/vocab."""
+    import asyncio
+    import dataclasses
+
+    from bee2bee_tpu.meshnet.node import P2PNode
+    from bee2bee_tpu.meshnet.pipeline import PipelineCoordinator
+    from bee2bee_tpu.services.pipeline import PipelineService
+
+    cfg = dataclasses.replace(
+        get_config("tiny-llama"), name="unregistered-pipe-llm", d_model=48,
+        n_heads=6, n_kv_heads=3, d_ff=80, vocab_size=384, max_seq_len=128,
+    )
+    params = core.init_params(cfg, jax.random.key(6), dtype=jnp.float32)
+    ckpt = export_hf(params, cfg, tmp_path / "ckpt", dtype="float32")
+
+    workers = [P2PNode(host="127.0.0.1", port=0, node_id=f"astage{i}")
+               for i in range(2)]
+    coord = P2PNode(host="127.0.0.1", port=0, node_id="acoord")
+    nodes = [*workers, coord]
+    for n in nodes:
+        await n.start()
+    try:
+        for w in workers:
+            await coord.connect_bootstrap(w.addr)
+        for _ in range(100):
+            if len(coord.peers) >= 2:
+                break
+            await asyncio.sleep(0.05)
+        coordinator = PipelineCoordinator(
+            coord, "auto", stage_peers=[w.peer_id for w in workers],
+            max_seq_len=64, dtype="float32",
+        )
+        await coordinator.load(checkpoint_path=str(ckpt), timeout=120.0)
+        out = await coordinator.generate([1, 7, 42], max_new_tokens=4,
+                                         temperature=0.0)
+        assert len(out) == 4
+
+        svc = PipelineService(
+            coordinator, asyncio.get_running_loop(), "auto",
+            checkpoint_path=str(ckpt),
+        )
+        assert svc.model_name == "llama-checkpoint"
+        assert svc.get_metadata()["models"] == ["llama-checkpoint"]
+        await svc.session.close()
+    finally:
+        for n in nodes:
+            await n.stop()
