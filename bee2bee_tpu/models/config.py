@@ -34,6 +34,8 @@ class ModelConfig:
     qkv_bias: bool = False  # bias on q/k/v ONLY (qwen2 style; no bo/mlp bias)
     qk_norm: bool = False  # per-head RMSNorm on q and k before rope
     # (qwen3 style; learned [head_dim] scales)
+    qk_norm_full: bool = False  # with qk_norm: normalize the WHOLE q/k
+    # projection width instead of per head (olmo2: [H*hd]/[Hkv*hd] scales)
     tie_embeddings: bool = True
     rope_theta: float = 10000.0
     # frequency-domain RoPE scaling, encoded as a hashable tuple:
@@ -72,6 +74,8 @@ class ModelConfig:
     # sqrt(attn_scale) instead of sqrt(head_dim) (query_pre_attn_scalar)
     post_norms: bool = False  # gemma-2: extra norms on the attn and mlp
     # OUTPUTS before they join the residual (4 norms per block)
+    no_pre_norms: bool = False  # olmo2: NO ln1/ln2 pre-norms — the
+    # post-output norms (post_norms must be set) are the only block norms
     parallel_block: bool = False  # x + attn(ln(x)) + mlp(ln'(x)) parallel
     # residual (phi/gpt-neox); sequential pre-norm blocks otherwise
     parallel_norms: int = 1  # parallel blocks only: 1 = attn and mlp share
@@ -109,6 +113,11 @@ class ModelConfig:
                     f"('linear', factor) or ('llama3', factor, low_freq, "
                     f"high_freq, original_max_pos)"
                 )
+        if self.no_pre_norms and not self.post_norms:
+            raise ValueError(
+                "no_pre_norms requires post_norms — the block would have "
+                "ZERO normalization otherwise (olmo2 sets both)"
+            )
         if self.pos_embedding not in ("rope", "learned", "alibi"):
             raise ValueError(
                 f"pos_embedding={self.pos_embedding!r} must be 'rope', "
@@ -418,6 +427,19 @@ CONFIGS["gpt-neox-20b"] = ModelConfig(
     tie_embeddings=False, rotary_pct=0.25, parallel_block=True,
     parallel_norms=2,
 )
+CONFIGS["tiny-olmo2"] = ModelConfig(
+    # olmo2 style: POST-norm-only blocks + full-width q/k RMSNorm
+    name="tiny-olmo2", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+    n_kv_heads=2, d_ff=128, max_seq_len=256, tie_embeddings=False,
+    post_norms=True, no_pre_norms=True, qk_norm=True, qk_norm_full=True,
+)
+CONFIGS["olmo2-7b"] = ModelConfig(
+    # allenai/OLMo-2-1124-7B: fully-open 7B, rope theta 5e5, 100k vocab
+    name="olmo2-7b", vocab_size=100352, d_model=4096, n_layers=32,
+    n_heads=32, n_kv_heads=32, d_ff=11008, max_seq_len=4096,
+    rope_theta=500000.0, norm_eps=1e-6, tie_embeddings=False,
+    post_norms=True, no_pre_norms=True, qk_norm=True, qk_norm_full=True,
+)
 CONFIGS["tiny-stablelm"] = ModelConfig(
     # stablelm-2 style: llama tensor layout with BIASED layernorms,
     # partial rotary 0.25, gated silu, untied head
@@ -659,6 +681,31 @@ def config_from_hf(d: dict, name: str | None = None) -> ModelConfig:
             rope_theta=d.get("rope_theta", 10000.0),
             rope_scaling=_parse_rope_scaling(d), parallel_block=True,
             lm_head_bias=True, norm_eps=d.get("layer_norm_eps", 1e-5),
+        )
+    if mt == "olmo2":
+        if d.get("attention_bias"):
+            # same refuse-don't-drop rule as the llama branch: the o_proj
+            # bias has no slot in our layout
+            raise ValueError(
+                "olmo2 checkpoints with attention_bias=true are not "
+                "supported by the native core; serve via the ollama/remote "
+                "backends"
+            )
+        H = d["num_attention_heads"]
+        return ModelConfig(
+            name=nm, vocab_size=d["vocab_size"], d_model=d["hidden_size"],
+            n_layers=d["num_hidden_layers"], n_heads=H,
+            n_kv_heads=d.get("num_key_value_heads") or H,
+            d_ff=d["intermediate_size"],
+            max_seq_len=d.get("max_position_embeddings", 2048),
+            rope_theta=d.get("rope_theta", 10000.0),
+            rope_scaling=_parse_rope_scaling(d),
+            norm_eps=d.get("rms_norm_eps", 1e-5),
+            tie_embeddings=d.get("tie_word_embeddings", False),
+            # olmo2 blocks norm only their OUTPUTS, and RMS-normalize the
+            # WHOLE q/k projection before the head reshape
+            post_norms=True, no_pre_norms=True,
+            qk_norm=True, qk_norm_full=True,
         )
     if mt == "stablelm":
         if d.get("use_parallel_residual"):

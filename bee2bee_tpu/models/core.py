@@ -78,7 +78,6 @@ def init_params(cfg: ModelConfig, key, dtype=jnp.bfloat16) -> Params:
             params["embed_norm"]["bias"] = jnp.zeros((D,), dtype)
 
     layers: Params = {
-        "ln1": {"scale": jnp.ones((L, D), dtype)},
         "attn": {
             "wq": dense((L, D, H * hd)),
             "wk": dense((L, D, Hkv * hd)),
@@ -86,27 +85,27 @@ def init_params(cfg: ModelConfig, key, dtype=jnp.bfloat16) -> Params:
             "wo": dense((L, H * hd, D), scale=1.0 / math.sqrt(H * hd)),
         },
     }
-    if not cfg.parallel_block or cfg.parallel_norms == 2:
-        # sequential blocks AND neox-style dual-norm parallel blocks have
-        # ln2; only phi's shared-norm parallel blocks drop it
-        layers["ln2"] = {"scale": jnp.ones((L, D), dtype)}
+    if not cfg.no_pre_norms:  # olmo2 blocks norm only their OUTPUTS
+        layers["ln1"] = {"scale": jnp.ones((L, D), dtype)}
+        if not cfg.parallel_block or cfg.parallel_norms == 2:
+            # sequential blocks AND neox-style dual-norm parallel blocks
+            # have ln2; only phi's shared-norm parallel blocks drop it
+            layers["ln2"] = {"scale": jnp.ones((L, D), dtype)}
     if cfg.post_norms:  # gemma-2: norms on the attn/mlp outputs too
         layers["ln1_post"] = {"scale": jnp.ones((L, D), dtype)}
         layers["ln2_post"] = {"scale": jnp.ones((L, D), dtype)}
     if cfg.norm == "layernorm" and cfg.norm_bias:
-        layers["ln1"]["bias"] = jnp.zeros((L, D), dtype)
-        if "ln2" in layers:
-            layers["ln2"]["bias"] = jnp.zeros((L, D), dtype)
-        for extra in ("ln1_post", "ln2_post"):
-            if extra in layers:
-                layers[extra]["bias"] = jnp.zeros((L, D), dtype)
+        for ln in ("ln1", "ln2", "ln1_post", "ln2_post"):
+            if ln in layers:
+                layers[ln]["bias"] = jnp.zeros((L, D), dtype)
     if cfg.use_bias or cfg.qkv_bias:
         layers["attn"]["bq"] = jnp.zeros((L, H * hd), dtype)
         layers["attn"]["bk"] = jnp.zeros((L, Hkv * hd), dtype)
         layers["attn"]["bv"] = jnp.zeros((L, Hkv * hd), dtype)
-    if cfg.qk_norm:  # qwen3: per-head q/k RMSNorm scales
-        layers["attn"]["q_norm"] = jnp.ones((L, hd), dtype)
-        layers["attn"]["k_norm"] = jnp.ones((L, hd), dtype)
+    if cfg.qk_norm:  # qwen3: per-head scales; olmo2: full-width scales
+        qn = (H * hd, Hkv * hd) if cfg.qk_norm_full else (hd, hd)
+        layers["attn"]["q_norm"] = jnp.ones((L, qn[0]), dtype)
+        layers["attn"]["k_norm"] = jnp.ones((L, qn[1]), dtype)
     if cfg.use_bias:  # qwen2 (qkv_bias) has NO output-projection bias
         layers["attn"]["bo"] = jnp.zeros((L, D), dtype)
 
@@ -446,7 +445,7 @@ def transformer_block(
     B, T, _ = x.shape
     H, Hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
-    h = _norm(x, lp["ln1"], cfg)
+    h = x if cfg.no_pre_norms else _norm(x, lp["ln1"], cfg)
     q = matmul(h, lp["attn"]["wq"])
     k = matmul(h, lp["attn"]["wk"])
     v = matmul(h, lp["attn"]["wv"])
@@ -454,10 +453,15 @@ def transformer_block(
         q = q + lp["attn"]["bq"]
         k = k + lp["attn"]["bk"]
         v = v + lp["attn"]["bv"]
+    if "q_norm" in lp["attn"] and cfg.qk_norm_full:
+        # olmo2: RMSNorm over the WHOLE projection width, before reshape
+        q = _qk_rmsnorm(q, lp["attn"]["q_norm"], cfg.norm_eps)
+        k = _qk_rmsnorm(k, lp["attn"]["k_norm"], cfg.norm_eps)
     q = q.reshape(B, T, H, hd)
     k = k.reshape(B, T, Hkv, hd)
     v = v.reshape(B, T, Hkv, hd)
-    if "q_norm" in lp["attn"]:  # qwen3: head-wise RMSNorm BEFORE rope
+    if "q_norm" in lp["attn"] and not cfg.qk_norm_full:
+        # qwen3/gemma3: head-wise RMSNorm BEFORE rope
         q = _qk_rmsnorm(q, lp["attn"]["q_norm"], cfg.norm_eps)
         k = _qk_rmsnorm(k, lp["attn"]["k_norm"], cfg.norm_eps)
     if cfg.pos_embedding == "rope":
@@ -493,11 +497,11 @@ def transformer_block(
         # (parallel_norms=2) norms the mlp branch separately with ln2
         h_mlp = h if cfg.parallel_norms == 1 else _norm(x, lp["ln2"], cfg)
         return x + attn_out + _mlp(h_mlp, lp["mlp"], cfg)
-    if cfg.post_norms:  # gemma-2: norm the attn OUTPUT before the residual
+    if cfg.post_norms:  # gemma-2/olmo2: norm the attn OUTPUT
         attn_out = _norm(attn_out, lp["ln1_post"], cfg)
     x = x + attn_out
 
-    h2 = _norm(x, lp["ln2"], cfg)
+    h2 = x if cfg.no_pre_norms else _norm(x, lp["ln2"], cfg)
     mlp_out = _moe(h2, lp["moe"], cfg) if cfg.is_moe else _mlp(h2, lp["mlp"], cfg)
     if cfg.post_norms:
         mlp_out = _norm(mlp_out, lp["ln2_post"], cfg)

@@ -167,14 +167,18 @@ def _export_llama_state(params, cfg: ModelConfig, dtype) -> dict[str, np.ndarray
         state["lm_head.weight"] = t(params["lm_head"])
     for i in range(cfg.n_layers):
         p = f"model.layers.{i}."
-        state[p + "input_layernorm.weight"] = norm(layers["ln1"]["scale"][i])
-        if cfg.post_norms:  # gemma-2 norm names (see loader._convert_llama)
+        if cfg.no_pre_norms:  # olmo2: output norms only
+            state[p + "post_attention_layernorm.weight"] = norm(layers["ln1_post"]["scale"][i])
+            state[p + "post_feedforward_layernorm.weight"] = norm(layers["ln2_post"]["scale"][i])
+        elif cfg.post_norms:  # gemma-2 norm names (see loader._convert_llama)
+            state[p + "input_layernorm.weight"] = norm(layers["ln1"]["scale"][i])
             state[p + "post_attention_layernorm.weight"] = norm(layers["ln1_post"]["scale"][i])
             state[p + "pre_feedforward_layernorm.weight"] = norm(layers["ln2"]["scale"][i])
             state[p + "post_feedforward_layernorm.weight"] = norm(layers["ln2_post"]["scale"][i])
         else:
+            state[p + "input_layernorm.weight"] = norm(layers["ln1"]["scale"][i])
             state[p + "post_attention_layernorm.weight"] = norm(layers["ln2"]["scale"][i])
-        if "bias" in layers["ln1"]:  # stablelm: biased layernorms
+        if "ln1" in layers and "bias" in layers["ln1"]:  # stablelm: biased LNs
             state[p + "input_layernorm.bias"] = _np(layers["ln1"]["bias"][i], dtype)
             state[p + "post_attention_layernorm.bias"] = _np(layers["ln2"]["bias"][i], dtype)
         a = layers["attn"]
@@ -613,6 +617,40 @@ def hf_config_dict(cfg: ModelConfig, qkv_bias: bool | None = None,
             "tie_word_embeddings": False,
             "hidden_act": "gelu_new",
         }
+    if cfg.no_pre_norms:  # olmo2: post-norm-only blocks
+        if (cfg.norm != "rmsnorm" or cfg.activation != "silu"
+                or not cfg.post_norms or not (cfg.qk_norm and cfg.qk_norm_full)
+                or cfg.rotary_pct < 1.0 or cfg.sliding_window or cfg.is_moe
+                or cfg.attn_logit_softcap or cfg.logits_softcap
+                or cfg.norm_plus_one or cfg.attn_scale or cfg.use_bias
+                or cfg.qkv_bias or cfg.embedding_scale or cfg.embedding_norm
+                or cfg.head_dim != cfg.d_model // cfg.n_heads):
+            # Olmo2ForCausalLM hardcodes all of these — anything else
+            # would load in transformers WITHOUT warning and diverge
+            raise ValueError(
+                f"olmo2 export requires rmsnorm/silu/full rotary/full-width "
+                f"qk-norm and no window/softcaps/moe ({cfg.name!r})"
+            )
+        out = {
+            "model_type": "olmo2",
+            "architectures": ["Olmo2ForCausalLM"],
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.d_model,
+            "num_hidden_layers": cfg.n_layers,
+            "num_attention_heads": cfg.n_heads,
+            "num_key_value_heads": cfg.n_kv_heads,
+            "intermediate_size": cfg.d_ff,
+            "max_position_embeddings": cfg.max_seq_len,
+            "rope_theta": cfg.rope_theta,
+            "rms_norm_eps": cfg.norm_eps,
+            "tie_word_embeddings": cfg.tie_embeddings,
+        }
+        if cfg.rope_scaling is not None:
+            if cfg.rope_scaling[0] != "linear":
+                raise ValueError("olmo2 export supports linear rope_scaling only")
+            out["rope_scaling"] = {"rope_type": "linear",
+                                   "factor": cfg.rope_scaling[1]}
+        return out
     if cfg.norm == "layernorm":  # stablelm: the one llama-layout family
         # with biased LayerNorms (and a partial_rotary_factor field)
         if (cfg.norm_plus_one or cfg.is_moe or cfg.post_norms

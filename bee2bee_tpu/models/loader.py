@@ -495,7 +495,13 @@ def _convert_llama(state, cfg: ModelConfig) -> dict:
     norm_off = 1.0 if cfg.norm_plus_one else 0.0
     raw = lambda k: state[pre + k]
     g = lambda k: (raw(k) + norm_off) if "layernorm.weight" in k or k == "norm.weight" else raw(k)
-    if cfg.post_norms:
+    if cfg.post_norms and cfg.no_pre_norms:
+        # olmo2: ONLY output norms — no input/pre_feedforward norms exist
+        layers = {
+            "ln1_post": {"scale": _stack([g(f"layers.{i}.post_attention_layernorm.weight") for i in range(L)])},
+            "ln2_post": {"scale": _stack([g(f"layers.{i}.post_feedforward_layernorm.weight") for i in range(L)])},
+        }
+    elif cfg.post_norms:
         # gemma-2 names: post_attention_layernorm is the POST-attn output
         # norm (ours ln1_post); the pre-mlp norm is pre_feedforward_…
         layers = {

@@ -19,7 +19,7 @@ from bee2bee_tpu.models.export import export_hf, hf_config_dict
     ["tiny-gpt2", "tiny-llama", "tiny-mistral", "tiny-mixtral", "tiny-gemma",
      "tiny-qwen", "tiny-phi", "tiny-neox", "tiny-gptj", "tiny-falcon",
      "tiny-bigcode", "tiny-bloom", "tiny-qwen3", "tiny-gemma2",
-     "tiny-mpt", "tiny-stablelm", "tiny-gemma3"],
+     "tiny-mpt", "tiny-stablelm", "tiny-gemma3", "tiny-olmo2"],
 )
 def test_config_from_hf_inverts_hf_config_dict(name):
     """For every supported family: our exported config.json must
@@ -318,3 +318,18 @@ async def test_pipeline_auto_model_end_to_end(tmp_path):
     finally:
         for n in nodes:
             await n.stop()
+
+
+def test_olmo2_guards():
+    """refuse-don't-drop for olmo2: attention_bias checkpoints refuse;
+    no_pre_norms without post_norms is unconstructible (a block with ZERO
+    norms)."""
+    import dataclasses
+
+    d = {"model_type": "olmo2", "vocab_size": 512, "hidden_size": 64,
+         "num_hidden_layers": 2, "num_attention_heads": 4,
+         "intermediate_size": 128, "attention_bias": True}
+    with pytest.raises(ValueError, match="attention_bias"):
+        config_from_hf(d)
+    with pytest.raises(ValueError, match="post_norms"):
+        dataclasses.replace(get_config("tiny-olmo2"), post_norms=False)

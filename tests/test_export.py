@@ -54,7 +54,7 @@ def test_write_safetensors_roundtrip_dtypes(tmp_path):
     ["tiny-gpt2", "tiny-llama", "tiny-mistral", "tiny-mixtral", "tiny-gemma",
      "tiny-qwen", "tiny-phi", "tiny-neox", "tiny-gptj", "tiny-falcon",
      "tiny-bigcode", "tiny-bloom", "tiny-qwen3", "tiny-gemma2",
-     "tiny-mpt", "tiny-stablelm", "tiny-gemma3"],
+     "tiny-mpt", "tiny-stablelm", "tiny-gemma3", "tiny-olmo2"],
 )
 def test_export_hf_roundtrips_through_loader(tmp_path, name):
     """export_hf must be the exact inverse of the loader's HF conversion
@@ -582,3 +582,10 @@ def test_torch_loads_gemma3_export_and_logits_match(tmp_path):
     layer types run."""
     _torch_conformance("tiny-gemma3", tmp_path, "Gemma3ForCausalLM",
                        seed=101)
+
+
+def test_torch_loads_olmo2_export_and_logits_match(tmp_path):
+    """olmo2 family conformance: POST-norm-only blocks (no pre-norms at
+    all) and FULL-WIDTH q/k RMSNorm applied before the head reshape,
+    against Olmo2ForCausalLM."""
+    _torch_conformance("tiny-olmo2", tmp_path, "Olmo2ForCausalLM", seed=111)

@@ -108,6 +108,9 @@ CASES = {
     "bloom": ("BloomConfig", "BloomForCausalLM",
               dict(vocab_size=512, hidden_size=64, n_layer=2, n_head=4,
                    hidden_dropout=0.0, attention_dropout=0.0)),
+    # POST-norm-only blocks + FULL-WIDTH q/k RMSNorm before the reshape
+    "olmo2": ("Olmo2Config", "Olmo2ForCausalLM",
+              dict(TINY, num_key_value_heads=2, attention_dropout=0.0)),
     # llama tensor layout with BIASED layernorms + partial rotary 0.25
     "stablelm": ("StableLmConfig", "StableLmForCausalLM",
                  dict(TINY, num_key_value_heads=2, use_qkv_bias=True,

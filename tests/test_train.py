@@ -95,7 +95,7 @@ def test_moe_training_on_expert_mesh():
 
 @pytest.mark.parametrize("family", ["tiny-bloom", "tiny-gemma2", "tiny-qwen3",
                                     "tiny-mpt", "tiny-stablelm",
-                                    "tiny-gemma3"])
+                                    "tiny-gemma3", "tiny-olmo2"])
 def test_new_architecture_classes_train(family):
     """Gradients flow through every round-5 architecture switch — ALiBi
     score bias + embedding norm (bloom/mpt), post-norms + tanh softcaps +
