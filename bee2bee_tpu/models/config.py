@@ -290,6 +290,21 @@ CONFIGS: dict[str, ModelConfig] = {
         qk_norm=True, rope_theta=1000000.0, norm_eps=1e-6,
         tie_embeddings=False,
     ),
+    "tiny-qwen3moe": ModelConfig(  # qwen3 qk-norm + qwen3_moe expert names
+        name="tiny-qwen3moe", vocab_size=512, d_model=64, n_layers=2,
+        n_heads=4, n_kv_heads=2, d_ff=32, max_seq_len=256, qk_norm=True,
+        rope_theta=1000000.0, norm_eps=1e-6, tie_embeddings=False,
+        n_experts=4, n_experts_per_tok=2,
+    ),
+    "qwen3-30b-a3b": ModelConfig(
+        # Qwen/Qwen3-30B-A3B: 128 experts, 8 active, 768-wide experts,
+        # per-head qk-norm, head_dim 128 over d_model 2048
+        name="qwen3-30b-a3b", vocab_size=151936, d_model=2048, n_layers=48,
+        n_heads=32, n_kv_heads=4, d_ff=768, max_seq_len=40960,
+        qk_norm=True, rope_theta=1000000.0, norm_eps=1e-6,
+        tie_embeddings=False, head_dim_override=128,
+        n_experts=128, n_experts_per_tok=8,
+    ),
     # -- larger members of the already-supported families --
     "gemma-2-9b": ModelConfig(
         # google/gemma-2-9b: 16 256-dim heads over d_model 3584 (override),
@@ -682,6 +697,54 @@ def config_from_hf(d: dict, name: str | None = None) -> ModelConfig:
             rope_scaling=_parse_rope_scaling(d), parallel_block=True,
             lm_head_bias=True, norm_eps=d.get("layer_norm_eps", 1e-5),
         )
+    if mt == "qwen3_moe":
+        if not d.get("norm_topk_prob", False):
+            # our routing renormalizes the top-k weights (softmax over the
+            # selected logits == softmax-all + renorm); without the renorm
+            # the weighting differs — refuse, don't serve drifted mixtures
+            raise ValueError(
+                "qwen3_moe with norm_topk_prob=false is not supported by "
+                "the native core (routing weights would differ)"
+            )
+        if d.get("decoder_sparse_step", 1) != 1 or d.get("mlp_only_layers"):
+            raise ValueError(
+                "qwen3_moe with dense interleaved layers "
+                "(decoder_sparse_step != 1 / mlp_only_layers) is not "
+                "supported by the native core"
+            )
+        if d.get("attention_bias"):
+            raise ValueError(
+                "qwen3_moe attention_bias=true is not supported by the "
+                "native core (o_proj bias)"
+            )
+        H = d["num_attention_heads"]
+        # Qwen3MoeConfig has NO head_dim parameter — transformers falls
+        # back to hidden_size // num_attention_heads when absent (unlike
+        # dense Qwen3Config's 128 default)
+        hd = d.get("head_dim")
+        kw3: dict = dict(
+            name=nm, vocab_size=d["vocab_size"], d_model=d["hidden_size"],
+            n_layers=d["num_hidden_layers"], n_heads=H,
+            # class default is 4, NOT n_heads (the family-default rule)
+            n_kv_heads=d.get("num_key_value_heads", 4),
+            # expert width, not the (unused) dense intermediate_size
+            d_ff=d["moe_intermediate_size"],
+            max_seq_len=d.get("max_position_embeddings", 32768),
+            rope_theta=d.get("rope_theta", 10000.0),
+            rope_scaling=_parse_rope_scaling(d),
+            norm_eps=d.get("rms_norm_eps", 1e-6),
+            tie_embeddings=d.get("tie_word_embeddings", False),
+            qk_norm=True,
+            n_experts=d["num_experts"],
+            n_experts_per_tok=d.get("num_experts_per_tok", 8),
+        )
+        if (d.get("use_sliding_window") and d.get("sliding_window")
+                and int(d.get("max_window_layers") or 0) <= 0):
+            # same partial-window rule as the dense qwen branch
+            kw3["sliding_window"] = d["sliding_window"]
+        if hd and hd != d["hidden_size"] // H:
+            kw3["head_dim_override"] = hd
+        return ModelConfig(**kw3)
     if mt == "olmo2":
         if d.get("attention_bias"):
             # same refuse-don't-drop rule as the llama branch: the o_proj

@@ -193,12 +193,20 @@ def _export_llama_state(params, cfg: ModelConfig, dtype) -> dict[str, np.ndarray
             state[p + "self_attn.k_norm.weight"] = norm(a["k_norm"][i])
         if cfg.is_moe:
             moe = layers["moe"]
-            state[p + "block_sparse_moe.gate.weight"] = t(moe["router"][i])
-            for e in range(cfg.n_experts):
-                q = p + f"block_sparse_moe.experts.{e}."
-                state[q + "w1.weight"] = t(moe["w_gate"][i][e])
-                state[q + "w2.weight"] = t(moe["w_down"][i][e])
-                state[q + "w3.weight"] = t(moe["w_up"][i][e])
+            if "q_norm" in a:  # qwen3_moe names
+                state[p + "mlp.gate.weight"] = t(moe["router"][i])
+                for e in range(cfg.n_experts):
+                    q = p + f"mlp.experts.{e}."
+                    state[q + "gate_proj.weight"] = t(moe["w_gate"][i][e])
+                    state[q + "down_proj.weight"] = t(moe["w_down"][i][e])
+                    state[q + "up_proj.weight"] = t(moe["w_up"][i][e])
+            else:  # mixtral names
+                state[p + "block_sparse_moe.gate.weight"] = t(moe["router"][i])
+                for e in range(cfg.n_experts):
+                    q = p + f"block_sparse_moe.experts.{e}."
+                    state[q + "w1.weight"] = t(moe["w_gate"][i][e])
+                    state[q + "w2.weight"] = t(moe["w_down"][i][e])
+                    state[q + "w3.weight"] = t(moe["w_up"][i][e])
         else:
             m = layers["mlp"]
             state[p + "mlp.gate_proj.weight"] = t(m["w_gate"][i])
@@ -734,6 +742,21 @@ def hf_config_dict(cfg: ModelConfig, qkv_bias: bool | None = None,
                 "original_max_position_embeddings": orig,
             }
     if cfg.is_moe:
+        has_qk = cfg.qk_norm if qk_norm is None else qk_norm
+        if has_qk:  # qwen3_moe: qk-norm + per-expert gate/up/down names
+            return {
+                "model_type": "qwen3_moe",
+                "architectures": ["Qwen3MoeForCausalLM"],
+                "num_experts": cfg.n_experts,
+                "num_experts_per_tok": cfg.n_experts_per_tok,
+                "moe_intermediate_size": cfg.d_ff,
+                # our routing renormalizes top-k weights; transformers must
+                # too or the mixture weighting silently differs
+                "norm_topk_prob": True,
+                "decoder_sparse_step": 1,
+                "mlp_only_layers": [],
+                **base,
+            }
         return {
             "model_type": "mixtral",
             "architectures": ["MixtralForCausalLM"],

@@ -541,18 +541,28 @@ def _convert_llama(state, cfg: ModelConfig) -> dict:
             )
     if cfg.is_moe:
         E = cfg.n_experts
+        if pre + "layers.0.block_sparse_moe.gate.weight" in state:
+            # mixtral names: block_sparse_moe.{gate, experts.N.w1/w2/w3}
+            mb, gate_k, up_k, down_k = "block_sparse_moe", "w1", "w3", "w2"
+            router_k = f"{mb}.gate"
+            ek = lambda i, e, w: f"layers.{i}.{mb}.experts.{e}.{w}.weight"
+        else:
+            # qwen3_moe names: mlp.{gate, experts.N.gate/up/down_proj}
+            gate_k, up_k, down_k = "gate_proj", "up_proj", "down_proj"
+            router_k = "mlp.gate"
+            ek = lambda i, e, w: f"layers.{i}.mlp.experts.{e}.{w}.weight"
         layers["moe"] = {
-            "router": _stack([t(g(f"layers.{i}.block_sparse_moe.gate.weight")) for i in range(L)]),
+            "router": _stack([t(g(f"layers.{i}.{router_k}.weight")) for i in range(L)]),
             "w_gate": _stack([
-                _stack([t(g(f"layers.{i}.block_sparse_moe.experts.{e}.w1.weight")) for e in range(E)])
+                _stack([t(g(ek(i, e, gate_k))) for e in range(E)])
                 for i in range(L)
             ]),
             "w_down": _stack([
-                _stack([t(g(f"layers.{i}.block_sparse_moe.experts.{e}.w2.weight")) for e in range(E)])
+                _stack([t(g(ek(i, e, down_k))) for e in range(E)])
                 for i in range(L)
             ]),
             "w_up": _stack([
-                _stack([t(g(f"layers.{i}.block_sparse_moe.experts.{e}.w3.weight")) for e in range(E)])
+                _stack([t(g(ek(i, e, up_k))) for e in range(E)])
                 for i in range(L)
             ]),
         }

@@ -19,7 +19,8 @@ from bee2bee_tpu.models.export import export_hf, hf_config_dict
     ["tiny-gpt2", "tiny-llama", "tiny-mistral", "tiny-mixtral", "tiny-gemma",
      "tiny-qwen", "tiny-phi", "tiny-neox", "tiny-gptj", "tiny-falcon",
      "tiny-bigcode", "tiny-bloom", "tiny-qwen3", "tiny-gemma2",
-     "tiny-mpt", "tiny-stablelm", "tiny-gemma3", "tiny-olmo2"],
+     "tiny-mpt", "tiny-stablelm", "tiny-gemma3", "tiny-olmo2",
+     "tiny-qwen3moe"],
 )
 def test_config_from_hf_inverts_hf_config_dict(name):
     """For every supported family: our exported config.json must
@@ -333,3 +334,12 @@ def test_olmo2_guards():
         config_from_hf(d)
     with pytest.raises(ValueError, match="post_norms"):
         dataclasses.replace(get_config("tiny-olmo2"), post_norms=False)
+
+
+def test_qwen3moe_refuses_unnormalized_routing():
+    d = {"model_type": "qwen3_moe", "vocab_size": 512, "hidden_size": 64,
+         "num_hidden_layers": 2, "num_attention_heads": 4,
+         "moe_intermediate_size": 32, "num_experts": 4,
+         "intermediate_size": 128, "norm_topk_prob": False}
+    with pytest.raises(ValueError, match="norm_topk_prob"):
+        config_from_hf(d)

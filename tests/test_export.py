@@ -54,7 +54,8 @@ def test_write_safetensors_roundtrip_dtypes(tmp_path):
     ["tiny-gpt2", "tiny-llama", "tiny-mistral", "tiny-mixtral", "tiny-gemma",
      "tiny-qwen", "tiny-phi", "tiny-neox", "tiny-gptj", "tiny-falcon",
      "tiny-bigcode", "tiny-bloom", "tiny-qwen3", "tiny-gemma2",
-     "tiny-mpt", "tiny-stablelm", "tiny-gemma3", "tiny-olmo2"],
+     "tiny-mpt", "tiny-stablelm", "tiny-gemma3", "tiny-olmo2",
+     "tiny-qwen3moe"],
 )
 def test_export_hf_roundtrips_through_loader(tmp_path, name):
     """export_hf must be the exact inverse of the loader's HF conversion
@@ -589,3 +590,12 @@ def test_torch_loads_olmo2_export_and_logits_match(tmp_path):
     all) and FULL-WIDTH q/k RMSNorm applied before the head reshape,
     against Olmo2ForCausalLM."""
     _torch_conformance("tiny-olmo2", tmp_path, "Olmo2ForCausalLM", seed=111)
+
+
+def test_torch_loads_qwen3moe_export_and_logits_match(tmp_path):
+    """qwen3_moe family conformance: per-head qk-norm + MoE with the
+    gate/up/down_proj expert names and RENORMALIZED top-k routing
+    (equivalent to mixtral's softmax-over-selected — the equivalence the
+    norm_topk_prob refusal guards) against Qwen3MoeForCausalLM."""
+    _torch_conformance("tiny-qwen3moe", tmp_path, "Qwen3MoeForCausalLM",
+                       seed=121)

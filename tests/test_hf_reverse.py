@@ -108,6 +108,16 @@ CASES = {
     "bloom": ("BloomConfig", "BloomForCausalLM",
               dict(vocab_size=512, hidden_size=64, n_layer=2, n_head=4,
                    hidden_dropout=0.0, attention_dropout=0.0)),
+    # qk-norm + MoE with the qwen3_moe expert names (gate/up/down_proj)
+    # and renormalized top-k routing
+    "qwen3_moe": ("Qwen3MoeConfig", "Qwen3MoeForCausalLM",
+                  dict(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+                       num_attention_heads=4, num_key_value_heads=2,
+                       head_dim=16, intermediate_size=128,
+                       moe_intermediate_size=32, num_experts=4,
+                       num_experts_per_tok=2, norm_topk_prob=True,
+                       tie_word_embeddings=False, attention_dropout=0.0,
+                       max_position_embeddings=64)),
     # POST-norm-only blocks + FULL-WIDTH q/k RMSNorm before the reshape
     "olmo2": ("Olmo2Config", "Olmo2ForCausalLM",
               dict(TINY, num_key_value_heads=2, attention_dropout=0.0)),
