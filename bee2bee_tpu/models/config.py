@@ -42,6 +42,10 @@ class ModelConfig:
     #   ("linear", factor)  — position-interpolation fine-tunes
     #   ("llama3", factor, low_freq_factor, high_freq_factor,
     #    original_max_position_embeddings)  — llama-3.1+ checkpoints
+    #   ("yarn", factor, attention_factor, beta_fast, beta_slow,
+    #    original_max_position_embeddings, truncate)  — NTK-by-parts
+    #    long-context fine-tunes (attention_factor resolved at parse time,
+    #    incl. the deepseek mscale variants)
     rope_scaling: tuple | None = None
     norm_eps: float = 1e-5
     logits_softcap: float | None = None
@@ -106,12 +110,14 @@ class ModelConfig:
             # native-checkpoint model_config.json round-trip)
             object.__setattr__(self, "rope_scaling", tuple(self.rope_scaling))
             kind = self.rope_scaling[0]
-            want = {"linear": 2, "llama3": 5}.get(kind)
+            want = {"linear": 2, "llama3": 5, "yarn": 7}.get(kind)
             if want is None or len(self.rope_scaling) != want:
                 raise ValueError(
                     f"rope_scaling={self.rope_scaling!r}: expected "
-                    f"('linear', factor) or ('llama3', factor, low_freq, "
-                    f"high_freq, original_max_pos)"
+                    f"('linear', factor), ('llama3', factor, low_freq, "
+                    f"high_freq, original_max_pos), or ('yarn', factor, "
+                    f"attention_factor, beta_fast, beta_slow, "
+                    f"original_max_pos, truncate)"
                 )
         if self.no_pre_norms and not self.post_norms:
             raise ValueError(
@@ -500,7 +506,7 @@ def _neox_act(hidden_act: str) -> str:
     )
 
 
-def _parse_rope_scaling(d: dict) -> tuple | None:
+def _parse_rope_scaling(d: dict, default_max_pos: int = 2048) -> tuple | None:
     """HF rope_scaling dict → cfg.rope_scaling tuple, or raise for
     schedules the core doesn't implement (yarn/longrope/dynamic) — every
     rotary family must route through this, or an extended-context
@@ -516,11 +522,30 @@ def _parse_rope_scaling(d: dict) -> tuple | None:
                 int(rs["original_max_position_embeddings"]))
     if rtype == "linear":
         return ("linear", float(rs["factor"]))
+    if rtype == "yarn":
+        import math as _math
+
+        factor = float(rs["factor"])
+        af = rs.get("attention_factor")
+        if af is None:
+            # HF's inference rule, incl. the deepseek mscale variants
+            def get_mscale(scale, ms=1.0):
+                return 1.0 if scale <= 1 else 0.1 * ms * _math.log(scale) + 1.0
+
+            ms, msad = rs.get("mscale"), rs.get("mscale_all_dim")
+            af = (get_mscale(factor, ms) / get_mscale(factor, msad)
+                  if ms and msad else get_mscale(factor))
+        orig = (rs.get("original_max_position_embeddings")
+                or d.get("max_position_embeddings", default_max_pos))
+        return ("yarn", factor, float(af),
+                float(rs.get("beta_fast") or 32),
+                float(rs.get("beta_slow") or 1),
+                int(orig), bool(rs.get("truncate", True)))
     if rtype in ("default", None):
         return None
     raise ValueError(
         f"rope_scaling type {rtype!r} is not supported by the native core "
-        f"(llama3/linear only); serve via the ollama/remote backends"
+        f"(llama3/linear/yarn only); serve via the ollama/remote backends"
     )
 
 
@@ -731,16 +756,16 @@ def config_from_hf(d: dict, name: str | None = None) -> ModelConfig:
             d_ff=d["moe_intermediate_size"],
             max_seq_len=d.get("max_position_embeddings", 32768),
             rope_theta=d.get("rope_theta", 10000.0),
-            rope_scaling=_parse_rope_scaling(d),
+            rope_scaling=_parse_rope_scaling(d, 32768),
             norm_eps=d.get("rms_norm_eps", 1e-6),
             tie_embeddings=d.get("tie_word_embeddings", False),
             qk_norm=True,
             n_experts=d["num_experts"],
             n_experts_per_tok=d.get("num_experts_per_tok", 8),
         )
-        if (d.get("use_sliding_window") and d.get("sliding_window")
-                and int(d.get("max_window_layers") or 0) <= 0):
-            # same partial-window rule as the dense qwen branch
+        if d.get("use_sliding_window") and d.get("sliding_window"):
+            # unlike dense qwen, Qwen3Moe modeling never reads
+            # max_window_layers — it windows EVERY layer when enabled
             kw3["sliding_window"] = d["sliding_window"]
         if hd and hd != d["hidden_size"] // H:
             kw3["head_dim_override"] = hd
@@ -791,7 +816,7 @@ def config_from_hf(d: dict, name: str | None = None) -> ModelConfig:
             norm="layernorm",  # biased LNs over the llama tensor layout
             rotary_pct=d.get("partial_rotary_factor", 0.25),
             rope_theta=d.get("rope_theta", 10000.0),
-            rope_scaling=_parse_rope_scaling(d),
+            rope_scaling=_parse_rope_scaling(d, 4096),
             qkv_bias=d.get("use_qkv_bias", False),
             tie_embeddings=d.get("tie_word_embeddings", False),
             norm_eps=d.get("layer_norm_eps", 1e-5),
@@ -807,7 +832,7 @@ def config_from_hf(d: dict, name: str | None = None) -> ModelConfig:
             d_ff=d["intermediate_size"],
             max_seq_len=d.get("max_position_embeddings", 4096),
             rope_theta=d.get("rope_theta", 10000.0),
-            rope_scaling=_parse_rope_scaling(d),  # longrope refuses here
+            rope_scaling=_parse_rope_scaling(d, 4096),  # longrope refuses
             rotary_pct=d.get("partial_rotary_factor", 1.0),
             norm_eps=d.get("rms_norm_eps", 1e-5),
             tie_embeddings=d.get("tie_word_embeddings", False),
@@ -860,7 +885,7 @@ def config_from_hf(d: dict, name: str | None = None) -> ModelConfig:
             logits_softcap=d.get("final_logit_softcapping"),
             rope_theta=d.get("rope_theta", 1000000.0),
             local_rope_theta=d.get("rope_local_base_freq", 10000.0),
-            rope_scaling=_parse_rope_scaling(d),
+            rope_scaling=_parse_rope_scaling(d, 131072),
             norm_eps=d.get("rms_norm_eps", 1e-6),
             tie_embeddings=d.get("tie_word_embeddings", True),
             # every/residues stay decoupled from the window: even with the
@@ -901,7 +926,7 @@ def config_from_hf(d: dict, name: str | None = None) -> ModelConfig:
             qkv_bias=mt == "qwen2",
             qk_norm=mt == "qwen3",
         )
-        if (scaling := _parse_rope_scaling(d)) is not None:
+        if (scaling := _parse_rope_scaling(d, default_maxpos)) is not None:
             kw["rope_scaling"] = scaling
         if d.get("attention_bias"):
             # HF attention_bias puts biases on q/k/v AND o_proj; our

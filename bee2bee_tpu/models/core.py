@@ -160,19 +160,44 @@ def _norm(x, p, cfg: ModelConfig):
     return out
 
 
-def scale_rope_freqs(freqs, scaling: tuple | None):
+def scale_rope_freqs(freqs, scaling: tuple | None, theta: float | None = None,
+                     rot: int | None = None):
     """Frequency-domain RoPE scaling (cfg.rope_scaling).
 
     "linear": all frequencies divided by the factor — position
     interpolation. "llama3" (llama-3.1+): long wavelengths (> original
     context / low_freq_factor) get the full division, short wavelengths
     (< original / high_freq_factor) stay untouched, the band between
-    interpolates — must match transformers' _compute_llama3_parameters
-    exactly or every position's rotation drifts."""
+    interpolates. "yarn": NTK-by-parts — a linear ramp over the rotary
+    DIMENSIONS (not wavelengths) between full interpolation and no
+    scaling, with the ramp bounds derived from beta_fast/beta_slow
+    rotations at the original context (theta and rot required). All must
+    match transformers' _compute_*_parameters exactly or every position's
+    rotation drifts. The yarn attention_factor (cos/sin magnitude) is
+    applied in _rope, not here."""
     if scaling is None:
         return freqs
     if scaling[0] == "linear":
         return freqs / scaling[1]
+    if scaling[0] == "yarn":
+        _, factor, _af, beta_fast, beta_slow, orig, truncate = scaling
+
+        def corr_dim(n_rot):
+            return (rot * math.log(orig / (n_rot * 2 * math.pi))
+                    ) / (2 * math.log(theta))
+
+        low, high = corr_dim(beta_fast), corr_dim(beta_slow)
+        if truncate:
+            low, high = math.floor(low), math.ceil(high)
+        low, high = max(low, 0), min(high, rot - 1)
+        if low == high:
+            high += 0.001
+        ramp = jnp.clip(
+            (jnp.arange(rot // 2, dtype=jnp.float32) - low) / (high - low),
+            0.0, 1.0,
+        )
+        extrap = 1.0 - ramp  # 1 = keep the base frequency (extrapolation)
+        return (freqs / factor) * (1.0 - extrap) + freqs * extrap
     _, factor, low_f, high_f, orig = scaling
     low_wavelen = orig / low_f
     high_wavelen = orig / high_f
@@ -207,7 +232,7 @@ def _rope(x, positions, theta: float, rot: int | None = None,
     rot = hd if rot is None else rot
     xr, tail = x[..., :rot], x[..., rot:]
     freqs = 1.0 / (theta ** (jnp.arange(0, rot, 2, dtype=jnp.float32) / rot))
-    freqs = scale_rope_freqs(freqs, scaling)
+    freqs = scale_rope_freqs(freqs, scaling, theta=theta, rot=rot)
     angles = positions[..., None].astype(jnp.float32) * freqs  # [B, T, rot/2]
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]
@@ -221,6 +246,11 @@ def _rope(x, positions, theta: float, rot: int | None = None,
     else:
         x1, x2 = jnp.split(xf, 2, axis=-1)
         out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    if scaling is not None and scaling[0] == "yarn":
+        # yarn's attention temperature: HF multiplies cos AND sin by the
+        # attention_factor, i.e. the whole rotated block scales (the
+        # non-rotary tail stays untouched)
+        out = out * scaling[2]
     out = out.astype(x.dtype)
     return out if rot == hd else jnp.concatenate([out, tail], axis=-1)
 

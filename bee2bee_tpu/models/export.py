@@ -734,6 +734,16 @@ def hf_config_dict(cfg: ModelConfig, qkv_bias: bool | None = None,
         if cfg.rope_scaling[0] == "linear":
             base["rope_scaling"] = {"rope_type": "linear",
                                     "factor": cfg.rope_scaling[1]}
+        elif cfg.rope_scaling[0] == "yarn":
+            _, f, af, bf, bs, orig, trunc = cfg.rope_scaling
+            base["rope_scaling"] = {
+                # attention_factor written EXPLICITLY: the parse-time
+                # inference already folded any mscale variants into it
+                "rope_type": "yarn", "factor": f, "attention_factor": af,
+                "beta_fast": bf, "beta_slow": bs,
+                "original_max_position_embeddings": orig,
+                "truncate": trunc,
+            }
         else:  # llama3
             _, f, lo, hi, orig = cfg.rope_scaling
             base["rope_scaling"] = {
@@ -744,7 +754,7 @@ def hf_config_dict(cfg: ModelConfig, qkv_bias: bool | None = None,
     if cfg.is_moe:
         has_qk = cfg.qk_norm if qk_norm is None else qk_norm
         if has_qk:  # qwen3_moe: qk-norm + per-expert gate/up/down names
-            return {
+            out = {
                 "model_type": "qwen3_moe",
                 "architectures": ["Qwen3MoeForCausalLM"],
                 "num_experts": cfg.n_experts,
@@ -757,6 +767,10 @@ def hf_config_dict(cfg: ModelConfig, qkv_bias: bool | None = None,
                 "mlp_only_layers": [],
                 **base,
             }
+            if cfg.sliding_window is not None:
+                # Qwen3MoeConfig NULLS sliding_window unless this is set
+                out["use_sliding_window"] = True
+            return out
         return {
             "model_type": "mixtral",
             "architectures": ["MixtralForCausalLM"],

@@ -154,9 +154,10 @@ def test_config_from_hf_rejects_falcon_bias():
         config_from_hf(d)
 
 
-def test_rope_scaling_round_trips_and_rejects_yarn():
-    """llama3 + linear rope scaling survive export->import; yarn (not
-    implemented) refuses instead of serving drifted rotations."""
+def test_rope_scaling_round_trips_and_rejects_longrope():
+    """llama3 + linear + yarn rope scaling survive export->import;
+    longrope (not implemented) refuses instead of serving drifted
+    rotations."""
     import dataclasses
 
     cfg = get_config("llama-3.1-8b")
@@ -169,9 +170,17 @@ def test_rope_scaling_round_trips_and_rejects_yarn():
     assert config_from_hf(hf_config_dict(lin), name=lin.name) == lin
 
     d = hf_config_dict(get_config("tiny-llama"))
-    d["rope_scaling"] = {"rope_type": "yarn", "factor": 4.0}
-    with pytest.raises(ValueError, match="yarn"):
+    d["rope_scaling"] = {"rope_type": "longrope", "short_factor": [1.0],
+                         "long_factor": [1.0]}
+    with pytest.raises(ValueError, match="longrope"):
         config_from_hf(d)
+
+    # yarn round-trips through export (attention_factor written explicitly)
+    ycfg = dataclasses.replace(
+        get_config("tiny-llama"),
+        rope_scaling=("yarn", 4.0, 1.1386294361119891, 32.0, 1.0, 32, True))
+    back = config_from_hf(hf_config_dict(ycfg), name=ycfg.name)
+    assert back == ycfg
 
 
 def test_gemma2_diff_config_uses_hf_defaults():

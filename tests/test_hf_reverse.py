@@ -145,6 +145,22 @@ CASES = {
         dict(TINY, partial_rotary_factor=0.4, resid_pdrop=0.0,
              embd_pdrop=0.0, attention_dropout=0.0,
              rope_scaling={"rope_type": "linear", "factor": 2.0})),
+    # yarn NTK-by-parts: ramp bounds + attention temperature must match
+    # HF's _compute_yarn_parameters (incl. the inferred attention_factor)
+    "llama_rope_yarn": (
+        "LlamaConfig", "LlamaForCausalLM",
+        dict(TINY, num_key_value_heads=2, tie_word_embeddings=False,
+             max_position_embeddings=128,
+             rope_scaling={"rope_type": "yarn", "factor": 4.0,
+                           "original_max_position_embeddings": 32})),
+    # deepseek-style mscale variants fold into the attention factor
+    "llama_rope_yarn_mscale": (
+        "LlamaConfig", "LlamaForCausalLM",
+        dict(TINY, num_key_value_heads=2, tie_word_embeddings=False,
+             max_position_embeddings=128,
+             rope_scaling={"rope_type": "yarn", "factor": 4.0,
+                           "original_max_position_embeddings": 32,
+                           "mscale": 1.0, "mscale_all_dim": 0.8})),
     "llama_rope_linear": (
         "LlamaConfig", "LlamaForCausalLM",
         dict(TINY, num_key_value_heads=2, tie_word_embeddings=False,
