@@ -180,6 +180,11 @@ def scale_rope_freqs(freqs, scaling: tuple | None, theta: float | None = None,
     if scaling[0] == "linear":
         return freqs / scaling[1]
     if scaling[0] == "yarn":
+        if theta is None or rot is None:
+            raise ValueError(
+                "yarn rope scaling needs theta and rot (the ramp bounds "
+                "are dimension- and base-dependent)"
+            )
         _, factor, _af, beta_fast, beta_slow, orig, truncate = scaling
 
         def corr_dim(n_rot):
