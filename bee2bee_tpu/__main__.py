@@ -79,6 +79,8 @@ def _apply_common_cfg(cfg, kw):
         cfg.attention = kw["attention"]
     if kw.get("quantize"):
         cfg.quantize = kw["quantize"]
+    if kw.get("paged"):
+        cfg.paged = True
     return cfg
 
 
@@ -156,6 +158,10 @@ def cli():
                    " | sp (seq-sharded long-context cache)")
 @click.option("--quantize", type=click.Choice(["none", "int8"]), default=None,
               help="weight-only quantization (int8 halves decode HBM traffic)")
+@click.option("--paged", is_flag=True, default=False,
+              help="paged KV cache: per-step cache HBM traffic scales with "
+                   "live tokens, not max_batch*max_seq; prefix-cache hits "
+                   "share prompt blocks copy-on-write (dense attention only)")
 @click.option("--publish-weights", is_flag=True,
               help="announce this node's params as DHT pieces for joiners")
 @click.option("--from-mesh", is_flag=True,
@@ -163,11 +169,11 @@ def cli():
                    "(zero local checkpoint)")
 @_common_opts
 def serve_tpu(model, checkpoint, lora, mesh_shape, attention, quantize,
-              publish_weights, from_mesh, **kw):
+              paged, publish_weights, from_mesh, **kw):
     """Serve a model on TPU via the jit engine (the flagship entrypoint)."""
     _serve(
         "tpu", model, checkpoint=checkpoint, lora=lora, mesh_shape=mesh_shape,
-        attention=attention, quantize=quantize,
+        attention=attention, quantize=quantize, paged=paged,
         publish_weights=publish_weights, from_mesh=from_mesh, **kw
     )
 

@@ -30,6 +30,9 @@ _ENV_MAP = {
     "BEE2BEE_ATTENTION": "attention",
     "BEE2BEE_PREFILL_CHUNK": "prefill_chunk",
     "BEE2BEE_PREFIX_CACHE": "prefix_cache_entries",
+    "BEE2BEE_PAGED": "paged",
+    "BEE2BEE_KV_BLOCK_SIZE": "kv_block_size",
+    "BEE2BEE_KV_POOL_BLOCKS": "kv_pool_blocks",
     "BEE2BEE_QUANTIZE": "quantize",
     "BEE2BEE_AUTO_NAT": "auto_nat",
     "BEE2BEE_DHT_PORT": "dht_port",
@@ -38,9 +41,10 @@ _ENV_MAP = {
 
 _INT_FIELDS = {
     "port", "api_port", "announce_port", "max_batch_size", "max_seq_len",
-    "dht_port", "prefill_chunk", "prefix_cache_entries",
+    "dht_port", "prefill_chunk", "prefix_cache_entries", "kv_block_size",
+    "kv_pool_blocks",
 }
-_BOOL_FIELDS = {"auto_nat"}
+_BOOL_FIELDS = {"auto_nat", "paged"}
 
 
 @dataclass
@@ -75,6 +79,15 @@ class NodeConfig:
     prefix_cache_entries: int = 0
     # weight-only quantization: "none" | "int8" (halves decode HBM traffic)
     quantize: str = "none"
+    # paged KV cache: block-pool cache + per-row block tables — per-step
+    # cache HBM traffic scales with live tokens instead of
+    # max_batch * max_seq (EngineConfig.paged; dense attention only)
+    paged: bool = False
+    kv_block_size: int = 16  # tokens per pool block (EngineConfig knob)
+    # total pool blocks; 0 = default sizing (exhaustion impossible). An
+    # explicit smaller value trades HBM for admission backpressure
+    # (EngineConfig.kv_pool_blocks)
+    kv_pool_blocks: int = 0
     max_batch_size: int = 8  # continuous-batching rows (EngineConfig.max_batch)
     max_seq_len: int = 2048
     max_new_tokens: int = 2048  # reference default (services.py:28)
@@ -102,6 +115,9 @@ class NodeConfig:
             prefill_chunk=self.prefill_chunk or None,
             prefix_cache_entries=self.prefix_cache_entries,
             quantize=self.quantize,
+            paged=self.paged,
+            kv_block_size=self.kv_block_size,
+            kv_pool_blocks=self.kv_pool_blocks or None,
         )
 
 

@@ -9,7 +9,10 @@ TPU-first structure (SURVEY §7 step 2, hard part 1):
 - **Continuous batching** (engine/scheduler.py): concurrent requests share
   one fixed-capacity [max_batch, max_seq_len] KV cache, donated through
   every decode step so XLA updates it in place in HBM; rows admit/retire
-  between chunks and a request stops paying compute at EOS.
+  between chunks and a request stops paying compute at EOS. With
+  ``paged=True`` the shared cache is a block pool + per-row block tables
+  instead (engine/paged.py): per-step cache traffic follows live tokens
+  and prompt prefixes are shared block-level copy-on-write.
 - **On-device sampling** inside the jit'd step: one fused
   forward+sample+cache-update program per token; the only host transfer per
   chunk is the sampled token ids (needed for streaming/stop anyway).
@@ -96,13 +99,38 @@ class EngineConfig:
     # activations/KV stay in `dtype`. Applied after checkpoint load,
     # before sharding.
     quantize: str = "none"
-    # prompt prefix cache: keep up to this many prompt K/V snapshots and
+    # prompt prefix cache: keep up to this many prompt K/V entries and
     # admit new requests from the longest cached prefix, prefilling only
     # the remainder. Chat transcripts resend the whole history every turn
     # (the reference rebuilds full context per message — its hf.py
-    # transcript path), so turn N+1 pays only the delta. Each entry costs
-    # one batch-1 row cache in HBM. 0 = disabled.
+    # transcript path), so turn N+1 pays only the delta. Cost depends on
+    # the cache layout: rectangular entries each snapshot a full batch-1
+    # row cache in HBM; paged entries cost NO extra HBM — they pin the
+    # prompt's existing pool blocks (refcounted), and a hit shares those
+    # blocks copy-on-write, device-copying at most the final partial
+    # block. Pinned blocks are reclaimed LRU-first under pool pressure.
+    # 0 = disabled.
     prefix_cache_entries: int = 0
+    # paged KV cache (engine/paged.py): replace the rectangular
+    # [max_batch, max_seq] cache with a block pool + per-row block tables
+    # so per-step cache HBM traffic scales with LIVE tokens, not
+    # max_batch * max_seq — short/idle rows stop taxing every decode step
+    # (the rectangular path measured 4x decode cost at bsz=8 with one
+    # active row). Dense attention only: flash reads a contiguous row
+    # layout and "sp" shards capacity over the seq axis — both stay on
+    # the rectangular path and are rejected with paged=True.
+    paged: bool = False
+    # tokens per pool block. Smaller blocks track live length tighter
+    # (less over-allocation, finer sharing granularity); larger blocks
+    # shrink the table/gather overhead. 16 matches the TPU second-minor
+    # tile and means a 64-token prompt costs 4 blocks, not a max_seq row.
+    kv_block_size: int = 16
+    # total pool blocks (incl. the reserved null block 0). None sizes the
+    # pool so exhaustion is impossible: max_batch full rows (plus decode-
+    # chunk overshoot) + worst-case pinned prefix entries. Set explicitly
+    # to trade HBM for admission backpressure (the scheduler queues, and
+    # reclaims prefix pins, when the free list runs dry).
+    kv_pool_blocks: int | None = None
 
     def __post_init__(self):
         # <= 0 means "disabled" (NodeConfig uses 0 as its sentinel); a raw
@@ -110,6 +138,8 @@ class EngineConfig:
         # never advances
         if self.prefill_chunk is not None and self.prefill_chunk < 1:
             self.prefill_chunk = None
+        if self.paged and self.kv_block_size < 1:
+            raise ValueError(f"kv_block_size must be >= 1, got {self.kv_block_size}")
 
 
 @dataclass
@@ -257,6 +287,13 @@ class InferenceEngine:
         a TPU-default host must not pick flash."""
         from ..ops.flash import validate_flash_mesh
 
+        if self.engine_cfg.paged:
+            # the seq-mesh rejection lives in _validate_attention_impl
+            # (it must hold for explicit 'dense' too, not just 'auto')
+            logger.info("attention=auto -> dense (paged KV cache: the block "
+                        "gather is a dense-path feature; flash/sp stay on "
+                        "the rectangular cache)")
+            return "dense"
         if self.model_cfg.pos_embedding == "alibi":
             if self.mesh.shape.get("seq", 1) > 1:
                 raise ValueError(
@@ -328,6 +365,28 @@ class InferenceEngine:
         return bool(w) and w < self.max_seq_len
 
     def _validate_attention_impl(self):
+        if self.engine_cfg.paged and self.mesh.shape.get("seq", 1) > 1:
+            # checked here, not only in the 'auto' resolution: an explicit
+            # attention='dense' must not silently serve a seq-sharded mesh
+            # with a capacity-replicated pool — the exact loss the seq
+            # axis exists to avoid
+            raise ValueError(
+                "paged=True does not support a seq-sharded mesh (the "
+                "block pool is unsharded along capacity); drop the seq "
+                "axis or serve paged=False with attention='sp'"
+            )
+        if self.engine_cfg.paged and self.engine_cfg.attention in ("flash", "sp"):
+            # explicit selection + paged is a contradiction, not a silent
+            # fallback: flash's pallas kernel reads a contiguous [B, S]
+            # cache row and sp shards cache capacity over the seq axis —
+            # neither understands a block-scattered pool. The paged win
+            # (gather only live blocks) is implemented on the dense path.
+            raise ValueError(
+                f"attention={self.engine_cfg.attention!r} is not supported "
+                "with paged=True — the paged block pool is served by the "
+                "dense path only; use attention='dense' (or 'auto'), or "
+                "disable paged"
+            )
         if (self.engine_cfg.attention in ("flash", "sp")
                 and self.model_cfg.pos_embedding == "alibi"):
             raise ValueError(
@@ -363,13 +422,21 @@ class InferenceEngine:
 
             validate_sp_mesh(self.model_cfg, self.engine_cfg, self.mesh)
 
-    def _prefill_fn(self, params, tokens, cache, true_len, offset):
+    def _prefill_fn(self, params, tokens, cache, true_len, offset,
+                    block_tables=None, write_floor=None, write_ceil=None):
         """tokens [B, Tb] padded; returns (cache, last_logits [B, V]).
         `offset` is the global cache position of tokens[:, 0] — 0 for a
         whole-prompt prefill, the running position for chunked prefill.
-        `true_len` is the valid length WITHIN this chunk."""
+        `true_len` is the valid length WITHIN this chunk. With
+        `block_tables`, `cache` is the paged pool and the chunk scatters
+        into the row's mapped blocks (core.forward's paged path);
+        `write_floor` keeps re-fed positions below a CoW share point from
+        rewriting shared donor blocks, `write_ceil` drops the padded tail
+        so short prompts only claim blocks covering their real length."""
         logits, cache = core.forward(
-            params, self.model_cfg, tokens, cache, offset, attn_fn=self._attn_fn()
+            params, self.model_cfg, tokens, cache, offset,
+            attn_fn=self._attn_fn(), block_tables=block_tables,
+            paged_write_floor=write_floor, paged_write_ceil=write_ceil,
         )
         idx = (true_len - 1).reshape(-1, 1, 1)  # [B,1,1]
         last = jnp.take_along_axis(logits, jnp.broadcast_to(idx, (logits.shape[0], 1, logits.shape[2])), axis=1)
@@ -383,22 +450,69 @@ class InferenceEngine:
                 return b
         return self.max_seq_len
 
+    def _fit_spec(self, spec: P, shape: tuple[int, ...]) -> P:
+        """Fall back axis-by-axis when a dim doesn't divide its mesh axis
+        (e.g. batch=1 on a data=2 mesh) instead of crashing device_put."""
+        return P(*[
+            e if e is None or shape[i] % self.mesh.shape.get(e, 1) == 0 else None
+            for i, e in enumerate(spec)
+        ])
+
     def new_cache(self, batch: int = 1):
         cache = core.init_cache(
             self.model_cfg, batch, self.max_seq_len, jnp.dtype(self.engine_cfg.cache_dtype)
         )
-        # fall back axis-by-axis when a cache dim doesn't divide its mesh
-        # axis (e.g. batch=1 on a data=2 mesh) instead of crashing device_put
         spec = partition.cache_spec(
             self.model_cfg, self.mesh,
             seq_sharded=self.engine_cfg.attention == "sp",
         )
-        k = cache["k"]
-        fitted = P(*[
-            e if e is None or k.shape[i] % self.mesh.shape.get(e, 1) == 0 else None
-            for i, e in enumerate(spec)
-        ])
+        fitted = self._fit_spec(spec, cache["k"].shape)
         return jax.device_put(cache, NamedSharding(self.mesh, fitted))
+
+    # ---- paged-pool geometry (engine/paged.py holds the allocator) ----
+
+    @property
+    def blocks_per_row(self) -> int:
+        """Max pool blocks one row can map: capacity plus the decode-chunk
+        overshoot (a readback window may write up to decode_chunk - 2
+        positions past capacity before the host sees the stop; the
+        rectangular path absorbs that via dynamic_update_slice clamping,
+        the paged path by owning real blocks for it — an out-of-table
+        position would otherwise depend on jax's OOB gather/scatter
+        defaults instead of landing in a block the row owns)."""
+        from .paged import ceil_div
+
+        return ceil_div(
+            self.max_seq_len + self.engine_cfg.decode_chunk,
+            self.engine_cfg.kv_block_size,
+        )
+
+    @property
+    def pool_blocks(self) -> int:
+        """Total pool blocks: explicit kv_pool_blocks, or sized so the
+        free list cannot run dry (null block + max_batch full rows +
+        worst-case pinned prefix entries)."""
+        from .paged import ceil_div
+
+        if self.engine_cfg.kv_pool_blocks is not None:
+            return self.engine_cfg.kv_pool_blocks
+        pin = ceil_div(self.max_seq_len, self.engine_cfg.kv_block_size)
+        return (
+            1
+            + self.engine_cfg.max_batch * self.blocks_per_row
+            + self.engine_cfg.prefix_cache_entries * pin
+        )
+
+    def new_pool(self):
+        """The paged KV block pool, placed with the kv-head `model` spec
+        (partition.paged_cache_spec) so TP serving gathers stay local."""
+        pool = core.init_paged_pool(
+            self.model_cfg, self.pool_blocks, self.engine_cfg.kv_block_size,
+            jnp.dtype(self.engine_cfg.cache_dtype),
+        )
+        spec = partition.paged_cache_spec(self.model_cfg, self.mesh)
+        fitted = self._fit_spec(spec, pool["k"].shape)
+        return jax.device_put(pool, NamedSharding(self.mesh, fitted))
 
     def _next_key(self):
         with self._mutex:

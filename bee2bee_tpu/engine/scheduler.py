@@ -7,11 +7,18 @@ were independent batch-1 programs contending for the chip. This scheduler
 replaces both (the reference's torch path stops at EOS per request but has
 no batching at all — reference hf.py:84-108):
 
-- **One shared KV cache** ``[L, bsz, S, Hkv, hd]`` plus per-row device
-  state (current token, write offset). All rows decode together in one
-  compiled program per chunk; on TPU, decode is HBM-bandwidth-bound on the
-  weights, so batched rows ride along nearly free — this is the route to
-  the BASELINE throughput ladder, not bigger single streams.
+- **One shared KV cache** plus per-row device state (current token, write
+  offset). All rows decode together in one compiled program per chunk; on
+  TPU, decode is HBM-bandwidth-bound on the weights, so batched rows ride
+  along nearly free — this is the route to the BASELINE throughput
+  ladder, not bigger single streams. Two layouts: the rectangular
+  ``[L, bsz, S, Hkv, hd]`` cache (default), or with ``paged=True`` a
+  block pool ``[L, num_blocks, block_size, Hkv, hd]`` + per-row block
+  tables (engine/paged.py) where blocks are allocated lazily and
+  attention gathers only live blocks — per-step cache HBM traffic scales
+  with live tokens instead of ``bsz * max_seq`` (the rectangular path's
+  measured 4x idle-row tax below), and batch resize/compaction become
+  host table moves instead of device row copies.
 - **Adaptive batch bucketing**: ``bsz`` tracks the active row count in
   power-of-two buckets (grow on admission, shrink on retirement, capped at
   max_batch). Idle rows are not free — each dead row still streams its
@@ -160,7 +167,26 @@ class SchedulerStats:
     peak_active: int = 0
     prefix_hits: int = 0
     prefix_tokens_saved: int = 0
+    # paged-cache observability (all zero on the rectangular path).
+    # blocks_read_last_step is what the decode gather actually touches per
+    # layer per step (bsz * table-width bucket); live_blocks is the sum of
+    # blocks mapped by active rows — the two tracking each other is the
+    # "cache HBM reads scale with live tokens" property. The rectangular
+    # equivalent is bsz * ceil(max_seq / block_size) regardless of
+    # occupancy.
+    paged_blocks_in_use: int = 0
+    paged_blocks_hwm: int = 0
+    paged_blocks_copied: int = 0  # CoW copies (<= 1 per prefix hit)
+    paged_blocks_read_last_step: int = 0
+    paged_live_blocks: int = 0
+    paged_alloc_waits: int = 0  # admissions deferred on an exhausted pool
     history: deque = field(default_factory=lambda: deque(maxlen=64))
+
+
+class _PoolExhausted(RuntimeError):
+    """Paged block pool has no free blocks (after reclaiming prefix pins).
+    Admission backpressure, not a crash — callers requeue or fail the one
+    request, never the whole scheduler."""
 
 
 class PrefixCache:
@@ -220,7 +246,21 @@ class BatchScheduler:
 
         e = engine
         self._bsz = 1  # current batch bucket (pow2-ish, <= max_batch)
-        self._cache = e.new_cache(self._bsz)
+        # paged mode: ONE block pool for every row + host-side tables; the
+        # pool never resizes with the batch bucket (row identity lives in
+        # the block table), so grow/shrink/compaction cost zero device
+        # copies and per-step cache traffic follows the table width.
+        self._paged = bool(e.engine_cfg.paged)
+        if self._paged:
+            from .paged import BlockAllocator
+
+            self._block_size = e.engine_cfg.kv_block_size
+            self._alloc = BlockAllocator(e.pool_blocks)
+            self._tables = np.zeros((max_batch, e.blocks_per_row), np.int32)
+            self._row_blocks: list[list[int]] = [[] for _ in range(max_batch)]
+            self._cache = e.new_pool()
+        else:
+            self._cache = e.new_cache(self._bsz)
         # cur/offsets live as HOST numpy mirrors: every eager device op is
         # a blocking round trip on a tunneled chip (~1 s each, measured),
         # so the scheduler never runs eager jnp — host state goes in as
@@ -311,11 +351,20 @@ class BatchScheduler:
         # jitted device-side deep copy (explicit jnp.copy — a bare identity
         # could alias buffers): snapshots for / restores from the prefix cache
         self._copy_cache = jax.jit(lambda c: jax.tree.map(jnp.copy, c))
-        self._prefix_cache = (
-            PrefixCache(e.engine_cfg.prefix_cache_entries)
-            if e.engine_cfg.prefix_cache_entries > 0
-            else None
-        )
+        # CoW single-block copy is move_row applied to the pool's block dim
+        # (both copy one dim-1 slice src -> dst, donating the big array)
+        self._copy_block = self._move_row
+        if e.engine_cfg.prefix_cache_entries > 0:
+            if self._paged:
+                from .paged import PagedPrefixCache
+
+                self._prefix_cache = PagedPrefixCache(
+                    e.engine_cfg.prefix_cache_entries, self._alloc
+                )
+            else:
+                self._prefix_cache = PrefixCache(e.engine_cfg.prefix_cache_entries)
+        else:
+            self._prefix_cache = None
 
         self._thread = threading.Thread(
             target=self._loop, name="bee2bee-batch-scheduler", daemon=True
@@ -345,9 +394,10 @@ class BatchScheduler:
     # ------------------------------------------------------------ device fns
 
     def _decode_fn(self, params, cur, cache, offsets, temps, topks, topps,
-                   minps, key):
+                   minps, key, tables=None):
         """One chunk: decode K tokens for ALL rows. Returns
-        (cur', cache', offsets', toks [B, K])."""
+        (cur', cache', offsets', toks [B, K]). `tables` [B, MBb] selects
+        the paged-pool path: attention gathers only the mapped blocks."""
         from ..models import core
         from .sampling import sample_batched
 
@@ -356,7 +406,8 @@ class BatchScheduler:
         def step(carry, key_t):
             cur, cache, off = carry
             logits, cache = core.forward(
-                params, e.model_cfg, cur[:, None], cache, off, attn_fn=e._attn_fn()
+                params, e.model_cfg, cur[:, None], cache, off,
+                attn_fn=e._attn_fn(), block_tables=tables,
             )
             nxt = sample_batched(
                 logits[:, -1, :], key_t, temps, topks, topps, minps
@@ -369,7 +420,7 @@ class BatchScheduler:
 
     def _decode_pen_fn(
         self, params, cur, cache, offsets, counts,
-        temps, topks, topps, minps, reps, press, freqs, key,
+        temps, topks, topps, minps, reps, press, freqs, key, tables=None,
     ):
         """Penalty-carrying decode chunk: counts ride the scan carry and
         every sampled token scatters into its row. Compiled only when a
@@ -384,7 +435,8 @@ class BatchScheduler:
         def step(carry, key_t):
             cur, cache, off, counts = carry
             logits, cache = core.forward(
-                params, e.model_cfg, cur[:, None], cache, off, attn_fn=e._attn_fn()
+                params, e.model_cfg, cur[:, None], cache, off,
+                attn_fn=e._attn_fn(), block_tables=tables,
             )
             nxt = sample_batched(
                 logits[:, -1, :], key_t, temps, topks, topps, minps,
@@ -440,18 +492,90 @@ class BatchScheduler:
             req.finish = "error"
             req.events.put({"done": True, "result": None, "error": reason})
         self._queue.clear()
+        if self._paged:
+            for b, r in enumerate(self._rows):
+                if r is not None:
+                    self._release_row(b)
         self._rows = [None] * self._bsz
 
     def _reset_device_state(self):
         """Recover to an empty bucket-1 batch after a device-side failure
-        (the old cache may hold donated/poisoned buffers)."""
+        (the old cache may hold donated/poisoned buffers). In paged mode
+        the whole pool/allocator/prefix-pin state is rebuilt — the pool
+        was donated through the failed call too."""
         self._bsz = 1
-        self._cache = self.engine.new_cache(1)
+        if self._paged:
+            from .paged import BlockAllocator, PagedPrefixCache
+
+            e = self.engine
+            self._alloc = BlockAllocator(e.pool_blocks)
+            self._tables[:] = 0
+            self._row_blocks = [[] for _ in range(self.max_batch)]
+            if self._prefix_cache is not None:
+                self._prefix_cache = PagedPrefixCache(
+                    e.engine_cfg.prefix_cache_entries, self._alloc
+                )
+            self._cache = e.new_pool()
+            self.stats.paged_blocks_in_use = 0
+        else:
+            self._cache = self.engine.new_cache(1)
         self._cur = np.zeros((1,), np.int32)
         self._offsets = np.zeros((1,), np.int32)
         self._rows = [None]
         self._counts = None  # lazily reallocated by the next penalized admit
         self._row_params_dirty = True
+
+    # ------------------------------------------------------------ paged state
+
+    def _release_row(self, b: int):
+        """Drop row b's block references (shared blocks survive via their
+        other refs — prefix pins, CoW donors) and null its table row so
+        dead-row decode writes land in the null block."""
+        if not self._paged:
+            return
+        if self._row_blocks[b]:
+            self._alloc.deref(self._row_blocks[b])
+            self._row_blocks[b] = []
+        self._tables[b, :] = 0
+        self.stats.paged_blocks_in_use = self._alloc.used_count
+
+    def _alloc_or_evict(self, n: int) -> list[int]:
+        """n fresh blocks, reclaiming LRU prefix pins under pressure;
+        raises _PoolExhausted when even that can't cover it."""
+        fresh = self._alloc.alloc(n)
+        if fresh is None and self._prefix_cache is not None:
+            if self._prefix_cache.evict_for_pressure(n):
+                fresh = self._alloc.alloc(n)
+        if fresh is None:
+            raise _PoolExhausted(
+                f"paged KV pool exhausted: need {n} blocks, "
+                f"{self._alloc.free_count} free of {self._alloc.num_blocks}"
+            )
+        self.stats.paged_blocks_in_use = self._alloc.used_count
+        self.stats.paged_blocks_hwm = self._alloc.hwm
+        return fresh
+
+    def _ensure_blocks(self, b: int, upto: int):
+        """Grow row b's block table to cover positions [0, upto) — the
+        lazy allocation that makes short rows cheap. Raises _PoolExhausted
+        (with row state untouched beyond already-owned blocks)."""
+        from .paged import ceil_div
+
+        need = ceil_div(upto, self._block_size)
+        have = len(self._row_blocks[b])
+        if need <= have:
+            return
+        assert need <= self.engine.blocks_per_row, (need, upto)
+        fresh = self._alloc_or_evict(need - have)
+        self._row_blocks[b].extend(fresh)
+        self._tables[b, have:need] = fresh
+
+    def _table_width(self, nblocks: int) -> int:
+        """Pow2-bucketed block-table width (bounds compile variants) —
+        never below what any row maps, never past the physical table."""
+        from .paged import pow2_at_least
+
+        return min(pow2_at_least(nblocks), self.engine.blocks_per_row)
 
     # ------------------------------------------------------- batch resizing
 
@@ -462,14 +586,16 @@ class BatchScheduler:
         if new_bsz == old:
             return
         if new_bsz > old:
-            fresh = self.engine.new_cache(new_bsz)
-            self._cache = self._grow(fresh, self._cache)
+            if not self._paged:  # the paged pool is batch-bucket-independent
+                fresh = self.engine.new_cache(new_bsz)
+                self._cache = self._grow(fresh, self._cache)
             if self._counts is not None:
                 self._counts = self._grow(
                     self._counts_zeros(new_bsz), self._counts
                 )
         else:
-            self._cache = self._shrink(self._cache, new_bsz)
+            if not self._paged:
+                self._cache = self._shrink(self._cache, new_bsz)
             if self._counts is not None:
                 self._counts = self._counts_shrink(self._counts, new_bsz)
         cur = np.zeros((new_bsz,), np.int32)
@@ -496,9 +622,16 @@ class BatchScheduler:
             )
             if hole is None or last is None or last < hole:
                 break
-            self._cache = self._move_row(
-                self._cache, np.int32(last), np.int32(hole)
-            )
+            if self._paged:
+                # compaction is a host table move — zero device copies
+                self._tables[hole] = self._tables[last]
+                self._tables[last] = 0
+                self._row_blocks[hole] = self._row_blocks[last]
+                self._row_blocks[last] = []
+            else:
+                self._cache = self._move_row(
+                    self._cache, np.int32(last), np.int32(hole)
+                )
             if self._counts is not None:
                 self._counts = self._counts_move(
                     self._counts, np.int32(last), np.int32(hole)
@@ -510,12 +643,120 @@ class BatchScheduler:
             self._row_params_dirty = True
         A = self.active
         if A == 0 and self._bsz > 1:
-            # idle: fresh bucket-1 cache, nothing to carry over
-            self._reset_device_state()
+            if self._paged:
+                # the pool and prefix pins persist across idle — only the
+                # host bucket shrinks (no device state to rebuild)
+                self._resize(1)
+            else:
+                # idle: fresh bucket-1 cache, nothing to carry over
+                self._reset_device_state()
         elif self._bsz > 1 and A * 2 <= self._bsz // 2:
             # quarter-occupancy hysteresis: halve without thrashing at the
             # boundary (A*2 <= bsz/2  ⇔  A <= bsz/4)
             self._resize(max(1, self._bsz // 2))
+
+    def _paged_prefill(self, req: Request, b: int, bucket: int, start: int,
+                       cached) -> object:
+        """Admit one request onto the paged pool: wire row b's block table
+        (sharing a matched prefix's full blocks, CoW-copying at most its
+        final partial block), chunk-prefill the remainder straight into
+        the pool, and pin the prompt's blocks in the prefix cache.
+        Returns last_logits [1, V]. On _PoolExhausted every reference this
+        call took is released and the table row is nulled, so the caller
+        can requeue the request cleanly — and the raise happens BEFORE any
+        device work (block sufficiency is prechecked), so a requeue-retry
+        cycle under pool pressure never redoes CoW copies or prefill
+        chunks, and never double-counts prefix stats."""
+        from .paged import ceil_div, prefill_chunk_positions
+
+        e = self.engine
+        BS = self._block_size
+        n = len(req.ids)
+        if cached is None:
+            start = 0
+        row: list[int] = []
+        self._row_blocks[b] = row
+        self._tables[b, :] = 0
+        temp_ref: list[int] = []
+        try:
+            full = start // BS
+            if cached is not None:
+                shared = list(cached[:full])
+                # take our refs FIRST: the eviction below may reclaim
+                # prefix entries — including the donor — and must not free
+                # blocks this row is about to depend on
+                self._alloc.ref(shared)
+                row.extend(shared)
+                self._tables[b, :full] = shared
+                if start % BS:
+                    self._alloc.ref([int(cached[full])])
+                    temp_ref.append(int(cached[full]))
+            # sufficiency precheck before ANY device work: the write ceil
+            # drops every scatter at/past position n, so prefill claims
+            # exactly the blocks covering the prompt — ceil(n / BS) —
+            # regardless of bucket padding (fresh blocks = that minus the
+            # shared fulls; the CoW copy target is the full-th block and
+            # is counted)
+            fresh_needed = ceil_div(n, BS) - full
+            if fresh_needed > self._alloc.free_count and not (
+                self._prefix_cache is not None
+                and self._prefix_cache.evict_for_pressure(fresh_needed)
+            ):
+                raise _PoolExhausted(
+                    f"paged KV pool exhausted: admission needs "
+                    f"{fresh_needed} blocks, {self._alloc.free_count} free "
+                    f"of {self._alloc.num_blocks}"
+                )
+            if cached is not None:
+                if start % BS:
+                    src = temp_ref[0]
+                    fresh = self._alloc_or_evict(1)
+                    # the ONE CoW device copy: the borrower writes into
+                    # this block from position `start`, so it gets its own
+                    self._cache = self._copy_block(
+                        self._cache, np.int32(src), np.int32(fresh[0])
+                    )
+                    self.stats.paged_blocks_copied += 1
+                    row.append(fresh[0])
+                    self._tables[b, full] = fresh[0]
+                    self._alloc.deref(temp_ref)
+                    temp_ref.clear()
+                self.stats.prefix_hits += 1
+                self.stats.prefix_tokens_saved += start
+            # same chunk walk as the rectangular path (shared generator —
+            # the precheck above simulated exactly these windows). The
+            # capacity re-anchor can re-feed tokens BELOW `start`;
+            # recomputed K/V under a different chunk geometry is not
+            # guaranteed bit-identical, so the write floor keeps shared
+            # donor blocks read-only (attention still reads the donor's
+            # values there)
+            for pos in prefill_chunk_positions(n, start, bucket, e.max_seq_len):
+                # the write ceil (n) turns the bucket's padded-tail
+                # scatters into null-block writes, so the row only ever
+                # claims blocks covering real prompt positions
+                self._ensure_blocks(b, min(pos + bucket, n))
+                chunk = req.ids[pos:pos + bucket]
+                tokens = np.zeros((1, bucket), np.int32)
+                tokens[0, :len(chunk)] = chunk
+                tw = self._table_width(len(row))
+                tbl = np.ascontiguousarray(self._tables[b:b + 1, :tw])
+                self._cache, last_logits = e._prefill(
+                    e.params, tokens, self._cache,
+                    np.asarray([len(chunk)], np.int32),
+                    np.int32(pos), tbl, np.int32(start), np.int32(n),
+                )
+            if self._prefix_cache is not None and not self._prefix_cache.has(req.ids):
+                # pinning is free (refcounts, no snapshot): the entry
+                # claims the blocks covering exactly the prompt positions
+                self._prefix_cache.put(req.ids, row[:ceil_div(n, BS)])
+                # a capacity eviction inside put() may have freed blocks
+                self.stats.paged_blocks_in_use = self._alloc.used_count
+            return last_logits
+        except _PoolExhausted:
+            if temp_ref:
+                self._alloc.deref(temp_ref)
+            self._release_row(b)
+            raise
 
     def _admit(self):
         """Prefill queued requests into free rows, growing the batch bucket
@@ -562,47 +803,48 @@ class BatchScheduler:
                 ):
                     # np arguments throughout: jit converts them on entry
                     # (one small transfer), no eager ops, no blocking
-                    if cached is not None:
-                        row_cache = self._copy_cache(cached)
-                        self.stats.prefix_hits += 1
-                        self.stats.prefix_tokens_saved += start
+                    if self._paged:
+                        # prefill straight into the shared pool through the
+                        # row's block table; prefix hits share the donor's
+                        # full blocks CoW (engine/paged.py)
+                        last_logits = self._paged_prefill(
+                            req, b, bucket, start, cached
+                        )
                     else:
-                        start = 0
-                        row_cache = e.new_cache(1)
-                    # walk the prompt in bucket-sized chunks writing the
-                    # row cache at the running offset; a single whole-
-                    # prompt bucket is the one-chunk case of the same loop
-                    S = e.max_seq_len
-                    pos = start
-                    while True:
-                        if pos + bucket > S:
-                            # a write spanning past capacity would be
-                            # CLAMPED by dynamic_update_slice (silently
-                            # shifting K/V rows): re-anchor the final
-                            # window to end at S. Tokens below the old
-                            # pos are re-fed and recompute identical K/V
-                            # in place — static shape preserved, no
-                            # corruption. Terminates: the anchored window
-                            # reaches n (n < S always).
-                            pos = max(0, S - bucket)
-                        chunk = req.ids[pos:pos + bucket]
-                        tokens = np.zeros((1, bucket), np.int32)
-                        tokens[0, :len(chunk)] = chunk
-                        row_cache, last_logits = e._prefill(
-                            e.params, tokens, row_cache,
-                            np.asarray([len(chunk)], np.int32),
-                            np.int32(pos),
-                        )
-                        pos += len(chunk)
-                        if pos >= n:
-                            break
-                    if self._prefix_cache is not None and not self._prefix_cache.has(req.ids):
-                        # snapshot BEFORE _insert donates row_cache away;
-                        # an exact-key hit skips the redundant re-snapshot
-                        # (match already LRU-touched it)
-                        self._prefix_cache.put(
-                            req.ids, self._copy_cache(row_cache)
-                        )
+                        if cached is not None:
+                            row_cache = self._copy_cache(cached)
+                            self.stats.prefix_hits += 1
+                            self.stats.prefix_tokens_saved += start
+                        else:
+                            start = 0
+                            row_cache = e.new_cache(1)
+                        # walk the prompt in bucket-sized chunks writing the
+                        # row cache at the running offset; a single whole-
+                        # prompt bucket is the one-chunk case of the same
+                        # loop. The walk (incl. the capacity re-anchor,
+                        # where re-fed tokens recompute identical K/V in
+                        # the PRIVATE row cache) is the shared generator
+                        # paged admission prechecks against.
+                        from .paged import prefill_chunk_positions
+
+                        for pos in prefill_chunk_positions(
+                            n, start, bucket, e.max_seq_len
+                        ):
+                            chunk = req.ids[pos:pos + bucket]
+                            tokens = np.zeros((1, bucket), np.int32)
+                            tokens[0, :len(chunk)] = chunk
+                            row_cache, last_logits = e._prefill(
+                                e.params, tokens, row_cache,
+                                np.asarray([len(chunk)], np.int32),
+                                np.int32(pos),
+                            )
+                        if self._prefix_cache is not None and not self._prefix_cache.has(req.ids):
+                            # snapshot BEFORE _insert donates row_cache away;
+                            # an exact-key hit skips the redundant re-snapshot
+                            # (match already LRU-touched it)
+                            self._prefix_cache.put(
+                                req.ids, self._copy_cache(row_cache)
+                            )
                     # one arg tuple for both branches: a marshalling
                     # change must hit penalized and plain rows identically
                     sample_args = [
@@ -638,7 +880,27 @@ class BatchScheduler:
                             np.asarray([req.frequency_penalty], np.float32),
                         ]
                     first = self._sample_first(*sample_args)
-                    self._cache = self._insert(self._cache, row_cache, np.int32(b))
+                    if not self._paged:
+                        self._cache = self._insert(self._cache, row_cache, np.int32(b))
+            except _PoolExhausted as err:
+                # backpressure, not failure: _paged_prefill released the
+                # row's blocks before raising. With work in flight (or a
+                # burst just placed) blocks WILL free — requeue at the
+                # front and admit again after the next window. With
+                # nothing in flight and nothing left to evict, this
+                # request can never fit the configured pool: fail it.
+                if self.active > 0 or placed:
+                    with self._cond:
+                        self._queue.appendleft(req)
+                    self.stats.paged_alloc_waits += 1
+                    break
+                req.finish = "error"
+                req.events.put({
+                    "done": True, "result": None,
+                    "error": f"admission failed: {err} "
+                             "(kv_pool_blocks too small for this request)",
+                })
+                continue
             except Exception as err:
                 # the popped request is in neither _queue nor _rows: fail it
                 # here or its caller hangs; then let _loop's handler recover
@@ -672,6 +934,7 @@ class BatchScheduler:
                 )
             if req.done:  # instant stop/zero-budget: free the row again
                 self._rows[b] = None
+                self._release_row(b)
                 self._retire(req)
                 continue
             if req.penalized and self._counts is not None:
@@ -732,13 +995,50 @@ class BatchScheduler:
             w = min(w, 2)
         return max(1, min(w, e.engine_cfg.max_inflight_chunks))
 
+    def _prepare_window_tables(self, W: int, K: int):
+        """Paged: grow every active row's block table to cover this
+        window's writes (positions < offset + W*K), then build the
+        [bsz, tw] device argument at the pow2-bucketed width. A row the
+        pool cannot cover even after reclaiming prefix pins fails alone
+        (explicitly undersized kv_pool_blocks); returns None when no
+        active rows survive."""
+        for b, req in enumerate(self._rows):
+            if req is None:
+                continue
+            try:
+                self._ensure_blocks(b, int(self._offsets[b]) + W * K)
+            except _PoolExhausted as err:
+                self._rows[b] = None
+                self._release_row(b)
+                self._row_params_dirty = True
+                self._retire_error(req, str(err))
+        live = [
+            len(self._row_blocks[b])
+            for b, r in enumerate(self._rows) if r is not None
+        ]
+        if not live:
+            return None
+        tw = self._table_width(max(live))
+        # the two proportionality counters: what the gather reads vs what
+        # is actually mapped (tests + bench assert they track each other)
+        self.stats.paged_live_blocks = sum(live)
+        self.stats.paged_blocks_read_last_step = self._bsz * tw
+        self.stats.paged_blocks_in_use = self._alloc.used_count
+        return np.ascontiguousarray(self._tables[:self._bsz, :tw])
+
     def _step(self):
         """One readback window: dispatch W decode chunks (async, chained
         on device), sync once, process W*decode_chunk tokens per row."""
         e = self.engine
-        temps, topks, topps = self._row_sampling_arrays()
         W = self._window_size()
         K = e.engine_cfg.decode_chunk
+        tables = None
+        if self._paged:
+            tables = self._prepare_window_tables(W, K)
+            if tables is None:
+                self._compact_and_shrink()
+                return
+        temps, topks, topps = self._row_sampling_arrays()
         pen = self._counts is not None and any(
             r is not None and r.penalized for r in self._rows
         )
@@ -761,13 +1061,13 @@ class BatchScheduler:
                             e.params, cur_d, self._cache, off_d, self._counts,
                             temps, topks, topps, minps,
                             self._reps, self._press, self._freqs,
-                            e._next_key(),
+                            e._next_key(), tables,
                         )
                     )
                 else:
                     cur_d, self._cache, off_d, toks = self._decode(
                         e.params, cur_d, self._cache, off_d,
-                        temps, topks, topps, minps, e._next_key(),
+                        temps, topks, topps, minps, e._next_key(), tables,
                     )
                 toks_parts.append(toks)
             parts_host = [np.asarray(x) for x in jax.device_get(toks_parts)]
@@ -800,6 +1100,7 @@ class BatchScheduler:
                 })
             if req.done:
                 self._rows[b] = None
+                self._release_row(b)
                 self._row_params_dirty = True
                 self._retire(req)
                 retired_any = True
@@ -813,3 +1114,16 @@ class BatchScheduler:
             {"new_tokens": len(req.out_ids), "chunks": req.chunks_decoded}
         )
         req.events.put({"done": True, "result": self.engine._build_result(req)})
+
+    def _retire_error(self, req: Request, reason: str):
+        """Error-terminate an ADMITTED row with full retirement accounting
+        (retired/history/t_done) — `admitted - retired` must not drift for
+        rows the pool failed mid-decode."""
+        req.finish = "error"
+        req.timing.t_done = time.perf_counter()
+        self.stats.retired += 1
+        self.stats.history.append(
+            {"new_tokens": len(req.out_ids), "chunks": req.chunks_decoded,
+             "error": True}
+        )
+        req.events.put({"done": True, "result": None, "error": reason})

@@ -9,8 +9,11 @@ reference's model-tag matching (reference services.py:136-151).
 from __future__ import annotations
 
 import json
+import logging
 from dataclasses import dataclass, fields, replace
 from pathlib import Path
+
+logger = logging.getLogger("bee2bee_tpu.models.config")
 
 
 @dataclass(frozen=True)
@@ -864,7 +867,15 @@ def config_from_hf(d: dict, name: str | None = None) -> ModelConfig:
                     "represent it"
                 )
         else:
-            every, res = 6, (0, 1, 2, 3, 4)
+            # no layer_types (older transformers writers): the pattern key
+            # is sliding_window_pattern (Gemma3TextConfig default 6),
+            # is_sliding = (i+1) % pattern != 0 — i.e. every pattern-th
+            # layer is global, the rest are local. Hardcoding 5-local-1-
+            # global here would silently mis-mask (and mis-rope) any
+            # checkpoint shipping a non-default pattern.
+            pattern = int(d.get("sliding_window_pattern") or 6)
+            every = max(pattern, 1)
+            res = tuple(r for r in range(every) if (r + 1) % every != 0)
         window = d.get("sliding_window", 4096)
         if not res:
             # no sliding layers at all (e.g. a long-context fine-tune):
@@ -940,17 +951,40 @@ def config_from_hf(d: dict, name: str | None = None) -> ModelConfig:
             )
         if hd and hd != d["hidden_size"] // n_heads:
             kw["head_dim_override"] = hd
-        if mt in ("mistral", "mixtral") and d.get("sliding_window"):
+        if mt == "mistral":
+            # an ABSENT key means MistralConfig's class default (4096) —
+            # the same "config.json is a diff against class defaults" rule
+            # gemma-2 follows below; an explicit null stays disabled
+            window = d.get("sliding_window", 4096)
+            if window:
+                kw["sliding_window"] = window
+        elif mt == "mixtral" and d.get("sliding_window"):
+            # MixtralConfig's class default is null — absent means off
             kw["sliding_window"] = d["sliding_window"]
         if (mt in ("qwen2", "qwen3") and d.get("use_sliding_window")
-                and d.get("sliding_window")
-                and int(d.get("max_window_layers") or 0) <= 0):
-            # HF windows only layers >= max_window_layers; our config
-            # windows EVERY layer, so a partial-window checkpoint
-            # (max_window_layers > 0) is served full-attention instead —
-            # exact for prompts within the window and matches HF on the
-            # majority (first) layers, vs. silently wrong everywhere
-            kw["sliding_window"] = d["sliding_window"]
+                and d.get("sliding_window")):
+            mwl = int(d.get("max_window_layers") or 0)
+            if mwl <= 0:
+                kw["sliding_window"] = d["sliding_window"]
+            elif mwl >= int(d["num_hidden_layers"]):
+                # HF windows only layers >= max_window_layers, so a cap at
+                # (or past) the layer count windows NOTHING — full
+                # attention is bit-exact, not a compromise: stay silent
+                pass
+            else:
+                # HF windows only layers >= max_window_layers; our config
+                # windows EVERY layer, so a partial-window checkpoint
+                # (max_window_layers > 0) is served full-attention instead —
+                # exact for prompts within the window and matches HF on the
+                # majority (first) layers, vs. silently wrong everywhere.
+                # Say so at serve time: this is a fidelity compromise.
+                logger.warning(
+                    "%s: dropping the partial sliding-window schedule "
+                    "(sliding_window=%s, max_window_layers=%s) — serving "
+                    "full attention on every layer; long-context logits "
+                    "will diverge from HF beyond the window",
+                    nm, d.get("sliding_window"), d.get("max_window_layers"),
+                )
         if mt in ("gemma", "gemma2"):
             act = d.get("hidden_activation") or d.get("hidden_act") or "gelu_pytorch_tanh"
             kw.update(
@@ -993,6 +1027,17 @@ def config_for_checkpoint(path: str | Path, name: str | None = None) -> ModelCon
     if native.exists():
         d = json.loads(native.read_text())
         known = {f.name for f in fields(ModelConfig)}
+        unknown = sorted(set(d) - known)
+        if unknown:
+            # a checkpoint saved by a newer version may carry architecture
+            # switches this build doesn't know; dropping them silently
+            # would serve wrong logits with no signal
+            logger.warning(
+                "%s: ignoring unknown model_config.json keys %s — if these "
+                "are architecture switches from a newer writer, the served "
+                "logits will diverge",
+                native, unknown,
+            )
         return ModelConfig(**{k: v for k, v in d.items() if k in known})
     hf = path / "config.json"
     if hf.exists():

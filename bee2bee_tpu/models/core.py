@@ -620,6 +620,9 @@ def forward(
     offset,  # [] or [B] int32: write position of input_ids[:, 0] in the cache
     remat: bool = False,  # jax.checkpoint each layer (training: HBM for FLOPs)
     attn_fn=None,  # custom attention (ops.flash / parallel.ring); None = dense
+    block_tables=None,  # [B, MB] int32: paged cache — see below
+    paged_write_floor=None,  # [] int32: drop paged WRITES below this position
+    paged_write_ceil=None,  # [] int32: drop paged WRITES at/after this position
 ):
     """Run a [B, T] token chunk. Returns (logits [B, T, V], new_cache).
 
@@ -627,6 +630,30 @@ def forward(
     attention looks at cache positions < offset+T (causally within the
     chunk). Without a cache (cache=None): plain causal self-attention over
     the chunk — the training/scoring path.
+
+    With ``block_tables`` [B, MB], the cache is a PAGED pool
+    {"k","v"}: [L, num_blocks, block_size, Hkv, hd] (init_paged_pool) and
+    row b's logical cache position p lives at pool slot
+    (block_tables[b, p // block_size], p % block_size). Writes scatter the
+    chunk into the mapped blocks; attention gathers ONLY the MB mapped
+    blocks per row, so cache HBM traffic per step scales with the table
+    width the caller passes (live blocks, bucketed) instead of the pool
+    capacity. The position→slot map is order-preserving, so every mask
+    (causal, sliding-window, gemma alternation) and the ALiBi bias apply
+    unchanged over the gathered [B, MB*block_size] view. Table entries
+    past a row's live extent must map to blocks whose positions are
+    causally masked (the engine pads with the reserved null block 0).
+
+    ``paged_write_floor`` / ``paged_write_ceil`` (paged only): scatter
+    writes outside [floor, ceil) are redirected to the null block — reads
+    still see the existing pool content. The floor protects copy-on-write
+    shares (the engine's chunked-prefill capacity re-anchor can re-feed
+    tokens BELOW a share point, and recomputed K/V under a different
+    chunk geometry is not guaranteed bit-identical, so shared donor
+    blocks must stay read-only). The ceil drops a prefill bucket's padded
+    tail, so a short prompt never needs pool blocks past
+    ceil(prompt_len / block_size) — pad positions are causally masked and
+    decode overwrites its own positions before reading them.
     """
     B, T = input_ids.shape
 
@@ -636,7 +663,21 @@ def forward(
 
     x = embed_tokens(params, cfg, input_ids, positions)
 
-    S = cache["k"].shape[2] if cache is not None else None
+    if block_tables is not None:
+        bt = jnp.asarray(block_tables, jnp.int32)
+        BS = cache["k"].shape[2]  # pool block size
+        S = bt.shape[1] * BS  # gathered view width = logical positions
+        wfloor = (
+            jnp.asarray(paged_write_floor, jnp.int32)
+            if paged_write_floor is not None else None
+        )
+        wceil = (
+            jnp.asarray(paged_write_ceil, jnp.int32)
+            if paged_write_ceil is not None else None
+        )
+    else:
+        bt = None
+        S = cache["k"].shape[2] if cache is not None else None
     layer_mask = make_layer_mask(cfg, positions, T, S)
 
     def rope_flag(layer_idx):
@@ -661,6 +702,38 @@ def forward(
             # write this chunk's K/V at [offset, offset+T) per batch row,
             # then attend over the whole cache row
             nonlocal cache_k, cache_v
+
+            if bt is not None:
+                # paged: scatter each position into its mapped (block, slot)
+                # and attend over the gathered per-row block views. Rows
+                # own disjoint blocks (the engine's allocator invariant),
+                # so the scatter indices never collide across rows except
+                # in the garbage null block 0.
+                Hkv, hd = k.shape[-2], k.shape[-1]
+                blk = jnp.take_along_axis(bt, positions // BS, axis=1)
+                slot = positions % BS  # [B, T]
+                if wfloor is not None:
+                    # re-fed positions below the share point write to the
+                    # null block instead — shared donor blocks stay
+                    # read-only (their content is already correct)
+                    blk = jnp.where(positions >= wfloor, blk, 0)
+                if wceil is not None:
+                    # the bucket's padded tail writes to the null block —
+                    # short prompts never claim blocks past their length
+                    # (an out-of-table lookup above may have produced a
+                    # fill value; this rewrites it to the real null block)
+                    blk = jnp.where(positions < wceil, blk, 0)
+                ck = cache_k[layer_idx].at[blk, slot].set(
+                    k.astype(cache_k.dtype)
+                )
+                cv = cache_v[layer_idx].at[blk, slot].set(
+                    v.astype(cache_v.dtype)
+                )
+                cache_k = cache_k.at[layer_idx].set(ck)
+                cache_v = cache_v.at[layer_idx].set(cv)
+                k_eff = ck[bt].reshape(B, S, Hkv, hd)
+                v_eff = cv[bt].reshape(B, S, Hkv, hd)
+                return k_eff, v_eff
 
             def write(cache_row, new_row, start):
                 return lax.dynamic_update_slice(
@@ -761,4 +834,16 @@ def init_cache(cfg: ModelConfig, batch: int, max_len: int | None = None, dtype=j
     """Preallocate the fixed-capacity KV cache: {"k","v"}: [L,B,S,Hkv,hd]."""
     S = max_len or cfg.max_seq_len
     shape = (cfg.n_layers, batch, S, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def init_paged_pool(
+    cfg: ModelConfig, num_blocks: int, block_size: int, dtype=jnp.bfloat16
+):
+    """Preallocate the paged KV block pool:
+    {"k","v"}: [L, num_blocks, block_size, Hkv, hd]. Block 0 is the
+    engine's reserved null block (padding target for table entries past a
+    row's live extent); rows map logical positions onto blocks via the
+    block tables forward() takes."""
+    shape = (cfg.n_layers, num_blocks, block_size, cfg.n_kv_heads, cfg.head_dim)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}

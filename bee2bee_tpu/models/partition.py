@@ -180,6 +180,19 @@ def cache_spec(
     return P(None, "data", seq, "model", None)
 
 
+def paged_cache_spec(cfg: ModelConfig | None = None, mesh: Mesh | None = None) -> P:
+    """Paged KV pool [L, num_blocks, block_size, Hkv, hd]: kv heads on
+    `model`, like the rectangular cache — attention over gathered blocks
+    stays collective-free per shard. The block and slot dims are never
+    sharded: any row gathers arbitrary pool blocks, so splitting them
+    would turn every gather into a cross-device reshard (the engine
+    refuses paged + seq-sharded meshes for the same reason). MQA meshes
+    (kv_replicated) replicate the kv-head dim to match wk/wv."""
+    if cfg is not None and mesh is not None and kv_replicated(cfg, mesh):
+        return P(None, None, None, None, None)
+    return P(None, None, None, "model", None)
+
+
 def flat_partition_specs(
     params,
     mesh_axes: dict[str, int] | None = None,

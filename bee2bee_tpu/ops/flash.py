@@ -26,6 +26,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+from ..compat import shard_map
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -245,7 +246,7 @@ def make_flash_attn_fn(mesh=None, interpret: bool | None = None):
             jnp.asarray(offset if offset is not None else 0, jnp.int32).reshape(-1),
             (B,),
         )
-        mapped = jax.shard_map(
+        mapped = shard_map(
             lambda q_, k_, v_, o_: flash_attention(
                 q_, k_, v_, offset=o_, interpret=interpret
             ),

@@ -31,6 +31,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models import core
+from ..compat import shard_map
 from ..models.config import ModelConfig
 
 NEG_INF = -1e30
@@ -103,7 +104,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "seq"):
     )
     spec = P(batch_axis, axis_name, None, None)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         partial(ring_attention_local, axis_name=axis_name, axis_size=n),
         mesh=mesh,
         in_specs=(spec, spec, spec),
@@ -166,7 +167,7 @@ def make_sp_forward(cfg: ModelConfig, mesh: Mesh, remat: bool = False):
         lambda: core.init_params(cfg, jax.random.key(0))
     ))
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         local_fn,
         mesh=mesh,
         in_specs=(param_specs, P("data", "seq")),

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
+from ..compat import shard_map
 
 NEG_INF = -1e30
 
@@ -82,7 +83,7 @@ def make_sp_attn_fn(mesh):
         h_ax = "model" if tp > 1 else None
         kv_ax = "model" if tp > 1 and Hkv % tp == 0 else None
 
-        mapped = jax.shard_map(
+        mapped = shard_map(
             lambda q_, k_, v_, m_: _partial_attention(q_, k_, v_, m_, "seq"),
             mesh=mesh,
             in_specs=(

@@ -204,6 +204,50 @@ def bench_model(name: str, max_seq_len: int, concurrencies=(1, 8),
         eng.close()
 
 
+def bench_paged(msl: int, new_tokens: int) -> dict:
+    """Paged-cache rung: ONE active request on a max_batch=8 engine — the
+    exact configuration where the rectangular cache paid its measured 4x
+    idle-row tax. Records the paged gather counters (what the decode step
+    actually read vs the rectangular equivalent) plus single-stream tok/s
+    so rectangular-vs-paged tracks across rounds."""
+    import time as _time
+
+    from bee2bee_tpu.engine import EngineConfig, InferenceEngine
+    from bee2bee_tpu.engine.paged import ceil_div
+
+    eng = InferenceEngine(
+        "distilgpt2",
+        engine_config=EngineConfig(max_seq_len=msl, max_batch=8, paged=True),
+    )
+    try:
+        prompt = [1 + j % 500 for j in range(PROMPT_LEN)]
+        eng.generate(prompt, max_new_tokens=8, temperature=0.0)  # warm/compile
+        t0 = _time.perf_counter()
+        r = eng.generate(prompt, max_new_tokens=new_tokens, temperature=0.0)
+        wall = _time.perf_counter() - t0
+        st = eng.scheduler.stats
+        bs = eng.engine_cfg.kv_block_size
+        out = {
+            "tok_per_s": round(r.new_tokens / wall, 2) if wall > 0 else 0.0,
+            "block_size": bs,
+            "blocks_read_per_step": st.paged_blocks_read_last_step,
+            "live_blocks": st.paged_live_blocks,
+            # what the same one-active-row step reads on the rectangular
+            # path: every row streams full capacity
+            "rect_equiv_blocks_per_step": 8 * ceil_div(eng.max_seq_len, bs),
+            "blocks_hwm": st.paged_blocks_hwm,
+            "blocks_copied": st.paged_blocks_copied,
+        }
+        log(
+            f"paged rung: {out['tok_per_s']} tok/s single-stream at "
+            f"max_batch=8; {out['blocks_read_per_step']} blocks/step read "
+            f"vs rectangular-equivalent {out['rect_equiv_blocks_per_step']}"
+        )
+        return out
+    finally:
+        eng.close()
+
+
 def bench_reference_path() -> float:
     """The reference's hot loop: HF transformers greedy generate on torch CPU
     (reference hf.py:35-44 minus tokenization — token ids in, ids out)."""
@@ -253,6 +297,15 @@ def main() -> None:
         "distilgpt2", max_seq_len=msl, concurrencies=(1, 8), new_tokens=tokens
     )
     extras["distilgpt2"] = distil
+
+    # paged KV cache counters (ISSUE 1 acceptance: per-step cache reads
+    # proportional to live blocks; one-active-row at max_batch=8 must not
+    # pay the rectangular idle-row tax)
+    try:
+        extras["paged_distilgpt2"] = bench_paged(msl, tokens)
+    except Exception as e:  # noqa: BLE001 — the rung must not kill the bench
+        log(f"paged rung failed: {e}")
+        extras["paged_distilgpt2"] = {"error": str(e)}
 
     if platform == "tpu":
         def rung(key: str, **kw) -> None:

@@ -11,6 +11,37 @@ import inspect  # noqa: E402
 
 import pytest  # noqa: E402
 
+# Persistent XLA compile cache: every test that builds a fresh
+# InferenceEngine creates NEW jax.jit objects, so identical tiny-model
+# programs recompile per test without it (the in-memory jit cache is per
+# closure). The persistent cache dedupes by HLO hash across engines and
+# across files — measured ~2.5x on the second identical engine+generate
+# in-process — which is what keeps the tier-1 suite inside its wall-clock
+# budget. The directory is PER RUN (unless BEE2BEE_JAX_CACHE pins one):
+# a run killed mid-write (the tier-1 timeout sends SIGKILL) leaves a
+# truncated entry, and XLA hard-aborts the next process that loads it —
+# a shared /tmp path turned one killed run into a poisoned suite.
+# Never fatal — a read-only /tmp just skips it.
+try:  # pragma: no cover - environment-dependent
+    import atexit  # noqa: E402
+    import shutil  # noqa: E402
+    import tempfile  # noqa: E402
+
+    import jax
+
+    _cache_dir = os.environ.get("BEE2BEE_JAX_CACHE")
+    if not _cache_dir:
+        _cache_dir = tempfile.mkdtemp(prefix="bee2bee_jax_cache_")
+        atexit.register(shutil.rmtree, _cache_dir, ignore_errors=True)
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+    try:
+        jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+    except Exception:
+        pass  # older jax: flag absent, executables still cached
+except Exception:
+    pass
+
 
 def pytest_pyfunc_call(pyfuncitem):
     """Run `async def` tests via asyncio.run (pytest-asyncio isn't in this

@@ -255,6 +255,79 @@ def test_gemma3_degenerate_layer_types():
     assert bool(is_sliding_layer(cfg2, 0)) and not bool(is_sliding_layer(cfg2, 1))
 
 
+def test_gemma3_sliding_window_pattern_without_layer_types():
+    """No layer_types (older transformers writers): the local/global
+    pattern comes from sliding_window_pattern (is_sliding = (i+1) %
+    pattern != 0), NOT a hardcoded 5-local-1-global — a pattern-4
+    checkpoint would otherwise get wrong masks AND wrong per-layer rope
+    thetas (ADVICE r5 medium)."""
+    base = {"model_type": "gemma3_text", "vocab_size": 512,
+            "hidden_size": 64, "num_hidden_layers": 8,
+            "num_attention_heads": 4, "num_key_value_heads": 2,
+            "head_dim": 16, "intermediate_size": 128}
+    cfg = config_from_hf(dict(base))  # default pattern 6
+    assert cfg.sliding_window_every == 6
+    assert cfg.sliding_window_residues == (0, 1, 2, 3, 4)
+    cfg4 = config_from_hf(dict(base, sliding_window_pattern=4))
+    assert cfg4.sliding_window_every == 4
+    assert cfg4.sliding_window_residues == (0, 1, 2)
+    # pattern 1 = every layer global: the window must disable entirely
+    cfg1 = config_from_hf(dict(base, sliding_window_pattern=1))
+    assert cfg1.sliding_window is None
+    assert cfg1.sliding_window_residues == ()
+
+
+def test_mistral_absent_window_key_means_class_default():
+    """transformers serializes config.json as a diff against class
+    defaults: an ABSENT mistral sliding_window means MistralConfig's 4096,
+    an explicit null means disabled (ADVICE r5: the old code served full
+    attention for default-trimmed configs)."""
+    m = {"model_type": "mistral", "vocab_size": 512, "hidden_size": 64,
+         "num_hidden_layers": 2, "num_attention_heads": 4,
+         "intermediate_size": 128}
+    assert config_from_hf(dict(m)).sliding_window == 4096
+    assert config_from_hf(dict(m, sliding_window=None)).sliding_window is None
+    assert config_from_hf(dict(m, sliding_window=8)).sliding_window == 8
+    # mixtral's class default IS null: absent stays disabled
+    x = dict(m, model_type="mixtral", num_local_experts=4,
+             num_experts_per_tok=2)
+    assert config_from_hf(x).sliding_window is None
+
+
+def test_qwen_partial_window_drop_warns(caplog):
+    """Dropping a max_window_layers>0 schedule is a fidelity compromise
+    and must be visible at serve time, not only in a code comment."""
+    import logging as _logging
+
+    q = {"model_type": "qwen2", "vocab_size": 512, "hidden_size": 64,
+         "num_hidden_layers": 4, "num_attention_heads": 4,
+         "intermediate_size": 128, "use_sliding_window": True,
+         "sliding_window": 8, "max_window_layers": 2}
+    with caplog.at_level(_logging.WARNING, logger="bee2bee_tpu.models.config"):
+        cfg = config_from_hf(q)
+    assert cfg.sliding_window is None
+    assert any("partial sliding-window" in r.message for r in caplog.records)
+
+
+def test_unknown_native_config_keys_warn(tmp_path, caplog):
+    """A model_config.json written by a newer version with an unknown
+    architecture switch must WARN when the key is filtered, not silently
+    serve with the switch disabled."""
+    import json as _json
+    import logging as _logging
+
+    d = {"name": "x", "vocab_size": 512, "d_model": 64, "n_layers": 2,
+         "n_heads": 4, "n_kv_heads": 2, "d_ff": 128,
+         "hyperbolic_attention": True}
+    (tmp_path / "model_config.json").write_text(_json.dumps(d))
+    from bee2bee_tpu.models.config import config_for_checkpoint
+
+    with caplog.at_level(_logging.WARNING, logger="bee2bee_tpu.models.config"):
+        cfg = config_for_checkpoint(tmp_path)
+    assert cfg.name == "x"
+    assert any("hyperbolic_attention" in r.message for r in caplog.records)
+
+
 def test_stage_runner_serves_unregistered_checkpoint(tmp_path):
     """serve-stage --model auto: a pipeline stage worker resolves an
     unregistered architecture from the checkpoint's config.json, same as

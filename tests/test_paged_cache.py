@@ -1,0 +1,473 @@
+"""Paged KV cache (engine/paged.py + core.forward block_tables path):
+
+- token parity vs the rectangular cache (greedy, same seeds) across
+  model families including GQA/MQA, sliding windows, and the gemma-3
+  dual-rope/alternating-mask stack;
+- free-list allocator exhaustion -> admission backpressure -> reuse;
+- block-level copy-on-write prefix sharing (at most ONE partial-block
+  copy per hit), including the donor-retires-first ordering;
+- per-step cache reads proportional to LIVE blocks, not
+  max_batch * max_seq — the idle-row tax the paged pool exists to kill.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from bee2bee_tpu.engine import EngineConfig, InferenceEngine
+from bee2bee_tpu.engine.paged import (
+    BlockAllocator,
+    PagedPrefixCache,
+    ceil_div,
+    pow2_at_least,
+)
+
+KW = dict(
+    max_seq_len=128, dtype="float32", cache_dtype="float32",
+    decode_chunk=4, prefill_buckets=(16, 32, 64),
+)
+
+
+def _prompt(seed: int, n: int = 37) -> list[int]:
+    return list(np.random.default_rng(seed).integers(3, 500, size=n))
+
+
+# ------------------------------------------------------------- unit: allocator
+
+
+def test_block_allocator_alloc_free_refcount():
+    a = BlockAllocator(6)  # block 0 reserved -> 5 usable
+    got = a.alloc(3)
+    assert got is not None and len(set(got)) == 3 and 0 not in got
+    assert a.used_count == 3 and a.free_count == 2
+    assert a.alloc(3) is None  # all-or-nothing: no partial leak
+    assert a.free_count == 2
+    a.ref([got[0]])
+    assert a.deref([got[0]]) == 0  # still referenced by the row
+    assert a.deref(got) == 3  # refs drop to zero -> all freed
+    assert a.free_count == 5 and a.hwm == 3
+    # freed ids come back out
+    again = a.alloc(5)
+    assert again is not None and sorted(again) == sorted(range(1, 6))
+
+
+def test_paged_prefix_cache_pins_and_evicts():
+    a = BlockAllocator(8)
+    pc = PagedPrefixCache(2, a)
+    b1, b2, b3 = a.alloc(2), a.alloc(2), a.alloc(2)
+    pc.put([1, 2, 3], b1)
+    pc.put([4, 5, 6], b2)
+    assert a.refcount(b1[0]) == 2  # pinned on top of the row's ref
+    m, blocks = pc.match([1, 2, 3, 9])
+    assert m == 3 and tuple(blocks) == tuple(b1)
+    # capacity eviction drops the LRU pin ([4,5,6] — match touched [1,2,3])
+    pc.put([7, 8, 9], b3)
+    assert len(pc) == 2 and a.refcount(b2[0]) == 1
+    # rows release; pinned blocks survive until eviction under pressure
+    a.deref(b1), a.deref(b2), a.deref(b3)
+    assert a.free_count == 2 + 1  # b2 fully freed, b1/b3 pinned...
+    assert pc.evict_for_pressure(7)
+    assert a.free_count == 7 and len(pc) == 0
+
+
+def test_pow2_and_ceil_helpers():
+    assert [pow2_at_least(n) for n in (1, 2, 3, 5, 8, 9)] == [1, 2, 4, 8, 8, 16]
+    assert ceil_div(7, 4) == 2 and ceil_div(8, 4) == 2
+
+
+# -------------------------------------------------------------- token parity
+
+
+@pytest.mark.parametrize(
+    "name",
+    [
+        "tiny-llama",   # GQA (2 kv heads / 4 q heads)
+        "tiny-gemma",   # MQA single kv head
+        "tiny-gemma3",  # alternating local/global masks + dual-theta rope,
+                        # sliding window 4 < prompt
+        # extended coverage outside the tier-1 time budget:
+        pytest.param("tiny-qwen", marks=pytest.mark.slow),     # qkv bias
+        pytest.param("tiny-mistral", marks=pytest.mark.slow),  # window only
+    ],
+)
+def test_paged_matches_rectangular_greedy(name):
+    prompt = _prompt(0, n=21)  # crosses a block boundary (block_size 16)
+    ref = InferenceEngine(name, engine_config=EngineConfig(**KW))
+    want = ref.generate(prompt, max_new_tokens=10, temperature=0.0).token_ids
+    ref.close()
+
+    eng = InferenceEngine(name, engine_config=EngineConfig(paged=True, **KW))
+    got = eng.generate(prompt, max_new_tokens=10, temperature=0.0).token_ids
+    eng.close()
+    assert got == want
+
+
+@pytest.mark.slow
+def test_paged_matches_rectangular_sampled_and_penalized():
+    """Same rng seed => same token stream: the sampled path reads the same
+    logits, and penalty counts ride independently of the cache layout."""
+    prompt = _prompt(3)
+    kwargs = dict(max_new_tokens=10, temperature=0.9, top_k=40, top_p=0.95,
+                  repetition_penalty=1.3)
+    ref = InferenceEngine("tiny-llama", engine_config=EngineConfig(**KW))
+    want = ref.generate(prompt, **kwargs).token_ids
+    ref.close()
+    eng = InferenceEngine(
+        "tiny-llama", engine_config=EngineConfig(paged=True, **KW)
+    )
+    got = eng.generate(prompt, **kwargs).token_ids
+    eng.close()
+    assert got == want
+
+
+def test_paged_concurrent_batch_matches_sequential():
+    eng = InferenceEngine(
+        "tiny-llama",
+        engine_config=EngineConfig(paged=True, max_batch=8, **KW),
+    )
+    try:
+        prompts = [_prompt(10 + i, n=12 + 3 * i) for i in range(4)]
+        budgets = [6, 8, 12, 16]
+        sequential = [
+            eng.generate(p, max_new_tokens=m, temperature=0.0).token_ids
+            for p, m in zip(prompts, budgets)
+        ]
+        results: list = [None] * 4
+
+        def run(i):
+            results[i] = eng.generate(
+                prompts[i], max_new_tokens=budgets[i], temperature=0.0
+            )
+
+        threads = [threading.Thread(target=run, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i in range(4):
+            assert results[i].token_ids == sequential[i], f"row {i} diverged"
+        assert eng.scheduler.stats.peak_active >= 2
+        # everything retired -> every block back on the free list
+        assert eng.scheduler.stats.paged_blocks_in_use == 0
+    finally:
+        eng.close()
+
+
+@pytest.mark.slow  # the chunked-prefill composition also rides tier-1 via
+# test_paged_chat_turn_extension_matches_fresh_engine (prefill_chunk=16)
+def test_paged_with_chunked_prefill_matches():
+    prompt = _prompt(5, n=50)
+    ref = InferenceEngine("tiny-llama", engine_config=EngineConfig(**KW))
+    want = ref.generate(prompt, max_new_tokens=8, temperature=0.0).token_ids
+    ref.close()
+    eng = InferenceEngine(
+        "tiny-llama",
+        engine_config=EngineConfig(paged=True, prefill_chunk=16, **KW),
+    )
+    got = eng.generate(prompt, max_new_tokens=8, temperature=0.0).token_ids
+    eng.close()
+    assert got == want
+
+
+# ------------------------------------------------- exhaustion / backpressure
+
+
+def test_pool_exhaustion_queues_and_reuses_freed_blocks():
+    """A pool sized for ~1.5 rows must still complete 4 concurrent
+    requests — admissions wait for retirements to free blocks, and the
+    high-water mark proves the free list was recycled, not grown."""
+    eng = InferenceEngine(
+        "tiny-llama",
+        engine_config=EngineConfig(
+            paged=True, max_batch=4, kv_pool_blocks=9, kv_block_size=8,
+            max_seq_len=64, dtype="float32", cache_dtype="float32",
+            decode_chunk=4, prefill_buckets=(16,),
+        ),
+    )
+    try:
+        results: list = [None] * 4
+
+        def run(i):
+            results[i] = eng.generate(
+                [5 + i] * 20, max_new_tokens=10, temperature=0.0
+            )
+
+        threads = [threading.Thread(target=run, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert all(r is not None and r.new_tokens == 10 for r in results)
+        st = eng.scheduler.stats
+        assert st.paged_alloc_waits > 0, "pool never backpressured"
+        assert st.paged_blocks_hwm <= 8  # never exceeded the pool
+        assert st.paged_blocks_in_use == 0  # free-list fully recovered
+        # the engine keeps serving after the contention
+        r = eng.generate([9] * 10, max_new_tokens=4, temperature=0.0)
+        assert r.new_tokens == 4
+    finally:
+        eng.close()
+
+
+def test_request_larger_than_pool_fails_cleanly():
+    eng = InferenceEngine(
+        "tiny-llama",
+        engine_config=EngineConfig(
+            paged=True, kv_pool_blocks=4, kv_block_size=8, **KW
+        ),
+    )
+    try:
+        with pytest.raises(RuntimeError, match="exhausted"):
+            eng.generate([1] * 40, max_new_tokens=4, temperature=0.0)
+        # the failure is per-request: a fitting one still serves
+        r = eng.generate([2] * 10, max_new_tokens=4, temperature=0.0)
+        assert r.new_tokens == 4
+    finally:
+        eng.close()
+
+
+# --------------------------------------------------- prefix sharing (CoW)
+
+
+def test_paged_prefix_hit_copies_at_most_one_block():
+    prompt = _prompt(0, n=24)
+    ref = InferenceEngine("tiny-llama", engine_config=EngineConfig(**KW))
+    want = ref.generate(prompt, max_new_tokens=8, temperature=0.0).token_ids
+    ref.close()
+
+    eng = InferenceEngine(
+        "tiny-llama",
+        engine_config=EngineConfig(paged=True, prefix_cache_entries=4, **KW),
+    )
+    try:
+        st = eng.scheduler.stats
+        first = eng.generate(prompt, max_new_tokens=8, temperature=0.0).token_ids
+        assert st.prefix_hits == 0 and st.paged_blocks_copied == 0
+        second = eng.generate(prompt, max_new_tokens=8, temperature=0.0).token_ids
+        # 24-token repeat matches 23 (cap n-1): 23//16=1 block shared,
+        # ONE partial block (tokens 16..22) copied
+        assert st.prefix_hits == 1
+        assert st.prefix_tokens_saved == len(prompt) - 1
+        assert st.paged_blocks_copied == 1
+        assert first == want and second == want
+    finally:
+        eng.close()
+
+
+def test_paged_prefix_block_aligned_hit_copies_nothing():
+    """A match on a block boundary shares every block: zero CoW copies."""
+    bs = 16
+    prompt = _prompt(1, n=2 * bs)  # 32 tokens
+    eng = InferenceEngine(
+        "tiny-llama",
+        engine_config=EngineConfig(
+            paged=True, prefix_cache_entries=4, kv_block_size=bs, **KW
+        ),
+    )
+    try:
+        st = eng.scheduler.stats
+        r1 = eng.generate(prompt, max_new_tokens=6, temperature=0.0).token_ids
+        # turn-2 transcript extends past the cached 32 tokens: the match is
+        # the FULL first turn (32 = 2 whole blocks) -> pure sharing
+        turn2 = prompt + r1 + _prompt(2, n=10)
+        eng.generate(turn2, max_new_tokens=6, temperature=0.0)
+        assert st.prefix_hits == 1
+        assert st.prefix_tokens_saved == len(prompt)
+        assert st.paged_blocks_copied == 0
+    finally:
+        eng.close()
+
+
+def test_paged_chat_turn_extension_matches_fresh_engine():
+    rng = np.random.default_rng(1)
+    turn1 = list(rng.integers(3, 500, size=30))
+    eng = InferenceEngine(
+        "tiny-llama",
+        engine_config=EngineConfig(
+            paged=True, prefix_cache_entries=4, prefill_chunk=16, **KW
+        ),
+    )
+    try:
+        r1 = eng.generate(turn1, max_new_tokens=6, temperature=0.0)
+        turn2 = turn1 + r1.token_ids + list(rng.integers(3, 500, size=10))
+        r2 = eng.generate(turn2, max_new_tokens=6, temperature=0.0)
+        assert eng.scheduler.stats.prefix_hits == 1
+    finally:
+        eng.close()
+
+    fresh = InferenceEngine("tiny-llama", engine_config=EngineConfig(**KW))
+    try:
+        want = fresh.generate(turn2, max_new_tokens=6, temperature=0.0).token_ids
+    finally:
+        fresh.close()
+    assert r2.token_ids == want
+
+
+def test_paged_prefix_survives_donor_retirement():
+    """The donor retires (its row refs drop) BEFORE the borrower admits:
+    the entry's pins must keep the shared blocks alive and intact."""
+    prompt = _prompt(2, n=24)
+    eng = InferenceEngine(
+        "tiny-llama",
+        engine_config=EngineConfig(paged=True, prefix_cache_entries=4, **KW),
+    )
+    try:
+        st = eng.scheduler.stats
+        a = eng.generate(prompt, max_new_tokens=10, temperature=0.0).token_ids
+        # donor fully retired; its generation-only blocks are back on the
+        # free list, the prompt blocks survive via the entry's pins
+        assert st.paged_blocks_in_use > 0  # pinned prompt blocks remain
+        # churn the pool so freed blocks get reused (stale-content hazard)
+        eng.generate(_prompt(9, n=30), max_new_tokens=10, temperature=0.0)
+        b = eng.generate(prompt, max_new_tokens=10, temperature=0.0).token_ids
+        c = eng.generate(prompt, max_new_tokens=10, temperature=0.0).token_ids
+        assert a == b == c
+        assert st.prefix_hits >= 2
+    finally:
+        eng.close()
+
+
+def test_reanchored_prefill_leaves_shared_blocks_read_only():
+    """A whole-prompt bucket larger than the remaining capacity re-anchors
+    the prefill window BELOW the CoW share point (pos = max(0, S - bucket)
+    < start). The re-fed positions must NOT rewrite the donor's shared
+    blocks (the write floor drops them): the donor's cached entry stays
+    byte-identical and the borrower still matches a fresh engine."""
+    kw = dict(max_seq_len=64, dtype="float32", cache_dtype="float32",
+              decode_chunk=4, prefill_buckets=(16, 64))
+    donor = _prompt(4, n=20)
+    borrower = donor + _prompt(5, n=40)  # 60 tokens: start=20, bucket=64
+    # -> re-anchor to pos=0 < start=20
+
+    fresh = InferenceEngine("tiny-llama", engine_config=EngineConfig(**kw))
+    want_d = fresh.generate(donor, max_new_tokens=6, temperature=0.0).token_ids
+    want_b = fresh.generate(borrower, max_new_tokens=3, temperature=0.0).token_ids
+    fresh.close()
+
+    eng = InferenceEngine(
+        "tiny-llama",
+        engine_config=EngineConfig(paged=True, prefix_cache_entries=4, **kw),
+    )
+    try:
+        d1 = eng.generate(donor, max_new_tokens=6, temperature=0.0).token_ids
+        got_b = eng.generate(borrower, max_new_tokens=3, temperature=0.0).token_ids
+        assert eng.scheduler.stats.prefix_hits == 1  # the re-anchored admit
+        # donor's pinned blocks survived the borrower's re-fed window
+        d2 = eng.generate(donor, max_new_tokens=6, temperature=0.0).token_ids
+        assert d1 == d2 == want_d
+        assert got_b == want_b
+    finally:
+        eng.close()
+
+
+def test_paged_prefix_entries_reclaimed_under_pressure():
+    """Pinned prefix blocks are reclaimable, not leaked: filling the pool
+    with pinned prompts must not starve new admissions."""
+    eng = InferenceEngine(
+        "tiny-llama",
+        engine_config=EngineConfig(
+            paged=True, prefix_cache_entries=8, max_batch=2,
+            kv_pool_blocks=12, kv_block_size=8,
+            max_seq_len=64, dtype="float32", cache_dtype="float32",
+            decode_chunk=4, prefill_buckets=(16,),
+        ),
+    )
+    try:
+        for seed in range(5):  # each pins ~3 blocks; pool has 11 usable
+            r = eng.generate(
+                _prompt(seed, n=20), max_new_tokens=6, temperature=0.0
+            )
+            # completed (possibly at a natural EOS) — never starved
+            assert r.new_tokens >= 1 and r.finish_reason != "error"
+    finally:
+        eng.close()
+
+
+# ------------------------------------------------ live-block proportionality
+
+
+def test_cache_reads_scale_with_live_blocks_not_capacity():
+    """The acceptance property: with max_batch=8 and ONE short active
+    request, the decode gather reads a few live blocks per step — not the
+    rectangular bsz * ceil(max_seq/block) equivalent."""
+    eng = InferenceEngine(
+        "tiny-llama",
+        engine_config=EngineConfig(paged=True, max_batch=8, **KW),
+    )
+    try:
+        # warm the batch up to 8 rows so the engine has seen full occupancy
+        threads = [
+            threading.Thread(
+                target=lambda i=i: eng.generate(
+                    _prompt(i, n=16), max_new_tokens=8, temperature=0.0
+                )
+            )
+            for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # now ONE active request: per-step reads must track ITS blocks
+        eng.generate(_prompt(99, n=16), max_new_tokens=12, temperature=0.0)
+        st = eng.scheduler.stats
+        bs = eng.engine_cfg.kv_block_size
+        rect_equiv = 8 * ceil_div(eng.max_seq_len, bs)  # rectangular tax
+        assert st.paged_blocks_read_last_step <= 2 * st.paged_live_blocks + 2
+        assert st.paged_blocks_read_last_step < rect_equiv / 4, (
+            f"read {st.paged_blocks_read_last_step} blocks/step with one "
+            f"active row vs rectangular-equivalent {rect_equiv}"
+        )
+    finally:
+        eng.close()
+
+
+@pytest.mark.slow
+def test_paged_parity_on_tp_mesh():
+    """The pool carries the kv-head `model` sharding
+    (partition.paged_cache_spec): TP serving over gathered blocks must
+    match the rectangular TP path token-for-token, including the MQA
+    kv-replication override."""
+    import jax
+
+    from bee2bee_tpu.parallel import MeshSpec, build_mesh
+
+    kw = dict(max_seq_len=64, dtype="float32", cache_dtype="float32",
+              decode_chunk=4, max_batch=2, prefill_buckets=(16,))
+    for name, spec in (("tiny-llama", MeshSpec(data=2, model=2)),
+                       ("tiny-gemma", MeshSpec(model=4))):  # MQA: Hkv=1
+        mesh = build_mesh(spec, devices=jax.devices()[:4])
+        ref = InferenceEngine(name, mesh=mesh,
+                              engine_config=EngineConfig(**kw))
+        want = ref.generate([5, 17, 99, 42], max_new_tokens=6,
+                            temperature=0.0).token_ids
+        ref.close()
+        eng = InferenceEngine(name, mesh=mesh,
+                              engine_config=EngineConfig(paged=True, **kw))
+        got = eng.generate([5, 17, 99, 42], max_new_tokens=6,
+                           temperature=0.0).token_ids
+        eng.close()
+        assert got == want, name
+
+
+def test_paged_rejects_flash_and_sp():
+    with pytest.raises(ValueError, match="paged"):
+        InferenceEngine(
+            "tiny-llama",
+            engine_config=EngineConfig(paged=True, attention="sp", **KW),
+        )
+    with pytest.raises(ValueError, match="paged"):
+        InferenceEngine(
+            "tiny-llama",
+            engine_config=EngineConfig(paged=True, attention="flash", **KW),
+        )
+    # auto resolves to dense instead of refusing
+    eng = InferenceEngine(
+        "tiny-llama",
+        engine_config=EngineConfig(paged=True, attention="auto", **KW),
+    )
+    assert eng.engine_cfg.attention == "dense"
+    eng.close()
