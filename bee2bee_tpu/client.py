@@ -18,12 +18,20 @@ from __future__ import annotations
 
 import asyncio
 import json
+import random
+import time
 from contextlib import asynccontextmanager
 from typing import AsyncIterator, Callable
 
 import aiohttp
 
 DEFAULT_TIMEOUT_S = 300.0  # matches the mesh request timeout
+# idempotent-GET retry policy: transient CONNECTION failures (refused /
+# reset / dropped mid-flight — aiohttp.ClientConnectionError) retry with
+# exponential backoff + jitter. POSTs never retry (a generate may have
+# executed), and HTTP error statuses never retry (they're answers).
+DEFAULT_GET_RETRIES = 2
+DEFAULT_RETRY_BACKOFF_S = 0.2
 
 
 class _Base:
@@ -32,9 +40,13 @@ class _Base:
     call opens an ephemeral session (sessions are loop-bound, and the sync
     wrappers run each call on a fresh loop)."""
 
-    def __init__(self, base_url: str, timeout: float = DEFAULT_TIMEOUT_S):
+    def __init__(self, base_url: str, timeout: float = DEFAULT_TIMEOUT_S,
+                 retries: int = DEFAULT_GET_RETRIES,
+                 retry_backoff_s: float = DEFAULT_RETRY_BACKOFF_S):
         self.base_url = base_url.rstrip("/")
         self.timeout = aiohttp.ClientTimeout(total=timeout)
+        self.retries = retries
+        self.retry_backoff_s = retry_backoff_s
         self._headers: dict[str, str] = {}
         self._session: aiohttp.ClientSession | None = None
 
@@ -56,6 +68,28 @@ class _Base:
                 yield s
 
     async def _get(self, path: str, **params) -> dict:
+        """GETs are idempotent: transient connection errors retry with
+        exponential backoff + jitter, bounded by self.retries AND by the
+        client's configured total timeout — retrying must not multiply
+        the caller's time budget (slow failures give up early)."""
+        total = self.timeout.total
+        deadline = (time.monotonic() + total) if total else None
+        attempt = 0
+        while True:
+            try:
+                return await self._get_once(path, **params)
+            except aiohttp.ClientConnectionError:
+                attempt += 1
+                delay = (self.retry_backoff_s * 2 ** (attempt - 1)
+                         * (1.0 + random.random() * 0.25))
+                if attempt > self.retries or (
+                    deadline is not None
+                    and time.monotonic() + delay >= deadline
+                ):
+                    raise
+                await asyncio.sleep(delay)
+
+    async def _get_once(self, path: str, **params) -> dict:
         async with self._sess() as s:
             async with s.get(
                 f"{self.base_url}{path}", headers=self._headers,
@@ -81,8 +115,8 @@ class NodeClient(_Base):
     """Client for one node's HTTP gateway (api.py routes)."""
 
     def __init__(self, base_url: str, api_key: str | None = None,
-                 timeout: float = DEFAULT_TIMEOUT_S):
-        super().__init__(base_url, timeout)
+                 timeout: float = DEFAULT_TIMEOUT_S, **kw):
+        super().__init__(base_url, timeout, **kw)
         if api_key:
             self._headers["X-API-KEY"] = api_key
 

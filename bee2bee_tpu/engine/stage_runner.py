@@ -47,6 +47,12 @@ class StageRunner:
         max_batch: int = 8,
         quantize: str = "none",  # "int8": weight-only quant of THIS stage's
         # slice — a 7B half per peer is exactly where halved weight HBM pays
+        stale_cache_s: float = STALE_CACHE_S,  # reap TTL for abandoned
+        # request caches (failover tests shrink it; long-idle coordinators
+        # raise it)
+        epoch: int = 0,  # stage epoch (pipeline failover): tasks stamped
+        # with a different epoch are rejected, so late traffic routed to a
+        # replaced occupant can never corrupt the rebuilt chain
     ):
         # same any-checkpoint rule as the engine
         # (`serve-stage --model auto --checkpoint <dir>`)
@@ -59,6 +65,12 @@ class StageRunner:
         self.dtype = jnp.dtype(dtype)
         self.max_seq_len = min(max_seq_len, self.model_cfg.max_seq_len)
         self.max_batch = max_batch
+        self.stale_cache_s = float(stale_cache_s)
+        self.epoch = int(epoch)
+        # identity fields for matches_load (part_load idempotency): a
+        # failover re-load of the SAME stage must be a no-op, not a rebuild
+        self.checkpoint_path = checkpoint_path
+        self.rng_seed = int(rng_seed)
         quantize = quantize or "none"  # accept ''/None like the engine does
         if quantize not in ("none", "int8"):
             raise ValueError(f"quantize={quantize!r}: only 'int8' or 'none'")
@@ -165,7 +177,32 @@ class StageRunner:
             # observable over the wire (part_load RESULT): a coordinator
             # can CONFIRM its stages quantized, not just request it
             "quantize": self.quantize,
+            # a worker that outlived a coordinator restart reports the
+            # epoch it is at; the coordinator adopts the max and re-loads
+            "epoch": self.epoch,
         }
+
+    def matches_load(self, data: dict) -> bool:
+        """Does a part_load request describe THIS runner? Same model
+        identity, partition, weights source, and serving shape — epoch
+        excluded on purpose: an epoch bump ADOPTS the runner (no-op
+        re-load, relay links re-dialed) instead of recompiling it."""
+        model = data.get("model")
+        try:
+            dtype_match = jnp.dtype(data.get("dtype", "bfloat16")) == self.dtype
+        except TypeError:
+            return False
+        return (
+            model in (self.requested_model, self.model_cfg.name)
+            and int(data.get("n_stages", -1)) == self.spec.n_stages
+            and int(data.get("stage", -1)) == self.spec.stage
+            and (data.get("checkpoint_path") or None) == self.checkpoint_path
+            and int(data.get("rng_seed", 0)) == self.rng_seed
+            and dtype_match
+            and min(int(data.get("max_seq_len", 2048)),
+                    self.model_cfg.max_seq_len) == self.max_seq_len
+            and (data.get("quantize") or "none") == self.quantize
+        )
 
     def forward(
         self,
@@ -221,7 +258,7 @@ class StageRunner:
             out, cache = self._fwd(self.params, xj, cache, off, mask, gat)
         except Exception:
             # free the slot: leaving the None entry would burn a max_batch
-            # row for STALE_CACHE_S and turn retries into misleading
+            # row for stale_cache_s and turn retries into misleading
             # "concurrent forward" errors
             with self._lock:
                 self._caches.pop(request_id, None)
@@ -279,7 +316,7 @@ class StageRunner:
         for table in (self._caches, self._train_acts):
             dead = [
                 rid for rid, e in table.items()
-                if now - e["touched"] > STALE_CACHE_S
+                if now - e["touched"] > self.stale_cache_s
             ]
             for rid in dead:
                 table.pop(rid, None)

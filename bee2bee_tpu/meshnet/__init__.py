@@ -5,4 +5,9 @@ reimagined; wire-compatible message set, known defects fixed — see node.py).
 """
 
 from .node import P2PNode  # noqa: F401
+from .pipeline import (  # noqa: F401 — the pipeline failure taxonomy
+    StageDead,
+    StageError,
+    StageTimeout,
+)
 from .runtime import run_p2p_node  # noqa: F401

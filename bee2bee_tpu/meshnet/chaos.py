@@ -1,0 +1,101 @@
+"""Deterministic fault injection for the meshnet pipeline.
+
+The chaos tests' original `_hard_kill` lived in tests/test_chaos.py;
+failover needs the same process-death semantics PLUS per-stage, per-step
+precision ("kill stage 1 on its 3rd forward"), so both live here as
+product code — operators can drive game-day drills with the same
+primitives the test suite uses (docs/ROBUSTNESS.md).
+
+- `hard_kill(node)`: every socket dies, no GOODBYE, nothing keeps
+  responding — what a power loss or OOM kill looks like to the mesh.
+- `ChaosStage(node, action=..., at_step=N)`: intercepts the node's stage
+  task handling and, at the Nth matching task, kills the node, delays
+  the task, or black-holes it (and everything after — a wedged process
+  that still holds its sockets open).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+
+from .. import protocol
+
+# the stage-serving task kinds a ChaosStage counts as "steps"
+FORWARD_KINDS = (
+    protocol.TASK_PART_FORWARD,
+    protocol.TASK_PART_FORWARD_RELAY,
+    protocol.TASK_DECODE_RUN,
+)
+
+
+async def hard_kill(node) -> None:
+    """Process-death semantics for an in-process node: every socket dies,
+    no GOODBYE is sent, nothing of the node keeps responding."""
+    node._stopped = True  # noqa: SLF001 — simulating death, not clean stop
+    for info in list(node.peers.values()):
+        with contextlib.suppress(Exception):
+            await info["ws"].close()
+    if node._server is not None:
+        node._server.close()
+        await node._server.wait_closed()
+    for t in list(node._tasks):
+        t.cancel()
+
+
+class ChaosStage:
+    """Wrap one stage worker node's task handler with a scheduled fault.
+
+    action:
+      - "kill":      hard_kill the node at step `at_step`; the triggering
+                     task (and everything after) is dropped.
+      - "blackhole": silently drop every matching task from `at_step` on
+                     — the node stays connected but never answers, which
+                     is the StageTimeout path.
+      - "delay":     sleep `delay_s` before handling each matching task
+                     from `at_step` on (latency injection).
+
+    Steps count tasks whose kind is in `kinds` (default: the forward /
+    relay / ring-decode serving kinds). `triggered` is an asyncio.Event
+    tests can await for deterministic sequencing; `steps_seen` exposes
+    the count. `restore()` un-wraps the handler (no-op after "kill").
+    """
+
+    def __init__(
+        self,
+        node,
+        action: str = "kill",
+        at_step: int = 1,
+        delay_s: float = 1.0,
+        kinds: tuple[str, ...] = FORWARD_KINDS,
+    ):
+        if action not in ("kill", "blackhole", "delay"):
+            raise ValueError(f"unknown chaos action {action!r}")
+        self.node = node
+        self.action = action
+        self.at_step = int(at_step)
+        self.delay_s = float(delay_s)
+        self.kinds = tuple(kinds)
+        self.steps_seen = 0
+        self.triggered = asyncio.Event()
+        self._orig = node._handle_task
+        node._handle_task = self._handle_task
+
+    async def _handle_task(self, ws, data):
+        if data.get("kind") in self.kinds:
+            self.steps_seen += 1
+            if self.steps_seen >= self.at_step:
+                if self.action == "kill":
+                    if not self.triggered.is_set():
+                        self.triggered.set()
+                        await hard_kill(self.node)
+                    return  # the dead never answer
+                if self.action == "blackhole":
+                    self.triggered.set()
+                    return  # connected but mute: the timeout path
+                self.triggered.set()
+                await asyncio.sleep(self.delay_s)
+        await self._orig(ws, data)
+
+    def restore(self) -> None:
+        self.node._handle_task = self._orig

@@ -30,7 +30,11 @@ import time
 from pathlib import Path
 from typing import Any, Callable
 
-import websockets
+try:
+    import websockets
+    import websockets.exceptions  # noqa: F401 — referenced as an attribute
+except ImportError:  # gate the missing dep: loopback shim (wscompat.py)
+    from .. import wscompat as websockets
 
 from .. import protocol
 from ..joinlink import generate_join_link, parse_join_link
@@ -44,7 +48,7 @@ from ..utils import (
     pump_queue_until,
     sha256_hex,
 )
-from .pipeline import StageTaskMixin
+from .pipeline import StageDead, StageTaskMixin
 
 logger = logging.getLogger("bee2bee_tpu.mesh")
 
@@ -74,8 +78,12 @@ class P2PNode(StageTaskMixin):
         announce_port: int | None = None,
         api_port: int | None = None,
         piece_dir: str | Path | None = None,
+        accept_stages: bool = True,  # advertise pipeline-stage capacity in
+        # hello: failover re-placement prefers peers that said yes (set
+        # False on client-only nodes that must never host model layers)
     ):
         self.host = host
+        self.accept_stages = accept_stages
         self.port = port
         self.region = region
         self.peer_id = node_id or new_id("node")
@@ -309,8 +317,11 @@ class P2PNode(StageTaskMixin):
                 self._pending_ws.pop(key, None)
                 fut = self._pending.get(key)
                 if fut and not fut.done():
+                    # typed: a stage chain awaiting this reply classifies
+                    # the loss as a DEAD stage (StageDead subclasses
+                    # RuntimeError, so non-pipeline callers are unchanged)
                     fut.set_exception(
-                        RuntimeError("peer connection lost mid-request")
+                        StageDead("peer connection lost mid-request")
                     )
         # we dialed this connection: redial unless the peer said goodbye
         # (or we are shutting down). Inbound connections are the remote
@@ -378,6 +389,7 @@ class P2PNode(StageTaskMixin):
             services={n: s.get_metadata() for n, s in self.local_services.items()},
             api_port=self.api_port,
             api_host=self.announce_host or get_lan_ip(),
+            accepts_stages=self.accept_stages,
         )
 
     async def _on_message(self, ws, data: dict):
@@ -444,6 +456,9 @@ class P2PNode(StageTaskMixin):
                 "metrics": data.get("metrics") or {},
                 "api_port": data.get("api_port"),
                 "api_host": data.get("api_host"),
+                # failover replacement candidates rank by this (pre-taxonomy
+                # peers omit it → still eligible, just deprioritized)
+                "accepts_stages": bool(data.get("accepts_stages")),
                 "health": "online",
                 "last_seen": time.time(),
                 "rtt_ms": self.peers.get(pid, {}).get("rtt_ms"),

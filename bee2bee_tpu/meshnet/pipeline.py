@@ -16,6 +16,13 @@ halves the TPU-native way:
 
 The coordinator is itself a mesh peer: it speaks to stage workers over
 the same WebSocket connections the gossip/generation traffic uses.
+
+Failover (docs/ROBUSTNESS.md): stage failures are typed (StageDead /
+StageTimeout / StageError); on StageDead the coordinator re-places the
+dead stage's layer range onto a replacement peer under a bumped stage
+epoch (late traffic to/from the old occupant is refused), and in-flight
+generations resume by re-prefilling prompt + accepted-so-far — the
+coordinator's accepted-token stream is the recovery truth.
 """
 
 from __future__ import annotations
@@ -34,6 +41,37 @@ from ..utils import new_id
 logger = logging.getLogger("bee2bee_tpu.pipeline")
 
 DEFAULT_STEP_TIMEOUT = 120.0
+# generation-level failover policy defaults (PipelineCoordinator knobs)
+DEFAULT_FAILOVER_RETRIES = 2
+DEFAULT_FAILOVER_BACKOFF_S = 0.5
+DEFAULT_GENERATION_DEADLINE_S = 600.0
+
+
+# ----------------------------------------------------------- error taxonomy
+
+
+class StageError(RuntimeError):
+    """A stage worker answered TASK_ERROR: the stage is alive and
+    reachable but the task failed. Retryable (bounded); never triggers
+    re-placement on its own."""
+
+    def __init__(self, message: str, peer: str | None = None,
+                 stage: int | None = None):
+        super().__init__(message)
+        self.peer = peer
+        self.stage = stage
+
+
+class StageDead(StageError):
+    """The stage's transport is gone (connection lost, peer unknown, or a
+    mid-chain successor vanished): a reply can never arrive. Failover
+    re-places the stage on a replacement peer and resumes by re-prefill."""
+
+
+class StageTimeout(StageError):
+    """No reply within the step timeout. The stage may be alive but
+    wedged or black-holed; blame can't be localized through a relay
+    chain, so timeouts retry the existing chain instead of re-placing."""
 
 
 # --------------------------------------------------------------- node mixin
@@ -55,21 +93,23 @@ class StageTaskMixin:
             self.stage_runners[requested] = runner
 
     async def _peer_ws(self, peer_id: str | None, what: str):
-        """Resolve a peer's live ws or raise — the relay/ring handlers'
-        shared lookup (one place to change if peer bookkeeping does)."""
+        """Resolve a peer's live ws or raise StageDead — the relay/ring
+        handlers' shared lookup (one place to change if peer bookkeeping
+        does). Typed so a mid-chain death classifies as `dead` at the
+        origin, not as a generic task error."""
         if not peer_id:
-            raise RuntimeError(f"{what}: peer unknown (dropped mid-task?)")
+            raise StageDead(f"{what}: peer unknown (dropped mid-task?)")
         async with self._lock:
             info = self.peers.get(peer_id)
         if info is None:
-            raise RuntimeError(f"{what}: peer {peer_id!r} gone")
+            raise StageDead(f"{what}: peer {peer_id!r} gone", peer=peer_id)
         return info["ws"]
 
     async def _handle_task(self, ws, data):
         kind = data.get("kind")
         task_id = data.get("task_id")
 
-        async def fail(error: str):
+        async def fail(error: str, error_kind: str = protocol.ERR_KIND_ERROR):
             # relayed tasks report failure to the ORIGIN coordinator, not
             # the previous stage (which isn't waiting on anything)
             origin = data.get("origin_peer")
@@ -84,11 +124,13 @@ class StageTaskMixin:
                         protocol.msg(
                             protocol.TASK_ERROR,
                             task_id=data.get("origin_task_id"), error=error,
+                            error_kind=error_kind,
                         ),
                     )
                     return
             await self._send(
-                ws, protocol.msg(protocol.TASK_ERROR, task_id=task_id, error=error)
+                ws, protocol.msg(protocol.TASK_ERROR, task_id=task_id,
+                                 error=error, error_kind=error_kind)
             )
 
         try:
@@ -115,27 +157,43 @@ class StageTaskMixin:
                 await fail(f"unknown task kind {kind!r}")
         except Exception as e:  # noqa: BLE001 — worker must answer, not die
             logger.exception("task %s failed", kind)
-            await fail(f"{type(e).__name__}: {e}")
+            await fail(
+                f"{type(e).__name__}: {e}",
+                protocol.ERR_KIND_DEAD if isinstance(e, StageDead)
+                else protocol.ERR_KIND_ERROR,
+            )
 
     async def _task_part_load(self, ws, data):
         from ..engine.stage_runner import StageRunner
 
         task_id = data.get("task_id")
-        loop = asyncio.get_running_loop()
-        runner = await loop.run_in_executor(
-            None,
-            lambda: StageRunner(
-                data["model"],
-                n_stages=int(data["n_stages"]),
-                stage=int(data["stage"]),
-                checkpoint_path=data.get("checkpoint_path"),
-                max_seq_len=int(data.get("max_seq_len", 2048)),
-                dtype=data.get("dtype", "bfloat16"),
-                rng_seed=int(data.get("rng_seed", 0)),
-                quantize=data.get("quantize", "none"),
-            ),
-        )
-        self.add_stage_runner(runner)
+        epoch = int(data.get("epoch", 0))
+        existing = self.stage_runners.get(data.get("model"))
+        if existing is not None and existing.matches_load(data):
+            # failover idempotency: re-loading the SAME stage is a no-op
+            # (no recompile) that adopts the request's epoch and re-dials
+            # the relay successor below — recover() re-wires surviving
+            # stages this way. max() so a straggling retry from an older
+            # attempt can never downgrade the epoch.
+            runner = existing
+            runner.epoch = max(runner.epoch, epoch)
+        else:
+            loop = asyncio.get_running_loop()
+            runner = await loop.run_in_executor(
+                None,
+                lambda: StageRunner(
+                    data["model"],
+                    n_stages=int(data["n_stages"]),
+                    stage=int(data["stage"]),
+                    checkpoint_path=data.get("checkpoint_path"),
+                    max_seq_len=int(data.get("max_seq_len", 2048)),
+                    dtype=data.get("dtype", "bfloat16"),
+                    rng_seed=int(data.get("rng_seed", 0)),
+                    quantize=data.get("quantize", "none"),
+                    epoch=epoch,
+                ),
+            )
+            self.add_stage_runner(runner)
         # relay chaining: dial the NEXT stage so hidden states can hop
         # worker→worker without bouncing through the coordinator
         relay = False
@@ -181,6 +239,13 @@ class StageTaskMixin:
         runner = self.stage_runners.get(data.get("model"))
         if runner is None:
             raise RuntimeError(f"no stage loaded for model {data.get('model')!r}")
+        epoch = data.get("epoch")
+        if epoch is not None and int(epoch) != getattr(runner, "epoch", 0):
+            # late traffic addressed to a replaced occupant (or a stage
+            # that missed a re-load): refuse instead of corrupting caches
+            raise RuntimeError(
+                f"stale stage epoch {epoch} (stage now at {runner.epoch})"
+            )
         x = data["_tensors"]["x"]
         offset = data.get("offset", 0)
         if not isinstance(offset, int):
@@ -239,7 +304,7 @@ class StageTaskMixin:
         fields = {
             k: data[k]
             for k in ("model", "request_id", "offset", "write_mask", "gather",
-                      "origin_peer", "origin_task_id")
+                      "origin_peer", "origin_task_id", "epoch")
             if k in data
         }
         frame = protocol.encode_binary(
@@ -290,7 +355,8 @@ class StageTaskMixin:
             await self._send(ws, protocol.encode_binary(msg, {"dx": dx}))
 
     _RING_FIELDS = ("model", "request_id", "offset", "k", "eos", "gather",
-                    "origin_peer", "origin_task_id", "temperature", "seed")
+                    "origin_peer", "origin_task_id", "temperature", "seed",
+                    "epoch")
     BURST_STALE_S = 600.0
 
     @staticmethod
@@ -400,14 +466,17 @@ class StageTaskMixin:
         # (relay/ring: the LAST stage answers, not the stage we send to)
     ) -> dict:
         """Send one task to a peer and await its RESULT (tensors included
-        under '_tensors'). Raises on TASK_ERROR or timeout."""
+        under '_tensors'). Failures raise the typed taxonomy: StageDead
+        (transport gone / peer unknown / worker reported a dead
+        successor), StageTimeout (no reply in `timeout`), StageError (the
+        worker answered TASK_ERROR)."""
         async with self._lock:
             info = self.peers.get(peer_id)
             reply_info = self.peers.get(reply_from) if reply_from else info
         if info is None:
-            raise RuntimeError(f"unknown peer {peer_id!r}")
+            raise StageDead(f"unknown peer {peer_id!r}", peer=peer_id)
         if reply_info is None:
-            raise RuntimeError(f"unknown reply peer {reply_from!r}")
+            raise StageDead(f"unknown reply peer {reply_from!r}", peer=reply_from)
         task_id = new_id("task")
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         async with self._pending_lock:
@@ -419,17 +488,35 @@ class StageTaskMixin:
             self._pending_ws[task_id] = reply_info["ws"]
         message = protocol.msg(protocol.TASK, kind=kind, task_id=task_id, **fields)
         try:
-            if tensors:
-                await self._send(info["ws"], protocol.encode_binary(message, tensors))
-            else:
-                await self._send(info["ws"], message)
-            result = await asyncio.wait_for(fut, timeout=timeout)
+            try:
+                if tensors:
+                    await self._send(
+                        info["ws"], protocol.encode_binary(message, tensors)
+                    )
+                else:
+                    await self._send(info["ws"], message)
+            except StageError:
+                raise
+            except Exception as e:  # ConnectionClosed/OSError under the send
+                raise StageDead(
+                    f"send {kind} to {peer_id!r} failed: {e}", peer=peer_id
+                ) from e
+            try:
+                result = await asyncio.wait_for(fut, timeout=timeout)
+            except asyncio.TimeoutError:
+                raise StageTimeout(
+                    f"{kind} on {peer_id!r}: no reply in {timeout:.0f}s",
+                    peer=reply_from or peer_id,
+                ) from None
         finally:
             async with self._pending_lock:
                 self._pending.pop(task_id, None)
                 self._pending_ws.pop(task_id, None)
         if result.get("type") == protocol.TASK_ERROR or result.get("error"):
-            raise RuntimeError(result.get("error") or "task failed")
+            err = result.get("error") or "task failed"
+            if result.get("error_kind") == protocol.ERR_KIND_DEAD:
+                raise StageDead(err, peer=peer_id)
+            raise StageError(err, peer=peer_id)
         return result
 
 
@@ -474,14 +561,33 @@ class PipelineCoordinator:
         dtype: str = "bfloat16",
         rng_seed: int = 0,
         quantize: str = "none",  # int8: each stage quantizes ITS slice
+        step_timeout: float = DEFAULT_STEP_TIMEOUT,
+        max_failover_retries: int = DEFAULT_FAILOVER_RETRIES,
+        failover_backoff_s: float = DEFAULT_FAILOVER_BACKOFF_S,
+        generation_deadline_s: float = DEFAULT_GENERATION_DEADLINE_S,
     ):
         self.node = node
         self.model = model
-        self.stage_peers = stage_peers
+        self.stage_peers = list(stage_peers)
         self.max_seq_len = max_seq_len
         self.dtype = dtype
         self.rng_seed = rng_seed
         self.quantize = quantize
+        # failover policy (docs/ROBUSTNESS.md): bounded retries with
+        # exponential backoff under a per-generation wall-clock deadline
+        self.step_timeout = step_timeout
+        self.max_failover_retries = max_failover_retries
+        self.failover_backoff_s = failover_backoff_s
+        self.generation_deadline_s = generation_deadline_s
+        # stage epoch: bumped by recover(); stamped into every task so
+        # late replies/relays from a replaced occupant are refused
+        self.epoch = 0
+        # single-flight: concurrent generations that all caught the same
+        # stage failure must share ONE rebuild, not ping-pong epoch bumps
+        # that invalidate each other's chains
+        self._recover_lock = asyncio.Lock()
+        self.checkpoint_path: str | None = None
+        self.load_timeout = 600.0
         # set by load(): every stage dialed its successor, so chains can
         # relay worker→worker instead of round-tripping the coordinator
         self.relay_ok = False
@@ -497,40 +603,60 @@ class PipelineCoordinator:
     ) -> list[dict]:
         """part_load every stage concurrently; returns their stage infos.
         `timeout` covers checkpoint read + compile per stage (a 7B half
-        takes minutes — far beyond the per-step default)."""
-        # each stage gets its successor's dial address for relay chaining
-        async with self.node._lock:
-            addrs = [
-                (self.node.peers.get(pid) or {}).get("addr")
-                for pid in self.stage_peers
-            ]
-        results = await asyncio.gather(
-            *(
-                self.node.run_stage_task(
-                    peer,
-                    protocol.TASK_PART_LOAD,
-                    {
-                        "model": self.model,
-                        "n_stages": len(self.stage_peers),
-                        "stage": s,
-                        "max_seq_len": self.max_seq_len,
-                        "dtype": self.dtype,
-                        "rng_seed": self.rng_seed,
-                        "quantize": self.quantize,
-                        "checkpoint_path": checkpoint_path,
-                        # wrap-around: the LAST stage dials stage 0, closing
-                        # the ring for burst decode
-                        "next_addr": (
-                            addrs[(s + 1) % len(self.stage_peers)]
-                            if len(self.stage_peers) > 1 else None
-                        ),
-                    },
-                    timeout=timeout,
+        takes minutes — far beyond the per-step default). The checkpoint
+        path and timeout are remembered so recover() can rebuild a dead
+        stage from the same source."""
+        self.checkpoint_path = checkpoint_path
+        self.load_timeout = timeout
+        return await self._load_stages(timeout)
+
+    async def _load_stages(self, timeout: float) -> list[dict]:
+        """part_load all stages at the current epoch (idempotent for
+        already-loaded stages — they adopt the epoch and re-dial their
+        relay successor). If a long-lived worker reports a HIGHER epoch
+        (it outlived a coordinator restart), adopt the max and re-load
+        once so every stage agrees."""
+        for _ in range(2):
+            # each stage gets its successor's dial address for relay chaining
+            async with self.node._lock:
+                addrs = [
+                    (self.node.peers.get(pid) or {}).get("addr")
+                    for pid in self.stage_peers
+                ]
+            results = await asyncio.gather(
+                *(
+                    self.node.run_stage_task(
+                        peer,
+                        protocol.TASK_PART_LOAD,
+                        {
+                            "model": self.model,
+                            "n_stages": len(self.stage_peers),
+                            "stage": s,
+                            "max_seq_len": self.max_seq_len,
+                            "dtype": self.dtype,
+                            "rng_seed": self.rng_seed,
+                            "quantize": self.quantize,
+                            "checkpoint_path": self.checkpoint_path,
+                            "epoch": self.epoch,
+                            # wrap-around: the LAST stage dials stage 0,
+                            # closing the ring for burst decode
+                            "next_addr": (
+                                addrs[(s + 1) % len(self.stage_peers)]
+                                if len(self.stage_peers) > 1 else None
+                            ),
+                        },
+                        timeout=timeout,
+                    )
+                    for s, peer in enumerate(self.stage_peers)
                 )
-                for s, peer in enumerate(self.stage_peers)
             )
-        )
-        infos = [r.get("info", {}) for r in results]
+            infos = [r.get("info", {}) for r in results]
+            top = max(
+                [self.epoch, *(int(i.get("epoch") or 0) for i in infos)]
+            )
+            if top == self.epoch:
+                break
+            self.epoch = top
         self.relay_ok = len(infos) > 0 and all(i.get("relay") for i in infos)
         self.ring_ok = (
             len(infos) > 1 and all(i.get("ring") for i in infos)
@@ -542,11 +668,91 @@ class PipelineCoordinator:
         )
         return infos
 
+    # ------------------------------------------------------------- failover
+
+    def stage_health(self) -> list[dict]:
+        """Per-stage health off the node's existing ping bookkeeping:
+        'online', 'unreachable' (3 missed pings), or 'dead' (no
+        connection at all). Sync read on the loop thread — same
+        justification as P2PNode.peer_for_addr."""
+        out = []
+        for s, pid in enumerate(self.stage_peers):
+            info = self.node.peers.get(pid)
+            status = "dead" if info is None else info.get("health", "online")
+            out.append({"stage": s, "peer": pid, "status": status})
+        return out
+
+    def _pick_replacement(self, exclude: set[str]) -> str | None:
+        """Best replacement peer for a dead stage: online peers outside
+        the pipeline, capacity-advertising ones (hello's accepts_stages)
+        first, then lowest RTT."""
+        cands = []
+        for pid, info in list(self.node.peers.items()):
+            if pid in exclude or info.get("health") != "online":
+                continue
+            cands.append((
+                0 if info.get("accepts_stages") else 1,
+                info.get("rtt_ms") or float("inf"),
+                pid,
+            ))
+        return sorted(cands)[0][2] if cands else None
+
+    async def recover(
+        self, timeout: float | None = None, observed_epoch: int | None = None,
+    ) -> list[tuple[int, str]]:
+        """Re-place every dead/unreachable stage on a replacement peer and
+        rebuild the whole chain under a bumped stage epoch: survivors
+        adopt the epoch and re-dial their relay successors (idempotent
+        part_load — no recompile); replacements load the dead stage's
+        layer range from the same checkpoint path (or the deterministic
+        seed init). Returns [(stage, new_peer_id)] for what moved. Raises
+        StageDead when a dead stage has no replacement candidate.
+
+        Single-flight: pass `observed_epoch` (the epoch at the moment the
+        failure was caught) and concurrent callers share one rebuild —
+        whoever queues behind the lock finds the epoch already past its
+        observation and returns immediately instead of bumping again."""
+        async with self._recover_lock:
+            if observed_epoch is not None and self.epoch > observed_epoch:
+                return []  # another caller already rebuilt the chain
+            timeout = self.load_timeout if timeout is None else timeout
+            # pick a replacement for EVERY dead stage before committing
+            # any of them: a no-replacement raise must leave stage_peers
+            # untouched, not half-pointing at a never-loaded peer
+            new_peers = list(self.stage_peers)
+            replaced: list[tuple[int, str]] = []
+            exclude = set(self.stage_peers) | {self.node.peer_id}
+            for h in self.stage_health():
+                if h["status"] == "online":
+                    continue
+                pid = self._pick_replacement(exclude)
+                if pid is None:
+                    # the ONLY raise carrying stage= — generate()'s retry
+                    # loop keys "terminal, fail fast" off that
+                    raise StageDead(
+                        f"stage {h['stage']} ({h['peer']}) is {h['status']} "
+                        "and no replacement peer is available",
+                        peer=h["peer"], stage=h["stage"],
+                    )
+                new_peers[h["stage"]] = pid
+                exclude.add(pid)
+                replaced.append((h["stage"], pid))
+            self.stage_peers = new_peers
+            self.epoch += 1
+            await self._load_stages(timeout)
+            if replaced:
+                logger.info(
+                    "pipeline failover: re-placed stages %s (epoch %d)",
+                    replaced, self.epoch,
+                )
+            return replaced
+
     async def _chain(self, request_id: str, x: np.ndarray, offset: int) -> np.ndarray:
         """ids/hidden through every stage; returns last stage's logits.
         With relay chaining (load() dialed stage→stage links) the whole
         chain is one send + one receive at the coordinator."""
-        fields = {"model": self.model, "request_id": request_id, "offset": offset}
+        fields = {"model": self.model, "request_id": request_id,
+                  "offset": offset, "epoch": self.epoch}
         if self.relay_ok and len(self.stage_peers) > 1:
             result = await self.node.run_stage_task(
                 self.stage_peers[0], protocol.TASK_PART_FORWARD_RELAY,
@@ -554,24 +760,26 @@ class PipelineCoordinator:
                 # ONE await covers the whole chain (first prefill lazily
                 # compiles every stage) — budget per stage, like the
                 # per-stage path effectively did
-                timeout=DEFAULT_STEP_TIMEOUT * len(self.stage_peers),
+                timeout=self.step_timeout * len(self.stage_peers),
                 reply_from=self.stage_peers[-1],
             )
             return result["_tensors"]["out"]
         for peer in self.stage_peers:
             result = await self.node.run_stage_task(
-                peer, protocol.TASK_PART_FORWARD, fields, tensors={"x": x}
+                peer, protocol.TASK_PART_FORWARD, fields, tensors={"x": x},
+                timeout=self.step_timeout,
             )
             x = result["_tensors"]["out"]
         return x
 
-    async def release(self, request_id: str) -> None:
+    async def release(self, request_id: str, timeout: float | None = None) -> None:
         await asyncio.gather(
             *(
                 self.node.run_stage_task(
                     peer,
                     "part_release",
                     {"model": self.model, "request_id": request_id},
+                    timeout=self.step_timeout if timeout is None else timeout,
                 )
                 for peer in self.stage_peers
             ),
@@ -585,9 +793,19 @@ class PipelineCoordinator:
         temperature: float = 0.0,
         eos_token_id: int | None = None,
         on_token=None,
+        deadline_s: float | None = None,
     ) -> list[int]:
         """Greedy/temperature generation across the pipeline. Returns new
-        token ids (stops at eos_token_id when given)."""
+        token ids (stops at eos_token_id when given).
+
+        Failover: a typed stage failure (StageDead/StageTimeout/
+        StageError) triggers recover() — dead stages re-placed, chain
+        rebuilt under a new epoch — and the generation RESUMES by
+        re-prefilling prompt + accepted-so-far through the rebuilt chain
+        (the coordinator holds every accepted token, so resume is exact
+        for greedy decode). Bounded by max_failover_retries with
+        exponential backoff under a wall-clock deadline: requests finish
+        or fail fast with the typed error, never hang."""
         rid = new_id("ppreq")
         rng = np.random.default_rng(abs(hash(rid)) % (2**32))
         # left-truncate over-long prompts to what the stage caches can hold
@@ -599,6 +817,73 @@ class PipelineCoordinator:
             max_new_tokens = max(0, self.max_seq_len - 1 - n)
         if max_new_tokens <= 0:
             return []
+        deadline = time.time() + (
+            self.generation_deadline_s if deadline_s is None else deadline_s
+        )
+        out: list[int] = []
+        attempt = 0
+        try:
+            while True:
+                # the epoch this attempt's chains run under: if a failure
+                # lands after ANOTHER caller already rebuilt the chain,
+                # recover() sees epoch > observed and coalesces to a no-op
+                attempt_epoch = self.epoch
+                try:
+                    return await self._generate_attempt(
+                        rid, prompt_ids, out, max_new_tokens, temperature,
+                        eos_token_id, on_token, rng,
+                    )
+                except StageError as e:
+                    attempt += 1
+                    remaining = deadline - time.time()
+                    if attempt > self.max_failover_retries or remaining <= 0:
+                        raise
+                    logger.warning(
+                        "pipeline generation hit %s (%s); failover attempt "
+                        "%d/%d with %d tokens accepted",
+                        type(e).__name__, e, attempt,
+                        self.max_failover_retries, len(out),
+                    )
+                    await asyncio.sleep(min(
+                        self.failover_backoff_s * 2 ** (attempt - 1),
+                        max(remaining, 0.0),
+                    ))
+                    # every recovery step is capped by the REMAINING
+                    # deadline budget: a wedged stage that also swallows
+                    # release/part_load must not stretch time-to-failure
+                    # past generation_deadline_s
+                    budget = max(deadline - time.time(), 1.0)
+                    await self.release(  # survivors drop the old caches
+                        rid, timeout=min(self.step_timeout, budget)
+                    )
+                    try:
+                        await self.recover(
+                            timeout=min(self.load_timeout,
+                                        max(deadline - time.time(), 1.0)),
+                            observed_epoch=attempt_epoch,
+                        )
+                    except StageDead as rec_err:
+                        if rec_err.stage is not None:
+                            raise  # no replacement exists: terminal
+                        # transient rebuild failure (e.g. the picked
+                        # replacement died mid-load): spend the retry,
+                        # the next recover() can pick another peer
+                        logger.warning("recover attempt failed: %s", rec_err)
+                    except StageError as rec_err:
+                        logger.warning("recover attempt failed: %s", rec_err)
+                    rid = new_id("ppreq")  # fresh caches on the rebuilt chain
+        finally:
+            await self.release(rid)
+
+    async def _generate_attempt(
+        self, rid, prompt_ids, out, max_new_tokens, temperature,
+        eos_token_id, on_token, rng,
+    ) -> list[int]:
+        """One pass of the decode loop. `out` accumulates ACROSS attempts:
+        on resume, prompt + accepted tokens re-prefill in one chain call
+        and decode continues from where the failure struck."""
+        full = list(prompt_ids) + out
+        n = len(full)
         # pow2 prompt bucket bounds worker recompiles; pad K/V past n is
         # overwritten by decode exactly when it enters the causal window
         # (same trick as the engine's bucketed prefill)
@@ -607,40 +892,36 @@ class PipelineCoordinator:
             bucket *= 2
         bucket = min(bucket, self.max_seq_len)
         padded = np.zeros((1, bucket), np.int32)
-        padded[0, :n] = prompt_ids
-        out: list[int] = []
-        try:
-            logits = await self._chain(rid, padded, offset=0)
-            tok = self._sample(logits[0, n - 1], temperature, rng)
-            greedy = temperature is None or temperature <= 0.0
-            if (self.ring_ok and max_new_tokens > 1
-                    and (greedy or self.ring_sampling_ok)):
-                # sampled requests ride the burst path too: the LAST stage
-                # draws with an rng keyed on (seed, position), so K tokens
-                # still cost one coordinator round trip (r4 was greedy-only).
-                # Gated on ring_sampling_ok — an older stage would ignore
-                # the temperature/seed fields and silently argmax
-                return await self._generate_ring(
-                    rid, tok, n, max_new_tokens, eos_token_id, on_token, out,
-                    temperature=temperature,
-                    seed=int(rng.integers(2**31)),
-                )
-            offset = n
-            while True:
-                if eos_token_id is not None and tok == eos_token_id:
-                    break
-                out.append(tok)
-                if on_token is not None:
-                    on_token(tok)
-                if len(out) >= max_new_tokens:
-                    break
-                logits = await self._chain(
-                    rid, np.asarray([[tok]], np.int32), offset=offset
-                )
-                offset += 1
-                tok = self._sample(logits[0, -1], temperature, rng)
-        finally:
-            await self.release(rid)
+        padded[0, :n] = full
+        logits = await self._chain(rid, padded, offset=0)
+        tok = self._sample(logits[0, n - 1], temperature, rng)
+        greedy = temperature is None or temperature <= 0.0
+        if (self.ring_ok and max_new_tokens - len(out) > 1
+                and (greedy or self.ring_sampling_ok)):
+            # sampled requests ride the burst path too: the LAST stage
+            # draws with an rng keyed on (seed, position), so K tokens
+            # still cost one coordinator round trip (r4 was greedy-only).
+            # Gated on ring_sampling_ok — an older stage would ignore
+            # the temperature/seed fields and silently argmax
+            return await self._generate_ring(
+                rid, tok, n, max_new_tokens, eos_token_id, on_token, out,
+                temperature=temperature,
+                seed=int(rng.integers(2**31)),
+            )
+        offset = n
+        while True:
+            if eos_token_id is not None and tok == eos_token_id:
+                break
+            out.append(tok)
+            if on_token is not None:
+                on_token(tok)
+            if len(out) >= max_new_tokens:
+                break
+            logits = await self._chain(
+                rid, np.asarray([[tok]], np.int32), offset=offset
+            )
+            offset += 1
+            tok = self._sample(logits[0, -1], temperature, rng)
         return out
 
     async def train_step(
@@ -744,8 +1025,9 @@ class PipelineCoordinator:
                     "eos": eos_token_id,
                     "temperature": float(temperature or 0.0),
                     "seed": int(seed),
+                    "epoch": self.epoch,
                 },
-                timeout=DEFAULT_STEP_TIMEOUT + 2.0 * k,
+                timeout=self.step_timeout + 2.0 * k,
                 reply_from=self.stage_peers[-1],
             )
             toks = result.get("tokens") or []
@@ -786,12 +1068,18 @@ class PipelineCoordinator:
         return PipelineSession(
             self.node,
             self.model,
-            self.stage_peers,
+            list(self.stage_peers),
             max_batch=max_batch,
             max_seq_len=self.max_seq_len,
             dtype=self.dtype,
             n_microbatches=n_microbatches,
             relay=self.relay_ok,
+            coordinator=self,  # stage failover: recover + resume rows
+            step_timeout=self.step_timeout,
+            # the session inherits this coordinator's failover policy —
+            # max_failover_retries=0 really disables failover everywhere
+            max_failovers=self.max_failover_retries,
+            failover_backoff_s=self.failover_backoff_s,
         )
 
 
@@ -845,8 +1133,13 @@ class PipelineSession:
       row between steps; stale K/V from a previous occupant is never
       attended (positions ≥ the new row's offset sit outside the causal
       mask until decode overwrites them — the bucketed-prefill argument).
-    - a chain failure fails all in-flight rows and rotates the session id
-      so the next admission starts from fresh stage caches.
+    - failover: a typed stage failure rotates the session id, asks the
+      coordinator to recover() (re-place dead stages, bump the epoch),
+      and REQUEUES the in-flight rows — admission prefills prompt +
+      accepted-so-far, so each row resumes exactly where it stopped.
+      Bounded attempts; past them (or when recovery itself fails) all
+      in-flight rows fail with the typed error and the session id
+      rotates so the next admission starts from fresh stage caches.
     - microbatch overlap (`n_microbatches` > 1): rows split into M groups,
       each with its OWN per-stage cache (request_id "{sid}:mN"), and the
       M decode chains run concurrently — while stage 1 computes group 0,
@@ -871,6 +1164,14 @@ class PipelineSession:
         dtype: str = "bfloat16",
         n_microbatches: int = 1,
         relay: bool = False,  # stage→stage links up (coordinator.load)
+        coordinator=None,  # enables failover: recover() + row resume
+        step_timeout: float = DEFAULT_STEP_TIMEOUT,
+        max_failovers: int = DEFAULT_FAILOVER_RETRIES,
+        failover_backoff_s: float = 0.2,
+        # cap on one recovery's part_load round; None = the coordinator's
+        # load_timeout. The session loop (and every queued row) blocks for
+        # at most this long per failover attempt before rows fail typed.
+        failover_load_timeout: float | None = None,
     ):
         self.node = node
         self.model = model
@@ -879,6 +1180,13 @@ class PipelineSession:
         self.max_seq_len = max_seq_len
         self.dtype = dtype
         self.relay = relay and len(stage_peers) > 1
+        self.coordinator = coordinator
+        self.step_timeout = step_timeout
+        self.max_failovers = max_failovers
+        self.failover_backoff_s = failover_backoff_s
+        self.failover_load_timeout = failover_load_timeout
+        self.epoch = getattr(coordinator, "epoch", 0)
+        self._failovers = 0  # consecutive; reset by a successful step
         self.sid = new_id("ppsess")
         M = max(1, min(n_microbatches, max_batch))
         base, extra = divmod(max_batch, M)
@@ -987,6 +1295,7 @@ class PipelineSession:
                     self.node.run_stage_task(
                         peer, "part_release",
                         {"model": self.model, "request_id": self._rid(g)},
+                        timeout=self.step_timeout,
                     )
                     for peer in self.stage_peers
                     for g in range(len(self.groups))
@@ -1003,6 +1312,7 @@ class PipelineSession:
             "request_id": self._rid(g),
             "offset": [int(o) for o in offsets],
             "write_mask": [bool(m) for m in mask],
+            "epoch": self.epoch,
         }
         if self.relay:
             # one send, one receive: stages hand hidden states to each
@@ -1013,14 +1323,15 @@ class PipelineSession:
                 self.stage_peers[0], protocol.TASK_PART_FORWARD_RELAY,
                 {**fields, "gather": [int(g_) for g_ in gather]},
                 tensors={"x": x},
-                timeout=DEFAULT_STEP_TIMEOUT * len(self.stage_peers),
+                timeout=self.step_timeout * len(self.stage_peers),
                 reply_from=self.stage_peers[-1],
             )
             return result["_tensors"]["out"]
         for peer in self.stage_peers[:-1]:
             self.stats["tasks_sent"] += 1
             result = await self.node.run_stage_task(
-                peer, protocol.TASK_PART_FORWARD, fields, tensors={"x": x}
+                peer, protocol.TASK_PART_FORWARD, fields, tensors={"x": x},
+                timeout=self.step_timeout,
             )
             x = result["_tensors"]["out"]
         self.stats["tasks_sent"] += 1
@@ -1029,24 +1340,30 @@ class PipelineSession:
             protocol.TASK_PART_FORWARD,
             {**fields, "gather": [int(g_) for g_ in gather]},
             tensors={"x": x},
+            timeout=self.step_timeout,
         )
         return result["_tensors"]["out"]  # [B, V]
 
     async def _admit(self, g: int, row: int, req: _SessionReq) -> None:
-        """Masked prefill of one request into `row` of group `g`'s cache."""
+        """Masked prefill of one request into `row` of group `g`'s cache.
+        A row requeued by failover carries accepted tokens in req.out:
+        prefilling prompt + accepted resumes its decode exactly where the
+        failure struck (offsets in _step_group are n + len(out) already)."""
         self.stats["prefills"] += 1
         B = len(self.groups[g])
+        full = list(req.ids) + req.out
+        n_full = len(full)
         bucket = 16
-        while bucket < req.n:
+        while bucket < n_full:
             bucket *= 2
         bucket = min(bucket, self.max_seq_len)
         x = np.zeros((B, bucket), np.int32)
-        x[row, : req.n] = req.ids
+        x[row, :n_full] = full
         offsets = np.zeros(B, np.int32)
         mask = np.zeros(B, bool)
         mask[row] = True
         gather = np.zeros(B, np.int32)
-        gather[row] = req.n - 1
+        gather[row] = n_full - 1
         logits = await self._chain(g, x, offsets, mask, gather)
         req.last_tok = PipelineCoordinator._sample(
             logits[row], req.temperature, req.rng
@@ -1141,19 +1458,66 @@ class PipelineSession:
                     admitting = None
                 if self._any_active:
                     await self._step()
-            except Exception as e:  # noqa: BLE001 — fail rows, rotate caches
-                logger.exception("session step failed; rotating session id")
-                err = RuntimeError(f"pipeline session step failed: {e}")
-                # the popped-but-not-yet-admitted request is in neither
-                # _pending nor a group — it must fail too, not hang
-                if admitting is not None and not admitting.future.done():
-                    admitting.future.set_exception(err)
-                for rows in self.groups:
-                    for i, req in enumerate(rows):
-                        if req is None:
-                            continue
-                        rows[i] = None
-                        if not req.future.done():
-                            req.future.set_exception(err)
-                await self._release()
-                self.sid = new_id("ppsess")
+                    self._failovers = 0  # a whole step landed: chain healthy
+            except Exception as e:  # noqa: BLE001 — failover or fail rows
+                await self._on_step_failure(e, admitting)
+
+    async def _on_step_failure(self, e: Exception,
+                               admitting: "_SessionReq | None") -> None:
+        """A chain call failed. Pull every in-flight row out of the
+        groups, rotate the session id, and either FAIL OVER (typed stage
+        failure, attempts left: recover the chain and requeue the rows —
+        admission re-prefills prompt + accepted-so-far) or fail the rows
+        with the typed error."""
+        # the popped-but-not-yet-admitted request is in neither _pending
+        # nor a group — collect it with the rest so it can't hang
+        inflight: list[_SessionReq] = [admitting] if admitting is not None else []
+        for rows in self.groups:
+            for i, req in enumerate(rows):
+                if req is not None:
+                    rows[i] = None
+                    inflight.append(req)
+        await self._release()  # survivors drop the old sid's caches
+        self.sid = new_id("ppsess")
+        if (not self._closed and self.coordinator is not None
+                and isinstance(e, StageError)
+                and self._failovers < self.max_failovers):
+            self._failovers += 1
+            try:
+                await asyncio.sleep(min(
+                    self.failover_backoff_s * 2 ** (self._failovers - 1), 5.0
+                ))
+                # observed_epoch: if another generation already rebuilt
+                # the chain, this returns immediately and we just adopt
+                await self.coordinator.recover(
+                    timeout=self.failover_load_timeout,
+                    observed_epoch=self.epoch,
+                )
+            except Exception as rec_err:  # noqa: BLE001 — typed fail below
+                logger.warning("session failover failed: %s", rec_err)
+                if isinstance(rec_err, StageError):
+                    e = rec_err
+            else:
+                # rebuilt chain: adopt the new topology/epoch and requeue
+                # the rows at the FRONT (resume before fresh admissions)
+                self.stage_peers = list(self.coordinator.stage_peers)
+                self.relay = (self.coordinator.relay_ok
+                              and len(self.stage_peers) > 1)
+                self.epoch = self.coordinator.epoch
+                live = [r for r in inflight if not r.future.done()]
+                self._pending[0:0] = live
+                logger.info(
+                    "session failover %d/%d: resuming %d rows (epoch %d)",
+                    self._failovers, self.max_failovers, len(live), self.epoch,
+                )
+                return
+        logger.warning(
+            "session step failed (%s: %s); failing %d in-flight rows",
+            type(e).__name__, e, len(inflight),
+        )
+        err = e if isinstance(e, StageError) else RuntimeError(
+            f"pipeline session step failed: {e}"
+        )
+        for req in inflight:
+            if not req.future.done():
+                req.future.set_exception(err)

@@ -56,6 +56,14 @@ TASK_PART_FORWARD_RELAY = "part_forward_relay"
 TASK_DECODE_RUN = "decode_run"
 TASK_TRAIN_STEP = "train_step"
 
+# task-failure classification, riding TASK_ERROR as an `error_kind` field:
+# a coordinator must tell a DEAD stage (transport gone — replies can never
+# arrive; failover re-places it) from a stage that is alive but FAILED the
+# task (retry/fail, never re-place). Old peers omit the field, which
+# classifies as ERR_KIND_ERROR — the conservative choice.
+ERR_KIND_DEAD = "dead"
+ERR_KIND_ERROR = "error"
+
 MESSAGE_TYPES = frozenset(
     {
         HELLO,

@@ -123,7 +123,11 @@ class PipelineService(BaseService):
         try:
             out_ids = self._run(self.session.generate(ids, **kw))
         except Exception as e:  # noqa: BLE001 — surface as a service error
-            raise ServiceError(f"pipeline generation failed: {e}") from e
+            # keep the taxonomy visible (StageDead/StageTimeout/...): a
+            # caller deciding whether to re-submit needs the class name
+            raise ServiceError(
+                f"pipeline generation failed: {type(e).__name__}: {e}"
+            ) from e
         text = scrub_stop_words(
             self.tokenizer.decode(out_ids), normalize_stops(params.get("stop"))
         )
@@ -141,7 +145,9 @@ class PipelineService(BaseService):
                 self.session.generate(ids, **kw), timeout=REQUEST_TIMEOUT_S
             )
         except Exception as e:  # noqa: BLE001 — surface as a service error
-            raise ServiceError(f"pipeline generation failed: {e}") from e
+            raise ServiceError(
+                f"pipeline generation failed: {type(e).__name__}: {e}"
+            ) from e
         text = scrub_stop_words(
             self.tokenizer.decode(out_ids), normalize_stops(params.get("stop"))
         )

@@ -28,7 +28,10 @@ import json
 import logging
 import time
 
-import websockets
+try:
+    import websockets
+except ImportError:  # gate the missing dep: loopback shim (wscompat.py)
+    from .. import wscompat as websockets
 
 from .. import protocol
 from ..joinlink import parse_join_link

@@ -43,17 +43,47 @@ except Exception:
     pass
 
 
+# files whose tests deliberately break things (killed peers, black-holed
+# stages): an introduced hang here must fail THAT test, not eat the whole
+# tier-1 wall-clock budget. The cap is ini-configurable (chaos_test_timeout)
+# and per-test overridable via @pytest.mark.async_timeout(seconds).
+_CHAOS_FILES = ("test_chaos", "test_failover")
+
+
+def pytest_addoption(parser):
+    parser.addini(
+        "chaos_test_timeout",
+        "per-test wall-clock cap (seconds) for async tests in the chaos/"
+        "failover files (0 disables)",
+        default="240",
+    )
+
+
 def pytest_pyfunc_call(pyfuncitem):
     """Run `async def` tests via asyncio.run (pytest-asyncio isn't in this
     image). Sync fixtures work normally; use async context managers instead
-    of async fixtures."""
+    of async fixtures. Chaos/failover tests run under a wall-clock cap —
+    pytest-timeout isn't in the image either, so the cap rides the same
+    asyncio.run bridge."""
     fn = pyfuncitem.obj
     if inspect.iscoroutinefunction(fn):
         kwargs = {
             name: pyfuncitem.funcargs[name]
             for name in pyfuncitem._fixtureinfo.argnames
         }
-        asyncio.run(fn(**kwargs))
+        timeout = None
+        marker = pyfuncitem.get_closest_marker("async_timeout")
+        if marker is not None and marker.args:
+            timeout = float(marker.args[0])
+        elif any(f in str(pyfuncitem.fspath) for f in _CHAOS_FILES):
+            timeout = float(pyfuncitem.config.getini("chaos_test_timeout"))
+        if timeout:
+            async def _capped():
+                await asyncio.wait_for(fn(**kwargs), timeout=timeout)
+
+            asyncio.run(_capped())
+        else:
+            asyncio.run(fn(**kwargs))
         return True
     return None
 

@@ -2,13 +2,17 @@
 injection anywhere (SURVEY §5); here we hard-kill and restart providers
 mid-workload and require (a) requests either succeed or fail fast with a
 clean error — never hang, (b) the mesh heals (reconnect + re-discovery),
-(c) serving resumes after every restart."""
+(c) serving resumes after every restart.
+
+The kill primitive lives in bee2bee_tpu/meshnet/chaos.py now (shared with
+the pipeline failover tests and operator game-day drills)."""
 
 import asyncio
 import contextlib
 
 import pytest
 
+from bee2bee_tpu.meshnet.chaos import hard_kill as _hard_kill
 from bee2bee_tpu.meshnet.node import P2PNode
 from bee2bee_tpu.services.fake import FakeService
 
@@ -19,20 +23,6 @@ async def _settle(cond, timeout=8.0, interval=0.05):
             return True
         await asyncio.sleep(interval)
     return False
-
-
-async def _hard_kill(node: P2PNode):
-    """Process-death semantics for an in-process node: every socket dies,
-    no GOODBYE is sent, nothing of the node keeps responding."""
-    node._stopped = True  # noqa: SLF001 — simulating death, not clean stop
-    for info in list(node.peers.values()):
-        with contextlib.suppress(Exception):
-            await info["ws"].close()
-    if node._server is not None:
-        node._server.close()
-        await node._server.wait_closed()
-    for t in list(node._tasks):
-        t.cancel()
 
 
 async def test_mesh_survives_provider_churn():

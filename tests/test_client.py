@@ -182,3 +182,70 @@ async def test_node_client_forwards_sampling_kwargs():
         assert call["top_p"] == 0.85
         assert call["repetition_penalty"] == 1.4
         assert call["frequency_penalty"] == 0.2
+
+
+# ------------------------------------------------------- GET retry policy
+
+
+async def test_get_retries_transient_connection_errors():
+    """Idempotent GETs retry transient connection failures with backoff:
+    two refused connections then a live answer must succeed without the
+    caller seeing the failures."""
+    import aiohttp
+
+    async with node_server() as (node, url):
+        c = NodeClient(url, retry_backoff_s=0.01)
+        attempts = {"n": 0}
+        real_get_once = c._get_once
+
+        async def flaky(path, **params):
+            attempts["n"] += 1
+            if attempts["n"] <= 2:
+                raise aiohttp.ClientConnectionError("connection refused")
+            return await real_get_once(path, **params)
+
+        c._get_once = flaky
+        st = await c.status()
+        assert st["peer_id"] == node.peer_id
+        assert attempts["n"] == 3  # 2 transient failures + 1 success
+
+
+async def test_get_retry_budget_exhausts_and_raises():
+    """Past the retry budget the original connection error surfaces."""
+    import aiohttp
+
+    c = NodeClient("http://127.0.0.1:9", retries=2, retry_backoff_s=0.01)
+    attempts = {"n": 0}
+
+    async def always_down(path, **params):
+        attempts["n"] += 1
+        raise aiohttp.ClientConnectionError("connection refused")
+
+    c._get_once = always_down
+    with pytest.raises(aiohttp.ClientConnectionError):
+        await c.status()
+    assert attempts["n"] == 3  # initial + 2 retries, then give up
+
+
+async def test_get_does_not_retry_http_errors_and_post_never_retries():
+    """HTTP error statuses are ANSWERS (no retry), and POSTs are not
+    idempotent — a connection error surfaces on the first attempt."""
+    import aiohttp
+
+    async with node_server() as (node, url):
+        c = NodeClient(url, api_key=None, retry_backoff_s=0.01)
+        calls = {"n": 0}
+        real_get_once = c._get_once
+
+        async def counting(path, **params):
+            calls["n"] += 1
+            return await real_get_once(path, **params)
+
+        c._get_once = counting
+        with pytest.raises(aiohttp.ClientResponseError):
+            await c._get("/definitely-not-a-route")
+        assert calls["n"] == 1  # 404 answered; no retry
+
+    c2 = NodeClient("http://127.0.0.1:9", timeout=5, retry_backoff_s=0.01)
+    with pytest.raises(aiohttp.ClientConnectionError):
+        await c2._post("/chat", {"prompt": "x"})

@@ -1,0 +1,330 @@
+"""Pipeline stage failover: a stage worker dying mid-decode is detected
+(typed StageDead), its layer range is re-placed onto a replacement peer
+under a bumped stage epoch, and in-flight generations RESUME by
+re-prefilling prompt + accepted-so-far — token-for-token greedy parity
+with an unfaulted run. With no replacement available, requests fail fast
+with the typed error instead of waiting out the step timeout.
+
+Faults are injected deterministically with meshnet.chaos.ChaosStage
+("kill stage 1 on its Nth forward"), so every scenario is reproducible.
+"""
+
+import asyncio
+import contextlib
+import time
+from contextlib import asynccontextmanager
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bee2bee_tpu.engine.tokenizer import ByteTokenizer
+from bee2bee_tpu.meshnet.chaos import ChaosStage
+from bee2bee_tpu.meshnet.node import P2PNode
+from bee2bee_tpu.meshnet.pipeline import (
+    DEFAULT_STEP_TIMEOUT,
+    PipelineCoordinator,
+    StageDead,
+    StageError,
+    StageTimeout,
+)
+from bee2bee_tpu.models import core, get_config
+
+MODEL = "tiny-llama"
+SEED = 0
+
+
+def _tok() -> ByteTokenizer:
+    return ByteTokenizer(get_config(MODEL).vocab_size)
+
+
+async def _settle(cond, timeout=8.0):
+    for _ in range(int(timeout / 0.05)):
+        if cond():
+            return True
+        await asyncio.sleep(0.05)
+    return False
+
+
+def _expected_text(prompt: str, n: int) -> str:
+    """Greedy single-process rollout of the same random-init params —
+    the parity oracle for resumed generations."""
+    cfg = get_config(MODEL)
+    tok = _tok()
+    params = core.init_params(cfg, jax.random.key(SEED), dtype=jnp.float32)
+    ids = tok.encode(prompt)
+    out = []
+    for _ in range(n):
+        logits, _ = core.forward(
+            params, cfg, jnp.asarray([ids + out], jnp.int32), None, jnp.int32(0)
+        )
+        t = int(np.argmax(np.asarray(logits[0, -1])))
+        if t == tok.eos_token_id:
+            break
+        out.append(t)
+    return tok.decode(out)
+
+
+@asynccontextmanager
+async def failover_mesh(n_stages=2, n_spares=1):
+    """n_stages stage workers + n_spares idle capacity peers + a
+    coordinator, all connected to the coordinator; stages loaded."""
+    workers = [
+        P2PNode(host="127.0.0.1", port=0, node_id=f"fstage{i}")
+        for i in range(n_stages)
+    ]
+    spares = [
+        P2PNode(host="127.0.0.1", port=0, node_id=f"fspare{i}")
+        for i in range(n_spares)
+    ]
+    coord = P2PNode(host="127.0.0.1", port=0, node_id="fcoord")
+    nodes = [*workers, *spares, coord]
+    for n in nodes:
+        await n.start()
+        n.reconnect_enabled = False  # nothing here should redial the dead
+    try:
+        for peer in [*workers, *spares]:
+            await coord.connect_bootstrap(peer.addr)
+        await _settle(lambda: len(coord.peers) >= len(nodes) - 1)
+        coordinator = PipelineCoordinator(
+            coord, MODEL, stage_peers=[w.peer_id for w in workers],
+            max_seq_len=128, dtype="float32", rng_seed=SEED,
+            failover_backoff_s=0.05,
+        )
+        await coordinator.load(timeout=120.0)
+        yield workers, spares, coord, coordinator
+    finally:
+        for n in nodes:
+            with contextlib.suppress(Exception):
+                await n.stop()
+
+
+# --------------------------------------------------------------- acceptance
+
+
+async def test_stage_death_mid_decode_failover_resumes_token_parity():
+    """Kill stage 1 on its 3rd forward (mid-decode, budget remaining):
+    the coordinator re-places the stage onto the spare, rebuilds the
+    relay/ring chain under epoch 1, re-prefills prompt + accepted tokens,
+    and finishes with exact greedy parity against an unfaulted rollout."""
+    async with failover_mesh(n_spares=1) as (workers, spares, coord, coordinator):
+        tok = _tok()
+        want = _expected_text("failover parity", 16)
+        chaos = ChaosStage(workers[1], action="kill", at_step=3)
+        out = await coordinator.generate(
+            tok.encode("failover parity"), max_new_tokens=16, temperature=0.0
+        )
+        assert chaos.triggered.is_set(), "fault never fired"
+        assert tok.decode(out) == want
+        assert coordinator.stage_peers[1] == spares[0].peer_id
+        assert coordinator.epoch >= 1
+        assert workers[1].peer_id not in coordinator.stage_peers
+        # the replacement really hosts the layer range now
+        assert MODEL in spares[0].stage_runners
+        # and the rebuilt chain keeps serving fresh requests
+        out2 = await coordinator.generate(
+            tok.encode("after failover"), max_new_tokens=6, temperature=0.0
+        )
+        assert tok.decode(out2) == _expected_text("after failover", 6)
+
+
+async def test_stage_death_without_replacement_fails_fast_typed():
+    """No spare in the mesh: the generation must surface StageDead well
+    under the step timeout — never hang out DEFAULT_STEP_TIMEOUT."""
+    async with failover_mesh(n_spares=0) as (workers, spares, coord, coordinator):
+        tok = _tok()
+        ChaosStage(workers[1], action="kill", at_step=3)
+        t0 = time.monotonic()
+        with pytest.raises(StageDead, match="no replacement peer"):
+            await coordinator.generate(
+                tok.encode("doomed"), max_new_tokens=32, temperature=0.0
+            )
+        elapsed = time.monotonic() - t0
+        assert elapsed < DEFAULT_STEP_TIMEOUT / 4, (
+            f"took {elapsed:.1f}s — not fail-fast"
+        )
+
+
+async def test_concurrent_generations_share_one_failover():
+    """Two generations in flight when the stage dies: recover() is
+    single-flight (observed_epoch), so ONE rebuild serves both and both
+    finish with parity — no epoch ping-pong between the retries."""
+    async with failover_mesh(n_spares=1) as (workers, spares, coord, coordinator):
+        tok = _tok()
+        prompts = ["conc one", "conc two"]
+        want = [_expected_text(p, 12) for p in prompts]
+        chaos = ChaosStage(workers[1], action="kill", at_step=5)
+        outs = await asyncio.gather(*(
+            coordinator.generate(tok.encode(p), max_new_tokens=12,
+                                 temperature=0.0)
+            for p in prompts
+        ))
+        assert chaos.triggered.is_set()
+        for p, o, w in zip(prompts, outs, want):
+            assert tok.decode(o) == w, f"{p!r} lost parity"
+        assert coordinator.epoch == 1, (
+            f"expected ONE shared rebuild, epoch={coordinator.epoch}"
+        )
+
+
+# ---------------------------------------------------------- session resume
+
+
+async def test_session_failover_resumes_rows_token_parity():
+    """The continuous-batching session: stage 1 dies with two rows in
+    flight; both rows are requeued, re-prefilled (prompt + accepted) on
+    the rebuilt chain, and finish with exact greedy parity."""
+    async with failover_mesh(n_spares=1) as (workers, spares, coord, coordinator):
+        tok = _tok()
+        sess = coordinator.session(max_batch=4)
+        try:
+            prompts = ["row alpha", "row beta longer"]
+            want = [_expected_text(p, 10) for p in prompts]
+            chaos = ChaosStage(workers[1], action="kill", at_step=4)
+            outs = await asyncio.gather(*(
+                sess.generate(tok.encode(p), max_new_tokens=10, temperature=0.0)
+                for p in prompts
+            ))
+            assert chaos.triggered.is_set(), "fault never fired"
+            for p, o, w in zip(prompts, outs, want):
+                assert tok.decode(o) == w, f"row {p!r} lost parity"
+            # resume really re-admitted rows (prefills beyond the 2 admissions)
+            assert sess.stats["prefills"] > len(prompts)
+            assert sess.epoch == coordinator.epoch >= 1
+        finally:
+            await sess.close()
+
+
+async def test_session_stage_death_no_replacement_fails_fast_typed():
+    """Session path, no spare: the in-flight row fails with the typed
+    StageDead (failover attempted, no candidate) well under the step
+    timeout — the mid-stream-death bugfix for the pipeline path."""
+    async with failover_mesh(n_spares=0) as (workers, spares, coord, coordinator):
+        tok = _tok()
+        sess = coordinator.session(max_batch=2)
+        try:
+            ChaosStage(workers[1], action="kill", at_step=3)
+            t0 = time.monotonic()
+            with pytest.raises(StageDead):
+                await sess.generate(
+                    tok.encode("doomed row"), max_new_tokens=40, temperature=0.0
+                )
+            assert time.monotonic() - t0 < DEFAULT_STEP_TIMEOUT / 4
+        finally:
+            await sess.close()
+
+
+# ------------------------------------------------------------ typed timeout
+
+
+async def test_blackholed_stage_surfaces_stage_timeout():
+    """A stage that stays connected but never answers (black hole) is a
+    StageTimeout, not a hang: with a shrunk step timeout the request
+    fails in seconds. No re-placement happens — every peer is alive, so
+    blame can't be pinned on a stage."""
+    async with failover_mesh(n_spares=1) as (workers, spares, coord, coordinator):
+        tok = _tok()
+        # warm the compiled paths first so the shrunk timeout measures
+        # the black hole, not XLA compile time
+        await coordinator.generate(tok.encode("warm"), max_new_tokens=2)
+        ChaosStage(workers[1], action="blackhole", at_step=1)
+        coordinator.step_timeout = 2.0
+        coordinator.max_failover_retries = 0
+        before = list(coordinator.stage_peers)
+        t0 = time.monotonic()
+        with pytest.raises(StageTimeout):
+            await coordinator.generate(
+                tok.encode("into the void"), max_new_tokens=8, temperature=0.0
+            )
+        assert time.monotonic() - t0 < 30.0
+        assert coordinator.stage_peers == before  # nobody was re-placed
+
+
+# ------------------------------------------- part_load idempotency / epochs
+
+
+async def test_part_load_idempotent_and_epoch_adoption():
+    """Re-loading an already-loaded stage reuses the runner (no
+    recompile); recover() on a healthy chain bumps the epoch everywhere;
+    traffic stamped with a stale epoch is refused as a typed error."""
+    from bee2bee_tpu import protocol as proto
+
+    async with failover_mesh(n_spares=0) as (workers, spares, coord, coordinator):
+        tok = _tok()
+        runner0 = workers[0].stage_runners[MODEL]
+        await coordinator._load_stages(timeout=120.0)  # same epoch re-load
+        assert workers[0].stage_runners[MODEL] is runner0, "rebuilt, not reused"
+        assert runner0.epoch == 0
+
+        await coordinator.recover()  # healthy: re-wire only, epoch bump
+        assert coordinator.epoch == 1
+        assert workers[0].stage_runners[MODEL] is runner0
+        assert runner0.epoch == 1
+        assert workers[1].stage_runners[MODEL].epoch == 1
+
+        with pytest.raises(StageError, match="stale stage epoch"):
+            await coord.run_stage_task(
+                workers[0].peer_id, proto.TASK_PART_FORWARD,
+                {"model": MODEL, "request_id": "stale", "offset": 0, "epoch": 0},
+                tensors={"x": np.zeros((1, 16), np.int32)},
+            )
+        # current-epoch serving is intact
+        out = await coordinator.generate(
+            tok.encode("epoch ok"), max_new_tokens=4, temperature=0.0
+        )
+        assert tok.decode(out) == _expected_text("epoch ok", 4)
+
+
+def test_stage_runner_stale_cache_ttl_configurable():
+    """The reap TTL is per-runner now (constructor), not a module
+    constant: a 50 ms TTL reaps an abandoned request on the next call."""
+    from bee2bee_tpu.engine.stage_runner import StageRunner
+
+    runner = StageRunner(
+        MODEL, n_stages=1, stage=0, max_seq_len=64, dtype="float32",
+        rng_seed=SEED, stale_cache_s=0.05,
+    )
+    x = np.zeros((1, 16), np.int32)
+    runner.forward("abandoned", x, 0)
+    assert runner.active_requests == 1
+    time.sleep(0.1)
+    runner.forward("fresh", x, 0)
+    assert runner.active_requests == 1  # "abandoned" reaped, "fresh" live
+    assert "fresh" in runner._caches and "abandoned" not in runner._caches
+
+
+# ------------------------------------------------------------ extended churn
+
+
+@pytest.mark.slow
+async def test_repeated_failover_rounds_two_spares():
+    """Churn variant: the replacement dies too. Two failover rounds in
+    one generation, ending on the second spare — still exact parity."""
+    async with failover_mesh(n_spares=2) as (workers, spares, coord, coordinator):
+        tok = _tok()
+        want = _expected_text("double churn", 20)
+        ChaosStage(workers[1], action="kill", at_step=3)
+        first_spare_chaos: list[ChaosStage] = []
+
+        orig_recover = coordinator.recover
+
+        async def recover_and_arm(*a, **kw):
+            replaced = await orig_recover(*a, **kw)
+            # arm the next kill on the peer that just took the stage over
+            for _s, pid in replaced:
+                for sp in spares:
+                    if sp.peer_id == pid and not first_spare_chaos:
+                        first_spare_chaos.append(
+                            ChaosStage(sp, action="kill", at_step=3)
+                        )
+            return replaced
+
+        coordinator.recover = recover_and_arm
+        out = await coordinator.generate(
+            tok.encode("double churn"), max_new_tokens=20, temperature=0.0
+        )
+        assert tok.decode(out) == want
+        assert coordinator.epoch >= 2
+        dead = {workers[1].peer_id, first_spare_chaos[0].node.peer_id}
+        assert not dead & set(coordinator.stage_peers)

@@ -212,6 +212,63 @@ def test_pool_exhaustion_queues_and_reuses_freed_blocks():
         eng.close()
 
 
+def test_concurrent_admission_under_pool_pressure_completes_or_raises():
+    """Hammer submit with more simultaneous requests than the pool can
+    hold, including two that can NEVER fit: every request either
+    completes its full budget or raises the _PoolExhausted-derived error
+    — no hangs, and after the drain every block is back on the free list
+    (leak check against the allocator's own initial free count)."""
+    eng = InferenceEngine(
+        "tiny-llama",
+        engine_config=EngineConfig(
+            paged=True, max_batch=4, kv_pool_blocks=9, kv_block_size=8,
+            max_seq_len=96, dtype="float32", cache_dtype="float32",
+            decode_chunk=4, prefill_buckets=(16, 32, 64, 96),
+        ),
+    )
+    try:
+        initial_free = eng.scheduler._alloc.free_count
+        # 8 fitting requests (4 blocks each at completion: 20 prompt + 10
+        # new = 30 positions) racing 2 that exceed the whole pool
+        # (80 prompt + 10 new = 90 positions > 64 the pool covers)
+        sizes = [20] * 8 + [80] * 2
+        results: list = [None] * len(sizes)
+
+        def run(i):
+            try:
+                results[i] = eng.generate(
+                    [3 + i] * sizes[i], max_new_tokens=10, temperature=0.0
+                )
+            except RuntimeError as e:
+                results[i] = e
+
+        threads = [
+            threading.Thread(target=run, args=(i,)) for i in range(len(sizes))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=240)
+        assert all(not t.is_alive() for t in threads), "a request hung"
+        for i, r in enumerate(results):
+            if isinstance(r, RuntimeError):
+                assert "exhausted" in str(r), f"req {i}: untyped error {r}"
+                assert sizes[i] == 80, f"fitting req {i} was failed: {r}"
+            else:
+                assert r is not None and r.new_tokens == 10, f"req {i}: {r}"
+        # the two impossible requests failed, everything else completed
+        assert sum(isinstance(r, RuntimeError) for r in results) == 2
+        st = eng.scheduler.stats
+        assert st.paged_blocks_in_use == 0, "leaked block references"
+        assert eng.scheduler._alloc.free_count == initial_free, (
+            "free list did not recover to its initial size"
+        )
+        # and the engine still serves after the stampede
+        assert eng.generate([7] * 12, max_new_tokens=4).new_tokens == 4
+    finally:
+        eng.close()
+
+
 def test_request_larger_than_pool_fails_cleanly():
     eng = InferenceEngine(
         "tiny-llama",

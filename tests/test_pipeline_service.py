@@ -298,9 +298,13 @@ async def test_pipeline_stages_quantize_int8():
 async def test_pipeline_session_stage_death_fails_fast_not_hangs():
     """A stage worker dying mid-generation must reject the in-flight
     futures (review hardening r4) — not strand them until the 300s
-    service timeout — and rotate the session id for the next request."""
+    service timeout — and rotate the session id for the next request.
+    Failover is disabled here (max_failovers=0) so the fail-fast path
+    stays covered; tests/test_failover.py covers the resume path."""
     async with pipeline_mesh() as (workers, coord, client, svc):
         sess = svc.coordinator.session(max_batch=2)
+        sess.max_failovers = 0  # else the client node gets drafted as a
+        # replacement stage and the generation RESUMES instead of failing
         tok = ByteTokenizer(get_config(MODEL).vocab_size)
         # healthy request proves the session works first
         out = await sess.generate(tok.encode("ok"), max_new_tokens=3, temperature=0.0)
@@ -317,7 +321,9 @@ async def test_pipeline_session_stage_death_fails_fast_not_hangs():
             await workers[1].stop()
 
         killer = asyncio.create_task(kill_on_first_token())
-        with pytest.raises(RuntimeError):
+        from bee2bee_tpu.meshnet.pipeline import StageError
+
+        with pytest.raises(StageError):
             await asyncio.wait_for(
                 sess.generate(
                     tok.encode("doomed"), max_new_tokens=120, temperature=0.0,

@@ -7,8 +7,13 @@ exactly what example-based tests under-cover (SURVEY §4 gap class)."""
 from __future__ import annotations
 
 import numpy as np
-from hypothesis import given, settings
-from hypothesis import strategies as st
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="hypothesis not in this image (pip extra: test)"
+)
+from hypothesis import given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
 
 from bee2bee_tpu import protocol
 from bee2bee_tpu.joinlink import (

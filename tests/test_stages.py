@@ -126,7 +126,9 @@ def test_stage_forward_unstacked_matches_stacked():
     # uncached
     want, _ = stages.stage_forward(sp, CFG, spec, ids, None, jnp.int32(0))
     got, _ = stages.stage_forward(sp_unstacked, CFG, spec, ids, None, jnp.int32(0))
-    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+    # 2e-6: scan vs unrolled fuse differently on some XLA:CPU builds —
+    # a systematic unrolled-path bug is orders of magnitude, not 1 ulp
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-6)
 
     # cached with per-row offsets and a write mask (the session contract)
     cache_a = stages.init_stage_cache(CFG, spec, 2, 16, jnp.float32)
@@ -139,12 +141,12 @@ def test_stage_forward_unstacked_matches_stacked():
     got, cache_b = stages.stage_forward(
         sp_unstacked, CFG, spec, ids, cache_b, offsets, write_mask=mask
     )
-    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-6)
     np.testing.assert_allclose(
-        np.asarray(cache_b["k"]), np.asarray(cache_a["k"]), atol=1e-6
+        np.asarray(cache_b["k"]), np.asarray(cache_a["k"]), atol=2e-6
     )
     np.testing.assert_allclose(
-        np.asarray(cache_b["v"]), np.asarray(cache_a["v"]), atol=1e-6
+        np.asarray(cache_b["v"]), np.asarray(cache_a["v"]), atol=2e-6
     )
 
 

@@ -52,8 +52,13 @@ async def _settle(cond, timeout=5.0):
 
 async def test_raw_bridge_dialect_byte_for_byte():
     """Drive the node with literal reference-bridge frames (no MeshBridge):
-    the exact JSON the JS bridge sends must stream a generation back."""
-    import websockets
+    the exact JSON the JS bridge sends must stream a generation back.
+    (The JSON dialect is the contract under test; the byte transport is
+    whatever stack the node runs — real websockets, or wscompat.)"""
+    try:
+        import websockets
+    except ImportError:
+        from bee2bee_tpu import wscompat as websockets
 
     async with provider_node() as node:
         async with websockets.connect(node.addr) as ws:
