@@ -1,0 +1,48 @@
+"""meshlint: purpose-built static analysis for the bee2bee-tpu mesh.
+
+Three pass families turn the codebase's load-bearing conventions into
+machine-checked invariants (rule catalog: docs/ANALYSIS.md):
+
+- **frames** (ML-F*) — every wire-frame construction and message-dict read
+  in meshnet/, web/, services/ and api.py checked against the per-op
+  schema registry (analysis/schema.py); catches the typo'd-key bug class
+  the wire protocol swallows by design.
+- **async** (ML-A*) — blocking calls inside ``async def``, unbounded
+  network awaits on mesh hot paths, network awaits under an asyncio lock.
+- **jax** (ML-J*) — implicit host syncs and Python branches on traced
+  values inside jit-compiled functions in engine/, models/, ops/,
+  parallel/.
+
+CLI: ``python -m bee2bee_tpu.analysis [paths...]`` (exit 1 on any finding
+not grandfathered by analysis/baseline.json). Library:
+``analyze_paths([...])`` / ``analyze_source(src, "meshnet/x.py")``.
+Deliberate violations: ``# meshlint: ignore[rule-id] -- reason``.
+"""
+
+from .core import (
+    BAD_SUPPRESSION,
+    DEFAULT_BASELINE,
+    Finding,
+    analyze_paths,
+    analyze_source,
+    filter_baselined,
+    load_baseline,
+    rule_catalog,
+    write_baseline,
+)
+from .schema import FRAME_SCHEMAS, TASK_SCHEMAS, declared_key_universe
+
+__all__ = [
+    "BAD_SUPPRESSION",
+    "DEFAULT_BASELINE",
+    "FRAME_SCHEMAS",
+    "Finding",
+    "TASK_SCHEMAS",
+    "analyze_paths",
+    "analyze_source",
+    "declared_key_universe",
+    "filter_baselined",
+    "load_baseline",
+    "rule_catalog",
+    "write_baseline",
+]

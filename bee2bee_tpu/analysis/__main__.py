@@ -1,0 +1,97 @@
+"""meshlint CLI: ``python -m bee2bee_tpu.analysis [paths...]``.
+
+Exit codes: 0 = clean (or everything grandfathered/baselined), 1 = new
+findings, 2 = usage error. Default target is the bee2bee_tpu package;
+default baseline is analysis/baseline.json next to this file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .core import (
+    DEFAULT_BASELINE,
+    PACKAGE_ROOT,
+    analyze_paths,
+    filter_baselined,
+    load_baseline,
+    rule_catalog,
+    write_baseline,
+)
+
+FAMILIES = ("frames", "async", "jax")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m bee2bee_tpu.analysis",
+        description="meshlint: wire-protocol, async-safety and JAX-hygiene "
+        "static analysis for the bee2bee-tpu mesh (docs/ANALYSIS.md)",
+    )
+    ap.add_argument("paths", nargs="*", default=None,
+                    help=f"files/dirs to analyze (default: {PACKAGE_ROOT})")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated pass families to run "
+                    f"(default: all of {','.join(FAMILIES)})")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: {DEFAULT_BASELINE})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, grandfathered or not")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from the current findings "
+                    "(ratchet maintenance: do this only to REMOVE fixed entries)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(rule_catalog().items()):
+            print(f"{rule}  {desc}")
+        return 0
+
+    families = None
+    if args.rules:
+        families = frozenset(f.strip() for f in args.rules.split(",") if f.strip())
+        unknown = families - set(FAMILIES)
+        if unknown:
+            print(f"unknown pass families: {sorted(unknown)} "
+                  f"(have: {FAMILIES})", file=sys.stderr)
+            return 2
+
+    paths = args.paths or [PACKAGE_ROOT]
+    findings = analyze_paths(paths, families)
+
+    if args.write_baseline:
+        out = write_baseline(findings, args.baseline)
+        print(f"baseline: {len(findings)} finding(s) written to {out}")
+        return 0
+
+    if args.no_baseline:
+        new, old = findings, []
+    else:
+        new, old = filter_baselined(findings, load_baseline(args.baseline))
+
+    if args.as_json:
+        print(json.dumps(
+            {
+                "new": [f.to_dict() for f in new],
+                "baselined": [f.to_dict() for f in old],
+            },
+            indent=2,
+        ))
+    else:
+        for f in new:
+            print(f.render())
+        tail = f"meshlint: {len(new)} new finding(s)"
+        if old and not args.no_baseline:
+            tail += f", {len(old)} grandfathered (analysis/baseline.json)"
+        print(tail)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

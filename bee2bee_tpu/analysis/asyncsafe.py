@@ -1,0 +1,197 @@
+"""Pass family 2: async-safety (ML-A*).
+
+~2.5k lines of meshnet/failover code run on one asyncio loop; a single
+blocking call there stalls every in-flight generation on the node. Rules:
+
+- ML-A001 — blocking call (time.sleep, requests.*, urllib urlopen, socket
+  connect, subprocess, os.system, builtin open) directly inside an
+  ``async def`` body. Offload via ``asyncio.to_thread`` /
+  ``run_in_executor`` (nested sync ``def``/``lambda`` bodies are exempt —
+  they already run off-loop when dispatched correctly).
+- ML-A002 — unbounded network await on meshnet/web hot paths: bare
+  ``await x.recv()`` and ``await websockets.connect(...)`` without an
+  ``open_timeout``/``timeout``. Wrap in ``asyncio.wait_for`` or pass the
+  timeout kwarg — a black-holed peer must not wedge the caller forever.
+- ML-A003 — network await while holding an ``asyncio.Lock`` (an
+  ``async with ...lock:`` block): one slow peer send serializes every
+  other task contending for the lock. Snapshot under the lock, send
+  outside it (the pattern node.py's broadcast uses).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import dotted_name as _dotted
+
+# blocking targets by dotted name; "requests." matches the whole module
+_BLOCKING_EXACT = {
+    "time.sleep",
+    "urllib.request.urlopen",
+    "socket.create_connection",
+    "subprocess.run",
+    "subprocess.call",
+    "subprocess.check_call",
+    "subprocess.check_output",
+    "os.system",
+    "open",
+}
+_BLOCKING_PREFIXES = ("requests.",)
+
+# awaits that talk to the network: forbidden while a lock is held
+_NETWORK_AWAITS = {
+    "send",
+    "_send",
+    "recv",
+    "connect",
+    "_connect_peer",
+    "broadcast",
+    "request_generation",
+    "run_stage_task",
+}
+
+_TIMEOUT_KWARGS = {"timeout", "open_timeout", "close_timeout"}
+
+
+def _names_a_lock(dotted: str) -> bool:
+    """Does a context-manager name look like a lock? Segment-wise match so
+    the paged-cache vocabulary ("block_pool", "blocked", "unblock" — all
+    containing the substring "lock") never trips ML-A003: only a segment
+    that IS "lock"/"locked" or ends in "...lock" without being a
+    "...block" counts (self._lock, pending_lock, rwlock)."""
+    for seg in dotted.lower().replace(".", "_").split("_"):
+        if seg in ("lock", "locked") or (
+            seg.endswith("lock") and not seg.endswith("block")
+        ):
+            return True
+    return False
+
+
+def _websocket_aliases(tree: ast.AST) -> set[str]:
+    """Names bound to the websockets module (including the wscompat shim
+    imported `as websockets`)."""
+    aliases: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.split(".")[0] == "websockets":
+                    aliases.add(a.asname or "websockets")
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name in ("websockets", "wscompat"):
+                    aliases.add(a.asname or a.name)
+    return aliases
+
+
+class AsyncSafetyPass:
+    family = "async"
+    rules = {
+        "ML-A001": "blocking call inside async def",
+        "ML-A002": "network await without a timeout on a mesh hot path",
+        "ML-A003": "network await while holding an asyncio lock",
+    }
+
+    def applies(self, path: str) -> bool:
+        return True  # any async def anywhere can stall its loop
+
+    def run(self, ctx) -> list:
+        findings: list = []
+        hot_path = ctx.path.startswith(("meshnet/", "web/"))
+        ws_aliases = _websocket_aliases(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                self._scan(ctx, node.body, findings, hot_path, ws_aliases, False)
+        return findings
+
+    # ------------------------------------------------------------- scanning
+
+    def _scan(self, ctx, body, findings, hot_path, ws_aliases, in_lock):
+        for stmt in body:
+            self._scan_node(ctx, stmt, findings, hot_path, ws_aliases, in_lock)
+
+    def _scan_node(self, ctx, node, findings, hot_path, ws_aliases, in_lock):
+        # nested defs/lambdas run off this coroutine's await flow: their
+        # bodies are not scanned here (nested async defs are scanned by
+        # the top-level walk on their own)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return
+        if isinstance(node, (ast.AsyncWith, ast.With)):
+            holds = in_lock or any(
+                _names_a_lock(
+                    _dotted(item.context_expr.func
+                            if isinstance(item.context_expr, ast.Call)
+                            else item.context_expr)
+                )
+                for item in node.items
+            )
+            for item in node.items:
+                self._scan_node(
+                    ctx, item.context_expr, findings, hot_path, ws_aliases, in_lock
+                )
+            self._scan(ctx, node.body, findings, hot_path, ws_aliases, holds)
+            return
+        if isinstance(node, ast.Await):
+            self._check_await(ctx, node, findings, hot_path, ws_aliases, in_lock)
+        elif isinstance(node, ast.Call):
+            self._check_blocking(ctx, node, findings)
+        for child in ast.iter_child_nodes(node):
+            self._scan_node(ctx, child, findings, hot_path, ws_aliases, in_lock)
+
+    def _check_blocking(self, ctx, call: ast.Call, findings):
+        name = _dotted(call.func)
+        if name in _BLOCKING_EXACT or name.startswith(_BLOCKING_PREFIXES):
+            findings.append(
+                ctx.finding(
+                    "ML-A001",
+                    call,
+                    f"blocking call {name}() inside async def",
+                    "stalls every in-flight generation on this loop — "
+                    "offload via asyncio.to_thread / run_in_executor",
+                )
+            )
+
+    def _check_await(self, ctx, node: ast.Await, findings, hot_path, ws_aliases,
+                     in_lock):
+        call = node.value
+        if not isinstance(call, ast.Call):
+            return
+        name = _dotted(call.func)
+        last = name.rsplit(".", 1)[-1]
+        if in_lock and last in _NETWORK_AWAITS:
+            findings.append(
+                ctx.finding(
+                    "ML-A003",
+                    node,
+                    f"await {name}(...) while holding an asyncio lock",
+                    "one slow peer serializes everyone contending for the "
+                    "lock — snapshot under the lock, await outside it",
+                )
+            )
+        if not hot_path:
+            return
+        if last == "recv" and not call.args:
+            findings.append(
+                ctx.finding(
+                    "ML-A002",
+                    node,
+                    "bare await recv() with no timeout",
+                    "a black-holed peer wedges this task forever — wrap in "
+                    "asyncio.wait_for",
+                )
+            )
+        elif (
+            last == "connect"
+            and name.rsplit(".", 1)[0] in ws_aliases
+            and not any(
+                kw.arg in _TIMEOUT_KWARGS for kw in call.keywords if kw.arg
+            )
+        ):
+            findings.append(
+                ctx.finding(
+                    "ML-A002",
+                    node,
+                    "websocket connect without open_timeout",
+                    "dialing a dead addr blocks until the OS gives up — "
+                    "pass open_timeout=... or wrap in asyncio.wait_for",
+                )
+            )

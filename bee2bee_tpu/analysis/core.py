@@ -1,0 +1,322 @@
+"""meshlint core: findings, suppressions, the ratchet baseline, the runner.
+
+Conventions this analyzer turns into machine-checked invariants (see
+docs/ANALYSIS.md for the full rule catalog):
+
+- frames (ML-F*): wire frames must match the schema registry
+  (analysis/schema.py) — the mesh silently ignores unknown keys, so a
+  typo'd key is a silently-wrong output, not an error.
+- async-safety (ML-A*): one blocking call inside the meshnet/gateway event
+  loop stalls every in-flight generation.
+- jax hygiene (ML-J*): a host sync inside a jit hot path erases the
+  paged-cache/scheduler wins with an invisible device round trip.
+
+The gate is **ratchet-only**: pre-existing findings are grandfathered in a
+checked-in baseline (analysis/baseline.json) matched by (rule, path,
+source-line snippet) — line numbers may drift, the offending line may not.
+New findings fail `python -m bee2bee_tpu.analysis` and the tier-1 test
+(tests/test_meshlint.py). Deliberate violations carry an inline
+``# meshlint: ignore[rule-id] -- reason`` (the reason is required).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from collections import Counter
+from dataclasses import dataclass, field
+from pathlib import Path
+
+PACKAGE_ROOT = Path(__file__).resolve().parent.parent  # bee2bee_tpu/
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+# rule id for a suppression comment with no reason — an unexplained ignore
+# is itself a finding, so suppressions stay auditable
+BAD_SUPPRESSION = "ML-S001"
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # package-relative, e.g. "meshnet/node.py"
+    line: int
+    col: int
+    message: str
+    hint: str = ""
+    snippet: str = ""  # stripped source line — the baseline fingerprint
+
+    def key(self) -> tuple:
+        return (self.rule, self.path, self.snippet)
+
+    def render(self) -> str:
+        out = f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "hint": self.hint,
+            "snippet": self.snippet,
+        }
+
+
+@dataclass
+class FileContext:
+    """Everything a pass needs about one source file."""
+
+    path: str  # virtual (package-relative) path used for scoping/reporting
+    src: str
+    tree: ast.AST
+    lines: list[str] = field(default_factory=list)
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(
+        self, rule: str, node: ast.AST, message: str, hint: str = ""
+    ) -> Finding:
+        line = getattr(node, "lineno", 0)
+        col = getattr(node, "col_offset", 0)
+        return Finding(
+            rule, self.path, line, col, message, hint, self.snippet(line)
+        )
+
+
+def dotted_name(expr: ast.AST) -> str:
+    """AST expression → dotted call-target name ("time.sleep" for
+    ``time.sleep(...)``, "span" for ``get_tracer().span`` — the chain
+    stops at any non-Name base). Shared by the passes so name resolution
+    can't diverge between them."""
+    parts: list[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+    return ".".join(reversed(parts))
+
+
+# ------------------------------------------------------------- suppressions
+
+# `# meshlint: ignore[ML-F001]` or `ignore[ML-F001,ML-A003]` or `ignore[*]`,
+# followed by a REQUIRED free-text reason (optionally after --/:/ dashes)
+_SUPPRESS_RE = re.compile(
+    r"#\s*meshlint:\s*ignore\[([A-Za-z0-9_*,\- ]+)\]\s*(?:[-—:]*\s*)?(.*)"
+)
+
+
+@dataclass(frozen=True)
+class Suppression:
+    line: int
+    rules: frozenset
+    reason: str
+
+    def covers(self, finding: Finding) -> bool:
+        return finding.line == self.line and (
+            "*" in self.rules or finding.rule in self.rules
+        )
+
+
+def parse_suppressions(ctx: FileContext) -> tuple[list[Suppression], list[Finding]]:
+    """Inline suppressions + findings for suppressions missing a reason."""
+    sups: list[Suppression] = []
+    bad: list[Finding] = []
+    for i, text in enumerate(ctx.lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        rules = frozenset(r.strip() for r in m.group(1).split(",") if r.strip())
+        reason = m.group(2).strip()
+        if not reason:
+            bad.append(
+                Finding(
+                    BAD_SUPPRESSION,
+                    ctx.path,
+                    i,
+                    text.index("#"),
+                    "meshlint suppression without a reason",
+                    "write `# meshlint: ignore[rule] -- why this is safe`",
+                    text.strip(),
+                )
+            )
+            continue
+        sups.append(Suppression(i, rules, reason))
+    return sups, bad
+
+
+# ----------------------------------------------------------------- baseline
+
+
+def load_baseline(path: str | Path | None = None) -> Counter:
+    """Baseline as a multiset of (rule, path, snippet) fingerprints."""
+    p = Path(path) if path else DEFAULT_BASELINE
+    if not p.exists():
+        return Counter()
+    data = json.loads(p.read_text())
+    return Counter(
+        (f["rule"], f["path"], f.get("snippet", "")) for f in data.get("findings", [])
+    )
+
+
+def write_baseline(findings: list[Finding], path: str | Path | None = None) -> Path:
+    p = Path(path) if path else DEFAULT_BASELINE
+    payload = {
+        "version": 1,
+        "comment": (
+            "meshlint ratchet baseline: grandfathered findings matched by "
+            "(rule, path, snippet). Regenerate with "
+            "`python -m bee2bee_tpu.analysis --write-baseline` — only ever "
+            "to REMOVE entries you fixed; new code must ship clean."
+        ),
+        "findings": [
+            {"rule": f.rule, "path": f.path, "line": f.line, "snippet": f.snippet}
+            for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+        ],
+    }
+    p.write_text(json.dumps(payload, indent=2) + "\n")
+    return p
+
+
+def filter_baselined(
+    findings: list[Finding], baseline: Counter
+) -> tuple[list[Finding], list[Finding]]:
+    """(new, grandfathered). Matching consumes baseline entries so N
+    baselined occurrences never absorb N+1 findings of the same shape."""
+    budget = Counter(baseline)
+    new: list[Finding] = []
+    old: list[Finding] = []
+    for f in findings:
+        if budget[f.key()] > 0:
+            budget[f.key()] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old
+
+
+# ------------------------------------------------------------------- runner
+
+
+def _passes():
+    # imported lazily: the pass modules import this one for Finding/ctx
+    from .asyncsafe import AsyncSafetyPass
+    from .frames import FramesPass
+    from .jaxhygiene import JaxHygienePass
+
+    return (FramesPass(), AsyncSafetyPass(), JaxHygienePass())
+
+
+def rule_catalog() -> dict[str, str]:
+    cat = {BAD_SUPPRESSION: "meshlint suppression without a reason"}
+    for p in _passes():
+        cat.update(p.rules)
+    return cat
+
+
+# subdirectories of the package: out-of-tree checkouts/copies scope by
+# these names so `python -m bee2bee_tpu.analysis /elsewhere/meshnet/x.py`
+# still runs the right passes (a basename-only fallback would silently
+# skip the frames/jax rules on anything outside the installed package)
+_PACKAGE_DIRS = frozenset(
+    {
+        "analysis",
+        "engine",
+        "meshnet",
+        "models",
+        "ops",
+        "parallel",
+        "services",
+        "train",
+        "web",
+    }
+)
+
+
+def virtual_path(path: str | Path) -> str:
+    """Package-relative posix path ("meshnet/node.py") used for pass
+    scoping and baseline fingerprints. Files outside the installed
+    package scope by their rightmost `bee2bee_tpu/` component or by a
+    recognizable package subdirectory; anything else keeps its name (the
+    self-test fixtures pass an explicit virtual path instead)."""
+    p = Path(path).resolve()
+    try:
+        return p.relative_to(PACKAGE_ROOT).as_posix()
+    except ValueError:
+        pass
+    parts = p.parts
+    for i in range(len(parts) - 1, -1, -1):
+        if parts[i] == "bee2bee_tpu" and i + 1 < len(parts):
+            return "/".join(parts[i + 1:])
+    for i in range(len(parts) - 1, -1, -1):
+        if parts[i] in _PACKAGE_DIRS:
+            return "/".join(parts[i:])
+    return p.name
+
+
+def analyze_source(
+    src: str,
+    path: str,
+    families: frozenset | None = None,
+) -> list[Finding]:
+    """Run the passes over one source string. `path` is the VIRTUAL path —
+    it selects which pass families apply (e.g. "meshnet/x.py" gets the
+    frames + async rules; "engine/x.py" gets jax hygiene)."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [
+            Finding(
+                "ML-E000",
+                path,
+                e.lineno or 0,
+                e.offset or 0,
+                f"syntax error: {e.msg}",
+                snippet="",
+            )
+        ]
+    ctx = FileContext(path=path, src=src, tree=tree, lines=src.splitlines())
+    sups, findings = parse_suppressions(ctx)
+    for p in _passes():
+        if families is not None and p.family not in families:
+            continue
+        if not p.applies(path):
+            continue
+        findings.extend(p.run(ctx))
+    findings = [f for f in findings if not any(s.covers(f) for s in sups)]
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def iter_py_files(root: str | Path) -> list[Path]:
+    root = Path(root)
+    if root.is_file():
+        return [root]
+    return sorted(
+        p
+        for p in root.rglob("*.py")
+        if "__pycache__" not in p.parts and "static" not in p.parts
+    )
+
+
+def analyze_paths(
+    paths: list[str | Path],
+    families: frozenset | None = None,
+) -> list[Finding]:
+    findings: list[Finding] = []
+    for root in paths:
+        for f in iter_py_files(root):
+            findings.extend(
+                analyze_source(
+                    f.read_text(encoding="utf-8"), virtual_path(f), families
+                )
+            )
+    return findings

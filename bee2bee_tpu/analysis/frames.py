@@ -1,0 +1,384 @@
+"""Pass family 1: protocol-frame checking (ML-F*).
+
+The wire contract deliberately ignores unknown JSON keys (wire compat with
+the reference mesh), which turns every typo'd key into a silently-wrong
+output instead of an error. This pass re-creates the missing error at
+build time by checking, against the schema registry (analysis/schema.py):
+
+- ML-F001 — frame construction with an undeclared key
+  (`protocol.msg(OP, typo=...)`, `{"type": OP, "typo": ...}`, or a
+  `run_stage_task(peer, KIND, {...})` fields dict)
+- ML-F002 — frame construction missing a required key
+- ML-F003 — message-dict read (`data.get("k")` / `data["k"]`) of a key no
+  declared frame carries
+- ML-F004 — a gen_request built without forwarding the sampling knobs
+  (protocol.SAMPLING_KEYS): the exact "knob dropped at one hop" bug class
+  protocol.py warns about
+
+Scope: meshnet/, web/, services/, api.py — everywhere frames are built or
+consumed.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import protocol as P
+from .schema import FRAME_SCHEMAS, TASK_SCHEMAS, declared_key_universe
+
+# functions whose dict-ish parameter is a decoded wire message (the mesh's
+# handler/worker naming convention); decode()-assigned variables are
+# tracked regardless of function name
+_HANDLER_PREFIXES = ("_handle_", "_task_", "_on_", "_run_stage", "_ring_")
+_MESSAGE_PARAM_NAMES = ("data", "msg", "message", "frame")
+
+_SCOPES = ("meshnet/", "web/", "services/")
+
+
+class _ProtocolNames:
+    """Resolve AST expressions to protocol constant values for this file."""
+
+    def __init__(self, tree: ast.AST):
+        self.module_aliases: set[str] = set()
+        self.const_names: dict[str, str] = {}
+        self.msg_names: set[str] = set()  # bare names bound to protocol.msg
+        self.copy_sampling_names: set[str] = {"copy_sampling"}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name.split(".")[-1] == "protocol":
+                        self.module_aliases.add(a.asname or a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod.endswith("protocol"):
+                    for a in node.names:
+                        if a.name == "msg":
+                            self.msg_names.add(a.asname or a.name)
+                        elif a.name == "copy_sampling":
+                            self.copy_sampling_names.add(a.asname or a.name)
+                        else:
+                            val = getattr(P, a.name, None)
+                            if isinstance(val, str):
+                                self.const_names[a.asname or a.name] = val
+                else:
+                    for a in node.names:
+                        if a.name == "protocol":
+                            self.module_aliases.add(a.asname or "protocol")
+
+    def resolve(self, expr: ast.AST) -> str | None:
+        """Expression → op/kind string, or None when not statically known."""
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return expr.value
+        if isinstance(expr, ast.Name):
+            return self.const_names.get(expr.id)
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id in self.module_aliases
+        ):
+            val = getattr(P, expr.attr, None)
+            return val if isinstance(val, str) else None
+        return None
+
+    def is_msg_call(self, call: ast.Call) -> bool:
+        f = call.func
+        if isinstance(f, ast.Name):
+            return f.id in self.msg_names
+        return (
+            isinstance(f, ast.Attribute)
+            and f.attr == "msg"
+            and isinstance(f.value, ast.Name)
+            and f.value.id in self.module_aliases
+        )
+
+
+def _call_name(expr: ast.AST) -> str:
+    """Last dotted component of a call target ("send" for self._send)."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return ""
+
+
+class FramesPass:
+    family = "frames"
+    rules = {
+        "ML-F001": "frame constructed with a key no schema declares",
+        "ML-F002": "frame constructed without a required key",
+        "ML-F003": "message-dict read of a key no declared frame carries",
+        "ML-F004": "gen_request built without forwarding SAMPLING_KEYS",
+    }
+
+    def applies(self, path: str) -> bool:
+        return path.startswith(_SCOPES) or path == "api.py"
+
+    def run(self, ctx) -> list:
+        names = _ProtocolNames(ctx.tree)
+        universe = declared_key_universe()
+        findings: list = []
+        self._walk_scope(ctx, names, universe, ctx.tree, _FnInfo(None), findings)
+        return findings
+
+    # ------------------------------------------------------------ traversal
+
+    def _walk_scope(self, ctx, names, universe, scope, fn, findings):
+        """Visit one function (or module) scope; recurse into nested
+        functions with their own _FnInfo."""
+        body = scope.body if hasattr(scope, "body") else []
+        for node in body:
+            self._visit(ctx, names, universe, node, fn, findings)
+
+    def _visit(self, ctx, names, universe, node, fn, findings):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            inner = _FnInfo(node)
+            self._walk_scope(ctx, names, universe, node, inner, findings)
+            self._check_fn_gen_requests(ctx, inner, findings)
+            return
+        if isinstance(node, ast.Assign):
+            self._track_assign(names, node, fn)
+        if isinstance(node, ast.Call):
+            self._check_call(ctx, names, universe, node, fn, findings)
+        elif isinstance(node, ast.Dict):
+            self._check_dict_literal(ctx, names, node, fn, findings)
+        elif isinstance(node, ast.Subscript):
+            self._check_subscript(ctx, universe, node, fn, findings)
+        for child in ast.iter_child_nodes(node):
+            self._visit(ctx, names, universe, child, fn, findings)
+
+    def _track_assign(self, names, node: ast.Assign, fn):
+        value = node.value
+        # fields = { ... }  → resolvable at run_stage_task call sites
+        if isinstance(value, ast.Dict) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name):
+                # only single-assignment names are trusted
+                fn.local_dicts[t.id] = (
+                    None if t.id in fn.local_dicts else value
+                )
+                fn.frame_names[id(value)] = t.id
+        # m = protocol.msg(...): the name copy_sampling may later target
+        if (
+            isinstance(value, ast.Call)
+            and names.is_msg_call(value)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+        ):
+            fn.frame_names[id(value)] = node.targets[0].id
+        # data = protocol.decode(raw) / data, tensors = decode_binary(raw)
+        if isinstance(value, ast.Call):
+            cname = _call_name(value.func)
+            if cname == "decode":
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        fn.message_vars.add(t.id)
+            elif cname == "decode_binary":
+                for t in node.targets:
+                    if isinstance(t, ast.Tuple) and t.elts:
+                        first = t.elts[0]
+                        if isinstance(first, ast.Name):
+                            fn.message_vars.add(first.id)
+
+    # ------------------------------------------------------------- checkers
+
+    def _check_call(self, ctx, names, universe, call: ast.Call, fn, findings):
+        if names.is_msg_call(call) and call.args:
+            op = names.resolve(call.args[0])
+            if op is not None:
+                keys = {kw.arg for kw in call.keywords if kw.arg is not None}
+                dynamic = any(kw.arg is None for kw in call.keywords)
+                self._check_frame(ctx, call, op, keys, dynamic, findings)
+                fn.note_frame(op, keys, dynamic, call)
+            return
+        if _call_name(call.func) == "run_stage_task" and len(call.args) >= 3:
+            kind = names.resolve(call.args[1])
+            fields = call.args[2]
+            if isinstance(fields, ast.Name):
+                fields = fn.local_dicts.get(fields.id)
+            if kind is not None and isinstance(fields, ast.Dict):
+                self._check_task_fields(ctx, call, kind, fields, findings)
+        if (
+            _call_name(call.func) in names.copy_sampling_names
+            and len(call.args) >= 2
+            and isinstance(call.args[1], ast.Name)
+        ):
+            fn.copy_sampling_targets.add(call.args[1].id)
+        if _call_name(call.func) == "get" and call.args:
+            # data.get("key"): reads on known message dicts
+            f = call.func
+            if (
+                isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id in fn.message_vars
+            ):
+                key = call.args[0]
+                if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                    self._check_read(ctx, universe, call, key.value, findings)
+
+    def _check_subscript(self, ctx, universe, node: ast.Subscript, fn, findings):
+        if not (isinstance(node.value, ast.Name) and node.value.id in fn.message_vars):
+            return
+        sl = node.slice
+        if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+            self._check_read(ctx, universe, node, sl.value, findings)
+
+    def _check_read(self, ctx, universe, node, key: str, findings):
+        if key not in universe:
+            findings.append(
+                ctx.finding(
+                    "ML-F003",
+                    node,
+                    f"read of message key {key!r} that no declared frame carries",
+                    "typo, or a protocol change that skipped the schema "
+                    "registry — fix the key or extend analysis/schema.py",
+                )
+            )
+
+    def _check_dict_literal(self, ctx, names, node: ast.Dict, fn, findings):
+        keys: set[str] = set()
+        op = None
+        dynamic = False
+        for k, v in zip(node.keys, node.values):
+            if k is None:  # {**spread}
+                dynamic = True
+                continue
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                keys.add(k.value)
+                if k.value == "type":
+                    op = names.resolve(v)
+        if op is None or op not in FRAME_SCHEMAS:
+            return
+        self._check_frame(ctx, node, op, keys - {"type"}, dynamic, findings)
+        fn.note_frame(op, keys - {"type"}, dynamic, node)
+
+    def _check_frame(self, ctx, node, op: str, keys: set, dynamic: bool, findings):
+        schema = FRAME_SCHEMAS.get(op)
+        if schema is None:
+            findings.append(
+                ctx.finding(
+                    "ML-F001",
+                    node,
+                    f"unknown frame op {op!r}",
+                    "not in protocol.MESSAGE_TYPES-derived registry — add a "
+                    "FrameSchema in analysis/schema.py",
+                )
+            )
+            return
+        if not schema.allow_extra:
+            for k in sorted(keys - schema.allowed_keys()):
+                findings.append(
+                    ctx.finding(
+                        "ML-F001",
+                        node,
+                        f"undeclared key {k!r} on a {op!r} frame",
+                        "the wire silently drops unknown keys — fix the typo "
+                        "or declare the key in analysis/schema.py",
+                    )
+                )
+        if not dynamic:
+            for k in sorted(schema.required - keys):
+                findings.append(
+                    ctx.finding(
+                        "ML-F002",
+                        node,
+                        f"{op!r} frame missing required key {k!r}",
+                        f"every {op!r} frame must carry {sorted(schema.required)}",
+                    )
+                )
+            for group in schema.required_any:
+                if not (keys & group):
+                    findings.append(
+                        ctx.finding(
+                            "ML-F002",
+                            node,
+                            f"{op!r} frame missing a correlation id "
+                            f"(one of {sorted(group)})",
+                            "replies are matched by rid/task_id; a frame "
+                            "without one is unanswerable",
+                        )
+                    )
+
+    def _check_task_fields(self, ctx, call, kind: str, fields: ast.Dict, findings):
+        schema = TASK_SCHEMAS.get(kind)
+        if schema is None:
+            findings.append(
+                ctx.finding(
+                    "ML-F001",
+                    call,
+                    f"unknown task kind {kind!r}",
+                    "add a TaskSchema in analysis/schema.py",
+                )
+            )
+            return
+        keys: set[str] = set()
+        dynamic = False
+        for k in fields.keys:
+            if k is None:
+                dynamic = True
+            elif isinstance(k, ast.Constant) and isinstance(k.value, str):
+                keys.add(k.value)
+        if not schema.allow_extra:
+            for k in sorted(keys - schema.allowed_keys()):
+                findings.append(
+                    ctx.finding(
+                        "ML-F001",
+                        call,
+                        f"undeclared field {k!r} on task kind {kind!r}",
+                        "the worker reads only declared fields — fix the "
+                        "typo or extend TASK_SCHEMAS in analysis/schema.py",
+                    )
+                )
+        if not dynamic:
+            for k in sorted(schema.required - keys):
+                findings.append(
+                    ctx.finding(
+                        "ML-F002",
+                        call,
+                        f"task kind {kind!r} missing required field {k!r}",
+                        f"workers require {sorted(schema.required)} for {kind!r}",
+                    )
+                )
+
+    def _check_fn_gen_requests(self, ctx, fn, findings):
+        """ML-F004, attributed per FRAME: a gen_request is exempt only when
+        it spreads dynamic kwargs, carries a sampling knob explicitly, or
+        is assigned to a name that some copy_sampling call in the function
+        targets as its dst — a copy_sampling aimed at a DIFFERENT frame
+        doesn't cover this one."""
+        sampling = set(P.SAMPLING_KEYS)
+        for keys, dynamic, node in fn.gen_requests:
+            if dynamic or keys & sampling:
+                continue
+            name = fn.frame_names.get(id(node))
+            if name and name in fn.copy_sampling_targets:
+                continue
+            findings.append(
+                ctx.finding(
+                    "ML-F004",
+                    node,
+                    "gen_request built without forwarding the sampling knobs",
+                    "a knob missing at ANY hop is a silently-wrong output "
+                    "(protocol.py SAMPLING_KEYS) — protocol.copy_sampling "
+                    "the source dict into this frame",
+                )
+            )
+
+
+class _FnInfo:
+    """Per-function-scope facts the frames pass accumulates."""
+
+    def __init__(self, node):
+        self.node = node
+        self.local_dicts: dict[str, ast.Dict | None] = {}
+        self.message_vars: set[str] = set()
+        self.gen_requests: list[tuple[set, bool, ast.AST]] = []
+        self.frame_names: dict[int, str] = {}  # id(frame node) -> bound name
+        self.copy_sampling_targets: set[str] = set()  # dst names copied into
+        if node is not None and node.name.startswith(_HANDLER_PREFIXES):
+            for arg in list(node.args.args) + list(node.args.kwonlyargs):
+                if arg.arg in _MESSAGE_PARAM_NAMES:
+                    self.message_vars.add(arg.arg)
+
+    def note_frame(self, op: str, keys: set, dynamic: bool, node) -> None:
+        if op == P.GEN_REQUEST and self.node is not None:
+            self.gen_requests.append((keys, dynamic, node))

@@ -1,0 +1,233 @@
+"""The checked frame-schema registry: which keys each wire message may carry.
+
+This is the machine-checked half of the wire-compat contract that
+``protocol.py`` can only state in comments: the reference mesh *silently
+ignores unknown JSON keys* (protocol.py's SAMPLING_KEYS note), so a typo'd
+key is not an error anywhere — it is a silently-wrong output at the far end.
+The frames pass (analysis/frames.py) checks every frame construction and
+every message-dict read in ``meshnet/``, ``web/``, ``services/`` and
+``api.py`` against these schemas.
+
+**Extending the protocol?** Add the new key here in the same change that
+introduces it on the wire — `python -m bee2bee_tpu.analysis` (and the tier-1
+gate tests/test_meshlint.py) fails otherwise. Op constants and SAMPLING_KEYS
+are imported from ``protocol`` so the registry can never drift from the
+constant set itself; only the per-op *key lists* live here.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .. import protocol as P
+
+# reply-correlation id: the node answers either key (reference bridge sends
+# task_id, our request path sends rid), so frames need ONE of them, not both
+ID_KEYS = frozenset({"rid", "task_id"})
+
+# the service result dict (services/base.py result_dict + streaming done
+# line) rides gen_success / gen_result via `**result`
+RESULT_FIELDS = frozenset(
+    {
+        "text",
+        "tokens",
+        "cost",
+        "latency_ms",
+        "price_per_token",
+        "streamed",
+        "backend",
+        "finish_reason",
+        "prompt_tokens",
+        "partial",
+        "via",
+        "error",
+    }
+)
+
+
+@dataclass(frozen=True)
+class FrameSchema:
+    """Key contract for one message op ("type" is implicit on every frame)."""
+
+    op: str
+    required: frozenset = frozenset()
+    optional: frozenset = frozenset()
+    # groups of alternatives: at least one key of each group must be present
+    required_any: tuple = ()
+    # GEN_REQUEST-style frames additionally carry protocol.SAMPLING_KEYS
+    allow_sampling: bool = False
+    # reference-compat ops we never construct: reads allowed, keys unchecked
+    allow_extra: bool = False
+
+    def allowed_keys(self) -> frozenset:
+        keys = self.required | self.optional | {"type"}
+        for group in self.required_any:
+            keys = keys | group
+        if self.allow_sampling:
+            keys = keys | frozenset(P.SAMPLING_KEYS)
+        return keys
+
+
+def _fs(*args, **kw) -> FrameSchema:
+    return FrameSchema(*args, **kw)
+
+
+FRAME_SCHEMAS: dict[str, FrameSchema] = {
+    s.op: s
+    for s in (
+        _fs(
+            P.HELLO,
+            required=frozenset({"peer_id"}),
+            optional=frozenset(
+                {
+                    "addr",
+                    "region",
+                    "metrics",
+                    "services",
+                    "api_port",
+                    "api_host",
+                    "accepts_stages",
+                }
+            ),
+        ),
+        _fs(P.PEER_LIST, required=frozenset({"peers"})),
+        _fs(P.PING, required=frozenset({"ts"}), optional=frozenset({"metrics"})),
+        _fs(P.PONG, required=frozenset({"ts"})),
+        _fs(
+            P.SERVICE_ANNOUNCE,
+            required=frozenset({"service"}),
+            optional=frozenset({"meta"}),
+        ),
+        _fs(
+            P.GEN_REQUEST,
+            required=frozenset({"prompt"}),
+            required_any=(ID_KEYS,),
+            optional=frozenset(
+                {"model", "svc", "max_new_tokens", "max_tokens", "temperature", "stream"}
+            ),
+            allow_sampling=True,
+        ),
+        _fs(P.GEN_CHUNK, required=frozenset({"text"}), required_any=(ID_KEYS,)),
+        _fs(P.GEN_SUCCESS, required_any=(ID_KEYS,), optional=RESULT_FIELDS),
+        _fs(P.GEN_ERROR, required=frozenset({"error"}), required_any=(ID_KEYS,)),
+        _fs(P.GEN_RESULT, required_any=(ID_KEYS,), optional=RESULT_FIELDS),
+        _fs(P.PIECE_REQUEST, required=frozenset({"rid", "hash"})),
+        _fs(
+            P.PIECE_DATA,
+            required=frozenset({"rid", "hash"}),
+            optional=frozenset({"error"}),
+        ),
+        _fs(P.PIECE_HAVE, required=frozenset({"hashes"})),
+        _fs(P.GOODBYE, required=frozenset({"peer_id"})),
+        # task protocol: per-kind field contracts live in TASK_SCHEMAS —
+        # the TASK envelope itself only promises kind + correlation id
+        _fs(P.TASK, required=frozenset({"kind", "task_id"}), allow_extra=True),
+        _fs(
+            P.RESULT,
+            required=frozenset({"task_id"}),
+            optional=frozenset({"ok", "info", "tokens", "stopped"}),
+        ),
+        _fs(
+            P.TASK_ERROR,
+            required=frozenset({"task_id", "error"}),
+            optional=frozenset({"error_kind"}),
+        ),
+        # reference worker-registration dialect: wire-compat constants we
+        # keep but never construct (reference protocol.py:25-53)
+        _fs(P.REGISTER, allow_extra=True),
+        _fs(P.INFO, allow_extra=True),
+    )
+}
+
+
+@dataclass(frozen=True)
+class TaskSchema:
+    """Field contract for one `task` kind (checked at run_stage_task call
+    sites and task-frame literals; "kind"/"task_id" belong to the TASK
+    envelope, tensors ride the binary frame, not these fields)."""
+
+    kind: str
+    required: frozenset = frozenset()
+    optional: frozenset = frozenset()
+    allow_extra: bool = False
+
+    def allowed_keys(self) -> frozenset:
+        return self.required | self.optional
+
+
+_RELAY_FIELDS = frozenset({"origin_peer", "origin_task_id"})
+
+
+def _ts(*args, **kw) -> TaskSchema:
+    return TaskSchema(*args, **kw)
+
+
+TASK_SCHEMAS: dict[str, TaskSchema] = {
+    s.kind: s
+    for s in (
+        _ts(
+            P.TASK_PART_LOAD,
+            required=frozenset({"model", "n_stages", "stage"}),
+            optional=frozenset(
+                {
+                    "max_seq_len",
+                    "dtype",
+                    "rng_seed",
+                    "quantize",
+                    "checkpoint_path",
+                    "epoch",
+                    "next_addr",
+                }
+            ),
+        ),
+        _ts(
+            P.TASK_PART_FORWARD,
+            required=frozenset({"model", "request_id", "offset"}),
+            optional=frozenset({"write_mask", "gather", "epoch"}),
+        ),
+        _ts(
+            P.TASK_PART_FORWARD_RELAY,
+            required=frozenset({"model", "request_id", "offset"}),
+            optional=frozenset({"write_mask", "gather", "epoch"}) | _RELAY_FIELDS,
+        ),
+        _ts(
+            P.TASK_DECODE_RUN,
+            required=frozenset({"model", "request_id", "offset"}),
+            optional=frozenset(
+                {"token", "k", "eos", "gather", "temperature", "seed", "epoch"}
+            )
+            | _RELAY_FIELDS,
+        ),
+        _ts(
+            P.TASK_LAYER_FORWARD_TRAIN,
+            required=frozenset({"model", "request_id"}),
+        ),
+        _ts(
+            P.TASK_LAYER_BACKWARD,
+            required=frozenset({"model", "request_id"}),
+            optional=frozenset({"lr"}),
+        ),
+        _ts("part_release", required=frozenset({"model", "request_id"})),
+        # reference worker kinds we keep for wire compat but never send
+        _ts(P.TASK_LAYER_FORWARD, allow_extra=True),
+        _ts(P.TASK_MODEL_LOAD, allow_extra=True),
+        _ts(P.TASK_MODEL_INFER, allow_extra=True),
+        _ts(P.TASK_MODEL_UNLOAD, allow_extra=True),
+        _ts(P.TASK_TRAIN_STEP, allow_extra=True),
+    )
+}
+
+# local-only annotations that never hit the wire: decode_binary hangs the
+# tensor dict off the message under "_tensors"
+LOCAL_KEYS = frozenset({"_tensors"})
+
+
+def declared_key_universe() -> frozenset:
+    """Every key any declared frame may carry — the reads check (ML-F003)
+    flags message-dict lookups outside this set."""
+    keys: set = set(LOCAL_KEYS) | set(P.SAMPLING_KEYS)
+    for schema in FRAME_SCHEMAS.values():
+        keys |= schema.allowed_keys()
+    for task in TASK_SCHEMAS.values():
+        keys |= task.allowed_keys()
+    return frozenset(keys)

@@ -128,10 +128,7 @@ async def run_p2p_node(
         if tunnel:
             from .. import tunnel as tunnel_mod
 
-            loop = asyncio.get_running_loop()
-            tun = await loop.run_in_executor(
-                None, lambda: tunnel_mod.open_tunnel(node.port, provider=tunnel)
-            )
+            tun = await tunnel_mod.open_tunnel_async(node.port, provider=tunnel)
             link = tunnel_mod.apply_to_node(node, tun)
             logger.info(
                 "tunnel (%s) up: %s — join link: %s", tun.provider, tun.ws_url, link

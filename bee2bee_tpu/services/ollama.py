@@ -95,6 +95,15 @@ class OllamaService(BaseService):
             out["latency_ms"] = int(body["total_duration"] / 1e6)  # ns → ms
         return out
 
+    # Loop-native variants: every OllamaService call blocks on a local-HTTP
+    # round trip (tag resolution + /api/generate), so serving it under the
+    # async gateway must offload to a worker thread — these wrappers are
+    # what meshnet/node._execute_local picks up; sync callers are unchanged
+    # (meshlint ML-A001 bug class: one blocking call stalls every in-flight
+    # generation on the node's loop).
+    execute_async = BaseService._execute_via_thread
+    execute_stream_async = BaseService._stream_via_thread
+
     def execute_stream(self, params: dict[str, Any]) -> Iterator[str]:
         import requests
 

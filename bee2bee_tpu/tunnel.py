@@ -200,6 +200,24 @@ def open_tunnel(
     )
 
 
+async def open_tunnel_async(
+    local_port: int,
+    provider: str = "auto",
+    timeout: float = 30.0,
+    bore_server: str = DEFAULT_BORE_SERVER,
+) -> Tunnel:
+    """Async front for :func:`open_tunnel`, whose polling core sleeps and
+    does sync HTTP (the ngrok agent probe) — that must never run on the
+    node's event loop (meshlint ML-A001 bug class), so it runs in a worker
+    thread. run_p2p_node boots tunnels through this."""
+    import asyncio
+
+    return await asyncio.to_thread(
+        open_tunnel, local_port, provider=provider,
+        timeout=timeout, bore_server=bore_server,
+    )
+
+
 def apply_to_node(node, tunnel: Tunnel) -> str:
     """Point the node's announce address at the tunnel and return the
     tunneled join link (what a remote peer actually dials). A wss tunnel

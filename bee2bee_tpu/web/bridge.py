@@ -316,7 +316,7 @@ class MeshBridge:
         return result
 
     async def _send_gen_request(self, task_id: str, payload: dict):
-        await self.active_ws.send(protocol.encode({
+        frame = {
             "type": protocol.GEN_REQUEST,
             "task_id": task_id,
             "model": payload.get("model"),
@@ -324,7 +324,12 @@ class MeshBridge:
             "max_new_tokens": payload.get("max_new_tokens") or payload.get("max_tokens"),
             "temperature": payload.get("temperature"),
             "stream": True,
-        }))
+        }
+        # every hop copies the knobs from ONE list (protocol.SAMPLING_KEYS):
+        # this hop used to drop them all — top_p/penalties/stop sent through
+        # the browser gateway silently became defaults (meshlint ML-F004)
+        protocol.copy_sampling(payload, frame)
+        await self.active_ws.send(protocol.encode(frame))
 
     # ------------------------------------------------------------ status
 

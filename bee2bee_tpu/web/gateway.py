@@ -25,6 +25,7 @@ from pathlib import Path
 
 from aiohttp import web
 
+from ..protocol import copy_sampling
 from ..utils import pump_queue_until
 from .bridge import MeshBridge
 
@@ -76,13 +77,19 @@ def create_web_app(bridge: MeshBridge, registry=None) -> web.Application:
         def on_chunk(text: str):
             chunk_q.put_nowait(text)
 
+        payload = {
+            "prompt": prompt,
+            "model": model,
+            "max_new_tokens": body.get("max_new_tokens") or body.get("max_tokens"),
+            "temperature": body.get("temperature"),
+        }
+        # sampling knobs ride the payload into BOTH bridge paths (direct
+        # HTTP posts the payload verbatim; the WS dialect copies from the
+        # same list again) — the top level wins over the legacy task{}
+        copy_sampling(task, payload)
+        copy_sampling(body, payload)
         req_task = asyncio.create_task(bridge.request(
-            {
-                "prompt": prompt,
-                "model": model,
-                "max_new_tokens": body.get("max_new_tokens") or body.get("max_tokens"),
-                "temperature": body.get("temperature"),
-            },
+            payload,
             on_chunk=on_chunk,
             target=target,
         ))

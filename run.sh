@@ -16,6 +16,13 @@ API_PORT="${API_PORT:-4002}"
 WEB_PORT="${WEB_PORT:-4001}"
 PY="${PYTHON:-python}"
 
+# build-time invariants before anything listens: meshlint catches the
+# typo'd-frame-key / blocked-event-loop bug classes the wire protocol and
+# asyncio swallow at runtime (docs/ANALYSIS.md). SKIP_LINT=1 to bypass.
+if [[ "${SKIP_LINT:-0}" != "1" ]]; then
+    "$(dirname "$0")/scripts/lint.sh"
+fi
+
 # kill only OUR children — `kill 0` would signal the whole process group,
 # including a calling Makefile/CI shell
 PIDS=()

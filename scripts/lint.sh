@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Build-time gate: meshlint (wire-protocol / async-safety / JAX-hygiene
+# static analysis, docs/ANALYSIS.md) + a bytecode compile sweep. Run from
+# anywhere; CI and run.sh call this. Exit nonzero on any new finding.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+PY="${PYTHON:-python}"
+
+echo "[lint] meshlint (python -m bee2bee_tpu.analysis)"
+"$PY" -m bee2bee_tpu.analysis "$@"
+
+echo "[lint] compileall"
+"$PY" -m compileall -q bee2bee_tpu
+
+echo "[lint] ok"

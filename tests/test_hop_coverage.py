@@ -1,0 +1,114 @@
+"""Sampling-knob hop coverage: every protocol.SAMPLING_KEYS knob must
+survive the full api → node → relay → service path.
+
+This is the dynamic twin of the meshlint frames pass (ML-F001/ML-F004):
+the wire protocol silently ignores unknown keys for wire compat, so a knob
+dropped at ANY hop is a silently-wrong output, not an error. The test
+derives its sentinel set from protocol.SAMPLING_KEYS itself — adding a new
+knob to the list automatically extends the coverage, and a hop that fails
+to copy it fails here.
+
+Topology: A (HTTP gateway, no service) → B (relay: believed to provide the
+model but has no local service) → C (the real service). B's relay leg is
+forced by hand-announcing a service B doesn't have — the exact situation
+a stale announce produces on a churny mesh.
+"""
+
+from __future__ import annotations
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from bee2bee_tpu import protocol
+from bee2bee_tpu.api import build_app
+from bee2bee_tpu.services.fake import FakeService
+from tests.test_meshnet import _settle, mesh
+
+MODEL = "hop-model"
+
+
+def _sentinels() -> dict:
+    """One distinct sentinel per sampling knob, derived from the list."""
+    out = {}
+    for i, key in enumerate(protocol.SAMPLING_KEYS):
+        out[key] = ["HOP_STOP_MARKER"] if key == "stop" else round(0.111 * (i + 1), 3)
+    return out
+
+
+async def _wire_a_b_c(a, b, c):
+    """B hand-announces MODEL at price 0.0 without holding a service for
+    it (a stale announce, normal weather on a churny mesh); C announces
+    the real service at 0.5. Peer-list gossip fully connects the
+    triangle, but cheapest-first provider selection pins A's route to B —
+    whose missing service forces the relay leg B → C."""
+    assert await a.connect_bootstrap(b.addr)
+    await _settle(lambda: a.peers and b.peers)
+    assert await b.connect_bootstrap(c.addr)
+    await _settle(lambda: c.peers)
+    svc = FakeService(MODEL, reply="made it through three hops",
+                      price_per_token=0.5)
+    c.add_service(svc)
+    await c.announce_service(svc)
+    # the stale announce: B claims MODEL without holding a service for it
+    await b.broadcast(
+        protocol.msg(
+            protocol.SERVICE_ANNOUNCE,
+            service="tpu",
+            meta={"models": [MODEL], "price_per_token": 0.0},
+        )
+    )
+    assert await _settle(lambda: b.providers.get(c.peer_id))
+    assert await _settle(lambda: a.providers.get(b.peer_id))
+    # preconditions for the path: A holds no service and must route via B
+    assert a.local_service_for(MODEL) is None
+    assert a.pick_provider(MODEL)["provider_id"] == b.peer_id
+    return svc
+
+
+async def test_sampling_keys_survive_api_node_relay_service():
+    async with mesh(3) as (a, b, c):
+        svc = await _wire_a_b_c(a, b, c)
+        client = TestClient(TestServer(build_app(a)))
+        await client.start_server()
+        try:
+            body = {"prompt": "hop", "model": MODEL, "max_new_tokens": 11,
+                    "temperature": 0.25, **_sentinels()}
+            r = await client.post("/chat", json=body)
+            assert r.status == 200
+            assert (await r.json())["text"] == "made it through three hops"
+        finally:
+            await client.close()
+        assert svc.calls, "service never executed — relay path broken"
+        got = svc.calls[-1]
+        missing = {
+            k: v for k, v in _sentinels().items() if got.get(k) != v
+        }
+        assert not missing, (
+            f"sampling knobs dropped on the api→node→relay→service path: "
+            f"{missing}; service saw {got}"
+        )
+        # the non-knob generation params survive the hops too
+        assert got["prompt"] == "hop"
+        assert got["max_new_tokens"] == 11
+        assert got["temperature"] == 0.25
+
+
+async def test_sampling_keys_survive_streaming_relay():
+    """Same three hops, streamed: the relay re-frames chunks under its own
+    rid and must still forward every knob."""
+    async with mesh(3) as (a, b, c):
+        svc = await _wire_a_b_c(a, b, c)
+        chunks: list[str] = []
+        result = await a.request_generation(
+            b.peer_id,
+            "hop",
+            model=MODEL,
+            max_new_tokens=8,
+            stream=True,
+            on_chunk=chunks.append,
+            extra=_sentinels(),
+        )
+        assert "".join(chunks) == "made it through three hops"
+        assert result.get("error") is None
+        got = svc.calls[-1]
+        for k, v in _sentinels().items():
+            assert got.get(k) == v, f"knob {k!r} dropped in streamed relay"

@@ -1,0 +1,388 @@
+"""meshlint (bee2bee_tpu/analysis): the tier-1 ratchet gate + pass self-tests.
+
+The gate test runs the analyzer over the installed package: any finding not
+grandfathered by analysis/baseline.json fails tier-1 — that is the ratchet.
+The self-tests prove each pass family actually catches its bug class on
+small known-bad fixtures (so a silently-broken pass can't hide behind a
+clean repo), and that seeding a typo'd sampling key into a real frame
+literal is caught.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from bee2bee_tpu import protocol
+from bee2bee_tpu.analysis import (
+    analyze_paths,
+    analyze_source,
+    declared_key_universe,
+    filter_baselined,
+    load_baseline,
+    rule_catalog,
+)
+from bee2bee_tpu.analysis.core import PACKAGE_ROOT
+from bee2bee_tpu.analysis.schema import FRAME_SCHEMAS, TASK_SCHEMAS
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# ------------------------------------------------------------------ the gate
+
+
+def test_package_is_clean_under_baseline():
+    """THE tier-1 gate: no non-baselined finding anywhere in the package."""
+    findings = analyze_paths([PACKAGE_ROOT])
+    new, _old = filter_baselined(findings, load_baseline())
+    assert not new, "new meshlint findings (fix them or, for deliberate " \
+        "violations, add `# meshlint: ignore[rule] -- reason`):\n" + \
+        "\n".join(f.render() for f in new)
+
+
+def test_seeded_sampling_key_typo_is_caught():
+    """The acceptance scenario: typo a sampling key in a REAL frame literal
+    (node.py's gen_request) and the frames pass must flag it."""
+    src = (PACKAGE_ROOT / "meshnet" / "node.py").read_text()
+    seeded = src.replace("temperature=temperature,", "temperture=temperature,", 1)
+    assert seeded != src, "node.py gen_request literal moved; update the seed"
+    findings = analyze_source(seeded, "meshnet/node.py")
+    assert any(
+        f.rule == "ML-F001" and "temperture" in f.message for f in findings
+    ), findings
+
+
+def test_seeded_task_field_typo_is_caught():
+    src = (PACKAGE_ROOT / "meshnet" / "pipeline.py").read_text()
+    seeded = src.replace('"rng_seed": self.rng_seed,', '"rngseed": self.rng_seed,', 1)
+    assert seeded != src
+    assert any(
+        f.rule == "ML-F001" and "rngseed" in f.message
+        for f in analyze_source(seeded, "meshnet/pipeline.py")
+    )
+
+
+def test_seeded_message_read_typo_is_caught():
+    src = (PACKAGE_ROOT / "meshnet" / "node.py").read_text()
+    seeded = src.replace('data.get("peer_id")', 'data.get("peerid")', 1)
+    assert seeded != src
+    assert any(
+        f.rule == "ML-F003" and "peerid" in f.message
+        for f in analyze_source(seeded, "meshnet/node.py")
+    )
+
+
+# ------------------------------------------------------- frames pass fixtures
+
+
+def test_frames_pass_known_bad_fixture():
+    src = '''
+from .. import protocol
+
+async def send(ws, rid):
+    await ws.send(protocol.encode(
+        protocol.msg(protocol.GEN_REQUEST, rid=rid, prompt="x", top_kk=5)))
+    await ws.send(protocol.encode({"type": protocol.GEN_CHUNK, "rid": rid}))
+
+async def _handle_gen_request(ws, data):
+    return data.get("promt")
+'''
+    rules = _rules(analyze_source(src, "meshnet/fixture.py"))
+    assert "ML-F001" in rules  # top_kk undeclared
+    assert "ML-F002" in rules  # gen_chunk without text
+    assert "ML-F003" in rules  # read of "promt"
+    assert "ML-F004" in rules  # no sampling forwarding on that gen_request
+
+
+def test_frames_pass_run_stage_task_fields():
+    src = '''
+from .. import protocol
+
+async def load(self, peer):
+    await self.node.run_stage_task(
+        peer, protocol.TASK_PART_LOAD,
+        {"model": "m", "n_stages": 2, "staeg": 0},
+    )
+'''
+    rules = _rules(analyze_source(src, "meshnet/fixture.py"))
+    assert "ML-F001" in rules  # staeg
+    assert "ML-F002" in rules  # stage missing
+
+
+def test_frames_pass_accepts_clean_constructions():
+    src = '''
+from .. import protocol
+
+async def send(ws, rid, extra):
+    await ws.send(protocol.encode(protocol.msg(
+        protocol.GEN_REQUEST, rid=rid, prompt="x", top_k=4, stop=["a"])))
+    await ws.send(protocol.encode(protocol.msg(
+        protocol.GEN_SUCCESS, rid=rid, **extra)))
+
+async def _handle_gen_request(ws, data):
+    return data.get("prompt"), data.get("top_p"), data["_tensors"]
+'''
+    assert analyze_source(src, "meshnet/fixture.py") == []
+
+
+def test_frames_pass_out_of_scope_paths_unchecked():
+    src = 'x = {"type": "gen_chunk"}\n'  # missing text+id: finding in scope
+    assert _rules(analyze_source(src, "web/fixture.py")).count("ML-F002") == 2
+    assert analyze_source(src, "engine/fixture.py") == []
+
+
+# -------------------------------------------------------- async pass fixtures
+
+
+def test_async_pass_known_bad_fixture():
+    src = '''
+import time, requests
+
+async def bad(self, ws):
+    time.sleep(1)
+    requests.post("http://x", json={})
+    async with self._lock:
+        await ws.send("hi")
+    await ws.recv()
+'''
+    rules = _rules(analyze_source(src, "meshnet/fixture.py"))
+    assert rules.count("ML-A001") == 2
+    assert "ML-A003" in rules
+    assert "ML-A002" in rules
+
+
+def test_async_pass_clean_patterns_pass():
+    src = '''
+import asyncio
+import websockets
+
+async def good(self, addr):
+    async with self._lock:
+        targets = list(self.peers)
+    ws = await websockets.connect(addr, open_timeout=10)
+    await asyncio.sleep(0.1)
+
+    def offloaded():
+        import time
+        time.sleep(1)  # runs in an executor thread, not the loop
+
+    await asyncio.get_running_loop().run_in_executor(None, offloaded)
+'''
+    assert analyze_source(src, "meshnet/fixture.py") == []
+
+
+def test_async_pass_ws_connect_without_timeout():
+    src = '''
+import websockets
+
+async def dial(addr):
+    return await websockets.connect(addr)
+'''
+    assert "ML-A002" in _rules(analyze_source(src, "meshnet/fixture.py"))
+    # outside the meshnet/web hot-path scope the timeout rule stays quiet
+    assert analyze_source(src, "services/fixture.py") == []
+
+
+# ---------------------------------------------------------- jax pass fixtures
+
+
+def test_jax_pass_known_bad_fixture():
+    src = '''
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+def _decode_fn(cache, x, k):
+    v = x.item()
+    h = np.asarray(x)
+    n = int(k)
+    if jnp.any(x > 0):
+        x = x + 1
+    return x
+
+decode = jax.jit(_decode_fn)
+'''
+    rules = _rules(analyze_source(src, "engine/fixture.py"))
+    assert rules.count("ML-J001") == 3
+    assert "ML-J002" in rules
+
+
+def test_jax_pass_only_flags_jit_reachable():
+    src = '''
+import numpy as np
+
+def host_side(x):
+    return np.asarray(x).item()  # never jit-compiled: fine
+'''
+    assert analyze_source(src, "engine/fixture.py") == []
+
+
+def test_jax_pass_sees_decorators_and_scan_bodies():
+    src = '''
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(x):
+    return x.item()
+
+def outer(xs):
+    def step(carry, x):
+        if jnp.sum(x):
+            carry = carry + 1
+        return carry, x
+    return jax.lax.scan(step, 0, xs)
+'''
+    rules = _rules(analyze_source(src, "models/fixture.py"))
+    assert "ML-J001" in rules and "ML-J002" in rules
+
+
+# ------------------------------------------------- suppressions and baseline
+
+
+def test_suppression_requires_reason():
+    src = '''
+async def f(ws):
+    await ws.recv()  # meshlint: ignore[ML-A002]
+'''
+    rules = _rules(analyze_source(src, "meshnet/fixture.py"))
+    assert "ML-S001" in rules and "ML-A002" in rules  # unexplained ≠ suppressed
+
+
+def test_suppression_with_reason_suppresses_only_that_rule():
+    src = '''
+async def f(ws):
+    await ws.recv()  # meshlint: ignore[ML-A002] -- loopback shim, in-process peer
+'''
+    assert analyze_source(src, "meshnet/fixture.py") == []
+    wildcard = src.replace("[ML-A002]", "[*]")
+    assert analyze_source(wildcard, "meshnet/fixture.py") == []
+    wrong_rule = src.replace("[ML-A002]", "[ML-A001]")
+    assert _rules(analyze_source(wrong_rule, "meshnet/fixture.py")) == ["ML-A002"]
+
+
+def test_baseline_is_a_consuming_multiset():
+    src = '''
+async def f(ws):
+    await ws.recv()
+
+async def g(ws):
+    await ws.recv()
+'''
+    findings = analyze_source(src, "meshnet/fixture.py")
+    assert _rules(findings) == ["ML-A002", "ML-A002"]
+    # identical snippets: one baseline entry absorbs exactly one finding
+    from collections import Counter
+    baseline = Counter([findings[0].key()])
+    new, old = filter_baselined(findings, baseline)
+    assert len(new) == 1 and len(old) == 1
+
+
+def test_cli_exit_codes(tmp_path):
+    from bee2bee_tpu.analysis.__main__ import main
+
+    bad = tmp_path / "meshnet"
+    bad.mkdir()
+    (bad / "x.py").write_text(
+        "import time\n\nasync def f(ws):\n    time.sleep(1)\n"
+    )
+    # a file outside the package scopes by basename; the blocking-call
+    # rule applies to every path, so the CLI must exit 1 on it
+    assert main([str(bad), "--no-baseline"]) != 0
+    assert main([str(PACKAGE_ROOT / "protocol.py")]) == 0
+    assert main(["--list-rules"]) == 0
+
+
+# -------------------------------------------------------- registry invariants
+
+
+def test_every_message_type_has_a_schema():
+    assert set(FRAME_SCHEMAS) >= set(protocol.MESSAGE_TYPES)
+
+
+def test_every_task_kind_constant_has_a_schema():
+    kinds = {
+        v
+        for k, v in vars(protocol).items()
+        if k.startswith("TASK_") and isinstance(v, str) and v != protocol.TASK_ERROR
+    }
+    assert kinds <= set(TASK_SCHEMAS)
+
+
+def test_sampling_keys_are_in_the_declared_universe():
+    assert set(protocol.SAMPLING_KEYS) <= declared_key_universe()
+
+
+def test_rule_catalog_covers_all_emitted_rules():
+    cat = rule_catalog()
+    for rule in ("ML-F001", "ML-F002", "ML-F003", "ML-F004",
+                 "ML-A001", "ML-A002", "ML-A003",
+                 "ML-J001", "ML-J002", "ML-S001"):
+        assert rule in cat
+
+
+def test_out_of_tree_paths_scope_by_package_structure(tmp_path):
+    """Analyzing a checkout/copy OUTSIDE the installed package must still
+    scope files by their meshnet/engine/... structure — a basename
+    fallback would silently skip the frames/jax passes there."""
+    from bee2bee_tpu.analysis.core import virtual_path
+
+    d = tmp_path / "clone" / "bee2bee_tpu" / "meshnet"
+    d.mkdir(parents=True)
+    f = d / "node.py"
+    f.write_text("")
+    assert virtual_path(f) == "meshnet/node.py"
+    d2 = tmp_path / "copy" / "engine"
+    d2.mkdir(parents=True)
+    assert virtual_path(d2 / "scheduler.py") == "engine/scheduler.py"
+
+
+def test_f004_attributed_per_frame_not_per_function():
+    """One copy_sampling call must exempt ONLY the frame it targets —
+    a second knob-less gen_request in the same function still fails."""
+    src = '''
+from .. import protocol
+
+async def two_frames(ws, payload, rid):
+    covered = {"type": protocol.GEN_REQUEST, "rid": rid, "prompt": "x"}
+    protocol.copy_sampling(payload, covered)
+    await ws.send(protocol.encode(covered))
+    naked = {"type": protocol.GEN_REQUEST, "rid": rid, "prompt": "y"}
+    await ws.send(protocol.encode(naked))
+'''
+    findings = analyze_source(src, "web/fixture.py")
+    f004 = [f for f in findings if f.rule == "ML-F004"]
+    assert len(f004) == 1 and "naked" in f004[0].snippet, findings
+
+
+def test_f004_covers_msg_assigned_frames():
+    src = '''
+from .. import protocol
+
+async def send(ws, body, rid):
+    m = protocol.msg(protocol.GEN_REQUEST, rid=rid, prompt="x")
+    protocol.copy_sampling(body, m)
+    await ws.send(protocol.encode(m))
+'''
+    assert analyze_source(src, "meshnet/fixture.py") == []
+
+
+def test_a003_lock_naming_does_not_match_block_vocabulary():
+    """'block' contains the substring 'lock': the paged-cache vocabulary
+    (block pools, blocked peers) must not trip the lock-held rule."""
+    src = '''
+async def fine(self, ws):
+    async with self.block_pool_guard:
+        await ws.send("hi")
+    async with self.unblock_gate:
+        await ws.send("hi")
+
+async def held(self, ws):
+    async with self.rw_lock:
+        await ws.send("hi")
+'''
+    findings = analyze_source(src, "meshnet/fixture.py")
+    assert _rules(findings) == ["ML-A003"]
+    # the one finding anchors to the await inside the real lock block
+    assert findings[0].line == 10

@@ -55,3 +55,77 @@ def test_binary_frame_is_compact():
 def test_short_magic_frame_raises_valueerror():
     with pytest.raises(ValueError):
         protocol.decode_binary(b"B2T1abc")
+
+
+# ---- tensor-frame decode error paths (the codec must fail loudly: a
+# mis-framed tensor that decoded "successfully" would be silent garbage
+# hidden states mid-pipeline) ----------------------------------------------
+
+
+def test_bad_magic_rejected():
+    good = protocol.encode_binary(protocol.msg(protocol.TASK, task_id="t"), {})
+    with pytest.raises(ValueError, match="magic"):
+        protocol.decode_binary(b"XXXX" + good[4:])
+
+
+def test_empty_and_magic_only_frames_rejected():
+    with pytest.raises(ValueError):
+        protocol.decode_binary(b"")
+    with pytest.raises(ValueError, match="truncated"):
+        protocol.decode_binary(b"B2T1")
+
+
+def test_header_length_past_frame_end_rejected():
+    # a header_len field pointing past the buffer must not slice garbage
+    import struct
+
+    raw = b"B2T1" + struct.pack("<I", 10_000) + b'{"type":"task"}'
+    with pytest.raises(ValueError, match="truncated tensor-frame header"):
+        protocol.decode_binary(raw)
+
+
+def test_truncated_payload_rejected_per_tensor():
+    x = np.arange(64, dtype=np.float32)
+    y = np.arange(8, dtype=np.int32)
+    raw = protocol.encode_binary(
+        protocol.msg(protocol.TASK, task_id="t"), {"x": x, "y": y}
+    )
+    # cut inside the SECOND tensor: the first decodes, the short one must
+    # still raise rather than return a truncated array
+    with pytest.raises(ValueError, match="truncated tensor frame"):
+        protocol.decode_binary(raw[:-2])
+
+
+def test_header_that_is_not_a_message_rejected():
+    import json
+    import struct
+
+    hb = json.dumps({"no_type": 1, "tensors": []}).encode()
+    raw = b"B2T1" + struct.pack("<I", len(hb)) + hb
+    with pytest.raises(ValueError, match="not a protocol message"):
+        protocol.decode_binary(raw)
+
+
+def test_reserved_tensors_key_clobber_rejected():
+    # "tensors" is the header slot the specs ride in (protocol.py): a
+    # message field of that name would be silently clobbered on encode and
+    # popped on decode — encode_binary must refuse it outright
+    with pytest.raises(ValueError, match="reserved"):
+        protocol.encode_binary(
+            {"type": "task", "task_id": "t", "tensors": [1, 2]},
+            {"x": np.ones(3, np.float32)},
+        )
+
+
+def test_scalar_and_empty_tensors_roundtrip():
+    # 0-d and 0-length tensors are the truncation checks' edge cases: both
+    # must survive the codec exactly (shape preserved, no payload misread)
+    scalar = np.float32(3.5)
+    empty = np.zeros((0, 4), np.int32)
+    raw = protocol.encode_binary(
+        protocol.msg(protocol.TASK, task_id="t"),
+        {"s": scalar, "e": empty},
+    )
+    m, tensors = protocol.decode_binary(raw)
+    assert tensors["s"].shape == () and float(tensors["s"]) == 3.5
+    assert tensors["e"].shape == (0, 4)

@@ -299,3 +299,46 @@ async def test_gateway_streams_incrementally():
                 assert arrivals[-1][0] - arrivals[0][0] > 0.3, arrivals
     finally:
         await node.stop()
+
+
+async def test_gateway_forwards_sampling_knobs_over_ws_dialect():
+    """The browser-gateway hop used to DROP every sampling knob (the
+    meshlint ML-F004 finding): body → bridge payload → WS gen_request →
+    node → service must carry protocol.SAMPLING_KEYS end to end."""
+    from tests.test_hop_coverage import _sentinels
+
+    sentinels = _sentinels()
+    async with provider_node() as node:
+        svc = node.local_services["fake"]
+        async with bridge_for(node) as bridge:
+            await _settle(lambda: bridge.active_ws is not None)
+            async with gateway_client(bridge) as client:
+                resp = await client.post(
+                    "/api/p2p/generate",
+                    json={"prompt": "knobs", "model": "web-model", **sentinels},
+                )
+                assert resp.status == 200
+                await resp.read()
+        assert svc.calls, "generation never reached the service"
+        got = svc.calls[-1]
+        dropped = {k: v for k, v in sentinels.items() if got.get(k) != v}
+        assert not dropped, f"gateway/bridge hop dropped knobs: {dropped}"
+
+
+async def test_bridge_ws_request_forwards_sampling_knobs():
+    """MeshBridge.request payload knobs ride the gen_request frame (the
+    direct-HTTP fast path posts the payload verbatim; this pins the WS
+    dialect to the same contract)."""
+    async with provider_node() as node:
+        svc = node.local_services["fake"]
+        async with bridge_for(node) as bridge:
+            await _settle(lambda: bridge.active_ws is not None)
+            result = await bridge.request(
+                {"prompt": "x", "model": "web-model", "top_k": 3,
+                 "top_p": 0.5, "stop": ["S"]},
+            )
+            assert result["text"]
+        got = svc.calls[-1]
+        assert got.get("top_k") == 3
+        assert got.get("top_p") == 0.5
+        assert got.get("stop") == ["S"]
