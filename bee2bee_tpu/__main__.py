@@ -81,6 +81,8 @@ def _apply_common_cfg(cfg, kw):
         cfg.quantize = kw["quantize"]
     if kw.get("paged"):
         cfg.paged = True
+    if kw.get("spec_tokens") is not None:
+        cfg.spec_tokens = kw["spec_tokens"]
     return cfg
 
 
@@ -162,6 +164,11 @@ def cli():
               help="paged KV cache: per-step cache HBM traffic scales with "
                    "live tokens, not max_batch*max_seq; prefix-cache hits "
                    "share prompt blocks copy-on-write (dense attention only)")
+@click.option("--spec", "spec_tokens", type=int, default=None,
+              help="self-speculative decoding: draft up to N tokens per "
+                   "step by n-gram lookup over the request's own "
+                   "prompt+output and verify them in one batched forward "
+                   "(greedy rows; BEE2BEE_SPEC; 0 = off)")
 @click.option("--publish-weights", is_flag=True,
               help="announce this node's params as DHT pieces for joiners")
 @click.option("--from-mesh", is_flag=True,
@@ -169,11 +176,12 @@ def cli():
                    "(zero local checkpoint)")
 @_common_opts
 def serve_tpu(model, checkpoint, lora, mesh_shape, attention, quantize,
-              paged, publish_weights, from_mesh, **kw):
+              paged, spec_tokens, publish_weights, from_mesh, **kw):
     """Serve a model on TPU via the jit engine (the flagship entrypoint)."""
     _serve(
         "tpu", model, checkpoint=checkpoint, lora=lora, mesh_shape=mesh_shape,
         attention=attention, quantize=quantize, paged=paged,
+        spec_tokens=spec_tokens,
         publish_weights=publish_weights, from_mesh=from_mesh, **kw
     )
 

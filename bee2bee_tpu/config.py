@@ -33,6 +33,7 @@ _ENV_MAP = {
     "BEE2BEE_PAGED": "paged",
     "BEE2BEE_KV_BLOCK_SIZE": "kv_block_size",
     "BEE2BEE_KV_POOL_BLOCKS": "kv_pool_blocks",
+    "BEE2BEE_SPEC": "spec_tokens",
     "BEE2BEE_QUANTIZE": "quantize",
     "BEE2BEE_AUTO_NAT": "auto_nat",
     "BEE2BEE_DHT_PORT": "dht_port",
@@ -42,7 +43,7 @@ _ENV_MAP = {
 _INT_FIELDS = {
     "port", "api_port", "announce_port", "max_batch_size", "max_seq_len",
     "dht_port", "prefill_chunk", "prefix_cache_entries", "kv_block_size",
-    "kv_pool_blocks",
+    "kv_pool_blocks", "spec_tokens",
 }
 _BOOL_FIELDS = {"auto_nat", "paged"}
 
@@ -84,6 +85,11 @@ class NodeConfig:
     # max_batch * max_seq (EngineConfig.paged; dense attention only)
     paged: bool = False
     kv_block_size: int = 16  # tokens per pool block (EngineConfig knob)
+    # self-speculative decoding: draft up to this many tokens per step
+    # by n-gram lookup over the request's own prompt+output and verify
+    # them in one batched forward (BEE2BEE_SPEC / --spec; 0 = off —
+    # EngineConfig.spec_tokens)
+    spec_tokens: int = 0
     # total pool blocks; 0 = default sizing (exhaustion impossible). An
     # explicit smaller value trades HBM for admission backpressure
     # (EngineConfig.kv_pool_blocks)
@@ -118,6 +124,7 @@ class NodeConfig:
             paged=self.paged,
             kv_block_size=self.kv_block_size,
             kv_pool_blocks=self.kv_pool_blocks or None,
+            spec_tokens=self.spec_tokens,
         )
 
 

@@ -131,6 +131,27 @@ class EngineConfig:
     # to trade HBM for admission backpressure (the scheduler queues, and
     # reclaims prefix pins, when the free list runs dry).
     kv_pool_blocks: int | None = None
+    # self-speculative decoding (engine/spec.py): draft up to this many
+    # tokens per step by n-gram lookup against the row's own
+    # prompt+output, verify them all in ONE [B, K+1] forward, accept the
+    # longest exact prefix. Greedy non-penalized rows only (token-for-
+    # token parity with plain greedy decode); sampled/penalized rows in
+    # the same batch keep the normal decode windows. 0 = off. Dense
+    # attention only — the verify chunk rides the dense cache write
+    # paths (rectangular and paged); under flash/sp the scheduler logs
+    # and decodes normally.
+    spec_tokens: int = 0
+    # suffix n-gram lengths the drafter tries, longest first. A longer
+    # match predicts the continuation better; min_match=2 keeps single
+    # high-frequency tokens (spaces, newlines) from drafting noise.
+    spec_min_match: int = 2
+    spec_max_match: int = 8
+    # per-row adaptive disable: after spec_probe_tokens drafted tokens,
+    # a row whose acceptance rate sits below spec_min_accept stops
+    # speculating (the draft lookup + wider verify buy nothing on
+    # non-repetitive content).
+    spec_min_accept: float = 0.25
+    spec_probe_tokens: int = 64
 
     def __post_init__(self):
         # <= 0 means "disabled" (NodeConfig uses 0 as its sentinel); a raw
@@ -140,6 +161,15 @@ class EngineConfig:
             self.prefill_chunk = None
         if self.paged and self.kv_block_size < 1:
             raise ValueError(f"kv_block_size must be >= 1, got {self.kv_block_size}")
+        if self.spec_tokens < 0:  # NodeConfig's 0-means-disabled sentinel
+            self.spec_tokens = 0
+        if self.spec_tokens and not (
+            1 <= self.spec_min_match <= self.spec_max_match
+        ):
+            raise ValueError(
+                f"need 1 <= spec_min_match <= spec_max_match, got "
+                f"{self.spec_min_match}..{self.spec_max_match}"
+            )
 
 
 @dataclass
@@ -246,6 +276,9 @@ class InferenceEngine:
         self._replicated = NamedSharding(self.mesh, P())
         # one jit object; it specializes per tokens shape (= per bucket)
         self._prefill = jax.jit(self._prefill_fn, donate_argnums=(2,))
+        # speculative-decode verify step: [B, K+1] forward through the
+        # same cache write paths, donated like the decode cache
+        self._spec_verify = jax.jit(self._spec_verify_fn, donate_argnums=(4,))
         self._rng = jax.random.key(self.engine_cfg.rng_seed)
         # jitted split: an eager jax.random.split is a blocking round trip
         # on a tunneled chip, and _next_key runs on every admission/window
@@ -441,6 +474,49 @@ class InferenceEngine:
         idx = (true_len - 1).reshape(-1, 1, 1)  # [B,1,1]
         last = jnp.take_along_axis(logits, jnp.broadcast_to(idx, (logits.shape[0], 1, logits.shape[2])), axis=1)
         return cache, last[:, 0, :]
+
+    def _spec_verify_fn(self, params, cur, drafts, draft_lens, cache, offsets,
+                        temps, topks, topps, minps, key, tables=None):
+        """Speculative-decode verify: one [B, K+1] forward checks a whole
+        draft. Returns (next_tok [B], cache, accepted [B]).
+
+        ``cur`` [B] is each row's last accepted token, ``drafts`` [B, K]
+        the proposed continuations (padded with zeros past
+        ``draft_lens`` [B]). The chunk [cur | drafts] runs through the
+        SAME cache write path as decode (rectangular vmapped
+        dynamic-update or paged block scatter via ``tables``) at each
+        row's offset. Position j's logits predict token j+1, so a draft
+        token is correct iff it equals the greedy argmax one position
+        earlier; ``accepted`` is the longest such prefix (capped at
+        draft_lens — pad positions never count). The returned token is
+        sampled from the logits AT the accept position: for greedy rows
+        that is exactly the argmax plain decode would have produced
+        (token-for-token parity), for non-drafting sampled rows
+        (draft_lens == 0) it is their normal one-token sample from
+        position 0. Rejected positions hold stale K/V but sit at/past
+        the row's new offset (offset + accepted + 1), where the causal
+        invariant masks or overwrites them — rollback costs nothing.
+        """
+        from .sampling import sample_batched
+
+        B, K = drafts.shape
+        tokens = jnp.concatenate([cur[:, None], drafts], axis=1)  # [B, K+1]
+        logits, cache = core.forward(
+            params, self.model_cfg, tokens, cache, offsets,
+            attn_fn=self._attn_fn(), block_tables=tables,
+        )
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K+1]
+        pos = jnp.arange(K, dtype=jnp.int32)[None, :]
+        match = (drafts == greedy[:, :-1]) & (pos < draft_lens[:, None])
+        # longest all-match prefix: cumprod zeroes everything after the
+        # first mismatch, the sum counts the survivors
+        accepted = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+        idx = accepted.reshape(-1, 1, 1)  # [B,1,1]
+        last = jnp.take_along_axis(
+            logits, jnp.broadcast_to(idx, (B, 1, logits.shape[2])), axis=1
+        )[:, 0, :]
+        nxt = sample_batched(last, key, temps, topks, topps, minps)
+        return nxt.astype(jnp.int32), cache, accepted
 
     # ------------------------------------------------------------ helpers
 
@@ -702,7 +778,7 @@ class InferenceEngine:
 
     @property
     def info(self) -> dict:
-        return {
+        out = {
             "model": self.model_cfg.name,
             "n_params": int(
                 sum(np.prod(x.shape) for x in jax.tree.leaves(self.params))
@@ -712,3 +788,18 @@ class InferenceEngine:
             "max_seq_len": self.max_seq_len,
             "platform": jax.devices()[0].platform,
         }
+        # speculative-decode observability (dashboards read acceptance to
+        # judge whether the workload repeats enough to keep K up). Read
+        # _scheduler directly — info() must not allocate the batch cache.
+        sch = self._scheduler
+        st = sch.stats if sch is not None else None
+        drafted = st.spec_drafted if st else 0
+        out["spec"] = {
+            "spec_tokens": self.engine_cfg.spec_tokens,
+            "drafted": drafted,
+            "accepted": st.spec_accepted if st else 0,
+            "acceptance": (
+                round(st.spec_accepted / drafted, 4) if drafted else 0.0
+            ),
+        }
+        return out

@@ -54,6 +54,34 @@ def pow2_at_least(n: int) -> int:
     return 1 << max(0, (max(n, 1) - 1).bit_length())
 
 
+def best_prefix_key(keys, ids) -> tuple[tuple | None, int]:
+    """THE prefix-cache match scan, shared by scheduler.PrefixCache and
+    PagedPrefixCache: the key with the longest usable prefix of ``ids``
+    (usable length = min(len(key), len(ids) - 1) — the final prompt
+    token always prefills so admission gets its first-sample logits; an
+    entry only matches when its WHOLE usable prefix equals the prompt's).
+
+    Element-wise with early exits: the first mismatching token abandons
+    the entry, and entries that cannot beat the current best are skipped
+    outright — the old form built a tuple(ids[:m]) and sliced key[:m]
+    per entry per admission, O(entries * prompt_len) churn that long
+    prompts paid even on guaranteed misses. Ties keep the first
+    (oldest-inserted) entry, matching the old `m > best_m` scan order.
+    """
+    cap = len(ids) - 1
+    best_key, best_m = None, 0
+    for key in keys:
+        m = min(len(key), cap)
+        if m <= best_m:
+            continue
+        for i in range(m):
+            if key[i] != ids[i]:
+                break
+        else:
+            best_key, best_m = key, m
+    return best_key, best_m
+
+
 def prefill_chunk_positions(n: int, start: int, bucket: int, S: int) -> list[int]:
     """THE chunk walk of admission prefill: start positions of each
     [pos, pos+bucket) window covering prompt tokens [start, n), with the
@@ -153,12 +181,7 @@ class PagedPrefixCache:
     def match(self, ids: list[int]):
         """-> (m, blocks | None): longest usable cached prefix and the
         entry's FULL block list (the caller slices per its match length)."""
-        cap = len(ids) - 1
-        best_key, best_m = None, 0
-        for key in self._entries:
-            m = min(len(key), cap)
-            if m > best_m and tuple(ids[:m]) == key[:m]:
-                best_key, best_m = key, m
+        best_key, best_m = best_prefix_key(self._entries, ids)
         if best_key is None:
             return 0, None
         blocks = self._entries.pop(best_key)  # LRU touch

@@ -115,6 +115,17 @@ class Request:
         self.bucket = 0
         self.chunks_decoded = 0  # observability: early-exit is visible here
         self._flushed_text = ""
+        # self-speculative decoding bookkeeping (engine/spec.py): per-row
+        # drafted/accepted counters feed the adaptive disable — a row
+        # whose acceptance collapses stops paying for draft lookups.
+        # spec_misses counts eligible steps where the drafter found no
+        # repeating n-gram at all; each weighs like a fully-rejected
+        # K-token draft in the disable math, so non-repetitive rows
+        # also revert to full decode windows after the probe budget.
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self.spec_misses = 0
+        self.spec_disabled = False
 
     # ---- token accounting (runs on the scheduler thread) ----
 
@@ -180,7 +191,20 @@ class SchedulerStats:
     paged_blocks_read_last_step: int = 0
     paged_live_blocks: int = 0
     paged_alloc_waits: int = 0  # admissions deferred on an exhausted pool
+    # self-speculative decoding (engine/spec.py): one spec step = one
+    # [B, K+1] verify forward replacing up to K+1 sequential decode
+    # steps. acceptance (accepted/drafted) near 1 means the workload
+    # repeats enough that almost every draft token was a free step;
+    # near 0 means rows are paying the wider forward for nothing (the
+    # per-row adaptive disable then kicks in).
+    spec_steps: int = 0
+    spec_drafted: int = 0
+    spec_accepted: int = 0
     history: deque = field(default_factory=lambda: deque(maxlen=64))
+
+    @property
+    def spec_acceptance(self) -> float:
+        return self.spec_accepted / self.spec_drafted if self.spec_drafted else 0.0
 
 
 class _PoolExhausted(RuntimeError):
@@ -210,12 +234,9 @@ class PrefixCache:
 
     def match(self, ids: list[int]):
         """-> (m, row_cache | None): longest usable cached prefix."""
-        cap = len(ids) - 1
-        best_key, best_m = None, 0
-        for key in self._entries:
-            m = min(len(key), cap)
-            if m > best_m and tuple(ids[:m]) == key[:m]:
-                best_key, best_m = key, m
+        from .paged import best_prefix_key
+
+        best_key, best_m = best_prefix_key(self._entries, ids)
         if best_key is None:
             return 0, None
         entry = self._entries.pop(best_key)  # LRU touch
@@ -365,6 +386,38 @@ class BatchScheduler:
                 self._prefix_cache = PrefixCache(e.engine_cfg.prefix_cache_entries)
         else:
             self._prefix_cache = None
+
+        # self-speculative decoding (engine/spec.py): greedy rows draft
+        # from their own prompt+output and one [B, K+1] verify call
+        # replaces up to K+1 sequential decode steps. The verify chunk
+        # rides the dense cache write paths (rectangular vmapped write /
+        # paged block scatter); flash reads a contiguous row layout and
+        # sp shards capacity, so those engines decode normally.
+        self._spec = None
+        if e.engine_cfg.spec_tokens > 0:
+            if e.engine_cfg.attention != "dense":
+                logger.info(
+                    "speculative decoding disabled: attention=%r (the "
+                    "[B, K+1] verify chunk is a dense-path feature)",
+                    e.engine_cfg.attention,
+                )
+            elif e.engine_cfg.spec_tokens + 1 >= e.max_seq_len:
+                # no prompt could ever leave K+1 positions of headroom —
+                # rows would never be spec-eligible; say so instead of
+                # silently decoding plain forever
+                logger.warning(
+                    "speculative decoding disabled: spec_tokens=%d leaves "
+                    "no room in max_seq_len=%d",
+                    e.engine_cfg.spec_tokens, e.max_seq_len,
+                )
+            else:
+                from .spec import NgramDrafter
+
+                self._spec = NgramDrafter(
+                    e.engine_cfg.spec_tokens,
+                    e.engine_cfg.spec_min_match,
+                    e.engine_cfg.spec_max_match,
+                )
 
         self._thread = threading.Thread(
             target=self._loop, name="bee2bee-batch-scheduler", daemon=True
@@ -980,10 +1033,24 @@ class BatchScheduler:
         EngineConfig.max_inflight_chunks). Streaming requests pin the
         window to 1 chunk so tokens flush at chunk cadence; otherwise the
         tightest active row budget bounds the window, so no row ever has
-        more than its own remaining tokens in flight."""
+        more than its own remaining tokens in flight. Speculation-
+        eligible rows also pin the window: a multi-chunk dispatch would
+        decode hundreds of tokens between draft opportunities, so while
+        such a row is live the drafter gets a look every chunk (rows
+        whose content never repeats stop being eligible via the
+        miss-counting adaptive disable and full windows resume)."""
         e = self.engine
         K = e.engine_cfg.decode_chunk
         if any(r is not None and r.stream for r in self._rows):
+            return 1
+        if (
+            self._spec is not None
+            and self._spec_possible()
+            and any(
+                r is not None and self._spec_eligible(b, r)
+                for b, r in enumerate(self._rows)
+            )
+        ):
             return 1
         min_left = min(
             r.max_new_tokens - len(r.out_ids)
@@ -995,18 +1062,19 @@ class BatchScheduler:
             w = min(w, 2)
         return max(1, min(w, e.engine_cfg.max_inflight_chunks))
 
-    def _prepare_window_tables(self, W: int, K: int):
-        """Paged: grow every active row's block table to cover this
-        window's writes (positions < offset + W*K), then build the
-        [bsz, tw] device argument at the pow2-bucketed width. A row the
-        pool cannot cover even after reclaiming prefix pins fails alone
+    def _prepare_window_tables(self, extra: int):
+        """Paged: grow every active row's block table to cover the next
+        device call's writes (positions < offset + extra — W*K for a
+        decode window, K+1 for a spec verify), then build the [bsz, tw]
+        device argument at the pow2-bucketed width. A row the pool
+        cannot cover even after reclaiming prefix pins fails alone
         (explicitly undersized kv_pool_blocks); returns None when no
         active rows survive."""
         for b, req in enumerate(self._rows):
             if req is None:
                 continue
             try:
-                self._ensure_blocks(b, int(self._offsets[b]) + W * K)
+                self._ensure_blocks(b, int(self._offsets[b]) + extra)
             except _PoolExhausted as err:
                 self._rows[b] = None
                 self._release_row(b)
@@ -1026,15 +1094,193 @@ class BatchScheduler:
         self.stats.paged_blocks_in_use = self._alloc.used_count
         return np.ascontiguousarray(self._tables[:self._bsz, :tw])
 
+    def _spec_eligible(self, b: int, req: Request) -> bool:
+        """Row-level speculation gate: greedy, not penalized, not
+        adaptively disabled, enough budget that a draft could beat the
+        single bonus token, and enough cache headroom for the fixed
+        [B, K+1] write extent. The headroom clause matters for
+        _window_size too: a spec_tokens larger than any row's remaining
+        capacity (or a row approaching the end of the cache) must stop
+        counting as eligible, or the batch would pay pinned 1-chunk
+        windows for the rest of the generation with zero speculation
+        possible — and no misses ever accruing to trigger the adaptive
+        disable, since drafting never even starts."""
+        e = self.engine
+        return (
+            req.temperature <= 0.0
+            and not req.penalized
+            and not req.spec_disabled
+            and not req.cancelled
+            and req.max_new_tokens - len(req.out_ids) >= 2
+            and int(self._offsets[b]) + e.engine_cfg.spec_tokens + 1
+            <= e.max_seq_len
+        )
+
+    def _spec_possible(self) -> bool:
+        """Batch-level speculation gate, shared by _spec_drafts and the
+        _window_size pin so they can never disagree: no penalized row
+        (penalty counts ride only the window graphs) and no active row
+        within K+1 of capacity (ineligible rows still ride the [B, K+1]
+        forward, and the rectangular write would clamp at S-(K+1) and
+        corrupt their earlier positions). A window pinned to 1 chunk
+        while every spec step is vetoed would be pure sync-cadence loss."""
+        e = self.engine
+        K = e.engine_cfg.spec_tokens
+        for b, req in enumerate(self._rows):
+            if req is None:
+                continue
+            if req.penalized:
+                return False
+            if int(self._offsets[b]) + K + 1 > e.max_seq_len:
+                return False
+        return True
+
+    def _spec_check_disable(self, req: Request):
+        """Adaptive per-row disable: drafted tokens plus miss-equivalents
+        (a no-match step weighs like a fully-rejected K-token draft)
+        against the acceptance floor."""
+        from .spec import should_disable
+
+        K = self.engine.engine_cfg.spec_tokens
+        if should_disable(
+            req.spec_drafted + K * req.spec_misses, req.spec_accepted,
+            self.engine.engine_cfg.spec_probe_tokens,
+            self.engine.engine_cfg.spec_min_accept,
+        ):
+            req.spec_disabled = True
+
+    def _spec_drafts(self):
+        """Collect per-row drafts for one spec step. Returns
+        (drafts [bsz, K], lens [bsz]) or None when this step must take
+        the plain/penalized window instead: no row drafted anything, a
+        penalized row is active (penalty counts ride only the window
+        graphs), or any active row is too close to capacity for the
+        fixed [B, K+1] write extent (the rectangular write would clamp
+        and corrupt earlier positions)."""
+        e = self.engine
+        K = e.engine_cfg.spec_tokens
+        if not self._spec_possible():
+            return None
+        drafts = np.zeros((self._bsz, K), np.int32)
+        lens = np.zeros((self._bsz,), np.int32)
+        any_draft = False
+        for b, req in enumerate(self._rows):
+            if req is None:
+                continue
+            # greedy non-penalized rows speculate; sampled rows ride
+            # along advancing their normal one token per forward
+            if not self._spec_eligible(b, req):
+                continue
+            d = self._spec.propose(req.ids, req.out_ids)
+            if not d:
+                req.spec_misses += 1
+                self._spec_check_disable(req)
+                continue
+            left = req.max_new_tokens - len(req.out_ids)
+            d = d[:left - 1]  # past-budget draft positions are dead weight
+            drafts[b, :len(d)] = d
+            lens[b] = len(d)
+            any_draft = True
+        return (drafts, lens) if any_draft else None
+
+    def _spec_step(self) -> bool:
+        """One speculative step: verify every drafting row's proposal in
+        a single [B, K+1] forward; offsets advance by accepted+1 per row
+        (rejected positions sit at/past the new offset, where the causal
+        invariant hides them — see engine._spec_verify_fn). Returns False
+        when the step was not taken and the caller should run a normal
+        decode window."""
+        proposal = self._spec_drafts()
+        if proposal is None:
+            return False
+        drafts, lens = proposal
+        e = self.engine
+        tables = None
+        if self._paged:
+            # cover the whole [offset, offset+K+1) write extent — blocks
+            # claimed for later-rejected slots stay owned by the row
+            # (over-allocated tail) and free normally at retirement
+            tables = self._prepare_window_tables(e.engine_cfg.spec_tokens + 1)
+            if tables is None:
+                self._compact_and_shrink()
+                return True  # nothing left to decode this step
+        temps, topks, topps = self._row_sampling_arrays()
+        minps = self._minps if self._minps.any() else None
+        with get_tracer().span(
+            "engine.spec_verify", active=self.active, drafted=int(lens.sum())
+        ):
+            nxt_d, self._cache, acc_d = e._spec_verify(
+                e.params, self._cur, drafts, lens, self._cache,
+                self._offsets, temps, topks, topps, minps,
+                e._next_key(), tables,
+            )
+            nxt, acc = (np.asarray(x) for x in jax.device_get((nxt_d, acc_d)))
+        self._cur = nxt.astype(np.int32).copy()
+        self._offsets = (self._offsets + acc + 1).astype(np.int32)
+        self.stats.spec_steps += 1
+
+        retired_any = False
+        for b, req in enumerate(self._rows):
+            if req is None:
+                continue
+            req.chunks_decoded += 1
+            a = int(acc[b])
+            if lens[b]:
+                req.spec_drafted += int(lens[b])
+                req.spec_accepted += a
+                self.stats.spec_drafted += int(lens[b])
+                self.stats.spec_accepted += a
+                self._spec_check_disable(req)
+            # accepted draft prefix, then the verify's own next token
+            retired_any |= self._process_row_tokens(
+                b, req, list(drafts[b, :a]) + [nxt[b]]
+            )
+        if retired_any:
+            self._compact_and_shrink()
+        return True
+
+    def _process_row_tokens(self, b: int, req: Request, tokens) -> bool:
+        """THE per-row token-intake protocol, shared by the decode-window
+        and spec-step paths (a retirement/streaming semantics change must
+        hit both identically): mark cancellation, accept tokens until the
+        request finishes, emit the stream event, retire a done row.
+        Returns True when the row retired."""
+        if req.cancelled and not req.done:
+            req.finish = "cancelled"
+        emitted: list[int] = []
+        for t in tokens:
+            if not req.accept(int(t)):
+                break
+            emitted.append(int(t))
+            if req.done:  # budget exhausted exactly on this token
+                break
+        if emitted and req.stream:
+            req.events.put({
+                "token": emitted[-1],
+                "tokens": emitted,
+                "text": req.text_delta(final=req.done),
+            })
+        if req.done:
+            self._rows[b] = None
+            self._release_row(b)
+            self._row_params_dirty = True
+            self._retire(req)
+            return True
+        return False
+
     def _step(self):
         """One readback window: dispatch W decode chunks (async, chained
-        on device), sync once, process W*decode_chunk tokens per row."""
+        on device), sync once, process W*decode_chunk tokens per row.
+        With speculation enabled, a step where some greedy row drafted
+        becomes ONE [B, K+1] verify call instead (_spec_step)."""
         e = self.engine
+        if self._spec is not None and self._spec_step():
+            return
         W = self._window_size()
         K = e.engine_cfg.decode_chunk
         tables = None
         if self._paged:
-            tables = self._prepare_window_tables(W, K)
+            tables = self._prepare_window_tables(W * K)
             if tables is None:
                 self._compact_and_shrink()
                 return
@@ -1083,27 +1329,7 @@ class BatchScheduler:
             if req is None:
                 continue
             req.chunks_decoded += W
-            if req.cancelled and not req.done:
-                req.finish = "cancelled"
-            emitted: list[int] = []
-            for t in toks_host[b]:
-                if not req.accept(int(t)):
-                    break
-                emitted.append(int(t))
-                if req.done:  # budget exhausted exactly on this token
-                    break
-            if emitted and req.stream:
-                req.events.put({
-                    "token": emitted[-1],
-                    "tokens": emitted,
-                    "text": req.text_delta(final=req.done),
-                })
-            if req.done:
-                self._rows[b] = None
-                self._release_row(b)
-                self._row_params_dirty = True
-                self._retire(req)
-                retired_any = True
+            retired_any |= self._process_row_tokens(b, req, toks_host[b])
         if retired_any:
             self._compact_and_shrink()
 

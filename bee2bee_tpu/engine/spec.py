@@ -1,0 +1,114 @@
+"""Self-speculative decoding: host-side n-gram drafting (prompt lookup).
+
+Single-stream decode pays one full forward pass per token — the latency
+floor interactive clients feel. Speculative decoding breaks it WITHOUT a
+second model: draft up to K tokens by matching the sequence's own tail
+against its earlier content (chat transcripts, code and RAG contexts are
+highly self-repetitive), then verify all K in ONE [B, K+1] forward
+(engine.InferenceEngine._spec_verify_fn) and accept the longest exact
+prefix. On a weight-bound chip that forward costs about the same as a
+single decode step, so every accepted draft token is a free step.
+
+Why rollback is free: the verify chunk writes K/V for positions
+[offset, offset+K+1), but the row's offset only advances by accepted+1.
+Rejected positions are >= the new offset, and the engine's causal
+invariant — any cache position >= the write offset is either masked at
+read time or overwritten before attention sees it — already guarantees
+stale K/V there is never observed (the same invariant that makes the
+paged cache's CoW prefix sharing sound; see engine/paged.py).
+
+The drafter is pure host-side python/numpy owned by the scheduler
+thread; nothing here is jit-traced. The device side lives in
+engine/engine.py (the verify jit root) and the per-row gating in
+engine/scheduler.py (greedy non-penalized rows speculate; sampled/
+penalized rows ride the existing decode windows).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def find_ngram_draft(
+    context,
+    k: int,
+    min_match: int = 2,
+    max_match: int = 8,
+) -> list[int]:
+    """Draft up to `k` tokens by longest-suffix n-gram lookup.
+
+    Tries suffix lengths from min(max_match, len-1) down to min_match:
+    the first (longest) n-gram whose most recent earlier occurrence is
+    found wins, and the draft is the tokens that followed that
+    occurrence. Returns [] when no suffix of length >= min_match repeats
+    — the caller falls back to plain decode for this row/step.
+
+    Matching the LONGEST suffix first maximizes draft quality (a longer
+    shared context predicts the continuation better). Among occurrences
+    of that suffix, the most recent one with a FULL k tokens of
+    continuation wins — recency biases toward the sequence's current
+    phase, but a naively-latest occurrence of a short-period repetition
+    overlaps the tail and leaves almost nothing to draft (an all-same-
+    token run would draft length 1 forever); only when no occurrence has
+    full room does the earliest — longest partial continuation — serve.
+    """
+    n_ctx = len(context)
+    if k < 1 or n_ctx < min_match + 1:
+        return []
+    arr = np.asarray(context, dtype=np.int64)
+    for n in range(min(max_match, n_ctx - 1), min_match - 1, -1):
+        pattern = arr[n_ctx - n:]
+        # candidate starts [0, n_ctx - n): every one has >= 1 token
+        # following its window; position n_ctx - n is the suffix itself
+        windows = np.lib.stride_tricks.sliding_window_view(arr, n)[:n_ctx - n]
+        hits = np.flatnonzero((windows == pattern).all(axis=1))
+        if hits.size:
+            roomy = hits[hits + n + k <= n_ctx]
+            start = int(roomy[-1] if roomy.size else hits[0]) + n
+            return arr[start:start + k].tolist()
+    return []
+
+
+def should_disable(
+    drafted: int, accepted: int, probe_tokens: int, min_rate: float
+) -> bool:
+    """Per-row adaptive disable: True once the row has drafted at least
+    `probe_tokens` tokens with acceptance below `min_rate`. A row whose
+    content stops repeating pays the draft lookup and the wider verify
+    forward for nothing — after the probe budget, it drops back to plain
+    decode for the rest of its life (requests are short-lived; there is
+    no re-enable)."""
+    return drafted >= probe_tokens and accepted < min_rate * drafted
+
+
+class NgramDrafter:
+    """Drafting policy object the scheduler holds: configuration plus the
+    propose() entry point. Stateless across rows/steps — per-row
+    acceptance bookkeeping lives on the Request (spec_drafted /
+    spec_accepted / spec_disabled)."""
+
+    def __init__(
+        self,
+        spec_tokens: int,
+        min_match: int = 2,
+        max_match: int = 8,
+    ):
+        if spec_tokens < 1:
+            raise ValueError(f"spec_tokens must be >= 1, got {spec_tokens}")
+        if not (1 <= min_match <= max_match):
+            raise ValueError(
+                f"need 1 <= min_match <= max_match, got "
+                f"{min_match}..{max_match}"
+            )
+        self.spec_tokens = spec_tokens
+        self.min_match = min_match
+        self.max_match = max_match
+
+    def propose(self, prompt_ids, out_ids) -> list[int]:
+        """Draft for one row from its OWN prompt + generated ids."""
+        return find_ngram_draft(
+            list(prompt_ids) + list(out_ids),
+            self.spec_tokens,
+            self.min_match,
+            self.max_match,
+        )

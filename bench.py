@@ -59,8 +59,20 @@ def ensure_live_backend() -> None:
     lease recycles on the order of minutes, so a single 150 s attempt
     (round 3) threw away a recoverable chip. The probe runs a real
     matmul, not just jax.devices() — a lease can hand out a device
-    handle whose first dispatch then hangs."""
+    handle whose first dispatch then hangs.
+
+    Knobs (BENCH_r05 recorded 203 failed probes: a box with NO chip at
+    all was paying the full retry ladder — ~90 s of sleeps — on every
+    run): BEE2BEE_BENCH_NO_PROBE=1 skips probing entirely (the bench
+    runs on whatever backend jax picks — set JAX_PLATFORMS=cpu alongside
+    it on accelerator-free boxes); BEE2BEE_BENCH_PROBE_WAIT scales the
+    backoff (sleep = wait * attempt; default 10 s, so 10+20 instead of
+    the old hardwired 30+60); BEE2BEE_BENCH_PROBE_TIMEOUT caps each
+    probe subprocess (default 150 s)."""
     if os.environ.get("_BEE2BEE_BENCH_PROBED") == "1":
+        return
+    if os.environ.get("BEE2BEE_BENCH_NO_PROBE") == "1":
+        log("probe skipped (BEE2BEE_BENCH_NO_PROBE=1)")
         return
     os.environ["_BEE2BEE_BENCH_PROBED"] = "1"
     probe_src = (
@@ -69,12 +81,15 @@ def ensure_live_backend() -> None:
         "jax.jit(lambda a: a @ a)(x).block_until_ready();"
         "print(jax.devices()[0].platform)"
     )
-    attempts = 3
+    attempts = int(os.environ.get("BEE2BEE_BENCH_PROBE_ATTEMPTS", "3"))
+    wait = float(os.environ.get("BEE2BEE_BENCH_PROBE_WAIT", "10"))
+    probe_timeout = float(os.environ.get("BEE2BEE_BENCH_PROBE_TIMEOUT", "150"))
     for i in range(attempts):
         try:
             r = subprocess.run(
                 [sys.executable, "-c", probe_src],
-                timeout=150, capture_output=True, check=True, text=True,
+                timeout=probe_timeout, capture_output=True, check=True,
+                text=True,
             )
             log(f"accelerator probe ok (platform={r.stdout.strip()})")
             return  # healthy accelerator: carry on in this process
@@ -87,8 +102,8 @@ def ensure_live_backend() -> None:
             log(f"accelerator probe {i + 1}/{attempts} failed "
                 f"({type(e).__name__}{detail})")
             if i < attempts - 1:
-                delay = 30 * (i + 1)  # 30 s, then 60 s — lease recycle window
-                log(f"retrying probe in {delay}s (pool lease may recycle)")
+                delay = wait * (i + 1)  # lease recycle window
+                log(f"retrying probe in {delay:g}s (pool lease may recycle)")
                 time.sleep(delay)
     log("all probes failed; benching on CPU")
     # the platform choice must land before jax is imported: re-exec
@@ -248,6 +263,65 @@ def bench_paged(msl: int, new_tokens: int) -> dict:
         eng.close()
 
 
+def bench_spec(msl: int, new_tokens: int) -> dict:
+    """Speculative-decoding rung (ISSUE 4): single-stream greedy on a
+    REPETITIVE prompt — the workload class (chat transcripts, code, RAG
+    contexts) where n-gram self-drafting pays. Runs the same prompt with
+    spec off and on and reports tok/s for both plus drafted/accepted/
+    acceptance, so rounds can track whether acceptance (the mechanism)
+    and the tok/s ratio (the win) move together."""
+    import time as _time
+
+    from bee2bee_tpu.engine import EngineConfig, InferenceEngine
+
+    period = [11, 23, 5, 99, 42, 7, 310, 18]
+    prompt = (period * (PROMPT_LEN // len(period) + 1))[:PROMPT_LEN]
+    out: dict = {}
+    for label, k in (("off", 0), ("on", 8)):
+        eng = InferenceEngine(
+            "distilgpt2",
+            engine_config=EngineConfig(
+                max_seq_len=msl, max_batch=1, spec_tokens=k
+            ),
+        )
+        try:
+            eng.generate(prompt, max_new_tokens=8, temperature=0.0)  # warm
+            # counters start AFTER warm-up: the rung's acceptance must
+            # describe exactly the timed run it reports tok/s for
+            st = eng.scheduler.stats
+            steps0, drafted0, accepted0 = (
+                st.spec_steps, st.spec_drafted, st.spec_accepted
+            )
+            t0 = _time.perf_counter()
+            r = eng.generate(prompt, max_new_tokens=new_tokens, temperature=0.0)
+            wall = _time.perf_counter() - t0
+            entry = {
+                "tok_per_s": round(r.new_tokens / wall, 2) if wall > 0 else 0.0,
+                "new_tokens": r.new_tokens,
+            }
+            if k:
+                drafted = st.spec_drafted - drafted0
+                accepted = st.spec_accepted - accepted0
+                entry.update(
+                    spec_tokens=k,
+                    spec_steps=st.spec_steps - steps0,
+                    drafted=drafted,
+                    accepted=accepted,
+                    acceptance=round(accepted / drafted, 3) if drafted else 0.0,
+                )
+            out[f"spec_{label}"] = entry
+        finally:
+            eng.close()
+    off, on = out["spec_off"]["tok_per_s"], out["spec_on"]["tok_per_s"]
+    out["speedup"] = round(on / off, 3) if off > 0 else 0.0
+    log(
+        f"spec rung: {on} tok/s with spec vs {off} without "
+        f"(x{out['speedup']}, acceptance "
+        f"{out['spec_on'].get('acceptance')})"
+    )
+    return out
+
+
 def bench_reference_path() -> float:
     """The reference's hot loop: HF transformers greedy generate on torch CPU
     (reference hf.py:35-44 minus tokenization — token ids in, ids out)."""
@@ -306,6 +380,14 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — the rung must not kill the bench
         log(f"paged rung failed: {e}")
         extras["paged_distilgpt2"] = {"error": str(e)}
+
+    # speculative-decoding rung (ISSUE 4 acceptance: single-stream tok/s
+    # + acceptance rate on a repetitive-prompt workload)
+    try:
+        extras["spec_distilgpt2"] = bench_spec(msl, tokens)
+    except Exception as e:  # noqa: BLE001 — the rung must not kill the bench
+        log(f"spec rung failed: {e}")
+        extras["spec_distilgpt2"] = {"error": str(e)}
 
     if platform == "tpu":
         def rung(key: str, **kw) -> None:

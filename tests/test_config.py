@@ -35,3 +35,14 @@ def test_env_int_coercion(tmp_home, monkeypatch):
 def test_parse_mesh_shape():
     assert config.parse_mesh_shape("") == {}
     assert config.parse_mesh_shape("data:2,model:4") == {"data": 2, "model": 4}
+
+
+def test_spec_env_knob_flows_to_engine_config(tmp_home, monkeypatch):
+    """BEE2BEE_SPEC -> NodeConfig.spec_tokens -> EngineConfig.spec_tokens
+    (the --spec CLI flag sets the same field)."""
+    monkeypatch.setenv("BEE2BEE_SPEC", "8")
+    cfg = config.load_config()
+    assert cfg.spec_tokens == 8
+    assert cfg.engine_config().spec_tokens == 8
+    monkeypatch.delenv("BEE2BEE_SPEC")
+    assert config.load_config().engine_config().spec_tokens == 0

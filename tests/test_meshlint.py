@@ -218,6 +218,46 @@ def host_side(x):
     assert analyze_source(src, "engine/fixture.py") == []
 
 
+def test_jax_pass_sees_spec_verify_wiring():
+    """The engine's speculative-decode verify root is wired as
+    ``self._spec_verify = jax.jit(self._spec_verify_fn, ...)`` — the
+    method-attribute form of jit wrapping. Pin that the root collector
+    resolves it: a host sync or traced branch seeded into a fixture
+    with exactly that wiring must be flagged (a collector regression
+    would silently stop scanning the hottest new jit root)."""
+    src = '''
+import jax
+import jax.numpy as jnp
+
+class Engine:
+    def __init__(self):
+        self._spec_verify = jax.jit(self._spec_verify_fn, donate_argnums=(4,))
+
+    def _spec_verify_fn(self, params, cur, drafts, draft_lens, cache,
+                        offsets, key):
+        n = int(draft_lens)
+        if jnp.any(cur > 0):
+            cur = cur + 1
+        return cur, cache
+'''
+    rules = _rules(analyze_source(src, "engine/engine.py"))
+    assert "ML-J001" in rules and "ML-J002" in rules
+
+
+def test_jax_pass_covers_spec_module_and_real_verify_is_clean():
+    """engine/spec.py is inside the jax-pass scope (a path move out of
+    engine/ would silently drop it from scanning), and the REAL spec
+    module + engine (with the verify fn) lint clean — the ratchet
+    baseline stays empty."""
+    from bee2bee_tpu.analysis.jaxhygiene import JaxHygienePass
+
+    assert JaxHygienePass().applies("engine/spec.py")
+    spec_py = PACKAGE_ROOT / "engine" / "spec.py"
+    engine_py = PACKAGE_ROOT / "engine" / "engine.py"
+    assert "_spec_verify_fn" in engine_py.read_text()  # the root exists
+    assert analyze_paths([spec_py, engine_py]) == []
+
+
 def test_jax_pass_sees_decorators_and_scan_bodies():
     src = '''
 import jax

@@ -79,3 +79,21 @@ def test_prefix_cache_entries_are_isolated():
     c = eng.generate(prompt, max_new_tokens=10, temperature=0.0).token_ids
     eng.close()
     assert a == b == c
+
+
+def test_best_prefix_key_element_wise_semantics():
+    """The shared match scan (engine/paged.best_prefix_key): longest
+    usable prefix with WHOLE-prefix equality — a partial match is no
+    match at all — and early exits must not change any of that."""
+    from bee2bee_tpu.engine.paged import best_prefix_key
+
+    keys = [(1, 2, 3, 4), (1, 2, 9), (1, 2, 3)]
+    # cap at len(ids)-1: key 0 usable up to 4, matches fully
+    assert best_prefix_key(keys, [1, 2, 3, 4, 5]) == ((1, 2, 3, 4), 4)
+    # (1,2,9) diverges at index 2 -> not a match of length 2, skipped;
+    # the longer key is usable up to the cap and was scanned first
+    assert best_prefix_key(keys, [1, 2, 3, 5]) == ((1, 2, 3, 4), 3)
+    # first-mismatch early exit: nothing matches
+    assert best_prefix_key(keys, [7, 7, 7]) == (None, 0)
+    # ties keep the first (oldest-inserted) key, like the old scan
+    assert best_prefix_key([(1, 2), (1, 2, 9)], [1, 2, 3]) == ((1, 2), 2)
