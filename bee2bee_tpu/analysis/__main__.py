@@ -21,7 +21,7 @@ from .core import (
     write_baseline,
 )
 
-FAMILIES = ("frames", "async", "jax")
+FAMILIES = ("frames", "async", "jax", "telemetry")
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -211,8 +211,9 @@ def _passes():
     from .asyncsafe import AsyncSafetyPass
     from .frames import FramesPass
     from .jaxhygiene import JaxHygienePass
+    from .telemetry import TelemetryPass
 
-    return (FramesPass(), AsyncSafetyPass(), JaxHygienePass())
+    return (FramesPass(), AsyncSafetyPass(), JaxHygienePass(), TelemetryPass())
 
 
 def rule_catalog() -> dict[str, str]:

@@ -25,6 +25,12 @@ from .. import protocol as P
 # task_id, our request path sends rid), so frames need ONE of them, not both
 ID_KEYS = frozenset({"rid", "task_id"})
 
+# cross-node trace propagation (tracing.TraceContext): optional on the
+# frames a generation/task traverses so worker spans parent under the
+# originating request — declared here so meshlint's reads/construction
+# checks know the key (protocol.TRACE_CTX holds the wire name)
+TRACE_KEYS = frozenset({P.TRACE_CTX})
+
 # the service result dict (services/base.py result_dict + streaming done
 # line) rides gen_success / gen_result via `**result`
 RESULT_FIELDS = frozenset(
@@ -41,6 +47,11 @@ RESULT_FIELDS = frozenset(
         "partial",
         "via",
         "error",
+        # per-request serving observability (ISSUE 5): TPUService attaches
+        # them, the node/relay/gateway forward them verbatim
+        "timing",
+        "tokens_per_sec",
+        "ttft_ms",
     }
 )
 
@@ -104,7 +115,8 @@ FRAME_SCHEMAS: dict[str, FrameSchema] = {
             required_any=(ID_KEYS,),
             optional=frozenset(
                 {"model", "svc", "max_new_tokens", "max_tokens", "temperature", "stream"}
-            ),
+            )
+            | TRACE_KEYS,
             allow_sampling=True,
         ),
         _fs(P.GEN_CHUNK, required=frozenset({"text"}), required_any=(ID_KEYS,)),
@@ -125,7 +137,7 @@ FRAME_SCHEMAS: dict[str, FrameSchema] = {
         _fs(
             P.RESULT,
             required=frozenset({"task_id"}),
-            optional=frozenset({"ok", "info", "tokens", "stopped"}),
+            optional=frozenset({"ok", "info", "tokens", "stopped"}) | TRACE_KEYS,
         ),
         _fs(
             P.TASK_ERROR,
@@ -178,17 +190,20 @@ TASK_SCHEMAS: dict[str, TaskSchema] = {
                     "epoch",
                     "next_addr",
                 }
-            ),
+            )
+            | TRACE_KEYS,
         ),
         _ts(
             P.TASK_PART_FORWARD,
             required=frozenset({"model", "request_id", "offset"}),
-            optional=frozenset({"write_mask", "gather", "epoch"}),
+            optional=frozenset({"write_mask", "gather", "epoch"}) | TRACE_KEYS,
         ),
         _ts(
             P.TASK_PART_FORWARD_RELAY,
             required=frozenset({"model", "request_id", "offset"}),
-            optional=frozenset({"write_mask", "gather", "epoch"}) | _RELAY_FIELDS,
+            optional=frozenset({"write_mask", "gather", "epoch"})
+            | _RELAY_FIELDS
+            | TRACE_KEYS,
         ),
         _ts(
             P.TASK_DECODE_RUN,
@@ -196,7 +211,8 @@ TASK_SCHEMAS: dict[str, TaskSchema] = {
             optional=frozenset(
                 {"token", "k", "eos", "gather", "temperature", "seed", "epoch"}
             )
-            | _RELAY_FIELDS,
+            | _RELAY_FIELDS
+            | TRACE_KEYS,
         ),
         _ts(
             P.TASK_LAYER_FORWARD_TRAIN,

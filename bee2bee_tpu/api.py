@@ -21,10 +21,33 @@ from aiohttp import web
 
 from . import __version__
 from .meshnet.node import P2PNode
+from .metrics import PROMETHEUS_CONTENT_TYPE, get_registry
 from .protocol import copy_sampling
-from .tracing import get_tracer
+from .tracing import get_tracer, stitch_trace
 
 logger = logging.getLogger("bee2bee_tpu.api")
+
+# node-level gauges refreshed at scrape time. Names match the pre-registry
+# /metrics exposition exactly (dashboards already scrape them); gauges, not
+# counters, because the Prometheus counter convention appends _total and
+# would rename the series.
+_REG = get_registry()
+_G_TOKENS_PER_SEC = _REG.gauge(
+    "tokens_per_sec", "measured serving throughput (rolling)"
+)
+_G_TOTAL_TOKENS = _REG.gauge("total_tokens", "tokens served since boot")
+_G_TOTAL_REQUESTS = _REG.gauge("total_requests", "requests served since boot")
+_G_PEERS = _REG.gauge("peers", "connected mesh peers")
+_G_PROVIDERS = _REG.gauge("providers", "remote services known")
+_G_LOCAL_SERVICES = _REG.gauge("local_services", "services hosted locally")
+_G_PIECES = _REG.gauge("pieces", "weight pieces stored")
+_G_CPU = _REG.gauge("cpu_percent", "host CPU utilization")
+_G_ACCEL_MEM = _REG.gauge(
+    "accelerator_mem_percent", "accelerator memory utilization"
+)
+_G_P50_LATENCY = _REG.gauge(
+    "p50_latency_seconds", "rolling p50 request latency"
+)
 
 
 def _cors_headers(api_key: str | None) -> dict[str, str]:
@@ -189,9 +212,54 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
         return web.json_response(result)
 
     async def trace(request):
-        """Observability surface the reference lacks (SURVEY §5): per-span
-        percentiles + recent spans from the process-global tracer."""
+        """Observability surface the reference lacks (SURVEY §5).
+
+        - default: per-span percentiles + recent spans.
+        - ``?trace_id=``: this node's local FRAGMENT of one trace —
+          {"node", "trace_id", "spans"} (spans share the id across every
+          hop the request touched, thanks to wire trace propagation).
+        - ``?trace_id=&stitch=1``: additionally query every peer that
+          advertises an api port for ITS fragment and merge them into one
+          cross-node timeline (tracing.stitch_trace). Best-effort: peers
+          that are unreachable or require a key we don't hold are skipped.
+        """
         tracer = get_tracer()
+        trace_id = request.query.get("trace_id")
+        if trace_id:
+            frag = {
+                "node": node.peer_id,
+                "trace_id": trace_id,
+                "spans": tracer.for_trace(trace_id),
+            }
+            if not request.query.get("stitch"):
+                return web.json_response(frag)
+            import asyncio
+
+            import aiohttp
+
+            async def fetch_fragment(s, host, port):
+                try:
+                    async with s.get(
+                        f"http://{host}:{port}/trace",
+                        params={"trace_id": trace_id},
+                        timeout=aiohttp.ClientTimeout(total=3),
+                    ) as r:
+                        if r.status == 200:
+                            return await r.json()
+                except Exception:  # noqa: BLE001 — stitch what answers
+                    pass
+                return None
+
+            # concurrent fan-out: N unreachable peers cost ONE 3s timeout,
+            # not 3s each — a stitch over a big mesh must stay interactive
+            async with aiohttp.ClientSession() as s:
+                got = await asyncio.gather(*(
+                    fetch_fragment(s, info.get("api_host"), info.get("api_port"))
+                    for info in list(node.peers.values())
+                    if info.get("api_host") and info.get("api_port")
+                ))
+            frags = [frag] + [f for f in got if f]
+            return web.json_response(stitch_trace(frags))
         try:
             limit = min(1000, max(1, int(request.query.get("limit", 50))))
         except ValueError:
@@ -203,45 +271,48 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
             }
         )
 
-    async def metrics(request):
-        """Prometheus text exposition of the node's live gauges — scrape
-        with any standard collector (the reference's only machine surface
-        is JSON status; this is the ops-stack-native variant)."""
+    def _refresh_node_gauges():
         from . import utils
 
         snap = node.throughput.snapshot()
         # None: one snapshot is enough — only cpu/gpu are read from sysm
         sysm = utils.get_system_metrics(None)
-        lines = [
-            "# TYPE bee2bee_tokens_per_sec gauge",
-            f"bee2bee_tokens_per_sec {snap.get('tokens_per_sec', 0.0)}",
-            "# TYPE bee2bee_total_tokens counter",
-            f"bee2bee_total_tokens {snap.get('total_tokens', 0)}",
-            "# TYPE bee2bee_total_requests counter",
-            f"bee2bee_total_requests {snap.get('total_requests', 0)}",
-            "# TYPE bee2bee_peers gauge",
-            f"bee2bee_peers {len(node.peers)}",
-            "# TYPE bee2bee_providers gauge",
-            f"bee2bee_providers {sum(len(v) for v in node.providers.values())}",
-            "# TYPE bee2bee_local_services gauge",
-            f"bee2bee_local_services {len(node.local_services)}",
-            "# TYPE bee2bee_pieces gauge",
-            f"bee2bee_pieces {len(node.piece_store)}",
-            "# TYPE bee2bee_cpu_percent gauge",
-            f"bee2bee_cpu_percent {sysm.get('cpu', 0.0)}",
-            "# TYPE bee2bee_accelerator_mem_percent gauge",
-            f"bee2bee_accelerator_mem_percent {sysm.get('gpu', 0.0)}",
-        ]
+        _G_TOKENS_PER_SEC.set(snap.get("tokens_per_sec", 0.0))
+        _G_TOTAL_TOKENS.set(snap.get("total_tokens", 0))
+        _G_TOTAL_REQUESTS.set(snap.get("total_requests", 0))
+        _G_PEERS.set(len(node.peers))
+        _G_PROVIDERS.set(sum(len(v) for v in node.providers.values()))
+        _G_LOCAL_SERVICES.set(len(node.local_services))
+        _G_PIECES.set(len(node.piece_store))
+        _G_CPU.set(sysm.get("cpu", 0.0))
+        _G_ACCEL_MEM.set(sysm.get("gpu", 0.0))
         p50 = snap.get("p50_latency_s")
         if p50 is not None:
-            lines += [
-                "# TYPE bee2bee_p50_latency_seconds gauge",
-                f"bee2bee_p50_latency_seconds {p50}",
-            ]
+            _G_P50_LATENCY.set(p50)
+        else:
+            # the rolling window is empty: drop the series rather than
+            # serve the last measured p50 as if it were current (the
+            # pre-registry exposition omitted the line in this case too)
+            _G_P50_LATENCY.clear()
+
+    async def metrics(request):
+        """The node's metrics registry (metrics.py): Prometheus text
+        exposition by default — node gauges plus every registered serving
+        series (TTFT/inter-token/queue-wait histograms, block-pool
+        occupancy, mesh frame counters, ...). Content-negotiated:
+        ``?format=json`` or ``Accept: application/json`` returns the JSON
+        snapshot (bucket counts + estimated percentiles) instead."""
+        _refresh_node_gauges()
+        reg = get_registry()
+        fmt = request.query.get("format")
+        accept = request.headers.get("Accept", "")
+        if fmt == "json" or (fmt is None and "application/json" in accept):
+            return web.json_response(
+                {"node": node.peer_id, "metrics": reg.snapshot()}
+            )
         return web.Response(
-            text="\n".join(lines) + "\n",
-            content_type="text/plain",
-            charset="utf-8",
+            body=reg.render().encode("utf-8"),
+            headers={"Content-Type": PROMETHEUS_CONTENT_TYPE},
         )
 
     # ---- OpenAI-compatible surface (/v1): standard SDKs and tools can
@@ -493,6 +564,16 @@ async def _stream_service(
                 try:  # count streamed text for the node's measured throughput
                     obj = json.loads(item)
                     text_chars += len(obj.get("text") or "")
+                    # the span must tell the request's story, not just its
+                    # setup: real token count + timing ride the done line,
+                    # service failures ride error lines (ISSUE 5 satellite)
+                    if obj.get("done"):
+                        if obj.get("tokens") is not None:
+                            span.attrs["tokens"] = int(obj["tokens"])
+                        if obj.get("timing") is not None:
+                            span.attrs["timing"] = obj["timing"]
+                    if obj.get("status") == "error":
+                        span.error = str(obj.get("message") or "stream error")
                 except (ValueError, AttributeError, TypeError):
                     # metrics must never kill a stream: non-object lines or
                     # non-string "text" from custom services pass through

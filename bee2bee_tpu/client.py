@@ -212,7 +212,14 @@ class NodeClient(_Base):
 
 
 class GatewayClient(_Base):
-    """Client for the web tier (web/gateway.py /api/p2p/* routes)."""
+    """Client for the web tier (web/gateway.py /api/p2p/* routes).
+
+    ``generate(..., with_meta=True)`` asks the gateway for its response
+    metadata trailer; the parsed dict (tokens / cost / latency_ms and the
+    node's per-request ``timing`` breakdown) lands on ``self.last_meta``
+    and is stripped from the returned text."""
+
+    last_meta: dict | None = None
 
     async def status(self) -> dict:
         return await self._get("/api/p2p/status")
@@ -231,9 +238,13 @@ class GatewayClient(_Base):
         on_chunk: Callable[[str], None] | None = None,
         max_new_tokens: int | None = None,
         temperature: float | None = None,
+        with_meta: bool = False,
     ) -> str:
         """Streamed generate through the gateway; returns the full text.
         (The gateway streams raw text chunks, not JSON lines.)"""
+        # reset FIRST: an errored call must not leave the previous call's
+        # meta readable as if it belonged to this one
+        self.last_meta = None
         body: dict = {"prompt": prompt, "model": model}
         if target_node:
             body["targetNode"] = target_node
@@ -241,10 +252,34 @@ class GatewayClient(_Base):
             body["max_new_tokens"] = max_new_tokens
         if temperature is not None:
             body["temperature"] = temperature
+        if with_meta:
+            body["meta"] = True
         import codecs
 
         decoder = codecs.getincrementaldecoder("utf-8")(errors="replace")
         parts: list[str] = []
+        meta_marker = "\n\n[Meta]: "
+        acc = ""  # decoded so far (for the on_chunk trailer scrub)
+        emitted = 0  # chars of acc already handed to on_chunk
+
+        def forward_clean() -> None:
+            """Feed on_chunk only text that cannot belong to the [Meta]
+            trailer: stop at a full marker, and hold back any tail that is
+            a prefix of it (markers can split across stream chunks)."""
+            nonlocal emitted
+            idx = acc.find(meta_marker, max(0, emitted - len(meta_marker)))
+            if idx != -1:
+                safe = idx
+            else:
+                safe = len(acc)
+                for k in range(min(len(meta_marker), len(acc)), 0, -1):
+                    if meta_marker.startswith(acc[len(acc) - k:]):
+                        safe = len(acc) - k
+                        break
+            if safe > emitted:
+                on_chunk(acc[emitted:safe])
+                emitted = safe
+
         async with self._sess() as s:
             async with s.post(
                 f"{self.base_url}/api/p2p/generate", json=body,
@@ -258,7 +293,11 @@ class GatewayClient(_Base):
                     if text:
                         parts.append(text)
                         if on_chunk:
-                            on_chunk(text)
+                            if with_meta:
+                                acc += text
+                                forward_clean()
+                            else:
+                                on_chunk(text)
                 tail = decoder.decode(b"", final=True)
                 if tail:
                     parts.append(tail)
@@ -272,6 +311,22 @@ class GatewayClient(_Base):
             err = RuntimeError(f"gateway error: {full[idx + len(marker):].strip()}")
             err.partial_text = full[:idx]
             raise err
+        # response metadata trailer (same in-stream convention): parse it
+        # off the text and keep it on last_meta for the caller
+        idx = full.rfind(meta_marker)
+        if idx != -1:
+            try:
+                self.last_meta = json.loads(full[idx + len(meta_marker):])
+                full = full[:idx]
+            except ValueError:
+                pass  # not ours: leave the text untouched
+        if on_chunk and with_meta and emitted < len(full):
+            # flush whatever forward_clean held back — a marker-prefix
+            # lookalike at stream end (e.g. the text just ends in "\n\n",
+            # or the gateway never sent a trailer), an in-text marker
+            # occurrence before the real trailer, or the decoder's final
+            # tail — so the streamed view equals the returned text
+            on_chunk(full[emitted:])
         return full
 
     def status_sync(self) -> dict:

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..metrics import get_registry
 from ..models import config as model_config
 from ..models import core, partition
 from ..parallel.mesh import local_mesh
@@ -46,6 +47,22 @@ from ..utils import MetricsAggregator
 from .tokenizer import load_tokenizer
 
 logger = logging.getLogger("bee2bee_tpu.engine")
+
+# per-request serving distributions, observed at retirement (scheduler
+# thread). TTFT and inter-token (TPOT) are the ROADMAP's "as fast as the
+# hardware allows" yardsticks; /metrics exposes their histograms.
+_H_TTFT = get_registry().histogram(
+    "engine.ttft_ms", "time to first token per request (ms)"
+)
+_H_INTER_TOKEN = get_registry().histogram(
+    "engine.inter_token_ms", "mean inter-token latency per request (ms)"
+)
+_H_E2E = get_registry().histogram(
+    "engine.e2e_latency_ms", "submit-to-done latency per request (ms)"
+)
+_C_TOKENS_OUT = get_registry().counter(
+    "engine.tokens_generated", "tokens generated across all requests"
+)
 
 DEFAULT_BUCKETS = (64, 128, 256, 512, 1024, 2048, 4096, 8192)
 
@@ -679,6 +696,41 @@ class InferenceEngine:
         n_out = len(req.out_ids)
         tps = n_out / decode_time if decode_time > 0 and n_out else 0.0
         self.metrics.record(n_out, latency)
+        ttft_ms = (t_first - t.t_submit) * 1000.0
+        if n_out or req.finish != "cancelled":
+            # a request cancelled while still queued never produced a
+            # token: its "ttft" would be the client's abandon wait, which
+            # would skew the serving distributions under cancel bursts
+            _H_TTFT.observe(ttft_ms)
+            _H_E2E.observe(latency * 1000.0)
+            if n_out > 1:
+                _H_INTER_TOKEN.observe(decode_time * 1000.0 / (n_out - 1))
+            _C_TOKENS_OUT.inc(n_out)
+        # the client-facing latency breakdown (ISSUE 5): rides the result
+        # through the service layer onto gen_success frames, so the caller
+        # sees WHERE its latency went without scraping any node.
+        # prefill_ms includes the first-token sample+readback (the device
+        # sync that makes the token observable — the client-visible cost).
+        # t_admit == 0 marks requests that never entered admission
+        # (cancelled in queue / zero budget): no queue/prefill split exists.
+        timings = {
+            "prefill_bucket": req.bucket,
+            "decode_s": round(decode_time, 4),
+            "chunks": req.chunks_decoded,
+            "queue_wait_ms": (
+                round((t.t_admit - t.t_submit) * 1000.0, 3) if t.t_admit else None
+            ),
+            "prefill_ms": (
+                round((t_first - t.t_admit) * 1000.0, 3) if t.t_admit else None
+            ),
+            "ttft_ms": round(ttft_ms, 3),
+            "decode_tokens": n_out,
+            "tokens_per_s": round(tps, 2),
+            "spec_acceptance": (
+                round(req.spec_accepted / req.spec_drafted, 4)
+                if req.spec_drafted else None
+            ),
+        }
         return GenerationResult(
             text=self.tokenizer.decode(req.out_ids),
             token_ids=list(req.out_ids),
@@ -688,11 +740,7 @@ class InferenceEngine:
             latency_s=round(latency, 4),
             tokens_per_sec=round(tps, 2),
             finish_reason=req.finish or "length",
-            timings={
-                "prefill_bucket": req.bucket,
-                "decode_s": round(decode_time, 4),
-                "chunks": req.chunks_decoded,
-            },
+            timings=timings,
         )
 
     def generate_stream(

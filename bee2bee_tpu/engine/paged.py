@@ -43,6 +43,20 @@ from collections.abc import Iterable
 
 import numpy as np
 
+from ..metrics import get_registry
+
+# block-pool occupancy for /metrics (one engine per serving node, so
+# unlabeled gauges suffice; the last-constructed allocator owns them)
+_G_BLOCKS_USED = get_registry().gauge(
+    "engine.paged_blocks_in_use", "paged KV pool blocks currently referenced"
+)
+_G_BLOCKS_FREE = get_registry().gauge(
+    "engine.paged_blocks_free", "paged KV pool blocks on the free list"
+)
+_G_BLOCKS_TOTAL = get_registry().gauge(
+    "engine.paged_blocks_total", "paged KV pool size (incl. the null block)"
+)
+
 
 def ceil_div(a: int, b: int) -> int:
     return -(-a // b)
@@ -116,6 +130,12 @@ class BlockAllocator:
         self._free: list[int] = list(range(num_blocks - 1, 0, -1))
         self._refs = np.zeros((num_blocks,), np.int32)
         self.hwm = 0  # high-water mark of blocks in use (observability)
+        _G_BLOCKS_TOTAL.set(num_blocks)
+        self._set_gauges()
+
+    def _set_gauges(self):
+        _G_BLOCKS_USED.set(self.used_count)
+        _G_BLOCKS_FREE.set(self.free_count)
 
     @property
     def free_count(self) -> int:
@@ -135,6 +155,7 @@ class BlockAllocator:
         for b in out:
             self._refs[b] = 1
         self.hwm = max(self.hwm, self.used_count)
+        self._set_gauges()
         return out
 
     def ref(self, blocks: Iterable[int]) -> None:
@@ -152,6 +173,7 @@ class BlockAllocator:
             if self._refs[b] == 0:
                 self._free.append(b)
                 freed += 1
+        self._set_gauges()
         return freed
 
     def refcount(self, block: int) -> int:

@@ -38,8 +38,9 @@ except ImportError:  # gate the missing dep: loopback shim (wscompat.py)
 
 from .. import protocol
 from ..joinlink import generate_join_link, parse_join_link
+from ..metrics import get_registry
 from ..pieces import ShardManifest
-from ..tracing import get_tracer
+from ..tracing import extract_trace, get_tracer, inject_trace, use_trace_ctx
 from ..utils import (
     MetricsAggregator,
     get_lan_ip,
@@ -65,6 +66,30 @@ RECONNECT_WINDOW_S = 300.0
 # spawned gen/task handlers per connection before the reader processes
 # inline (TCP backpressure); sized past any engine/session batch depth
 MAX_CONCURRENT_SERVES_PER_CONN = 32
+
+# mesh wire accounting (metrics.py): frames/bytes by op, both directions.
+# The op label is bounded by MESSAGE_TYPES (+ "tensor" for binary sends,
+# whose op would cost a header decode to learn), so cardinality is fixed.
+_C_FRAMES_SENT = get_registry().counter("mesh.frames_sent", "frames sent by op")
+_C_BYTES_SENT = get_registry().counter("mesh.bytes_sent", "payload bytes sent by op")
+_C_FRAMES_RECV = get_registry().counter(
+    "mesh.frames_recv", "frames received by op"
+)
+_C_BYTES_RECV = get_registry().counter(
+    "mesh.bytes_recv", "payload bytes received by op"
+)
+_C_RELAY_HOPS = get_registry().counter(
+    "mesh.relay_hops", "gen_requests forwarded through the swarm relay"
+)
+
+
+def _frame_bytes(raw: str | bytes) -> int:
+    """Wire size of a RECEIVED frame: foreign peers may send non-ASCII
+    JSON, where len() of the decoded str would undercount the bytes. Our
+    own sends never need this — protocol.encode uses json.dumps with its
+    ensure_ascii default, so outgoing text frames are pure ASCII and
+    len(raw) is already the exact wire byte count."""
+    return len(raw) if isinstance(raw, bytes) else len(raw.encode("utf-8"))
 
 
 class P2PNode(StageTaskMixin):
@@ -295,6 +320,14 @@ class P2PNode(StageTaskMixin):
             except ValueError as e:
                 logger.warning("bad frame from peer: %s", e)
                 continue
+            op = data.get("type")
+            if op not in protocol.MESSAGE_TYPES:
+                # the type string is PEER-CONTROLLED: clamping unknown ops
+                # to one bucket keeps the label set (and the series table)
+                # bounded no matter what a hostile peer sends
+                op = "other"
+            _C_FRAMES_RECV.inc(op=op)
+            _C_BYTES_RECV.inc(_frame_bytes(raw), op=op)
             try:
                 await self._on_message(ws, data)
             except Exception:
@@ -367,6 +400,16 @@ class P2PNode(StageTaskMixin):
 
     async def _send(self, ws, message: dict | bytes):
         raw = message if isinstance(message, bytes) else protocol.encode(message)
+        # pre-encoded binary tensor frames would cost a header decode to
+        # attribute; they count under one "tensor" op instead
+        op = message.get("type") if isinstance(message, dict) else "tensor"
+        if op not in protocol.MESSAGE_TYPES and op != "tensor":
+            op = "other"  # keep the label set bounded (see _listen)
+        _C_FRAMES_SENT.inc(op=op)
+        # len(raw) IS the wire size here: bytes frames trivially, and text
+        # frames because protocol.encode emits pure-ASCII JSON (see
+        # _frame_bytes) — no re-encode on the send hot path
+        _C_BYTES_SENT.inc(len(raw), op=op)
         await ws.send(raw)
 
     async def broadcast(self, message: dict):
@@ -642,9 +685,12 @@ class P2PNode(StageTaskMixin):
             with get_tracer().span(
                 "gen.p2p", provider=provider_id, model=model, rid=rid
             ):
+                # inject_trace: the remote hop parents its spans under this
+                # gen.p2p span (relay hops chain the context onward), so
+                # /trace?trace_id= fragments stitch into one timeline
                 await self._send(
                     info["ws"],
-                    protocol.msg(
+                    inject_trace(protocol.msg(
                         protocol.GEN_REQUEST,
                         rid=rid,
                         prompt=prompt,
@@ -655,7 +701,7 @@ class P2PNode(StageTaskMixin):
                         temperature=temperature,
                         stream=bool(stream or on_chunk),
                         **(extra or {}),
-                    ),
+                    )),
                 )
                 result = await asyncio.wait_for(fut, timeout=timeout)
                 # raise inside the span so remote-error results count as
@@ -715,9 +761,12 @@ class P2PNode(StageTaskMixin):
                                 loop.call_soon_threadsafe(on_chunk, obj["text"])
                             else:
                                 on_chunk(obj["text"])
-                    if obj.get("done") and obj.get("tokens") is not None:
-                        final["tokens"] = int(obj["tokens"])
-                        final["cost"] = float(obj.get("cost") or 0.0)
+                    if obj.get("done"):
+                        if obj.get("tokens") is not None:
+                            final["tokens"] = int(obj["tokens"])
+                            final["cost"] = float(obj.get("cost") or 0.0)
+                        if obj.get("timing") is not None:
+                            final["timing"] = obj["timing"]
                     if obj.get("status") == "error":
                         raise RuntimeError(obj.get("message", "stream error"))
 
@@ -744,12 +793,15 @@ class P2PNode(StageTaskMixin):
                 )
                 if est:
                     self.throughput.record(est, time.time() - t0)
-                return {
+                out = {
                     "text": "".join(text_parts),
                     "tokens": final.get("tokens"),
                     "cost": final.get("cost"),
                     "streamed": True,
                 }
+                if final.get("timing") is not None:
+                    out["timing"] = final["timing"]
+                return out
             exec_async = getattr(svc, "execute_async", None)
             if exec_async is not None:
                 result = await exec_async(params)
@@ -768,6 +820,14 @@ class P2PNode(StageTaskMixin):
             return result
 
     async def _handle_gen_request(self, ws, data):
+        # adopt the requester's trace context: the gen.local / relay
+        # gen.p2p spans below parent under the ORIGINATING request, so
+        # every node's /trace?trace_id= fragment joins one timeline
+        # (absent/malformed ctx from old peers is a no-op)
+        with use_trace_ctx(extract_trace(data)):
+            await self._serve_gen_request(ws, data)
+
+    async def _serve_gen_request(self, ws, data):
         rid = data.get("rid") or data.get("task_id")
         model = data.get("model")
         svc = self.local_services.get(data.get("svc", "")) or self.local_service_for(model)
@@ -826,6 +886,7 @@ class P2PNode(StageTaskMixin):
                 ),
             )
             return
+        _C_RELAY_HOPS.inc()
         try:
             if data.get("stream"):
                 # relay the STREAM too: chunks from the far provider are

@@ -35,10 +35,30 @@ from urllib.parse import urlparse
 import numpy as np
 
 from .. import protocol
-from ..tracing import get_tracer
+from ..metrics import get_registry
+from ..tracing import extract_trace, get_tracer, inject_trace, use_trace_ctx
 from ..utils import new_id
 
 logger = logging.getLogger("bee2bee_tpu.pipeline")
+
+# failover observability (metrics.py): the ROBUSTNESS layer's health is
+# invisible without these — a mesh that fails over constantly "works"
+# while burning re-prefills. kind labels are bounded by the task-kind set.
+_C_STAGE_TASKS = get_registry().counter(
+    "pipeline.stage_tasks", "stage tasks sent by the coordinator, by kind"
+)
+_C_RECOVERIES = get_registry().counter(
+    "pipeline.recoveries", "coordinator recover() rebuilds"
+)
+_C_STAGES_REPLACED = get_registry().counter(
+    "pipeline.stages_replaced", "dead stages re-placed onto new peers"
+)
+_C_EPOCH_BUMPS = get_registry().counter(
+    "pipeline.epoch_bumps", "stage-epoch bumps (one per chain rebuild)"
+)
+_C_SESSION_FAILOVERS = get_registry().counter(
+    "pipeline.session_failovers", "batched-session failover attempts"
+)
 
 DEFAULT_STEP_TIMEOUT = 120.0
 # generation-level failover policy defaults (PipelineCoordinator knobs)
@@ -106,6 +126,18 @@ class StageTaskMixin:
         return info["ws"]
 
     async def _handle_task(self, ws, data):
+        # adopt the coordinator's trace context before dispatch: the
+        # stage.task span (and any onward relay/ring frame this worker
+        # sends, which inject_trace stamps from the contextvar) parents
+        # under the request that caused it — every stage's /trace
+        # fragment then stitches into the coordinator's timeline
+        with use_trace_ctx(extract_trace(data)):
+            with get_tracer().span(
+                "stage.task", kind=data.get("kind"), model=data.get("model")
+            ):
+                await self._dispatch_task(ws, data)
+
+    async def _dispatch_task(self, ws, data):
         kind = data.get("kind")
         task_id = data.get("task_id")
 
@@ -267,7 +299,11 @@ class StageTaskMixin:
     async def _task_part_forward(self, ws, data):
         out = await self._run_stage_forward(data)
         frame = protocol.encode_binary(
-            protocol.msg(protocol.RESULT, task_id=data.get("task_id"), ok=True),
+            # inject: the RESULT carries this worker's span context back
+            # (a coordinator-side consumer can link reply to stage span)
+            inject_trace(
+                protocol.msg(protocol.RESULT, task_id=data.get("task_id"), ok=True)
+            ),
             {"out": out},
         )
         await self._send(ws, frame)
@@ -291,9 +327,9 @@ class StageTaskMixin:
         if runner.spec.is_last:
             origin_ws = await self._peer_ws(data.get("origin_peer"), "relay origin")
             frame = protocol.encode_binary(
-                protocol.msg(
+                inject_trace(protocol.msg(
                     protocol.RESULT, task_id=data.get("origin_task_id"), ok=True
-                ),
+                )),
                 {"out": out},
             )
             await self._send(origin_ws, frame)
@@ -308,10 +344,12 @@ class StageTaskMixin:
             if k in data
         }
         frame = protocol.encode_binary(
-            protocol.msg(
+            # inject under THIS stage's span (set by _handle_task), so the
+            # next stage's span parents stage-under-stage along the chain
+            inject_trace(protocol.msg(
                 protocol.TASK, kind=protocol.TASK_PART_FORWARD_RELAY,
                 task_id=new_id("task"), **fields,
-            ),
+            )),
             {"x": out},
         )
         await self._send(next_ws, frame)
@@ -406,8 +444,9 @@ class StageTaskMixin:
             next_ws = await self._peer_ws(nxt, "ring next stage")
             fields = {k: data[k] for k in self._RING_FIELDS if k in data}
             await self._send(next_ws, protocol.encode_binary(
-                protocol.msg(protocol.TASK, kind=protocol.TASK_DECODE_RUN,
-                             task_id=new_id("task"), **fields),
+                inject_trace(protocol.msg(
+                    protocol.TASK, kind=protocol.TASK_DECODE_RUN,
+                    task_id=new_id("task"), **fields)),
                 {"x": out},
             ))
             return
@@ -429,10 +468,10 @@ class StageTaskMixin:
             tokens = burst["tokens"]
             self.stage_bursts.pop(otid, None)
             origin_ws = await self._peer_ws(data["origin_peer"], "ring origin")
-            await self._send(origin_ws, protocol.msg(
+            await self._send(origin_ws, inject_trace(protocol.msg(
                 protocol.RESULT, task_id=otid, ok=True,
                 tokens=tokens, stopped=stopped,
-            ))
+            )))
             return
         try:
             next_ws = await self._peer_ws(nxt, "ring link to stage 0")
@@ -442,10 +481,10 @@ class StageTaskMixin:
         fields = {key: data[key] for key in self._RING_FIELDS if key in data}
         fields["offset"] = int(np.asarray(data["offset"]).reshape(-1)[0]) + 1
         fields["token"] = tok
-        await self._send(next_ws, protocol.msg(
+        await self._send(next_ws, inject_trace(protocol.msg(
             protocol.TASK, kind=protocol.TASK_DECODE_RUN,
             task_id=new_id("task"), **fields,
-        ))
+        )))
 
     async def _handle_result(self, ws, data):
         """RESULT / TASK_ERROR → resolve the matching pending future."""
@@ -486,7 +525,12 @@ class StageTaskMixin:
             # timeout. (Mid-chain stage deaths are covered separately: the
             # predecessor's failed send routes a TASK_ERROR to the origin.)
             self._pending_ws[task_id] = reply_info["ws"]
-        message = protocol.msg(protocol.TASK, kind=kind, task_id=task_id, **fields)
+        _C_STAGE_TASKS.inc(kind=kind)
+        # trace_ctx rides every stage task: the worker's stage.task span
+        # (and relayed hops beyond it) parents under the caller's span
+        message = inject_trace(
+            protocol.msg(protocol.TASK, kind=kind, task_id=task_id, **fields)
+        )
         try:
             try:
                 if tensors:
@@ -739,6 +783,9 @@ class PipelineCoordinator:
                 replaced.append((h["stage"], pid))
             self.stage_peers = new_peers
             self.epoch += 1
+            _C_RECOVERIES.inc()
+            _C_EPOCH_BUMPS.inc()
+            _C_STAGES_REPLACED.inc(len(replaced))
             await self._load_stages(timeout)
             if replaced:
                 logger.info(
@@ -821,6 +868,25 @@ class PipelineCoordinator:
             self.generation_deadline_s if deadline_s is None else deadline_s
         )
         out: list[int] = []
+        # the root span of a pipeline generation: run_stage_task injects
+        # its context into every stage task, so worker-side stage.task
+        # spans across the mesh share this trace_id (stitched timeline)
+        with get_tracer().span(
+            "pipeline.generate", model=self.model,
+            stages=len(self.stage_peers),
+        ) as gen_span:
+            try:
+                return await self._generate_with_failover(
+                    rid, prompt_ids, out, max_new_tokens,
+                    temperature, eos_token_id, on_token, rng, deadline,
+                )
+            finally:
+                gen_span.attrs["tokens"] = len(out)
+
+    async def _generate_with_failover(
+        self, rid, prompt_ids, out, max_new_tokens, temperature,
+        eos_token_id, on_token, rng, deadline,
+    ) -> list[int]:
         attempt = 0
         try:
             while True:
@@ -1483,6 +1549,7 @@ class PipelineSession:
                 and isinstance(e, StageError)
                 and self._failovers < self.max_failovers):
             self._failovers += 1
+            _C_SESSION_FAILOVERS.inc()
             try:
                 await asyncio.sleep(min(
                     self.failover_backoff_s * 2 ** (self._failovers - 1), 5.0

@@ -179,6 +179,13 @@ def decode_binary(raw: bytes) -> tuple[dict, dict]:
     return header, tensors
 
 
+# cross-node trace propagation (tracing.py): the originating request's
+# (trace_id, span_id) rides gen_request / task / result frames under this
+# optional key so worker-side spans parent under the request that caused
+# them. The reference mesh ignores unknown keys, so old peers are
+# unaffected; receivers treat a missing/malformed value as "no context".
+TRACE_CTX = "trace_ctx"
+
 # sampling knobs that ride GEN_REQUEST as plain message keys (the
 # reference ignores unknown keys, so frames stay wire-compatible). ONE
 # list: the gateway, the node handler, and the relay all copy from it —

@@ -13,6 +13,15 @@ import json
 import time
 from typing import Any, Iterator
 
+from ..metrics import get_registry
+
+# every backend's execute() funnels through result_dict, so this one
+# histogram covers service execute latency for tpu/ollama/remote/fake
+# alike (streaming paths report their own done-line accounting)
+_H_EXECUTE = get_registry().histogram(
+    "service.execute_ms", "service execute() latency per request (ms)"
+)
+
 
 class ServiceError(Exception):
     pass
@@ -101,6 +110,7 @@ class BaseService:
     def result_dict(text: str, new_tokens: int, t0: float, price_per_token: float) -> dict:
         """The reference's result schema (services.py:101-113)."""
         latency_ms = int((time.time() - t0) * 1000.0)
+        _H_EXECUTE.observe(latency_ms)
         return {
             "text": text,
             "tokens": int(new_tokens),

@@ -44,6 +44,21 @@ class FakeService(BaseService):
             return self.reply
         return f"echo({self._require_prompt(params)})"
 
+    def _timing(self, t0: float, n_tokens: int) -> dict:
+        """Engine-shaped per-request breakdown (engine.py _build_result):
+        the mesh/gateway timing plumbing is testable without a model."""
+        e2e_ms = (time.time() - t0) * 1000.0
+        return {
+            "queue_wait_ms": 0.0,
+            "prefill_ms": round(e2e_ms, 3),
+            "ttft_ms": round(e2e_ms, 3),
+            "decode_tokens": n_tokens,
+            "tokens_per_s": (
+                round(n_tokens / (e2e_ms / 1000.0), 2) if e2e_ms > 0 else 0.0
+            ),
+            "spec_acceptance": None,
+        }
+
     def execute(self, params: dict[str, Any]) -> dict[str, Any]:
         self.calls.append(dict(params))
         if self.fail_with:
@@ -52,13 +67,17 @@ class FakeService(BaseService):
             raise ServiceError(self.fail_with)
         t0 = time.time()
         text = self._reply_for(params)
-        return self.result_dict(text, len(text.split()), t0, self.price_per_token)
+        n = len(text.split())
+        out = self.result_dict(text, n, t0, self.price_per_token)
+        out["timing"] = self._timing(t0, n)
+        return out
 
     def execute_stream(self, params: dict[str, Any]) -> Iterator[str]:
         self.calls.append(dict(params))
         if self.fail_with:
             yield self.stream_line({"status": "error", "message": self.fail_with})
             return
+        t0 = time.time()
         text = self._reply_for(params)
         for i in range(0, len(text), self.chunk_size):
             if self.delay_s:
@@ -66,5 +85,6 @@ class FakeService(BaseService):
             yield self.stream_line({"text": text[i : i + self.chunk_size]})
         n = len(text.split())  # same accounting as execute()
         yield self.stream_line(
-            {"done": True, "tokens": n, "cost": self.price_per_token * n}
+            {"done": True, "tokens": n, "cost": self.price_per_token * n,
+             "timing": self._timing(t0, n)}
         )

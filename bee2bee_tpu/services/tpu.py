@@ -116,6 +116,10 @@ class TPUService(BaseService):
         out["ttft_ms"] = int(result.ttft_s * 1000)
         out["finish_reason"] = result.finish_reason
         out["prompt_tokens"] = result.prompt_tokens  # /v1 usage accounting
+        # the per-request latency breakdown (queue_wait/prefill/ttft/
+        # tokens_per_s/spec_acceptance): rides gen_success frames so the
+        # requester sees where its latency went (ISSUE 5)
+        out["timing"] = dict(result.timings)
         return out
 
     def _execute_with_stops(self, params: dict, stops: tuple, t0: float) -> dict:
@@ -146,6 +150,7 @@ class TPUService(BaseService):
             out["tokens_per_sec"] = result.tokens_per_sec
             out["ttft_ms"] = int(result.ttft_s * 1000)
             out["prompt_tokens"] = result.prompt_tokens
+            out["timing"] = dict(result.timings)
         return out
 
     def execute_stream(self, params: dict[str, Any]) -> Iterator[str]:
@@ -160,6 +165,7 @@ class TPUService(BaseService):
             acc = ""  # full raw accumulation
             emitted = 0  # chars of scrub(acc) already yielded
             n_new = None  # real token count, when the engine reports it
+            timing = None  # engine timing breakdown off the done event
             n_seen = 0  # tokens streamed so far (the billable count on a
             # stop hit — the engine's own total never arrives then)
             for ev in self.engine.generate_stream(**args):
@@ -167,6 +173,7 @@ class TPUService(BaseService):
                     res = ev.get("result")
                     if res is not None:
                         n_new = res.new_tokens
+                        timing = dict(res.timings)
                     tail = scrub_stop_words(acc, stops)
                     if tail[emitted:]:
                         yield self.stream_line({"text": tail[emitted:]})
@@ -185,6 +192,8 @@ class TPUService(BaseService):
             if n_new is not None:
                 done["tokens"] = int(n_new)
                 done["cost"] = self.price_per_token * int(n_new)
+            if timing is not None:
+                done["timing"] = timing
             yield self.stream_line(done)
         except Exception as e:  # match reference stream-error contract
             yield self.stream_line({"status": "error", "message": f"Stream error: {e}"})

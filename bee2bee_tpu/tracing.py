@@ -1,4 +1,5 @@
-"""Tracing and observability: request spans + on-demand device profiles.
+"""Tracing and observability: request spans + cross-node trace propagation
++ on-demand device profiles.
 
 The reference has NO tracing (SURVEY §5) — the closest artifacts are
 per-request latency_ms (reference services.py:97-105) and ping RTTs
@@ -9,12 +10,22 @@ per-request latency_ms (reference services.py:97-105) and ping RTTs
   and zero dependencies. One process-global instance via `get_tracer()`.
 - `Span` context manager works in sync and async code and never throws:
   tracing must not take down the serving path.
+- **Trace context propagation**: every span carries a `trace_id` (opened
+  fresh at the first span of a request, inherited inside it).
+  `inject_trace(frame)` stamps the current (trace_id, span_id) onto a wire
+  frame as the optional `trace_ctx` key; the receiving hop calls
+  `extract_trace(data)` + `use_trace_ctx(ctx)` so its spans parent under
+  the ORIGINATING request across nodes. `/trace?trace_id=` on any node
+  returns its local fragment; `stitch_trace()` merges fragments from
+  several nodes into one cross-node timeline.
 - `device_profile()`: wraps `jax.profiler.trace` so one call captures an
   XLA device trace viewable in TensorBoard/Perfetto.
 
 Spans are cheap (monotonic clock + dict append) and bounded (ring
 buffer), so they stay on in production; mesh nodes surface them at the
-gateway's `/trace` route.
+gateway's `/trace` route. Span NAMES are literal dotted constants —
+meshlint ML-T001 rejects dynamically-built names (request-varying names
+would defeat the per-name aggregation and explode cardinality).
 """
 
 from __future__ import annotations
@@ -27,10 +38,14 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Iterator
 
+from .protocol import TRACE_CTX
 from .utils import new_id
 
 _current_span: contextvars.ContextVar[str | None] = contextvars.ContextVar(
     "bee2bee_current_span", default=None
+)
+_current_trace: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "bee2bee_current_trace", default=None
 )
 
 
@@ -39,6 +54,7 @@ class Span:
     name: str
     span_id: str = field(default_factory=lambda: new_id("span"))
     parent_id: str | None = None
+    trace_id: str | None = None
     start_ms: float = 0.0
     duration_ms: float = -1.0  # -1 while open
     attrs: dict[str, Any] = field(default_factory=dict)
@@ -49,11 +65,83 @@ class Span:
             "name": self.name,
             "span_id": self.span_id,
             "parent_id": self.parent_id,
+            "trace_id": self.trace_id,
             "start_ms": round(self.start_ms, 3),
             "duration_ms": round(self.duration_ms, 3),
             "attrs": self.attrs,
             "error": self.error,
         }
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """The wire-portable half of a span: enough for a remote hop to parent
+    its own spans under the originating request."""
+
+    trace_id: str
+    span_id: str
+
+    def to_wire(self) -> dict:
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
+    @classmethod
+    def from_wire(cls, obj: Any) -> "TraceContext | None":
+        if (
+            isinstance(obj, dict)
+            and isinstance(obj.get("trace_id"), str)
+            and isinstance(obj.get("span_id"), str)
+        ):
+            return cls(obj["trace_id"], obj["span_id"])
+        return None
+
+
+def current_trace_ctx() -> TraceContext | None:
+    """The (trace_id, span_id) pair of the innermost open span, or None
+    outside any span."""
+    tid, sid = _current_trace.get(), _current_span.get()
+    if tid is None or sid is None:
+        return None
+    return TraceContext(tid, sid)
+
+
+def inject_trace(fields: dict) -> dict:
+    """Stamp the current trace context onto a wire frame/fields dict as
+    the optional `trace_ctx` key (declared in analysis/schema.py; the
+    reference mesh ignores unknown keys, so frames stay wire-compatible).
+    No-op outside a span — never throws."""
+    try:
+        ctx = current_trace_ctx()
+        if ctx is not None:
+            fields[TRACE_CTX] = ctx.to_wire()
+    except Exception:  # noqa: BLE001 — telemetry never breaks the wire path
+        pass
+    return fields
+
+
+def extract_trace(data: dict) -> TraceContext | None:
+    """Read a `trace_ctx` key off a received frame; None when absent or
+    malformed (old peers / non-instrumented senders) — never throws."""
+    try:
+        return TraceContext.from_wire(data.get(TRACE_CTX))
+    except Exception:  # noqa: BLE001 — a bad frame must not kill a handler
+        return None
+
+
+@contextmanager
+def use_trace_ctx(ctx: TraceContext | None):
+    """Run a block under a remote trace context: spans opened inside carry
+    ctx.trace_id and parent under ctx.span_id. ctx=None is a no-op, so
+    handlers can call this unconditionally."""
+    if ctx is None:
+        yield
+        return
+    t_trace = _current_trace.set(ctx.trace_id)
+    t_span = _current_span.set(ctx.span_id)
+    try:
+        yield
+    finally:
+        _current_span.reset(t_span)
+        _current_trace.reset(t_trace)
 
 
 class Tracer:
@@ -67,9 +155,15 @@ class Tracer:
 
     @contextmanager
     def span(self, name: str, **attrs) -> Iterator[Span]:
+        trace_id = _current_trace.get()
+        trace_token = None
+        if trace_id is None:  # first span of a request: open a new trace
+            trace_id = new_id("trace")
+            trace_token = _current_trace.set(trace_id)
         s = Span(
             name=name,
             parent_id=_current_span.get(),
+            trace_id=trace_id,
             start_ms=self._epoch + time.monotonic() * 1000.0,
             attrs=dict(attrs),
         )
@@ -83,6 +177,8 @@ class Tracer:
         finally:
             s.duration_ms = (time.monotonic() - t0) * 1000.0
             _current_span.reset(token)
+            if trace_token is not None:
+                _current_trace.reset(trace_token)
             with self._lock:
                 self._spans.append(s)
 
@@ -95,6 +191,14 @@ class Tracer:
             spans = list(self._spans)
         if name is not None:
             spans = [s for s in spans if s.name == name]
+        return [s.to_dict() for s in spans[-limit:]]
+
+    def for_trace(self, trace_id: str, limit: int = 1000) -> list[dict]:
+        """This process's local fragment of one trace, oldest first —
+        what `/trace?trace_id=` serves; stitch fragments from several
+        nodes with `stitch_trace`."""
+        with self._lock:
+            spans = [s for s in self._spans if s.trace_id == trace_id]
         return [s.to_dict() for s in spans[-limit:]]
 
     def stats(self) -> dict[str, dict]:
@@ -123,6 +227,31 @@ class Tracer:
         with self._lock:
             self._spans.clear()
             self.counters.clear()
+
+
+def stitch_trace(fragments: list[dict]) -> dict:
+    """Merge per-node trace fragments into one cross-node timeline.
+
+    `fragments` is a list of ``{"node": <peer_id>, "spans": [span dicts]}``
+    (each the payload of one node's ``/trace?trace_id=`` response). Spans
+    are annotated with their node, de-duplicated by span_id (fragments may
+    overlap when nodes share a process, e.g. loopback tests) and ordered
+    by start_ms — parent links then read as one tree across nodes."""
+    seen: dict[str, dict] = {}
+    for frag in fragments or []:
+        node = frag.get("node")
+        for s in frag.get("spans") or []:
+            sid = s.get("span_id")
+            if sid is None or sid in seen:
+                continue
+            seen[sid] = {**s, "node": node}
+    spans = sorted(seen.values(), key=lambda s: s.get("start_ms") or 0.0)
+    trace_ids = {s.get("trace_id") for s in spans if s.get("trace_id")}
+    return {
+        "trace_id": next(iter(trace_ids)) if len(trace_ids) == 1 else None,
+        "nodes": sorted({s["node"] for s in spans if s.get("node")}),
+        "spans": spans,
+    }
 
 
 def _pct(sorted_vals: list[float], q: float) -> float:

@@ -183,6 +183,10 @@ class MeshBridge:
                             # (services' done line → gen_success fields)
                             "tokens": msg.get("tokens"),
                             "cost": msg.get("cost"),
+                            # per-request latency breakdown (ISSUE 5):
+                            # queue_wait/prefill/ttft/tokens_per_s from the
+                            # serving engine, forwarded hop-by-hop
+                            "timing": msg.get("timing"),
                         }
                     )
             return
@@ -261,6 +265,8 @@ class MeshBridge:
                         if obj.get("tokens") is not None:
                             final["tokens"] = int(obj["tokens"])
                             final["cost"] = float(obj.get("cost") or 0.0)
+                        if obj.get("timing") is not None:
+                            final["timing"] = obj["timing"]
                         break
         return {
             "text": "".join(chunks),
@@ -268,6 +274,7 @@ class MeshBridge:
             "via": "direct",
             "tokens": final.get("tokens"),
             "cost": final.get("cost"),
+            "timing": final.get("timing"),
         }
 
     async def request(

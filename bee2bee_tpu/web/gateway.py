@@ -20,6 +20,7 @@ aiohttp):
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 from pathlib import Path
 
@@ -105,6 +106,20 @@ def create_web_app(bridge: MeshBridge, registry=None) -> web.Application:
             text = result.get("text") or streamed
             if len(text) > len(streamed):  # non-streamed remainder
                 await resp.write(text[len(streamed):].encode())
+            if body.get("meta") or task.get("meta"):
+                # opt-in response metadata trailer, mirroring the existing
+                # "\n\n[Error]: " in-stream convention (the raw-text stream
+                # has nowhere else to carry it): the node's per-request
+                # timing breakdown reaches gateway clients end-to-end
+                trailer = {
+                    "tokens": result.get("tokens"),
+                    "cost": result.get("cost"),
+                    "latency_ms": result.get("latency_ms"),
+                    "timing": result.get("timing"),
+                }
+                await resp.write(
+                    ("\n\n[Meta]: " + json.dumps(trailer)).encode()
+                )
             # prefer the node's REAL accounting when the mesh result
             # carries it (services/base.py result_dict: tokens + cost =
             # price_per_token x tokens); len/4 is the reference's estimate,

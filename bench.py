@@ -437,6 +437,22 @@ def main() -> None:
     except OSError:
         pass
 
+    # serving-telemetry snapshot (ISSUE 5): every rung above ran through
+    # the instrumented engine in THIS process, so the registry holds the
+    # round's real TTFT/TPOT/queue-wait distributions and the tracer its
+    # per-span percentiles — the perf trajectory carries distributions,
+    # not just aggregate throughput
+    try:
+        from bee2bee_tpu.metrics import get_registry
+        from bee2bee_tpu.tracing import get_tracer
+
+        extras["telemetry"] = {
+            "metrics": get_registry().snapshot(),
+            "tracer_stats": get_tracer().stats(),
+        }
+    except Exception as e:  # noqa: BLE001 — telemetry must not kill the bench
+        extras["telemetry"] = {"error": str(e)}
+
     ref = bench_reference_path()
     headline_entry = distil.get("batch8") or {}
     metric = "serve_tokens_per_sec_distilgpt2_batch8"

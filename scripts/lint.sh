@@ -12,4 +12,12 @@ echo "[lint] meshlint (python -m bee2bee_tpu.analysis)"
 echo "[lint] compileall"
 "$PY" -m compileall -q bee2bee_tpu
 
+# telemetry smoke (docs/OBSERVABILITY.md): loopback node + one generation;
+# /metrics must parse as Prometheus text with the mandatory series present.
+# SKIP_SMOKE=1 skips it (e.g. environments without aiohttp sockets).
+if [ "${SKIP_SMOKE:-0}" != "1" ]; then
+  echo "[lint] telemetry smoke"
+  "$PY" scripts/telemetry_smoke.py
+fi
+
 echo "[lint] ok"

@@ -426,3 +426,62 @@ async def held(self, ws):
     assert _rules(findings) == ["ML-A003"]
     # the one finding anchors to the await inside the real lock block
     assert findings[0].line == 10
+
+
+# --------------------------------------------------- telemetry pass fixtures
+
+
+def test_telemetry_pass_known_bad_fixture():
+    """ML-T001: every dynamic-name construction a span/metric call can
+    smuggle a request-varying string through — f-string, + concat,
+    %-format, .format()."""
+    src = '''
+from ..tracing import get_tracer, annotate
+from ..metrics import get_registry
+
+def f(rid, op):
+    with get_tracer().span(f"gen.{rid}"):
+        pass
+    with annotate("stage." + op):
+        pass
+    get_registry().counter("frames_%s" % op).inc()
+    get_registry().histogram(name="lat.{}".format(op)).observe(1.0)
+'''
+    rules = _rules(analyze_source(src, "engine/fixture.py"))
+    assert rules == ["ML-T001"] * 4, rules
+
+
+def test_telemetry_pass_accepts_literal_and_variable_names():
+    """Literal dotted constants pass; so does forwarding a plain variable
+    (the literal is checked at ITS call site), and request-varying data in
+    attrs/labels — the pattern the rule exists to steer people toward."""
+    src = '''
+from ..tracing import get_tracer
+from ..metrics import get_registry
+
+SPAN_NAME = "gen.local"
+
+def f(rid, op):
+    with get_tracer().span("gen.p2p", rid=rid):
+        pass
+    with get_tracer().span(SPAN_NAME):
+        pass
+    get_registry().counter("mesh.frames_sent").inc(op=op)
+    "a,b".split(",")[0].count("a")  # str.count is not Tracer.count
+'''
+    assert analyze_source(src, "meshnet/fixture.py") == []
+
+
+def test_telemetry_pass_scans_whole_package():
+    """Telemetry calls live in engine/, meshnet/, services/, web/ and
+    api.py alike — the pass must not scope itself out of any of them."""
+    from bee2bee_tpu.analysis.telemetry import TelemetryPass
+
+    p = TelemetryPass()
+    for path in ("engine/scheduler.py", "meshnet/node.py", "api.py",
+                 "web/gateway.py", "services/base.py", "tracing.py"):
+        assert p.applies(path), path
+
+
+def test_telemetry_rule_in_catalog():
+    assert "ML-T001" in rule_catalog()
