@@ -131,6 +131,10 @@ FRAME_SCHEMAS: dict[str, FrameSchema] = {
         ),
         _fs(P.PIECE_HAVE, required=frozenset({"hashes"})),
         _fs(P.GOODBYE, required=frozenset({"peer_id"})),
+        # health-plane gossip (health.build_digest rides the ping cadence);
+        # the digest is ONE opaque dict on the wire — its internal layout
+        # is versioned by health.DIGEST_VERSION, not by frame schema
+        _fs(P.TELEMETRY, required=frozenset({"peer_id", "digest"})),
         # task protocol: per-kind field contracts live in TASK_SCHEMAS —
         # the TASK envelope itself only promises kind + correlation id
         _fs(P.TASK, required=frozenset({"kind", "task_id"}), allow_extra=True),

@@ -11,6 +11,7 @@ aiohttp (fastapi/uvicorn are not in this image).
 
 from __future__ import annotations
 
+import asyncio
 import hmac
 import json
 import logging
@@ -20,6 +21,7 @@ from typing import Any
 from aiohttp import web
 
 from . import __version__
+from .health import fleet_view, render_fleet_prom
 from .meshnet.node import P2PNode
 from .metrics import PROMETHEUS_CONTENT_TYPE, get_registry
 from .protocol import copy_sampling
@@ -233,11 +235,12 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
             }
             if not request.query.get("stitch"):
                 return web.json_response(frag)
-            import asyncio
-
             import aiohttp
 
-            async def fetch_fragment(s, host, port):
+            async def fetch_fragment(s, pid, host, port):
+                """A peer that can't answer (or answers garbage) becomes a
+                typed PARTIAL fragment, so stitch_trace reports it under
+                missing_peers instead of silently shrinking the timeline."""
                 try:
                     async with s.get(
                         f"http://{host}:{port}/trace",
@@ -245,21 +248,37 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
                         timeout=aiohttp.ClientTimeout(total=3),
                     ) as r:
                         if r.status == 200:
-                            return await r.json()
+                            got = await r.json()
+                            if isinstance(got, dict) and isinstance(
+                                got.get("spans"), list
+                            ):
+                                return got
+                            return {"node": pid, "partial": True}
                 except Exception:  # noqa: BLE001 — stitch what answers
                     pass
-                return None
+                return {"node": pid, "unreachable": True}
 
             # concurrent fan-out: N unreachable peers cost ONE 3s timeout,
-            # not 3s each — a stitch over a big mesh must stay interactive
+            # not 3s each — a stitch over a big mesh must stay interactive.
+            # A peer with no advertised API endpoint can't be asked at all:
+            # it lands in missing_peers too, so the stitch never reports
+            # complete while silently lacking that node's spans.
+            tasks, no_endpoint = [], []
+            for pid, info in list(node.peers.items()):
+                if info.get("api_host") and info.get("api_port"):
+                    tasks.append(
+                        (pid, info.get("api_host"), info.get("api_port"))
+                    )
+                else:
+                    no_endpoint.append({"node": pid, "unreachable": True})
             async with aiohttp.ClientSession() as s:
                 got = await asyncio.gather(*(
-                    fetch_fragment(s, info.get("api_host"), info.get("api_port"))
-                    for info in list(node.peers.values())
-                    if info.get("api_host") and info.get("api_port")
+                    fetch_fragment(s, pid, host, port)
+                    for pid, host, port in tasks
                 ))
-            frags = [frag] + [f for f in got if f]
-            return web.json_response(stitch_trace(frags))
+            return web.json_response(
+                stitch_trace([frag] + list(got) + no_endpoint)
+            )
         try:
             limit = min(1000, max(1, int(request.query.get("limit", 50))))
         except ValueError:
@@ -313,6 +332,67 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
         return web.Response(
             body=reg.render().encode("utf-8"),
             headers={"Content-Type": PROMETHEUS_CONTENT_TYPE},
+        )
+
+    # ---- health plane (health.py): the fleet view, SLO status, and the
+    # incident flight recorder — the surface the SLO-aware front door
+    # (ROADMAP item 3) scrapes/routes on.
+
+    async def mesh_health(request):
+        """Merged fleet view: this node's live digest + every FRESH peer
+        digest from telemetry gossip, with fleet aggregates. JSON default;
+        ``?format=prom`` (or ``Accept: text/plain``) renders Prometheus
+        text with one series per fresh peer under a ``peer`` label —
+        stale peers' series drop out rather than serving forever."""
+        view = fleet_view(node.peer_id, node.telemetry_digest(), node.health)
+        fmt = request.query.get("format")
+        accept = request.headers.get("Accept", "")
+        if fmt == "prom" or (fmt is None and "text/plain" in accept):
+            return web.Response(
+                body=render_fleet_prom(view).encode("utf-8"),
+                headers={"Content-Type": PROMETHEUS_CONTENT_TYPE},
+            )
+        return web.json_response(view)
+
+    async def slo(request):
+        """Per-objective SLO status: a FRESH burn-rate evaluation (also
+        refreshes the bee2bee_slo_* gauges served by /metrics)."""
+        return web.json_response(
+            {
+                "node": node.peer_id,
+                "windows": {
+                    "fast_s": node.slo.fast_window_s,
+                    "slow_s": node.slo.slow_window_s,
+                },
+                "trip_burn_rate": node.slo.trip_burn_rate,
+                "objectives": node.slo.status(),
+            }
+        )
+
+    async def debug_incidents(request):
+        """Flight-recorder surface: ``?id=<incident id>`` fetches one full
+        on-disk bundle; otherwise the newest-first bundle index plus the
+        live ring tail (the events an incident WOULD snapshot right now)."""
+        inc_id = request.query.get("id")
+        if inc_id:
+            # bundle reads hit disk — off the event loop, same reasoning
+            # as the recorder's threaded write path
+            bundle = await asyncio.to_thread(node.recorder.load_incident, inc_id)
+            if bundle is None:
+                return web.json_response(
+                    {"detail": f"unknown incident {inc_id!r}"}, status=404
+                )
+            return web.json_response(bundle)
+        try:
+            limit = min(500, max(1, int(request.query.get("ring", 50))))
+        except ValueError:
+            return web.json_response({"detail": "ring must be an int"}, status=400)
+        return web.json_response(
+            {
+                "node": node.peer_id,
+                "incidents": await asyncio.to_thread(node.recorder.list_incidents),
+                "ring": node.recorder.events(limit=limit),
+            }
         )
 
     # ---- OpenAI-compatible surface (/v1): standard SDKs and tools can
@@ -427,6 +507,9 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
     app.router.add_get("/providers", providers)
     app.router.add_get("/trace", trace)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/mesh/health", mesh_health)
+    app.router.add_get("/slo", slo)
+    app.router.add_get("/debug/incidents", debug_incidents)
     app.router.add_post("/connect", connect)
     app.router.add_post("/chat", chat)
     app.router.add_post("/generate", chat)  # alias (reference api.py:190-191)

@@ -58,6 +58,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..health import get_recorder
 from ..metrics import get_registry
 from ..tracing import get_tracer
 
@@ -981,6 +982,15 @@ class BatchScheduler:
                     "error": f"admission failed: {err} "
                              "(kv_pool_blocks too small for this request)",
                 })
+                # TERMINAL exhaustion (nothing in flight to free blocks) is
+                # an incident, unlike the backpressure requeue above — a
+                # pool sized under the workload is an operator problem the
+                # flight recorder should evidence
+                get_recorder().incident(
+                    "pool_exhausted",
+                    detail=str(err),
+                    extra={"prompt_tokens": len(req.ids)},
+                )
                 continue
             except Exception as err:
                 # the popped request is in neither _queue nor _rows: fail it

@@ -1,0 +1,842 @@
+"""Mesh health plane: telemetry digests, SLO burn-rate tracking, and an
+incident flight recorder.
+
+PR 5 gave every node rich *local* instruments (metrics.py histograms,
+tracing.py spans). This module turns them into the *operational* layer the
+ROADMAP's front-door items consume:
+
+- ``build_digest()`` folds the local metrics registry into a compact,
+  wire-portable summary (histogram count/sum/percentiles, pool occupancy,
+  batch fill, spec acceptance, per-stage task counters). Nodes gossip it
+  on the ping cadence as a ``TELEMETRY`` frame (meshnet/node.py) and store
+  peers' digests in a ``HealthStore`` with staleness stamps, so *every*
+  node can serve the merged fleet view at ``GET /mesh/health``.
+- ``SloTracker`` evaluates a declarative SLO config (``ttft_p95 < 2s``
+  style latency objectives and error-rate objectives) against the local
+  histograms with **multi-window burn rates** (fast + slow window, Google
+  SRE style): burn rate = (bad fraction over the window) / error budget.
+  Exposed as ``bee2bee_slo_*`` gauges and ``GET /slo`` — the signal the
+  future SLO-aware router and admission controller route on.
+- ``FlightRecorder`` keeps a bounded ring of recent span completions,
+  frame-op events and metric deltas; typed failures (StageDead /
+  StageTimeout, paged-pool exhaustion, gen_error, SLO burn trips) snapshot
+  the ring plus the stitched trace of the offending request into an
+  on-disk **incident bundle**, listable via ``GET /debug/incidents``.
+
+Everything here honors the telemetry never-throw contract (metrics.py,
+tracing.py): recording, gossiping and snapshotting must not take down the
+serving path. Disk writes are best-effort; a full disk costs incident
+bundles, never generations.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Callable
+
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry, get_registry
+from .tracing import current_trace_ctx, get_tracer, stitch_trace
+from .utils import bee2bee_home, new_id
+
+logger = logging.getLogger("bee2bee_tpu.health")
+
+DIGEST_VERSION = 1
+
+# the metric allowlist a digest summarizes. A digest is a WIRE payload
+# repeated every ping interval to every peer: it must stay compact and
+# schema-stable, so the contents are enumerated here instead of shipping
+# the whole registry snapshot (which grows with every instrumented
+# subsystem and with label cardinality).
+DIGEST_HISTOGRAMS = (
+    "engine.queue_wait_ms",
+    "engine.ttft_ms",
+    "engine.inter_token_ms",
+    "engine.e2e_latency_ms",
+    "service.execute_ms",
+)
+DIGEST_GAUGES = (
+    "engine.batch_fill",
+    "engine.active_rows",
+    "engine.paged_blocks_in_use",
+    "engine.paged_blocks_free",
+    "engine.paged_blocks_total",
+)
+DIGEST_COUNTERS = (
+    "engine.tokens_generated",
+    "engine.spec_drafted",
+    "engine.spec_accepted",
+    "gen.requests",
+    "gen.errors",
+    "mesh.relay_hops",
+    "pipeline.recoveries",
+    "pipeline.session_failovers",
+)
+# labeled counter whose per-label breakdown rides the digest (the MPMD
+# bubble-fraction analysis needs per-stage task counts, not one total)
+DIGEST_STAGE_TASKS = "pipeline.stage_tasks"
+
+
+def build_digest(registry: MetricsRegistry | None = None) -> dict:
+    """Fold the metrics registry into a compact wire-portable summary.
+
+    Missing metrics (e.g. a client-only node that never imported the
+    engine) are simply absent from the digest — receivers treat absent
+    keys as "this node doesn't run that subsystem", not as zero."""
+    reg = registry or get_registry()
+    digest: dict[str, Any] = {"v": DIGEST_VERSION, "ts": time.time()}
+    hists: dict[str, dict] = {}
+    for name in DIGEST_HISTOGRAMS:
+        m = reg.get(name)
+        if not isinstance(m, Histogram):
+            continue
+        count, total = m.totals()
+        if count == 0:
+            continue
+        hists[name] = {
+            "count": count,
+            "sum": round(total, 3),
+            "p50": m.percentile(0.5),
+            "p95": m.percentile(0.95),
+            "p99": m.percentile(0.99),
+        }
+    if hists:
+        digest["hist"] = hists
+    gauges: dict[str, float] = {}
+    for name in DIGEST_GAUGES:
+        m = reg.get(name)
+        if isinstance(m, Gauge) and m.series():
+            gauges[name] = m.value()
+    if gauges:
+        digest["gauge"] = gauges
+    counters: dict[str, float] = {}
+    for name in DIGEST_COUNTERS:
+        m = reg.get(name)
+        if isinstance(m, Counter):
+            counters[name] = m.total()
+    if counters:
+        digest["counter"] = counters
+    stage = reg.get(DIGEST_STAGE_TASKS)
+    if isinstance(stage, Counter):
+        by_kind = {
+            ",".join(v for _, v in labels) or "_": value
+            for labels, value in stage.series()
+        }
+        if by_kind:
+            digest["stage_tasks"] = by_kind
+    drafted = counters.get("engine.spec_drafted") or 0.0
+    if drafted:
+        digest["spec_acceptance"] = round(
+            (counters.get("engine.spec_accepted") or 0.0) / drafted, 4
+        )
+    return digest
+
+
+# --------------------------------------------------------------- health store
+
+
+class HealthStore:
+    """Per-peer telemetry digests with staleness stamps.
+
+    A digest older than ``ttl_s`` is STALE: it stays readable (``all()``)
+    for debugging but is excluded from ``fresh()`` — and therefore from
+    ``/mesh/health`` aggregates and the peer-labeled exposition, matching
+    the registry's empty-gauge contract (a reading that stopped arriving
+    must drop out, not serve forever as if current)."""
+
+    def __init__(self, ttl_s: float = 45.0):
+        self.ttl_s = ttl_s
+        self._lock = threading.Lock()
+        self._digests: dict[str, dict] = {}  # peer_id -> digest
+        self._received: dict[str, float] = {}  # peer_id -> local arrival time
+
+    def update(self, peer_id: str, digest: dict) -> None:
+        if not peer_id or not isinstance(digest, dict):
+            return
+        with self._lock:
+            self._digests[peer_id] = digest
+            self._received[peer_id] = time.time()
+
+    def drop(self, peer_id: str) -> None:
+        with self._lock:
+            self._digests.pop(peer_id, None)
+            self._received.pop(peer_id, None)
+
+    def age_s(self, peer_id: str) -> float | None:
+        with self._lock:
+            t = self._received.get(peer_id)
+        return None if t is None else time.time() - t
+
+    def fresh(self) -> dict[str, dict]:
+        """{peer_id: digest} for peers heard from within the TTL."""
+        now = time.time()
+        with self._lock:
+            return {
+                pid: d
+                for pid, d in self._digests.items()
+                if now - self._received[pid] <= self.ttl_s
+            }
+
+    def all(self) -> dict[str, dict]:
+        """Every stored digest annotated with age/staleness (debug view)."""
+        now = time.time()
+        with self._lock:
+            return {
+                pid: {
+                    **d,
+                    "age_s": round(now - self._received[pid], 3),
+                    "stale": now - self._received[pid] > self.ttl_s,
+                }
+                for pid, d in self._digests.items()
+            }
+
+    def stale_peers(self) -> list[str]:
+        now = time.time()
+        with self._lock:
+            return sorted(
+                pid
+                for pid in self._digests
+                if now - self._received[pid] > self.ttl_s
+            )
+
+
+def fleet_view(local_peer_id: str, local_digest: dict, store: HealthStore) -> dict:
+    """The merged ``/mesh/health`` payload: the local node's digest plus
+    every FRESH peer digest, with fleet-level aggregates. Stale peers are
+    listed by id but contribute nothing to the aggregates."""
+    peers: dict[str, dict] = {local_peer_id: {**local_digest, "age_s": 0.0}}
+    for pid, digest in store.fresh().items():
+        age = store.age_s(pid)
+        peers[pid] = {**digest, "age_s": round(age, 3) if age is not None else None}
+    agg: dict[str, float] = {"nodes": len(peers)}
+    p95s, queue_p95s, tokens, blocks, rows = [], [], 0.0, 0.0, 0.0
+    for d in peers.values():
+        hist = d.get("hist") or {}
+        ttft = hist.get("engine.ttft_ms")
+        if ttft:
+            p95s.append(float(ttft.get("p95") or 0.0))
+        qw = hist.get("engine.queue_wait_ms")
+        if qw:
+            queue_p95s.append(float(qw.get("p95") or 0.0))
+        counter = d.get("counter") or {}
+        tokens += float(counter.get("engine.tokens_generated") or 0.0)
+        gauge = d.get("gauge") or {}
+        blocks += float(gauge.get("engine.paged_blocks_in_use") or 0.0)
+        rows += float(gauge.get("engine.active_rows") or 0.0)
+    if p95s:
+        agg["ttft_p95_ms_max"] = max(p95s)
+    if queue_p95s:
+        agg["queue_wait_p95_ms_max"] = max(queue_p95s)
+    agg["tokens_generated_total"] = tokens
+    agg["paged_blocks_in_use_total"] = blocks
+    agg["active_rows_total"] = rows
+    return {
+        "node": local_peer_id,
+        "ttl_s": store.ttl_s,
+        "peers": peers,
+        "stale_peers": store.stale_peers(),
+        "aggregate": agg,
+    }
+
+
+def render_fleet_prom(view: dict) -> str:
+    """Prometheus text exposition of a fleet view, one series per FRESH
+    peer under a ``peer`` label. Built from a throwaway registry each
+    scrape, so a peer absent from the view simply has no series — the
+    drop-out contract for stale peers comes for free."""
+    reg = MetricsRegistry()
+    up = reg.gauge("mesh.peer_up", "1 for every fresh peer digest in the view")
+    age = reg.gauge("mesh.peer_digest_age_s", "digest age at scrape")
+    ttft = reg.gauge("mesh.peer_ttft_p95_ms", "peer-reported TTFT p95")
+    qwait = reg.gauge("mesh.peer_queue_wait_p95_ms", "peer-reported queue-wait p95")
+    e2e = reg.gauge("mesh.peer_e2e_p95_ms", "peer-reported e2e latency p95")
+    fill = reg.gauge("mesh.peer_batch_fill", "peer-reported batch fill")
+    rows = reg.gauge("mesh.peer_active_rows", "peer-reported active rows")
+    used = reg.gauge("mesh.peer_paged_blocks_in_use", "peer-reported pool blocks used")
+    free = reg.gauge("mesh.peer_paged_blocks_free", "peer-reported pool blocks free")
+    toks = reg.gauge("mesh.peer_tokens_generated", "peer-reported tokens generated")
+    errs = reg.gauge("mesh.peer_gen_errors", "peer-reported failed generations")
+    acc = reg.gauge("mesh.peer_spec_acceptance", "peer-reported spec acceptance")
+    for pid, d in (view.get("peers") or {}).items():
+        up.set(1, peer=pid)
+        if d.get("age_s") is not None:
+            age.set(d["age_s"], peer=pid)
+        hist = d.get("hist") or {}
+        if "engine.ttft_ms" in hist:
+            ttft.set(hist["engine.ttft_ms"].get("p95") or 0.0, peer=pid)
+        if "engine.queue_wait_ms" in hist:
+            qwait.set(hist["engine.queue_wait_ms"].get("p95") or 0.0, peer=pid)
+        if "engine.e2e_latency_ms" in hist:
+            e2e.set(hist["engine.e2e_latency_ms"].get("p95") or 0.0, peer=pid)
+        gauge = d.get("gauge") or {}
+        if "engine.batch_fill" in gauge:
+            fill.set(gauge["engine.batch_fill"], peer=pid)
+        if "engine.active_rows" in gauge:
+            rows.set(gauge["engine.active_rows"], peer=pid)
+        if "engine.paged_blocks_in_use" in gauge:
+            used.set(gauge["engine.paged_blocks_in_use"], peer=pid)
+        if "engine.paged_blocks_free" in gauge:
+            free.set(gauge["engine.paged_blocks_free"], peer=pid)
+        counter = d.get("counter") or {}
+        if "engine.tokens_generated" in counter:
+            toks.set(counter["engine.tokens_generated"], peer=pid)
+        if "gen.errors" in counter:
+            errs.set(counter["gen.errors"], peer=pid)
+        if d.get("spec_acceptance") is not None:
+            acc.set(d["spec_acceptance"], peer=pid)
+    return reg.render()
+
+
+# ------------------------------------------------------------- SLO tracking
+
+
+@dataclass(frozen=True)
+class SloObjective:
+    """One declarative objective.
+
+    kind="latency": good events are observations of histogram ``metric``
+    at or under ``threshold_ms`` (the threshold should sit on a bucket
+    bound — the default buckets are powers of two ms — since bucketed
+    counts can only split at bounds; an off-bound threshold is rounded
+    DOWN to the nearest bound, the conservative direction).
+
+    kind="error_rate": good events are ``total_metric`` counts minus
+    ``errors_metric`` counts (both counters).
+
+    ``target`` is the availability goal, e.g. 0.95 ⇒ a 5% error budget.
+    """
+
+    name: str
+    kind: str  # "latency" | "error_rate"
+    target: float
+    metric: str = ""  # latency: histogram name
+    threshold_ms: float = 0.0  # latency only
+    errors_metric: str = ""  # error_rate: counters
+    total_metric: str = ""
+
+    @property
+    def budget(self) -> float:
+        return max(1.0 - self.target, 1e-9)
+
+    def describe(self) -> dict:
+        out = {"name": self.name, "kind": self.kind, "target": self.target}
+        if self.kind == "latency":
+            out["metric"] = self.metric
+            out["threshold_ms"] = self.threshold_ms
+        else:
+            out["errors_metric"] = self.errors_metric
+            out["total_metric"] = self.total_metric
+        return out
+
+
+DEFAULT_SLO_CONFIG: tuple[dict, ...] = (
+    {"name": "ttft_p95", "kind": "latency", "metric": "engine.ttft_ms",
+     "threshold_ms": 2048.0, "target": 0.95},
+    {"name": "queue_wait_p99", "kind": "latency",
+     "metric": "engine.queue_wait_ms", "threshold_ms": 4096.0, "target": 0.99},
+    {"name": "gen_error_rate", "kind": "error_rate",
+     "errors_metric": "gen.errors", "total_metric": "gen.requests",
+     "target": 0.99},
+)
+
+
+def parse_slo_config(entries) -> list[SloObjective]:
+    """Validate a list of objective dicts; raises ValueError on junk (a
+    mis-typed SLO config must fail loudly at boot, not route on garbage)."""
+    out: list[SloObjective] = []
+    seen_names: set[str] = set()
+    for e in entries:
+        if not isinstance(e, dict) or not e.get("name"):
+            raise ValueError(f"SLO entry needs a name: {e!r}")
+        name = str(e["name"])
+        # SloTracker keys its snapshot deques by name: two objectives
+        # sharing one would interleave unrelated cumulative counts and
+        # burn-rate on garbage
+        if name in seen_names:
+            raise ValueError(f"duplicate SLO objective name {name!r}")
+        seen_names.add(name)
+        kind = e.get("kind")
+        target = float(e.get("target", 0.0))
+        if not 0.0 < target < 1.0:
+            raise ValueError(f"SLO {e['name']!r}: target must be in (0, 1)")
+        if kind == "latency":
+            if not e.get("metric") or float(e.get("threshold_ms", 0)) <= 0:
+                raise ValueError(
+                    f"SLO {e['name']!r}: latency kind needs metric + threshold_ms"
+                )
+            out.append(SloObjective(
+                name=str(e["name"]), kind="latency", target=target,
+                metric=str(e["metric"]), threshold_ms=float(e["threshold_ms"]),
+            ))
+        elif kind == "error_rate":
+            if not e.get("errors_metric") or not e.get("total_metric"):
+                raise ValueError(
+                    f"SLO {e['name']!r}: error_rate kind needs "
+                    "errors_metric + total_metric"
+                )
+            out.append(SloObjective(
+                name=str(e["name"]), kind="error_rate", target=target,
+                errors_metric=str(e["errors_metric"]),
+                total_metric=str(e["total_metric"]),
+            ))
+        else:
+            raise ValueError(f"SLO {e['name']!r}: unknown kind {kind!r}")
+    return out
+
+
+def load_slo_config(source: str | None = None) -> list[SloObjective]:
+    """SLO objectives from `source`, the ``BEE2BEE_SLO_CONFIG`` env var
+    (inline JSON array, or a path to a JSON file), or the defaults."""
+    raw = source if source is not None else os.environ.get("BEE2BEE_SLO_CONFIG")
+    if not raw:
+        return parse_slo_config(DEFAULT_SLO_CONFIG)
+    text = raw.strip()
+    if not text.startswith("["):
+        text = Path(text).read_text()
+    return parse_slo_config(json.loads(text))
+
+
+# burn-rate gauges (bee2bee_slo_* after prefixing): labeled by objective
+# name — bounded by the configured objective list, not by request traffic
+_G_SLO_BURN = get_registry().gauge(
+    "slo.burn_rate", "error-budget burn rate by objective and window"
+)
+_G_SLO_STATUS = get_registry().gauge(
+    "slo.status", "objective status: 0 ok, 1 burning, 2 tripped"
+)
+_G_SLO_BAD_FRACTION = get_registry().gauge(
+    "slo.bad_fraction", "bad-event fraction over the fast window"
+)
+
+STATUS_OK = "ok"
+STATUS_BURNING = "burning"
+STATUS_TRIPPED = "tripped"
+_STATUS_CODE = {STATUS_OK: 0, STATUS_BURNING: 1, STATUS_TRIPPED: 2}
+
+
+class SloTracker:
+    """Continuous multi-window burn-rate evaluation of SLO objectives
+    against the (cumulative) local metrics registry.
+
+    Each ``evaluate()`` snapshots every objective's cumulative (bad,
+    total) event counts and computes the bad fraction over a FAST and a
+    SLOW trailing window from snapshot deltas; burn rate is that fraction
+    divided by the error budget (burn 1.0 = exactly spending the budget;
+    the classic page condition is burn high in BOTH windows — fast for
+    responsiveness, slow to ignore blips). A trip calls ``on_trip``
+    (the flight recorder) at most once per ``trip_cooldown_s``."""
+
+    def __init__(
+        self,
+        objectives: list[SloObjective] | None = None,
+        registry: MetricsRegistry | None = None,
+        fast_window_s: float = 300.0,
+        slow_window_s: float = 3600.0,
+        trip_burn_rate: float = 6.0,
+        on_trip: Callable[[SloObjective, dict], None] | None = None,
+        trip_cooldown_s: float = 300.0,
+    ):
+        self.objectives = (
+            list(objectives) if objectives is not None
+            else parse_slo_config(DEFAULT_SLO_CONFIG)
+        )
+        self._reg = registry or get_registry()
+        self.fast_window_s = fast_window_s
+        self.slow_window_s = slow_window_s
+        self.trip_burn_rate = trip_burn_rate
+        self.on_trip = on_trip
+        self.trip_cooldown_s = trip_cooldown_s
+        self._lock = threading.Lock()
+        self._snaps: dict[str, deque] = {
+            o.name: deque() for o in self.objectives
+        }
+        self._last_trip: dict[str, float] = {}
+        self._last_eval: list[dict] = []
+
+    # ---- cumulative event counts
+
+    def _counts(self, o: SloObjective) -> tuple[float, float]:
+        """Cumulative (bad, total) event counts for an objective."""
+        if o.kind == "latency":
+            m = self._reg.get(o.metric)
+            if not isinstance(m, Histogram):
+                return 0.0, 0.0
+            count, _ = m.totals()
+            good = m.count_le(o.threshold_ms)
+            # totals() and count_le() take the histogram lock separately:
+            # an observe landing between them can make good > count for
+            # one reading. bad is cumulative and monotone — clamp rather
+            # than report a negative burn for a tick.
+            return float(max(0, count - good)), float(count)
+        errors = self._reg.get(o.errors_metric)
+        total = self._reg.get(o.total_metric)
+        bad = errors.total() if isinstance(errors, Counter) else 0.0
+        tot = total.total() if isinstance(total, Counter) else 0.0
+        return float(bad), float(tot)
+
+    @staticmethod
+    def _window_delta(snaps: deque, now: float, window_s: float) -> tuple[float, float]:
+        """(bad, total) delta over the trailing window: latest snapshot
+        minus the newest snapshot at/before the window start (or the
+        oldest available — a partial window early in the process's life
+        still reports, it just covers less time)."""
+        if len(snaps) < 2:
+            return 0.0, 0.0
+        t_now, bad_now, tot_now = snaps[-1]
+        start = now - window_s
+        ref = snaps[0]
+        for s in snaps:
+            if s[0] <= start:
+                ref = s
+            else:
+                break
+        return bad_now - ref[1], tot_now - ref[2]
+
+    def evaluate(self, now: float | None = None) -> list[dict]:
+        """Snapshot + compute every objective; refresh the slo.* gauges;
+        fire on_trip for fresh trips. Never throws (telemetry contract)."""
+        try:
+            return self._evaluate(now)
+        except Exception:  # noqa: BLE001 — the health plane must not crash serving
+            logger.exception("SLO evaluation failed")
+            return self._last_eval
+
+    def _evaluate(self, now: float | None) -> list[dict]:
+        now = time.time() if now is None else now
+        out: list[dict] = []
+        with self._lock:
+            for o in self.objectives:
+                bad, tot = self._counts(o)
+                snaps = self._snaps[o.name]
+                snaps.append((now, bad, tot))
+                horizon = now - self.slow_window_s
+                # keep ONE snapshot at/before the horizon as the slow
+                # window's reference point
+                while len(snaps) > 2 and snaps[1][0] <= horizon:
+                    snaps.popleft()
+                entry = {**o.describe()}
+                burns = {}
+                for label, win in (("fast", self.fast_window_s),
+                                   ("slow", self.slow_window_s)):
+                    dbad, dtot = self._window_delta(snaps, now, win)
+                    frac = dbad / dtot if dtot > 0 else 0.0
+                    burns[label] = {
+                        "bad": dbad, "total": dtot,
+                        "bad_fraction": round(frac, 6),
+                        "burn_rate": round(frac / o.budget, 4),
+                    }
+                burn_fast = burns["fast"]["burn_rate"]
+                burn_slow = burns["slow"]["burn_rate"]
+                if (burn_fast >= self.trip_burn_rate
+                        and burn_slow >= self.trip_burn_rate):
+                    status = STATUS_TRIPPED
+                elif burn_fast >= 1.0:
+                    status = STATUS_BURNING
+                else:
+                    status = STATUS_OK
+                entry.update(
+                    windows=burns, status=status,
+                    burn_rate_fast=burn_fast, burn_rate_slow=burn_slow,
+                )
+                _G_SLO_BURN.set(burn_fast, objective=o.name, window="fast")
+                _G_SLO_BURN.set(burn_slow, objective=o.name, window="slow")
+                _G_SLO_STATUS.set(_STATUS_CODE[status], objective=o.name)
+                _G_SLO_BAD_FRACTION.set(
+                    burns["fast"]["bad_fraction"], objective=o.name
+                )
+                if status == STATUS_TRIPPED:
+                    last = self._last_trip.get(o.name, -math.inf)
+                    if now - last >= self.trip_cooldown_s:
+                        self._last_trip[o.name] = now
+                        entry["tripped_at"] = now
+                        if self.on_trip is not None:
+                            try:
+                                self.on_trip(o, dict(entry))
+                            except Exception:  # noqa: BLE001
+                                logger.exception("SLO on_trip hook failed")
+                out.append(entry)
+            self._last_eval = out
+        return out
+
+    def status(self) -> list[dict]:
+        """A fresh evaluation (what ``GET /slo`` serves)."""
+        return self.evaluate()
+
+    def brief(self) -> dict:
+        """Compact per-objective summary for the gossip digest."""
+        out = {}
+        for entry in self._last_eval:
+            out[entry["name"]] = {
+                "status": entry["status"],
+                "burn_fast": entry["burn_rate_fast"],
+                "burn_slow": entry["burn_rate_slow"],
+            }
+        return out
+
+
+# --------------------------------------------------------- flight recorder
+
+
+@dataclass
+class _RingEvent:
+    ts: float
+    kind: str
+    fields: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"ts": round(self.ts, 3), "kind": self.kind, **self.fields}
+
+
+class FlightRecorder:
+    """Bounded ring of recent telemetry events + on-disk incident bundles.
+
+    ``record()`` is the cheap path (deque append under a lock, never
+    throws) fed by span completions (tracing listener), notable frame ops
+    (meshnet/node.py) and per-tick metric deltas (monitor loop).
+
+    ``incident()`` is the expensive path, taken only on typed failures:
+    it snapshots the ring, the metrics digest, and the stitched trace of
+    the offending request into one JSON bundle under ``incident_dir``.
+    The snapshot itself is in-memory and cheap; the DISK half (mkdir,
+    write, prune) runs on a short-lived writer thread so callers on the
+    asyncio event loop (gen_error serve path, pipeline failover, SLO
+    trips from the monitor loop) never block mesh traffic on a slow
+    filesystem — ``flush()`` joins outstanding writes (tests, shutdown).
+    Per-kind cooldown bounds disk churn under a failure storm; bundles
+    beyond ``max_incidents`` are pruned oldest-first."""
+
+    def __init__(
+        self,
+        capacity: int = 512,
+        incident_dir: str | Path | None = None,
+        max_incidents: int = 32,
+        cooldown_s: float = 30.0,
+    ):
+        self._events: deque[_RingEvent] = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._incident_dir = Path(incident_dir) if incident_dir else None
+        self.max_incidents = max_incidents
+        self.cooldown_s = cooldown_s
+        self._last_incident: dict[str, float] = {}  # kind -> ts
+        self._disk_lock = threading.Lock()  # serializes write + prune
+        self._writers: list[threading.Thread] = []
+        # header index cache for list_incidents: path -> (stat sig, header)
+        self._index_cache: dict[str, tuple[tuple, dict]] = {}
+
+    # ---- configuration
+
+    @property
+    def incident_dir(self) -> Path:
+        """Resolved lazily: env ``BEE2BEE_INCIDENT_DIR``, else
+        ``<bee2bee home>/incidents`` (home itself is env-overridable)."""
+        if self._incident_dir is None:
+            env = os.environ.get("BEE2BEE_INCIDENT_DIR")
+            self._incident_dir = (
+                Path(env) if env else bee2bee_home() / "incidents"
+            )
+        return self._incident_dir
+
+    @incident_dir.setter
+    def incident_dir(self, value: str | Path | None) -> None:
+        self._incident_dir = Path(value) if value else None
+
+    # ---- ring
+
+    def record(self, kind: str, **fields) -> None:
+        """Append one ring event; never throws."""
+        try:
+            with self._lock:
+                self._events.append(_RingEvent(time.time(), str(kind), fields))
+        except Exception:  # noqa: BLE001 — telemetry never throws
+            pass
+
+    def events(self, limit: int = 200) -> list[dict]:
+        with self._lock:
+            evs = list(self._events)
+        return [e.to_dict() for e in evs[-limit:]]
+
+    def clear(self) -> None:
+        """Tests: reset ring + cooldowns (disk bundles stay)."""
+        with self._lock:
+            self._events.clear()
+            self._last_incident.clear()
+
+    # ---- incidents
+
+    def incident(
+        self,
+        kind: str,
+        detail: str = "",
+        trace_id: str | None = None,
+        node: str | None = None,
+        extra: dict | None = None,
+    ) -> str | None:
+        """Snapshot an incident bundle. Returns the incident id, or None
+        when suppressed by the per-kind cooldown (or when the snapshot
+        itself fails). The bundle is captured in-memory HERE — ring, trace
+        and digest reflect this instant — but the disk write happens on a
+        writer thread (``flush()`` waits for it): callers sit on the
+        asyncio event loop and must not block on a slow filesystem. A
+        failed write costs the bundle, never serving — best-effort by
+        contract."""
+        try:
+            now = time.time()
+            with self._lock:
+                last = self._last_incident.get(kind, -math.inf)
+                if now - last < self.cooldown_s:
+                    return None
+                self._last_incident[kind] = now
+            if trace_id is None:
+                ctx = current_trace_ctx()
+                trace_id = ctx.trace_id if ctx else None
+            inc_id = new_id("inc")
+            bundle: dict[str, Any] = {
+                "id": inc_id,
+                "ts": now,
+                "kind": kind,
+                "detail": detail,
+                "node": node,
+                "trace_id": trace_id,
+                "events": self.events(limit=self._events.maxlen or 512),
+                "metrics": build_digest(),
+            }
+            if extra:
+                bundle["extra"] = extra
+            if trace_id:
+                # the stitched trace of the offending request: in a
+                # one-node-per-process deployment this is the local
+                # fragment (peers' fragments stitch on read via /trace);
+                # in loopback meshes the shared tracer holds every hop
+                bundle["trace"] = stitch_trace([
+                    {"node": node, "spans": get_tracer().for_trace(trace_id)}
+                ])
+            self.record("incident", id=inc_id, incident_kind=kind, detail=detail)
+            payload = json.dumps(bundle, default=str)
+            t = threading.Thread(
+                target=self._write_bundle, args=(inc_id, kind, detail, payload),
+                name=f"incident-write-{inc_id}", daemon=True,
+            )
+            with self._lock:
+                self._writers = [w for w in self._writers if w.is_alive()]
+                self._writers.append(t)
+            t.start()
+            return inc_id
+        except Exception:  # noqa: BLE001 — telemetry never throws
+            logger.exception("incident snapshot failed")
+            return None
+
+    def flush(self, timeout_s: float = 5.0) -> None:
+        """Join outstanding bundle writes (tests, orderly shutdown)."""
+        deadline = time.time() + timeout_s
+        with self._lock:
+            writers = list(self._writers)
+        for w in writers:
+            w.join(max(0.0, deadline - time.time()))
+
+    def _write_bundle(self, inc_id: str, kind: str, detail: str, payload: str) -> None:
+        try:
+            with self._disk_lock:
+                d = self.incident_dir
+                d.mkdir(parents=True, exist_ok=True)
+                path = d / f"{inc_id}.json"
+                path.write_text(payload)
+                self._prune(d)
+            logger.warning("incident %s (%s): %s -> %s", inc_id, kind, detail, path)
+        except Exception:  # noqa: BLE001 — a full disk must not take down serving
+            logger.exception("incident write failed (%s)", inc_id)
+
+    def _prune(self, d: Path) -> None:
+        bundles = sorted(d.glob("inc-*.json"), key=lambda p: p.stat().st_mtime)
+        for p in bundles[: max(0, len(bundles) - self.max_incidents)]:
+            try:
+                p.unlink()
+            except OSError:
+                pass
+
+    def list_incidents(self) -> list[dict]:
+        """Newest-first header index of on-disk bundles (id, ts, kind,
+        detail, node, trace_id) — the ``GET /debug/incidents`` listing.
+        Headers are cached per (path, stat signature): polling the debug
+        surface re-parses only bundles that actually changed, not every
+        multi-hundred-KB ring+trace payload on each request."""
+        try:
+            d = self.incident_dir
+            if not d.is_dir():
+                return []
+            out = []
+            seen_paths: set[str] = set()
+            for p in sorted(d.glob("inc-*.json"),
+                            key=lambda p: p.stat().st_mtime, reverse=True):
+                key = str(p)
+                seen_paths.add(key)
+                try:
+                    st = p.stat()
+                    sig = (st.st_mtime_ns, st.st_size)
+                    cached = self._index_cache.get(key)
+                    if cached and cached[0] == sig:
+                        out.append(dict(cached[1]))
+                        continue
+                    b = json.loads(p.read_text())
+                except (OSError, ValueError):
+                    continue
+                header = {
+                    k: b.get(k)
+                    for k in ("id", "ts", "kind", "detail", "node", "trace_id")
+                }
+                self._index_cache[key] = (sig, header)
+                out.append(dict(header))
+            for key in list(self._index_cache):
+                if key not in seen_paths:  # pruned/removed bundles
+                    self._index_cache.pop(key, None)
+            return out
+        except Exception:  # noqa: BLE001
+            logger.exception("incident listing failed")
+            return []
+
+    def load_incident(self, incident_id: str) -> dict | None:
+        """Full bundle by id; None when unknown. The id is user input off
+        a URL — resolve by exact-match listing, never by path join."""
+        try:
+            d = self.incident_dir
+            if not d.is_dir():
+                return None
+            for p in d.glob("inc-*.json"):
+                if p.stem == incident_id:
+                    return json.loads(p.read_text())
+            return None
+        except Exception:  # noqa: BLE001
+            logger.exception("incident load failed")
+            return None
+
+
+_RECORDER = FlightRecorder()
+_LISTENER_WIRED = False
+
+
+def _span_listener(span) -> None:
+    """Tracing listener: every completed span becomes a compact ring
+    event — the 'what just happened' half of an incident bundle."""
+    _RECORDER.record(
+        "span",
+        name=span.name,
+        duration_ms=round(span.duration_ms, 3),
+        trace_id=span.trace_id,
+        error=span.error,
+    )
+
+
+def get_recorder() -> FlightRecorder:
+    """The process-global flight recorder (wired to the global tracer on
+    first use, so span completions start landing in the ring)."""
+    global _LISTENER_WIRED
+    if not _LISTENER_WIRED:
+        _LISTENER_WIRED = True
+        get_tracer().add_listener(_span_listener)
+    return _RECORDER

@@ -37,6 +37,7 @@ except ImportError:  # gate the missing dep: loopback shim (wscompat.py)
     from .. import wscompat as websockets
 
 from .. import protocol
+from ..health import HealthStore, SloTracker, build_digest, get_recorder, load_slo_config
 from ..joinlink import generate_join_link, parse_join_link
 from ..metrics import get_registry
 from ..pieces import ShardManifest
@@ -81,6 +82,23 @@ _C_BYTES_RECV = get_registry().counter(
 _C_RELAY_HOPS = get_registry().counter(
     "mesh.relay_hops", "gen_requests forwarded through the swarm relay"
 )
+# generation outcome counters: the event stream the gen_error_rate SLO
+# objective (health.DEFAULT_SLO_CONFIG) burns against. Counted at
+# _execute_local — the one funnel every locally-served generation
+# (HTTP /chat, /v1, p2p gen_request, relay target) passes through.
+_C_GEN_REQUESTS = get_registry().counter(
+    "gen.requests", "generations served by local services"
+)
+_C_GEN_ERRORS = get_registry().counter(
+    "gen.errors", "locally-served generations that raised"
+)
+
+# received frame ops worth a flight-recorder ring entry: failures and
+# membership changes — the events an incident bundle needs for context.
+# Pings/pongs/chunks would drown the ring in weather.
+_NOTABLE_OPS = frozenset(
+    {protocol.GEN_ERROR, protocol.TASK_ERROR, protocol.GOODBYE, protocol.HELLO}
+)
 
 
 def _frame_bytes(raw: str | bytes) -> int:
@@ -123,6 +141,20 @@ class P2PNode(StageTaskMixin):
         self.stage_next: dict[str, str] = {}  # model -> next stage's peer_id (relay)
         self.stage_bursts: dict[str, dict] = {}  # ring decode accumulators (last stage)
         self.throughput = MetricsAggregator()
+
+        # health plane (health.py): per-peer telemetry digests gossiped on
+        # the ping cadence; SLO burn-rate tracking over the local registry;
+        # the process-global incident flight recorder. ping_interval_s is
+        # an attribute so tests shrink the cadence without monkeypatching.
+        self.ping_interval_s = PING_INTERVAL_S
+        self.health = HealthStore(ttl_s=3 * self.ping_interval_s)
+        self.recorder = get_recorder()
+        # load_slo_config raises on a malformed BEE2BEE_SLO_CONFIG — a
+        # mis-typed SLO must fail the node at construction, not route on
+        # garbage later
+        self.slo = SloTracker(
+            objectives=load_slo_config(), on_trip=self._on_slo_trip
+        )
 
         # piece store: hash -> bytes (optionally spilled to piece_dir)
         self.piece_store: dict[str, bytes] = {}
@@ -328,6 +360,11 @@ class P2PNode(StageTaskMixin):
                 op = "other"
             _C_FRAMES_RECV.inc(op=op)
             _C_BYTES_RECV.inc(_frame_bytes(raw), op=op)
+            if op in _NOTABLE_OPS:  # frame-op events land in the incident ring
+                self.recorder.record(
+                    "frame", op=op, peer=data.get("peer_id"),
+                    error=data.get("error"),
+                )
             try:
                 await self._on_message(ws, data)
             except Exception:
@@ -451,6 +488,7 @@ class P2PNode(StageTaskMixin):
             protocol.PIECE_DATA: self._handle_piece_data,
             protocol.PIECE_HAVE: self._handle_piece_have,
             protocol.GOODBYE: self._handle_goodbye,
+            protocol.TELEMETRY: self._handle_telemetry,
             protocol.TASK: self._handle_task,
             protocol.RESULT: self._handle_result,
             protocol.TASK_ERROR: self._handle_result,
@@ -561,7 +599,67 @@ class P2PNode(StageTaskMixin):
         addr = self._dial_addr_by_ws.get(ws)
         if addr and self._addr_key(addr) not in self._bootstrap_addrs:
             self._mark_departed(addr)
+        # a clean departure also retires the peer's health digest at once;
+        # an UNCLEAN drop keeps it until the staleness TTL, so a flapping
+        # peer's last reading survives the reconnect window
+        pid = await self._peer_for(ws)
+        if pid:
+            self.health.drop(pid)
         await self._drop_peer(ws)
+
+    # ------------------------------------------------------------ health plane
+
+    def telemetry_digest(self) -> dict:
+        """This node's gossip digest: the metrics-registry summary
+        (health.build_digest) plus node-local context the registry can't
+        see — peer RTTs and the latest SLO brief."""
+        digest = build_digest()
+        # sync snapshot of the peer table (same pattern as peer_for_addr):
+        # safe on the loop thread, and list() guards executor callers
+        rtts = {
+            pid: info.get("rtt_ms")
+            for pid, info in list(self.peers.items())
+            if info.get("rtt_ms") is not None
+        }
+        if rtts:
+            digest["peer_rtt_ms"] = rtts
+        slo = self.slo.brief()
+        if slo:
+            digest["slo"] = slo
+        return digest
+
+    async def gossip_telemetry(self) -> int:
+        """Broadcast this node's digest as one TELEMETRY frame; returns the
+        number of peers reached. Rides the ping cadence (_monitor_loop) but
+        is callable directly (tests, smoke gates) for deterministic gossip."""
+        return await self.broadcast(
+            protocol.msg(
+                protocol.TELEMETRY,
+                peer_id=self.peer_id,
+                digest=self.telemetry_digest(),
+            )
+        )
+
+    async def _handle_telemetry(self, ws, data):
+        # identity comes from the CONNECTION (hello handshake), not the
+        # frame's peer_id claim — a peer cannot overwrite another peer's
+        # digest by lying in the payload
+        pid = await self._peer_for(ws)
+        digest = data.get("digest")
+        if pid and isinstance(digest, dict):
+            self.health.update(pid, digest)
+
+    def _on_slo_trip(self, objective, entry: dict) -> None:
+        """SloTracker trip hook: snapshot an incident bundle. The kind is
+        per-objective (bounded by the configured objective list) so one
+        burning objective's cooldown never masks a different one."""
+        self.recorder.incident(
+            "slo:" + objective.name,
+            detail=f"burn rate fast={entry.get('burn_rate_fast')} "
+                   f"slow={entry.get('burn_rate_slow')}",
+            node=self.peer_id,
+            extra=entry,
+        )
 
     def peer_for_addr(self, addr: str) -> str | None:
         """peer_id for a dialed OR announced address (scheme-insensitive).
@@ -738,6 +836,16 @@ class P2PNode(StageTaskMixin):
         return next(iter(svcs), "tpu")
 
     async def _execute_local(self, svc, params, stream, on_chunk) -> dict:
+        # SLO event accounting wraps the whole serve: every locally-served
+        # generation (HTTP, /v1, p2p, relay target) funnels through here
+        _C_GEN_REQUESTS.inc()
+        try:
+            return await self._execute_local_inner(svc, params, stream, on_chunk)
+        except Exception:
+            _C_GEN_ERRORS.inc()
+            raise
+
+    async def _execute_local_inner(self, svc, params, stream, on_chunk) -> dict:
         loop = asyncio.get_running_loop()
         with get_tracer().span(
             "gen.local", service=svc.name, stream=bool(stream or on_chunk)
@@ -863,6 +971,12 @@ class P2PNode(StageTaskMixin):
                     result = await self._execute_local(svc, params, False, None)
                     await self._send(ws, protocol.msg(protocol.GEN_SUCCESS, rid=rid, **result))
             except Exception as e:
+                # a failed generation is a typed incident: snapshot the ring
+                # + this request's trace (we're under use_trace_ctx, so the
+                # recorder picks the trace_id off the contextvar)
+                self.recorder.incident(
+                    "gen_error", detail=str(e), node=self.peer_id
+                )
                 # the peer may be the reason we failed (died mid-stream):
                 # best-effort error reply, no second exception
                 with contextlib.suppress(Exception):
@@ -1028,9 +1142,10 @@ class P2PNode(StageTaskMixin):
     # ------------------------------------------------------------ monitoring
 
     async def _monitor_loop(self):
+        last_counts: dict[str, float] = {}
         while not self._stopped:
             try:
-                await asyncio.sleep(PING_INTERVAL_S)
+                await asyncio.sleep(self.ping_interval_s)
                 async with self._lock:
                     targets = list(self.peers.items())
                 now = time.time()
@@ -1048,12 +1163,42 @@ class P2PNode(StageTaskMixin):
                         await self._drop_peer(info["ws"])
                 async with self._lock:
                     for pid, info in self.peers.items():
-                        if now - info.get("last_seen", now) > 3 * PING_INTERVAL_S:
+                        if now - info.get("last_seen", now) > 3 * self.ping_interval_s:
                             info["health"] = "unreachable"
+                # health plane, on the same cadence: evaluate SLO burn
+                # rates (refreshes the slo.* gauges, fires trip incidents),
+                # gossip the digest, and drop a metric-delta ring event
+                self.slo.evaluate()
+                await self.gossip_telemetry()
+                self._record_metric_deltas(last_counts)
             except asyncio.CancelledError:
                 raise
             except Exception:
                 logger.exception("monitor loop error")
+
+    def _record_metric_deltas(self, last: dict[str, float]) -> None:
+        """One per-tick flight-recorder event with the counter deltas that
+        tell an incident's story ('what changed in the last interval') —
+        never throws, like everything feeding the ring."""
+        try:
+            reg = get_registry()
+            deltas: dict[str, float] = {}
+            for name in (
+                "gen.requests", "gen.errors", "engine.tokens_generated",
+                "mesh.relay_hops", "pipeline.recoveries",
+            ):
+                m = reg.get(name)
+                if m is None:
+                    continue
+                cur = m.total()
+                d = cur - last.get(name, 0.0)
+                last[name] = cur
+                if d:
+                    deltas[name] = d
+            if deltas:
+                self.recorder.record("metrics_delta", deltas=deltas)
+        except Exception:  # noqa: BLE001 — telemetry never throws
+            pass
 
     # ------------------------------------------------------------ status
 

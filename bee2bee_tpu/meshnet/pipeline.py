@@ -35,6 +35,7 @@ from urllib.parse import urlparse
 import numpy as np
 
 from .. import protocol
+from ..health import get_recorder
 from ..metrics import get_registry
 from ..tracing import extract_trace, get_tracer, inject_trace, use_trace_ctx
 from ..utils import new_id
@@ -902,6 +903,23 @@ class PipelineCoordinator:
                 except StageError as e:
                     attempt += 1
                     remaining = deadline - time.time()
+                    # flight-recorder incident BEFORE the terminal check:
+                    # both a failover and a final failure leave a bundle.
+                    # We're inside the pipeline.generate span, so the
+                    # recorder snapshots this generation's stitched trace
+                    # (every stage.task span shares its trace_id).
+                    get_recorder().incident(
+                        "stage_failover",
+                        detail=f"{type(e).__name__}: {e}",
+                        extra={
+                            "attempt": attempt,
+                            "accepted_tokens": len(out),
+                            "model": self.model,
+                            "epoch": attempt_epoch,
+                            "terminal": attempt > self.max_failover_retries
+                            or remaining <= 0,
+                        },
+                    )
                     if attempt > self.max_failover_retries or remaining <= 0:
                         raise
                     logger.warning(

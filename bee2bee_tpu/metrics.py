@@ -98,6 +98,16 @@ class Counter(_Metric):
         with self._lock:
             return float(self._series.get(_labels_key(labels), 0.0))
 
+    def total(self) -> float:
+        """Sum across every labeled series (the digest-friendly scalar)."""
+        with self._lock:
+            return float(sum(self._series.values()))
+
+    def series(self) -> list[tuple[tuple, float]]:
+        """[(labels_key, value)] — labels_key is the sorted (k, v) tuple."""
+        with self._lock:
+            return sorted(self._series.items())
+
     def render(self) -> list[str]:
         # prometheus convention: counters expose as <name>_total
         base = self.prom_name + "_total"
@@ -146,6 +156,11 @@ class Gauge(_Metric):
     def value(self, **labels) -> float:
         with self._lock:
             return float(self._series.get(_labels_key(labels), 0.0))
+
+    def series(self) -> list[tuple[tuple, float]]:
+        """[(labels_key, value)] — labels_key is the sorted (k, v) tuple."""
+        with self._lock:
+            return sorted(self._series.items())
 
     def clear(self, **labels) -> None:
         """Drop a series so the exposition omits it: a gauge whose source
@@ -238,6 +253,31 @@ class Histogram(_Metric):
             s = self._series.get(_labels_key(labels))
             return s.count if s else 0
 
+    def totals(self, **labels) -> tuple[int, float]:
+        """(observation count, value sum) for one series."""
+        with self._lock:
+            s = self._series.get(_labels_key(labels))
+            return (s.count, s.sum) if s else (0, 0.0)
+
+    def count_le(self, v: float, **labels) -> int:
+        """Observations that landed in buckets whose upper bound is <= v
+        (bucket resolution: an off-bound v rounds DOWN to the nearest
+        bound, so the answer never overcounts — what SLO good-event
+        counting needs from a bucketed histogram)."""
+        with self._lock:
+            s = self._series.get(_labels_key(labels))
+            if s is None:
+                return 0
+            cum = 0
+            for i, b in enumerate(self.buckets):
+                if b > v:
+                    break
+                cum += s.counts[i]
+            else:
+                if v == math.inf:
+                    cum += s.counts[-1]
+            return cum
+
     def render(self) -> list[str]:
         base = self.prom_name
         with self._lock:
@@ -307,6 +347,13 @@ class MetricsRegistry:
         self, name: str, help_: str = "", buckets: tuple | None = None
     ) -> Histogram:
         return self._get_or_make(Histogram, name, help_, buckets=buckets)
+
+    def get(self, name: str) -> _Metric | None:
+        """Registered metric by name WITHOUT creating it — readers (the
+        health digest, SLO evaluation) must not materialize series for
+        subsystems this process never imported."""
+        with self._lock:
+            return self._metrics.get(name)
 
     def render(self) -> str:
         """Prometheus text exposition (format 0.0.4) of every metric."""

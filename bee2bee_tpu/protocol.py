@@ -32,6 +32,10 @@ PIECE_REQUEST = "piece_request"
 PIECE_DATA = "piece_data"
 PIECE_HAVE = "piece_have"
 GOODBYE = "goodbye"
+# mesh health plane (health.py): a compact metrics digest gossiped on the
+# ping cadence — NOT in the reference message set, but safe on the wire
+# because the reference ignores unknown message types entirely
+TELEMETRY = "telemetry"
 
 # ---- coordinator/worker task protocol (reference protocol.py:25-53, node.py:89+)
 REGISTER = "register"
@@ -80,6 +84,7 @@ MESSAGE_TYPES = frozenset(
         PIECE_DATA,
         PIECE_HAVE,
         GOODBYE,
+        TELEMETRY,
         REGISTER,
         INFO,
         TASK,

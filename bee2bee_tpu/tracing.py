@@ -152,6 +152,21 @@ class Tracer:
         self._lock = threading.Lock()
         self._epoch = time.time() * 1000.0 - time.monotonic() * 1000.0
         self.counters: dict[str, int] = {}
+        # completion listeners (health.FlightRecorder): called with each
+        # closed Span outside the lock; listener errors are swallowed —
+        # an observability consumer must never fail the traced code path
+        self._listeners: list = []
+
+    def add_listener(self, fn) -> None:
+        """Subscribe to span completions (idempotent per function)."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def remove_listener(self, fn) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
 
     @contextmanager
     def span(self, name: str, **attrs) -> Iterator[Span]:
@@ -181,6 +196,12 @@ class Tracer:
                 _current_trace.reset(trace_token)
             with self._lock:
                 self._spans.append(s)
+                listeners = list(self._listeners)
+            for fn in listeners:
+                try:
+                    fn(s)
+                except Exception:  # noqa: BLE001 — tracing never throws
+                    pass
 
     def count(self, name: str, n: int = 1) -> None:
         with self._lock:
@@ -229,28 +250,51 @@ class Tracer:
             self.counters.clear()
 
 
-def stitch_trace(fragments: list[dict]) -> dict:
+def stitch_trace(
+    fragments: list[dict], expected_nodes: list[str] | None = None
+) -> dict:
     """Merge per-node trace fragments into one cross-node timeline.
 
     `fragments` is a list of ``{"node": <peer_id>, "spans": [span dicts]}``
     (each the payload of one node's ``/trace?trace_id=`` response). Spans
     are annotated with their node, de-duplicated by span_id (fragments may
     overlap when nodes share a process, e.g. loopback tests) and ordered
-    by start_ms — parent links then read as one tree across nodes."""
+    by start_ms — parent links then read as one tree across nodes.
+
+    Degrades gracefully instead of failing the whole stitch: a fragment
+    marked ``{"unreachable": True}`` (the peer never answered) or
+    ``{"partial": True}`` (it answered without a usable span list), and
+    any ``expected_nodes`` entry that contributed no fragment, land in
+    ``missing_peers`` and flip ``incomplete`` — the merged PARTIAL
+    timeline is still returned."""
     seen: dict[str, dict] = {}
+    responded: set = set()
+    missing: set = set()
     for frag in fragments or []:
         node = frag.get("node")
+        if frag.get("unreachable") or frag.get("partial"):
+            if node:
+                missing.add(node)
+            continue
+        if node:
+            responded.add(node)
         for s in frag.get("spans") or []:
             sid = s.get("span_id")
             if sid is None or sid in seen:
                 continue
             seen[sid] = {**s, "node": node}
+    for node in expected_nodes or []:
+        if node not in responded:
+            missing.add(node)
+    missing -= responded  # a duplicate fragment pair: any answer counts
     spans = sorted(seen.values(), key=lambda s: s.get("start_ms") or 0.0)
     trace_ids = {s.get("trace_id") for s in spans if s.get("trace_id")}
     return {
         "trace_id": next(iter(trace_ids)) if len(trace_ids) == 1 else None,
         "nodes": sorted({s["node"] for s in spans if s.get("node")}),
         "spans": spans,
+        "incomplete": bool(missing),
+        "missing_peers": sorted(missing),
     }
 
 

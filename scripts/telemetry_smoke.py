@@ -11,6 +11,15 @@ works end to end:
   least once, node gauges, mesh frame counters registered);
 - ``/metrics?format=json`` returns the JSON snapshot twin.
 
+Then boots a SECOND loopback node, connects the two into a mesh and
+exercises the health plane (ISSUE 6):
+
+- after one telemetry gossip round, ``/mesh/health`` on EITHER node
+  reports both peers' digests (and the Prometheus view carries one
+  ``peer``-labeled series per fresh peer);
+- ``/slo`` parses, with every configured objective present and carrying
+  a burn-rate evaluation.
+
 No model loads, no accelerator touched — this must stay cheap enough to
 run before every boot. Exit 0 on success, 1 with a reason on failure.
 """
@@ -104,9 +113,84 @@ async def run_smoke() -> None:
         await node.stop()
 
 
+async def run_mesh_health_smoke() -> None:
+    """2-node loopback mesh: /mesh/health on either node sees both peers'
+    digests; /slo parses with every configured objective present."""
+    import asyncio as aio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from bee2bee_tpu.api import build_app
+    from bee2bee_tpu.meshnet.node import P2PNode
+    from bee2bee_tpu.services.fake import FakeService
+
+    a = P2PNode(host="127.0.0.1", port=0)
+    b = P2PNode(host="127.0.0.1", port=0)
+    await a.start()
+    await b.start()
+    clients: list = []
+    try:
+        a.add_service(FakeService("smoke-model", reply="mesh health ok"))
+        assert await b.connect_bootstrap(a.addr), "bootstrap connect failed"
+        for _ in range(100):
+            if a.peers and b.peers:
+                break
+            await aio.sleep(0.05)
+        assert a.peers and b.peers, "hello handshake never settled"
+
+        # a generation seeds a's digest with real series, then one
+        # explicit gossip round (deterministic — no 15 s ping wait)
+        await b.request_generation(a.peer_id, "smoke", model="smoke-model")
+        await a.gossip_telemetry()
+        await b.gossip_telemetry()
+        for _ in range(100):
+            if a.health.fresh() and b.health.fresh():
+                break
+            await aio.sleep(0.05)
+
+        for node, other in ((a, b), (b, a)):
+            client = TestClient(TestServer(build_app(node)))
+            clients.append(client)
+            await client.start_server()
+
+            r = await client.get("/mesh/health")
+            assert r.status == 200, f"/mesh/health returned {r.status}"
+            view = await r.json()
+            for pid in (a.peer_id, b.peer_id):
+                assert pid in view["peers"], (
+                    f"{node.peer_id}'s /mesh/health is missing digest "
+                    f"for {pid} (has {sorted(view['peers'])})"
+                )
+            assert view["aggregate"]["nodes"] == 2
+            # the peer-labeled Prometheus twin
+            r = await client.get("/mesh/health", params={"format": "prom"})
+            text = await r.text()
+            parse_prometheus(text)
+            assert f'peer="{other.peer_id}"' in text, (
+                "peer-labeled series missing from /mesh/health prom view"
+            )
+
+            r = await client.get("/slo")
+            assert r.status == 200, f"/slo returned {r.status}"
+            slo = await r.json()
+            got = {o["name"] for o in slo["objectives"]}
+            want = {o.name for o in node.slo.objectives}
+            assert got == want, f"/slo objectives {got} != configured {want}"
+            for o in slo["objectives"]:
+                assert "burn_rate_fast" in o and "status" in o, (
+                    f"objective {o.get('name')} missing burn-rate fields"
+                )
+    finally:
+        for client in clients:
+            await client.close()
+        await b.stop()
+        await a.stop()
+
+
 def main() -> int:
     try:
         asyncio.run(run_smoke())
+        asyncio.run(run_mesh_health_smoke())
     except AssertionError as e:
         print(f"[telemetry-smoke] FAIL: {e}", file=sys.stderr)
         return 1

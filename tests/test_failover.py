@@ -328,3 +328,64 @@ async def test_repeated_failover_rounds_two_spares():
         assert coordinator.epoch >= 2
         dead = {workers[1].peer_id, first_spare_chaos[0].node.peer_id}
         assert not dead & set(coordinator.stage_peers)
+
+
+# ------------------------------------------------------- incident recorder
+
+
+async def test_chaos_failover_records_incident_bundle(tmp_path):
+    """ISSUE 6 acceptance: a ChaosStage-induced failover snapshots a
+    stage_failover incident bundle to disk, containing the stitched trace
+    of the failed generation (stage.task spans of the originating
+    request) — retrievable through GET /debug/incidents."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from bee2bee_tpu.api import build_app
+    from bee2bee_tpu.health import get_recorder
+    from bee2bee_tpu.tracing import get_tracer
+
+    rec = get_recorder()
+    rec.incident_dir = tmp_path
+    rec.clear()
+    get_tracer().clear()
+    async with failover_mesh(n_spares=1) as (workers, spares, coord, coordinator):
+        tok = _tok()
+        chaos = ChaosStage(workers[1], action="kill", at_step=3)
+        out = await coordinator.generate(
+            tok.encode("incident bundle"), max_new_tokens=8, temperature=0.0
+        )
+        assert chaos.triggered.is_set(), "fault never fired"
+        assert tok.decode(out) == _expected_text("incident bundle", 8)
+
+        rec.flush()  # bundle writes land on a writer thread
+        incs = rec.list_incidents()
+        inc = next((i for i in incs if i["kind"] == "stage_failover"), None)
+        assert inc is not None, f"no stage_failover incident in {incs}"
+        bundle = rec.load_incident(inc["id"])
+        assert "StageDead" in bundle["detail"]
+        assert bundle["extra"]["attempt"] == 1
+        assert bundle["extra"]["terminal"] is False
+        assert bundle["extra"]["model"] == MODEL
+        # the stitched trace of the FAILED generation: the bundle's
+        # trace_id is the pipeline.generate trace, and the completed
+        # stage.task spans of that request ride along
+        assert bundle["trace_id"], "incident lost the generation's trace id"
+        span_names = [s["name"] for s in bundle["trace"]["spans"]]
+        assert "stage.task" in span_names, (
+            f"stitched trace missing stage spans: {span_names}"
+        )
+        # the ring captured the span completions leading up to the fault
+        assert any(e["kind"] == "span" for e in bundle["events"])
+
+        # retrievable through the coordinator node's debug surface
+        client = TestClient(TestServer(build_app(coord)))
+        await client.start_server()
+        try:
+            listing = await (await client.get("/debug/incidents")).json()
+            assert any(i["id"] == inc["id"] for i in listing["incidents"])
+            served = await (
+                await client.get("/debug/incidents", params={"id": inc["id"]})
+            ).json()
+            assert served["kind"] == "stage_failover"
+        finally:
+            await client.close()

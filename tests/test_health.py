@@ -1,0 +1,604 @@
+"""Mesh health plane tests (ISSUE 6): telemetry gossip digests +
+HealthStore staleness, SLO multi-window burn-rate tracking, the incident
+flight recorder, and the /mesh/health + /slo + /debug/incidents routes —
+including the 3-node loopback acceptance walk."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from bee2bee_tpu.health import (
+    FlightRecorder,
+    HealthStore,
+    SloTracker,
+    build_digest,
+    fleet_view,
+    load_slo_config,
+    parse_slo_config,
+    render_fleet_prom,
+)
+from bee2bee_tpu.metrics import MetricsRegistry, get_registry
+from bee2bee_tpu.tracing import get_tracer
+
+# ------------------------------------------------------------ digest units
+
+
+def test_build_digest_summarizes_known_metrics_only():
+    reg = MetricsRegistry()
+    h = reg.histogram("engine.ttft_ms")
+    for v in (10.0, 20.0, 4000.0):
+        h.observe(v)
+    reg.gauge("engine.batch_fill").set(0.5)
+    reg.counter("engine.tokens_generated").inc(128)
+    reg.counter("engine.spec_drafted").inc(10)
+    reg.counter("engine.spec_accepted").inc(8)
+    reg.counter("some.unrelated_metric").inc(99)  # not in the allowlist
+
+    d = build_digest(reg)
+    assert d["v"] == 1 and d["ts"] > 0
+    ttft = d["hist"]["engine.ttft_ms"]
+    assert ttft["count"] == 3 and ttft["sum"] == pytest.approx(4030.0)
+    assert ttft["p95"] >= 4000.0
+    assert d["gauge"]["engine.batch_fill"] == 0.5
+    assert d["counter"]["engine.tokens_generated"] == 128
+    assert d["spec_acceptance"] == pytest.approx(0.8)
+    # the digest is an allowlist, not a registry dump
+    flat = json.dumps(d)
+    assert "some.unrelated_metric" not in flat
+
+
+def test_build_digest_omits_absent_subsystems():
+    """A client-only node (no engine imported) gossips a digest without
+    engine keys — absent means 'doesn't run that subsystem', not zero."""
+    reg = MetricsRegistry()
+    reg.counter("gen.requests").inc(2)
+    d = build_digest(reg)
+    assert "hist" not in d and "gauge" not in d
+    assert d["counter"] == {"gen.requests": 2.0}
+    assert "spec_acceptance" not in d
+
+
+def test_stage_task_counter_breakdown_rides_digest():
+    reg = MetricsRegistry()
+    c = reg.counter("pipeline.stage_tasks")
+    c.inc(3, kind="part_forward")
+    c.inc(1, kind="decode_run")
+    d = build_digest(reg)
+    assert d["stage_tasks"] == {"part_forward": 3.0, "decode_run": 1.0}
+
+
+def test_histogram_count_le_rounds_down_off_bound():
+    reg = MetricsRegistry()
+    h = reg.histogram("t.lat", buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.5, 3.0, 9.0):
+        h.observe(v)
+    assert h.count_le(2.0) == 2
+    # off-bound threshold rounds DOWN (never overcounts good events)
+    assert h.count_le(3.0) == 2
+    assert h.count_le(4.0) == 3
+    assert h.count_le(float("inf")) == 4
+
+
+# ------------------------------------------------- health store staleness
+
+
+def test_health_store_staleness_excludes_from_fresh_and_aggregates():
+    store = HealthStore(ttl_s=0.05)
+    store.update("peer-live", {"counter": {"engine.tokens_generated": 10}})
+    store.update("peer-gone", {"counter": {"engine.tokens_generated": 90}})
+    assert set(store.fresh()) == {"peer-live", "peer-gone"}
+
+    time.sleep(0.06)  # both age past the TTL
+    store.update("peer-live", {"counter": {"engine.tokens_generated": 11}})
+    assert set(store.fresh()) == {"peer-live"}
+    assert store.stale_peers() == ["peer-gone"]
+    # the debug view keeps the stale digest, marked
+    allv = store.all()
+    assert allv["peer-gone"]["stale"] is True
+    assert allv["peer-live"]["stale"] is False
+
+    view = fleet_view("me", {"counter": {"engine.tokens_generated": 5}}, store)
+    assert set(view["peers"]) == {"me", "peer-live"}
+    assert view["stale_peers"] == ["peer-gone"]
+    # aggregates exclude the stale peer's 90 tokens
+    assert view["aggregate"]["tokens_generated_total"] == 16.0
+    assert view["aggregate"]["nodes"] == 2
+
+
+def test_stale_peer_series_drop_out_of_prom_exposition():
+    """The empty-gauge contract at fleet level: a peer that stopped
+    gossiping must have NO series, not a frozen last reading."""
+    store = HealthStore(ttl_s=0.05)
+    store.update("peer-gone", {"gauge": {"engine.batch_fill": 0.9}})
+    view = fleet_view("me", {}, store)
+    text = render_fleet_prom(view)
+    assert 'peer="peer-gone"' in text
+
+    time.sleep(0.06)
+    view = fleet_view("me", {}, store)
+    text = render_fleet_prom(view)
+    assert 'peer="peer-gone"' not in text
+    assert 'peer="me"' in text  # the local node always has its up series
+
+
+# ------------------------------------------------------------- SLO config
+
+
+def test_parse_slo_config_validates_loudly():
+    ok = parse_slo_config([
+        {"name": "t", "kind": "latency", "metric": "engine.ttft_ms",
+         "threshold_ms": 2048, "target": 0.95},
+        {"name": "e", "kind": "error_rate", "errors_metric": "gen.errors",
+         "total_metric": "gen.requests", "target": 0.99},
+    ])
+    assert [o.name for o in ok] == ["t", "e"]
+    assert ok[0].budget == pytest.approx(0.05)
+    with pytest.raises(ValueError, match="needs a name"):
+        parse_slo_config([{"kind": "latency"}])
+    with pytest.raises(ValueError, match="target"):
+        parse_slo_config([{"name": "x", "kind": "latency",
+                           "metric": "m", "threshold_ms": 1, "target": 1.5}])
+    with pytest.raises(ValueError, match="threshold_ms"):
+        parse_slo_config([{"name": "x", "kind": "latency", "target": 0.9}])
+    with pytest.raises(ValueError, match="errors_metric"):
+        parse_slo_config([{"name": "x", "kind": "error_rate", "target": 0.9}])
+    with pytest.raises(ValueError, match="unknown kind"):
+        parse_slo_config([{"name": "x", "kind": "availability", "target": 0.9}])
+    # duplicate names would share one snapshot deque in SloTracker and
+    # interleave unrelated cumulative counts — refuse at parse time
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_slo_config([
+            {"name": "t", "kind": "latency", "metric": "engine.ttft_ms",
+             "threshold_ms": 2048, "target": 0.95},
+            {"name": "t", "kind": "latency", "metric": "engine.queue_wait_ms",
+             "threshold_ms": 1024, "target": 0.9},
+        ])
+
+
+def test_load_slo_config_env_inline_and_default(monkeypatch):
+    monkeypatch.delenv("BEE2BEE_SLO_CONFIG", raising=False)
+    defaults = load_slo_config()
+    assert {o.name for o in defaults} == {
+        "ttft_p95", "queue_wait_p99", "gen_error_rate"
+    }
+    inline = json.dumps([
+        {"name": "only", "kind": "latency", "metric": "engine.ttft_ms",
+         "threshold_ms": 1024, "target": 0.9}
+    ])
+    monkeypatch.setenv("BEE2BEE_SLO_CONFIG", inline)
+    assert [o.name for o in load_slo_config()] == ["only"]
+
+
+# --------------------------------------------------- SLO burn-rate windows
+
+
+def _slow_ttft_objective():
+    return parse_slo_config([
+        {"name": "ttft_p95", "kind": "latency", "metric": "engine.ttft_ms",
+         "threshold_ms": 2048, "target": 0.95},
+    ])
+
+
+def test_slo_burn_rate_multi_window_and_trip_cooldown():
+    reg = MetricsRegistry()
+    h = reg.histogram("engine.ttft_ms")
+    trips: list = []
+    tracker = SloTracker(
+        objectives=_slow_ttft_objective(), registry=reg,
+        fast_window_s=10.0, slow_window_s=100.0,
+        trip_burn_rate=6.0, trip_cooldown_s=50.0,
+        on_trip=lambda o, entry: trips.append((o.name, entry["status"])),
+    )
+    t0 = 1000.0
+    # baseline: healthy traffic
+    for _ in range(20):
+        h.observe(100.0)
+    out = tracker.evaluate(now=t0)
+    assert out[0]["status"] == "ok"  # single snapshot: no window delta yet
+
+    # every request over the next tick blows the threshold
+    for _ in range(10):
+        h.observe(5000.0)
+    out = tracker.evaluate(now=t0 + 5.0)
+    entry = out[0]
+    # fast window: 10 bad / 10 total over the delta -> burn = 1.0 / 0.05
+    assert entry["windows"]["fast"]["bad"] == 10.0
+    assert entry["windows"]["fast"]["bad_fraction"] == pytest.approx(1.0)
+    assert entry["burn_rate_fast"] == pytest.approx(20.0)
+    assert entry["status"] == "tripped"  # both windows burn >= 6
+    assert trips == [("ttft_p95", "tripped")]
+
+    # still burning inside the cooldown: no second trip
+    for _ in range(5):
+        h.observe(5000.0)
+    tracker.evaluate(now=t0 + 10.0)
+    assert len(trips) == 1
+    # past the cooldown, still burning: trips again
+    for _ in range(5):
+        h.observe(5000.0)
+    tracker.evaluate(now=t0 + 60.0)
+    assert len(trips) == 2
+
+    # the bee2bee_slo_* gauges reflect the latest evaluation
+    g = get_registry().gauge("slo.burn_rate")
+    assert g.value(objective="ttft_p95", window="fast") >= 6.0
+    assert get_registry().gauge("slo.status").value(objective="ttft_p95") == 2
+
+
+def test_slo_recovery_returns_to_ok():
+    reg = MetricsRegistry()
+    h = reg.histogram("engine.ttft_ms")
+    tracker = SloTracker(
+        objectives=_slow_ttft_objective(), registry=reg,
+        fast_window_s=10.0, slow_window_s=100.0,
+    )
+    t0 = 2000.0
+    tracker.evaluate(now=t0)
+    for _ in range(10):
+        h.observe(5000.0)
+    assert tracker.evaluate(now=t0 + 5.0)[0]["status"] == "tripped"
+    # fast window slides past the bad burst; fresh traffic is healthy
+    for _ in range(50):
+        h.observe(50.0)
+    out = tracker.evaluate(now=t0 + 20.0)
+    assert out[0]["windows"]["fast"]["bad"] == 0.0
+    assert out[0]["status"] == "ok"
+    # the slow window still remembers the burst
+    assert out[0]["windows"]["slow"]["bad"] == 10.0
+
+
+def test_slo_error_rate_objective_counts_counters():
+    reg = MetricsRegistry()
+    req, err = reg.counter("gen.requests"), reg.counter("gen.errors")
+    tracker = SloTracker(
+        objectives=parse_slo_config([
+            {"name": "err", "kind": "error_rate", "errors_metric": "gen.errors",
+             "total_metric": "gen.requests", "target": 0.99},
+        ]),
+        registry=reg, fast_window_s=10.0, slow_window_s=100.0,
+    )
+    t0 = 3000.0
+    tracker.evaluate(now=t0)
+    req.inc(100)
+    err.inc(50)
+    entry = tracker.evaluate(now=t0 + 5.0)[0]
+    assert entry["windows"]["fast"]["bad_fraction"] == pytest.approx(0.5)
+    assert entry["burn_rate_fast"] == pytest.approx(50.0)
+    assert entry["status"] == "tripped"
+
+
+def test_slo_counts_clamp_racy_negative_bad():
+    """totals() and count_le() lock separately: an observe landing
+    between the two reads can make good > count for one tick — the
+    cumulative bad count clamps at 0 instead of going negative."""
+    reg = MetricsRegistry()
+    h = reg.histogram("engine.ttft_ms", "t")
+    h.observe(100.0)
+    tracker = SloTracker(objectives=_slow_ttft_objective(), registry=reg)
+    real_totals = h.totals
+
+    def racy_totals(**labels):
+        count, total = real_totals(**labels)
+        return count - 1, total  # count read before a concurrent observe
+
+    h.totals = racy_totals
+    bad, tot = tracker._counts(tracker.objectives[0])
+    assert bad == 0.0 and tot >= 0.0
+
+
+def test_slo_evaluate_never_throws(monkeypatch):
+    tracker = SloTracker(objectives=_slow_ttft_objective())
+    monkeypatch.setattr(
+        tracker, "_counts", lambda o: (_ for _ in ()).throw(RuntimeError("boom"))
+    )
+    assert tracker.evaluate() == []  # falls back to last (empty) eval
+
+
+# ------------------------------------------------------- flight recorder
+
+
+def test_recorder_ring_is_bounded_and_never_throws():
+    rec = FlightRecorder(capacity=4)
+    for i in range(10):
+        rec.record("span", i=i)
+    evs = rec.events()
+    assert len(evs) == 4
+    assert [e["i"] for e in evs] == [6, 7, 8, 9]
+    rec.record("weird", obj=object())  # non-JSON field: ring still fine
+    assert len(rec.events()) == 4
+
+
+def test_incident_bundle_snapshot_and_listing(tmp_path):
+    rec = FlightRecorder(incident_dir=tmp_path, cooldown_s=0.0)
+    tr = get_tracer()
+    with tr.span("inc.root") as root:
+        with tr.span("inc.step"):
+            pass
+        rec.record("frame", op="gen_error")
+        inc_id = rec.incident("gen_error", detail="boom", node="node-x")
+    assert inc_id is not None
+    rec.flush()  # the disk half runs on a writer thread
+    bundle = rec.load_incident(inc_id)
+    assert bundle["kind"] == "gen_error" and bundle["detail"] == "boom"
+    assert bundle["node"] == "node-x"
+    # the trace_id was picked off the open span's contextvar, and the
+    # stitched trace carries the COMPLETED spans of that request
+    assert bundle["trace_id"] == root.trace_id
+    names = [s["name"] for s in bundle["trace"]["spans"]]
+    assert "inc.step" in names
+    assert any(e["kind"] == "frame" for e in bundle["events"])
+    assert "metrics" in bundle
+
+    listing = rec.list_incidents()
+    assert listing[0]["id"] == inc_id
+    assert rec.load_incident("inc-nonexistent") is None
+
+
+def test_incident_cooldown_and_prune(tmp_path):
+    rec = FlightRecorder(incident_dir=tmp_path, max_incidents=2, cooldown_s=30.0)
+    first = rec.incident("pool_exhausted", detail="one")
+    assert first is not None
+    # same kind inside the cooldown: suppressed
+    assert rec.incident("pool_exhausted", detail="two") is None
+    # a DIFFERENT kind is not suppressed
+    assert rec.incident("gen_error", detail="three") is not None
+    rec.cooldown_s = 0.0
+    ids = [rec.incident("gen_error", detail=str(i)) for i in range(3)]
+    assert all(ids)
+    rec.flush()
+    files = list(tmp_path.glob("inc-*.json"))
+    assert len(files) == 2  # pruned oldest-first to max_incidents
+
+
+def test_incident_write_failure_is_swallowed(tmp_path):
+    """A failed disk write costs the bundle, never raises: the snapshot
+    is accepted (id returned), the writer thread swallows the OSError,
+    and the listing simply has nothing."""
+    target = tmp_path / "not_a_dir"
+    target.write_text("file, not a directory")
+    rec = FlightRecorder(incident_dir=target, cooldown_s=0.0)
+    assert rec.incident("gen_error", detail="disk says no") is not None
+    rec.flush()
+    assert rec.list_incidents() == []
+
+
+# ------------------------------------------------------------ node + routes
+
+
+async def _health_app(node):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from bee2bee_tpu.api import build_app
+
+    client = TestClient(TestServer(build_app(node)))
+    await client.start_server()
+    return client
+
+
+async def test_three_node_mesh_health_via_monitor_loop():
+    """The acceptance walk: three live nodes gossiping on a (shrunk) ping
+    cadence — /mesh/health on ANY node reports digests for all three."""
+    from bee2bee_tpu.meshnet.node import P2PNode
+    from tests.test_meshnet import _settle
+
+    nodes = [P2PNode(host="127.0.0.1", port=0) for _ in range(3)]
+    for n in nodes:
+        n.ping_interval_s = 0.05  # gossip rides the ping cadence
+        await n.start()
+    clients = []
+    try:
+        a, b, c = nodes
+        # b and c bootstrap off a; peer_list gossip meshes b <-> c
+        assert await b.connect_bootstrap(a.addr)
+        assert await c.connect_bootstrap(a.addr)
+        assert await _settle(lambda: all(len(n.peers) == 2 for n in nodes))
+        assert await _settle(
+            lambda: all(len(n.health.fresh()) == 2 for n in nodes)
+        ), "telemetry digests never gossiped to every node"
+
+        all_ids = {n.peer_id for n in nodes}
+        for n in nodes:
+            client = await _health_app(n)
+            clients.append(client)
+            r = await client.get("/mesh/health")
+            assert r.status == 200
+            view = await r.json()
+            assert set(view["peers"]) == all_ids, (
+                f"{n.peer_id} fleet view missing peers: {view['peers']}"
+            )
+            assert view["aggregate"]["nodes"] == 3
+            assert view["stale_peers"] == []
+            # every peer digest carries an age stamp
+            for pid, d in view["peers"].items():
+                assert "age_s" in d
+            # Prometheus twin: one peer-labeled up series per node
+            r = await client.get("/mesh/health", params={"format": "prom"})
+            text = await r.text()
+            for pid in all_ids:
+                assert f'bee2bee_mesh_peer_up{{peer="{pid}"}} 1' in text
+    finally:
+        for client in clients:
+            await client.close()
+        for n in nodes:
+            await n.stop()
+
+
+async def test_stale_peer_drops_out_of_mesh_health_route():
+    """Satellite: a peer that stops gossiping goes stale after the TTL and
+    is excluded from /mesh/health aggregates; its peer-labeled series
+    drop out of the prom view instead of freezing."""
+    from bee2bee_tpu.meshnet.node import P2PNode
+    from tests.test_meshnet import _settle
+
+    a = P2PNode(host="127.0.0.1", port=0)
+    b = P2PNode(host="127.0.0.1", port=0)
+    await a.start()
+    await b.start()
+    client = None
+    try:
+        assert await b.connect_bootstrap(a.addr)
+        assert await _settle(lambda: a.peers and b.peers)
+        await b.gossip_telemetry()  # deterministic single gossip round
+        assert await _settle(lambda: b.peer_id in a.health.fresh())
+
+        client = await _health_app(a)
+        view = await (await client.get("/mesh/health")).json()
+        assert b.peer_id in view["peers"]
+
+        a.health.ttl_s = 0.05  # b now "stops gossiping" past the TTL
+        await asyncio.sleep(0.06)
+        r = await client.get("/mesh/health")
+        view = await r.json()
+        assert b.peer_id not in view["peers"]
+        assert view["stale_peers"] == [b.peer_id]
+        assert view["aggregate"]["nodes"] == 1
+        text = await (
+            await client.get("/mesh/health", params={"format": "prom"})
+        ).text()
+        assert f'peer="{b.peer_id}"' not in text
+    finally:
+        if client is not None:
+            await client.close()
+        await b.stop()
+        await a.stop()
+
+
+async def test_slow_generation_flips_slo_burn_gauge_via_route():
+    """Acceptance: injected slow generations (TTFT observations far over
+    the 2048 ms objective threshold) flip the ttft_p95 burn-rate gauge,
+    visible on /slo and as bee2bee_slo_* gauges on /metrics."""
+    from bee2bee_tpu.meshnet.node import P2PNode
+
+    node = P2PNode(host="127.0.0.1", port=0)
+    await node.start()
+    client = None
+    try:
+        client = await _health_app(node)
+        node.slo.evaluate()  # baseline snapshot
+        h = get_registry().histogram("engine.ttft_ms")
+        for _ in range(10):
+            h.observe(30_000.0)  # the injected slow generations
+        r = await client.get("/slo")
+        assert r.status == 200
+        body = await r.json()
+        assert body["node"] == node.peer_id
+        ttft = next(o for o in body["objectives"] if o["name"] == "ttft_p95")
+        assert ttft["burn_rate_fast"] >= 1.0
+        assert ttft["status"] in ("burning", "tripped")
+        assert (
+            get_registry().gauge("slo.burn_rate").value(
+                objective="ttft_p95", window="fast"
+            ) >= 1.0
+        )
+        # and the gauges ride the ordinary /metrics exposition
+        text = await (await client.get("/metrics")).text()
+        assert "bee2bee_slo_burn_rate" in text
+    finally:
+        if client is not None:
+            await client.close()
+        await node.stop()
+
+
+async def test_gen_error_incident_recorded_and_served(tmp_path):
+    """A p2p generation failing on the serving node snapshots a gen_error
+    incident whose bundle is fetchable through /debug/incidents."""
+    from bee2bee_tpu.health import get_recorder
+    from bee2bee_tpu.meshnet.node import P2PNode
+    from bee2bee_tpu.services.fake import FakeService
+    from tests.test_meshnet import _settle
+
+    rec = get_recorder()
+    rec.incident_dir = tmp_path
+    rec.clear()
+    a = P2PNode(host="127.0.0.1", port=0)
+    b = P2PNode(host="127.0.0.1", port=0)
+    await a.start()
+    await b.start()
+    client = None
+    try:
+        a.add_service(FakeService("err-model", fail_with="backend on fire"))
+        assert await b.connect_bootstrap(a.addr)
+        assert await _settle(lambda: b.providers)
+        with pytest.raises(RuntimeError, match="backend on fire"):
+            await b.request_generation(
+                a.peer_id, "boom", model="err-model", timeout=10.0
+            )
+        assert await _settle(
+            lambda: any(
+                i["kind"] == "gen_error" for i in rec.list_incidents()
+            ),
+            timeout=5.0,
+        ), "gen_error incident never recorded"
+        inc = next(
+            i for i in rec.list_incidents() if i["kind"] == "gen_error"
+        )
+        assert inc["node"] == a.peer_id
+        client = await _health_app(a)
+        listing = await (await client.get("/debug/incidents")).json()
+        assert any(i["id"] == inc["id"] for i in listing["incidents"])
+        bundle = await (
+            await client.get("/debug/incidents", params={"id": inc["id"]})
+        ).json()
+        assert bundle["kind"] == "gen_error"
+        assert "backend on fire" in bundle["detail"]
+        r = await client.get("/debug/incidents", params={"id": "inc-nope"})
+        assert r.status == 404
+    finally:
+        if client is not None:
+            await client.close()
+        await b.stop()
+        await a.stop()
+
+
+async def test_gen_error_counter_feeds_slo_objective():
+    """gen.requests / gen.errors count at _execute_local — the event
+    stream the gen_error_rate objective burns against."""
+    from bee2bee_tpu.meshnet.node import P2PNode
+    from bee2bee_tpu.services.fake import FakeService
+
+    reg = get_registry()
+    req0 = reg.counter("gen.requests").total()
+    err0 = reg.counter("gen.errors").total()
+    node = P2PNode(host="127.0.0.1", port=0)
+    await node.start()
+    try:
+        node.add_service(FakeService("ok-model", reply="fine"))
+        await node.request_generation(node.peer_id, "x", model="ok-model")
+        # swap in a failing backend (FakeServices share the "fake" name)
+        node.add_service(FakeService("bad-model", fail_with="nope"))
+        with pytest.raises(Exception):
+            await node.request_generation(node.peer_id, "x", model="bad-model")
+    finally:
+        await node.stop()
+    assert reg.counter("gen.requests").total() == req0 + 2
+    assert reg.counter("gen.errors").total() == err0 + 1
+
+
+async def test_telemetry_digest_carries_peer_rtts_and_slo_brief():
+    from bee2bee_tpu.meshnet.node import P2PNode
+    from tests.test_meshnet import _settle
+
+    a = P2PNode(host="127.0.0.1", port=0)
+    b = P2PNode(host="127.0.0.1", port=0)
+    a.ping_interval_s = b.ping_interval_s = 0.05
+    await a.start()
+    await b.start()
+    try:
+        assert await b.connect_bootstrap(a.addr)
+        # an RTT needs a ping/pong round trip off the monitor loop
+        assert await _settle(
+            lambda: a.peers and list(a.peers.values())[0].get("rtt_ms") is not None
+        )
+        a.slo.evaluate()
+        d = a.telemetry_digest()
+        assert b.peer_id in d["peer_rtt_ms"]
+        assert set(d["slo"]) == {o.name for o in a.slo.objectives}
+        for brief in d["slo"].values():
+            assert {"status", "burn_fast", "burn_slow"} <= set(brief)
+    finally:
+        await b.stop()
+        await a.stop()

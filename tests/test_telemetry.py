@@ -236,21 +236,135 @@ def test_use_trace_ctx_parents_remote_spans():
         assert current_trace_ctx() is None
 
 
-def test_stitch_trace_merges_fragments():
-    mk = lambda sid, parent, start, node: {
+def _mk_frag(sid, parent, start, node):
+    return {
         "node": node,
         "spans": [{"span_id": sid, "parent_id": parent, "trace_id": "T",
                    "start_ms": start, "name": f"s.{sid}"}],
     }
+
+
+def test_stitch_trace_merges_fragments():
     stitched = stitch_trace([
-        mk("b", "a", 2.0, "node2"),
-        mk("a", None, 1.0, "node1"),
-        mk("b", "a", 2.0, "node3"),  # duplicate span_id: dropped
+        _mk_frag("b", "a", 2.0, "node2"),
+        _mk_frag("a", None, 1.0, "node1"),
+        _mk_frag("b", "a", 2.0, "node3"),  # duplicate span_id: dropped
     ])
     assert stitched["trace_id"] == "T"
     assert stitched["nodes"] == ["node1", "node2"]
     assert [s["span_id"] for s in stitched["spans"]] == ["a", "b"]
     assert stitched["spans"][0]["node"] == "node1"
+    # every fragment answered: the stitch is complete
+    assert stitched["incomplete"] is False
+    assert stitched["missing_peers"] == []
+
+
+def test_stitch_trace_degrades_on_unreachable_and_partial_fragments():
+    """ISSUE 6 satellite: an unreachable peer or a partial fragment no
+    longer fails the stitch — the merged PARTIAL timeline returns with
+    incomplete=true and the offenders in missing_peers."""
+    stitched = stitch_trace([
+        _mk_frag("a", None, 1.0, "node1"),
+        {"node": "node2", "unreachable": True},
+        {"node": "node3", "partial": True},
+    ])
+    assert [s["span_id"] for s in stitched["spans"]] == ["a"]
+    assert stitched["incomplete"] is True
+    assert stitched["missing_peers"] == ["node2", "node3"]
+    # expected_nodes that contributed nothing also count as missing
+    stitched = stitch_trace(
+        [_mk_frag("a", None, 1.0, "node1")],
+        expected_nodes=["node1", "node4"],
+    )
+    assert stitched["missing_peers"] == ["node4"]
+    assert stitched["incomplete"] is True
+    # a peer that both failed once and answered once (duplicate fragment
+    # pair) counts as answered
+    stitched = stitch_trace([
+        {"node": "node1", "unreachable": True},
+        _mk_frag("a", None, 1.0, "node1"),
+    ])
+    assert stitched["incomplete"] is False
+    assert stitched["missing_peers"] == []
+
+
+async def test_stitch_route_reports_unreachable_peer_as_missing():
+    """/trace?stitch=1 marks a peer whose api endpoint cannot be reached
+    as a missing peer instead of silently shrinking the timeline."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from bee2bee_tpu.api import build_app
+    from bee2bee_tpu.meshnet.node import P2PNode
+    from bee2bee_tpu.services.fake import FakeService
+    from tests.test_meshnet import _settle
+
+    get_tracer().clear()
+    a = P2PNode(host="127.0.0.1", port=0)
+    # b advertises an api port nothing listens on (9: discard/closed)
+    b = P2PNode(host="127.0.0.1", port=0, api_port=9, announce_host="127.0.0.1")
+    await a.start()
+    await b.start()
+    client = None
+    try:
+        a.add_service(FakeService("tiny", reply="stitch me"))
+        assert await b.connect_bootstrap(a.addr)
+        assert await _settle(lambda: a.peers and b.peers)
+        await a.request_generation(a.peer_id, "x", model="tiny")
+        tid = get_tracer().recent(name="gen.local")[-1]["trace_id"]
+        client = TestClient(TestServer(build_app(a)))
+        await client.start_server()
+        r = await client.get(
+            "/trace", params={"trace_id": tid, "stitch": "1"}
+        )
+        stitched = await r.json()
+        assert any(s["name"] == "gen.local" for s in stitched["spans"])
+        assert stitched["incomplete"] is True
+        assert b.peer_id in stitched["missing_peers"]
+    finally:
+        if client is not None:
+            await client.close()
+        await b.stop()
+        await a.stop()
+
+
+async def test_stitch_route_reports_endpointless_peer_as_missing():
+    """A peer that advertises NO api endpoint can't be asked for its
+    fragment at all — it must land in missing_peers, not be silently
+    skipped with the stitch still claiming complete."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from bee2bee_tpu.api import build_app
+    from bee2bee_tpu.meshnet.node import P2PNode
+    from bee2bee_tpu.services.fake import FakeService
+    from tests.test_meshnet import _settle
+
+    get_tracer().clear()
+    a = P2PNode(host="127.0.0.1", port=0)
+    b = P2PNode(host="127.0.0.1", port=0)  # api_port defaults to None
+    await a.start()
+    await b.start()
+    client = None
+    try:
+        a.add_service(FakeService("tiny", reply="stitch me"))
+        assert await b.connect_bootstrap(a.addr)
+        assert await _settle(lambda: a.peers and b.peers)
+        assert all(
+            not info.get("api_port") for info in a.peers.values()
+        ), "test premise: b advertises no api endpoint"
+        await a.request_generation(a.peer_id, "x", model="tiny")
+        tid = get_tracer().recent(name="gen.local")[-1]["trace_id"]
+        client = TestClient(TestServer(build_app(a)))
+        await client.start_server()
+        stitched = await (await client.get(
+            "/trace", params={"trace_id": tid, "stitch": "1"}
+        )).json()
+        assert stitched["incomplete"] is True
+        assert b.peer_id in stitched["missing_peers"]
+    finally:
+        if client is not None:
+            await client.close()
+        await b.stop()
+        await a.stop()
 
 
 # ------------------------------------------------------------- route tests
