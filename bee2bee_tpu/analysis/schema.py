@@ -31,6 +31,15 @@ ID_KEYS = frozenset({"rid", "task_id"})
 # checks know the key (protocol.TRACE_CTX holds the wire name)
 TRACE_KEYS = frozenset({P.TRACE_CTX})
 
+# per-tenant identity (router/tenants.py): rides GEN_REQUEST api→node→relay
+# so admission fairness bills the same tenant at every hop
+TENANT_KEYS = frozenset({P.TENANT})
+
+# typed admission rejections (router/admission.py): every 429/503 shed —
+# HTTP response AND p2p GEN_ERROR frame alike — carries the rejection kind
+# and the Retry-After hint, so callers can back off instead of hammering
+ADMISSION_KEYS = frozenset({"error_kind", "retry_after_s"})
+
 # the service result dict (services/base.py result_dict + streaming done
 # line) rides gen_success / gen_result via `**result`
 RESULT_FIELDS = frozenset(
@@ -116,13 +125,26 @@ FRAME_SCHEMAS: dict[str, FrameSchema] = {
             optional=frozenset(
                 {"model", "svc", "max_new_tokens", "max_tokens", "temperature", "stream"}
             )
-            | TRACE_KEYS,
+            | TRACE_KEYS
+            | TENANT_KEYS,
             allow_sampling=True,
         ),
         _fs(P.GEN_CHUNK, required=frozenset({"text"}), required_any=(ID_KEYS,)),
         _fs(P.GEN_SUCCESS, required_any=(ID_KEYS,), optional=RESULT_FIELDS),
-        _fs(P.GEN_ERROR, required=frozenset({"error"}), required_any=(ID_KEYS,)),
-        _fs(P.GEN_RESULT, required_any=(ID_KEYS,), optional=RESULT_FIELDS),
+        _fs(
+            P.GEN_ERROR,
+            required=frozenset({"error"}),
+            required_any=(ID_KEYS,),
+            # typed admission rejections (429/503 over the wire)
+            optional=ADMISSION_KEYS,
+        ),
+        # GEN_RESULT answers relays too: a relay target's typed admission
+        # rejection forwards its error_kind/retry_after_s intact
+        _fs(
+            P.GEN_RESULT,
+            required_any=(ID_KEYS,),
+            optional=RESULT_FIELDS | ADMISSION_KEYS,
+        ),
         _fs(P.PIECE_REQUEST, required=frozenset({"rid", "hash"})),
         _fs(
             P.PIECE_DATA,

@@ -20,11 +20,14 @@ from typing import Any
 
 from aiohttp import web
 
+import math
+
 from . import __version__
 from .health import fleet_view, render_fleet_prom
 from .meshnet.node import P2PNode
 from .metrics import PROMETHEUS_CONTENT_TYPE, get_registry
 from .protocol import copy_sampling
+from .router import DEFAULT_TENANT, AdmissionReject
 from .tracing import get_tracer, stitch_trace
 
 logger = logging.getLogger("bee2bee_tpu.api")
@@ -77,24 +80,65 @@ def _int_param(body: dict, keys: tuple[str, ...], default: int) -> int:
     return default
 
 
-def _auth_ok(request: web.Request, api_key: str | None) -> bool:
+def _presented_key(request: web.Request) -> str:
+    """The credential the caller sent: X-API-KEY, or the Bearer token
+    (standard OpenAI SDKs send the key that way on /v1)."""
+    key = request.headers.get("X-API-KEY", "")
+    if key:
+        return key
+    auth = request.headers.get("Authorization", "")
+    if auth.startswith("Bearer "):
+        return auth[len("Bearer "):]
+    return ""
+
+
+def _auth_ok(request: web.Request, api_key: str | None, tenants=None) -> bool:
+    # constant-time comparisons: == leaks matching-prefix length via
+    # timing on the SDK-facing /v1 surface. Compare utf-8 bytes —
+    # compare_digest raises TypeError on non-ASCII str input, which
+    # would turn a bad header into a 500 instead of a 401
+    enc = lambda s: s.encode("utf-8", "surrogateescape")
+    presented = _presented_key(request)
+    if api_key and hmac.compare_digest(enc(presented), enc(api_key)):
+        return True
+    # per-tenant API keys (router/tenants.py) authenticate too — tenant
+    # identity FLOWS from the key, so a tenant key must open the door it
+    # is billed through (resolve_key is constant-time per key)
+    if tenants is not None and tenants.resolve_key(presented) is not None:
+        return True
     if api_key:
-        # constant-time comparisons: == leaks matching-prefix length via
-        # timing on the SDK-facing /v1 surface. Compare utf-8 bytes —
-        # compare_digest raises TypeError on non-ASCII str input, which
-        # would turn a bad header into a 500 instead of a 401
-        enc = lambda s: s.encode("utf-8", "surrogateescape")
-        if hmac.compare_digest(enc(request.headers.get("X-API-KEY", "")),
-                               enc(api_key)):
-            return True
-        # standard OpenAI SDKs send the key as a Bearer token — the /v1
-        # surface is useless off-loopback without accepting it
-        auth = request.headers.get("Authorization", "")
-        return hmac.compare_digest(enc(auth), enc(f"Bearer {api_key}"))
-    # no key configured: loopback only (safer than the reference's open
-    # default, per SURVEY §7 "what NOT to carry over")
+        return False
+    # no node key configured: loopback only (safer than the reference's
+    # open default, per SURVEY §7 "what NOT to carry over")
     peer = request.remote or ""
     return peer in ("127.0.0.1", "::1", "localhost", "")
+
+
+def _tenant_of(request: web.Request, tenants) -> str:
+    """Tenant billed for this request: the one owning the presented API
+    key, else the default tenant (weight 1, no budget)."""
+    if tenants is None:
+        return DEFAULT_TENANT
+    return tenants.resolve_key(_presented_key(request)) or DEFAULT_TENANT
+
+
+def _admission_response(rej: AdmissionReject, cors, v1: bool = False):
+    """Typed 429/503 response: Retry-After header + error_kind /
+    retry_after_s body — the contract docs/SERVING.md documents and
+    client.MeshOverloaded parses."""
+    if v1:
+        body = {"error": {
+            "message": rej.detail, "type": "overloaded_error",
+            "error_kind": rej.kind, "retry_after_s": rej.retry_after_s,
+        }}
+    else:
+        body = {"detail": rej.detail, "error_kind": rej.kind,
+                "retry_after_s": rej.retry_after_s}
+    return web.json_response(
+        body,
+        status=rej.status,
+        headers={**dict(cors), "Retry-After": str(max(1, math.ceil(rej.retry_after_s)))},
+    )
 
 
 # local service resolution lives on the node (_local_service_for) so the
@@ -110,7 +154,7 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
     async def middleware(request: web.Request, handler):
         if request.method == "OPTIONS":
             return web.Response(headers=cors)
-        if not _auth_ok(request, api_key):
+        if not _auth_ok(request, api_key, node.tenants):
             return web.json_response(
                 {"detail": "invalid or missing X-API-KEY"}, status=401, headers=cors
             )
@@ -120,6 +164,14 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
             raise
         except ConnectionResetError:
             raise  # client went away mid-stream; nothing to respond to
+        except AdmissionReject as rej:
+            # a typed shed from ANY depth — this node's admission or a
+            # remote hop's rejection surfaced by request_generation —
+            # keeps its 429/503 + Retry-After contract instead of
+            # collapsing into the generic 500 below
+            return _admission_response(
+                rej, cors, v1=request.path.startswith("/v1")
+            )
         except Exception as e:
             if request.transport is None:
                 raise  # response already started and connection is gone
@@ -163,6 +215,31 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
         ok = await node.connect_bootstrap(target)
         return web.json_response({"connected": ok})
 
+    async def _admit_and_serve_local(request, svc, params, stream, sse=None):
+        """THE admission contract on the HTTP surface, shared by /chat and
+        /v1: acquire a slot (WDRR-queued by tenant when saturated) →
+        stream or execute → bill the tenant's completed tokens → release.
+        Raises AdmissionReject for the middleware's typed 429/503 +
+        Retry-After response; returns the StreamResponse (streaming) or
+        the service result dict."""
+        ticket = await node.admission.acquire(
+            params["tenant"], cost_tokens=params["max_new_tokens"]
+        )
+        try:
+            if stream:
+                return await _stream_service(
+                    request, node, svc, params, cors, sse=sse, ticket=ticket
+                )
+            # node._execute_local = executor dispatch + gen.local span
+            # with contextvar parenting (engine spans nest under it)
+            result = await node._execute_local(
+                svc, params, stream=False, on_chunk=None
+            )
+            ticket.note_tokens(result.get("tokens") or 0)
+            return result
+        finally:
+            ticket.release()
+
     async def chat(request):
         body = await _json_body(request)
         prompt = body.get("prompt") or _prompt_from_messages(body.get("messages"))
@@ -186,23 +263,25 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
         copy_sampling(body, params)
         svc = node.local_service_for(model)
         stream = bool(body.get("stream"))
+        tenant = _tenant_of(request, node.tenants)
+        params["tenant"] = tenant
 
         if svc is not None:
-            if stream:
-                return await _stream_service(request, node, svc, params, cors)
-            # node._execute_local = executor dispatch + gen.local span with
-            # contextvar parenting (engine spans nest under it)
-            result = await node._execute_local(svc, params, stream=False, on_chunk=None)
-            return web.json_response(result)
+            out = await _admit_and_serve_local(request, svc, params, stream)
+            if isinstance(out, web.StreamResponse):
+                return out
+            return web.json_response(out)
 
-        # P2P fallback (reference api.py:247-264)
-        provider = node.pick_provider(model)
+        # P2P fallback (reference api.py:247-264): prefix-aware scored pick
+        provider = node.pick_provider(model, prompt=prompt)
         if provider is None or provider["local"]:
             return web.json_response(
                 {"detail": f"no provider for model {model!r}"}, status=404
             )
         if stream:
-            return await _stream_p2p(request, node, provider, params, model, cors)
+            return await _stream_p2p(
+                request, node, provider, params, model, cors, tenant=tenant
+            )
         result = await node.request_generation(
             provider["provider_id"],
             prompt,
@@ -210,6 +289,7 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
             max_new_tokens=params["max_new_tokens"],
             temperature=params["temperature"],
             extra=_sampling_extra(params),
+            tenant=tenant,
         )
         return web.json_response(result)
 
@@ -452,25 +532,31 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
         params = _openai_params(body, prompt)
         svc = node.local_service_for(model)
         sse = ("chat" if chat else "text", model or "")
+        tenant = _tenant_of(request, node.tenants)
+        params["tenant"] = tenant
         if svc is not None:
-            if bool(body.get("stream")):
-                return await _stream_service(request, node, svc, params, cors, sse=sse)
-            result = await node._execute_local(svc, params, stream=False, on_chunk=None)
+            result = await _admit_and_serve_local(
+                request, svc, params, bool(body.get("stream")), sse=sse
+            )
+            if isinstance(result, web.StreamResponse):
+                return result
         else:
-            provider = node.pick_provider(model)
+            provider = node.pick_provider(model, prompt=prompt)
             if provider is None or provider["local"]:
                 return web.json_response(
                     {"error": {"message": f"model {model!r} not found",
                                "type": "invalid_request_error"}}, status=404)
             if bool(body.get("stream")):
                 return await _stream_p2p(
-                    request, node, provider, params, model, cors, sse=sse
+                    request, node, provider, params, model, cors, sse=sse,
+                    tenant=tenant,
                 )
             result = await node.request_generation(
                 provider["provider_id"], prompt, model=model,
                 max_new_tokens=params["max_new_tokens"],
                 temperature=params["temperature"],
                 extra=_sampling_extra(params),
+                tenant=tenant,
             )
         return web.json_response(_openai_response(result, model, chat))
 
@@ -599,7 +685,7 @@ def _make_frame(sse):
 
 
 async def _stream_service(
-    request, node: P2PNode, svc, params, cors=(), sse=None
+    request, node: P2PNode, svc, params, cors=(), sse=None, ticket=None
 ) -> web.StreamResponse:
     """Streaming from a local service: JSON-lines by default, or OpenAI
     SSE chunks when sse=("chat"|"text", model) (the /v1 surface)."""
@@ -653,6 +739,10 @@ async def _stream_service(
                     if obj.get("done"):
                         if obj.get("tokens") is not None:
                             span.attrs["tokens"] = int(obj["tokens"])
+                            if ticket is not None:
+                                # per-tenant completed-token accounting
+                                # must not exclude streaming traffic
+                                ticket.note_tokens(int(obj["tokens"]))
                         if obj.get("timing") is not None:
                             span.attrs["timing"] = obj["timing"]
                     if obj.get("status") == "error":
@@ -677,18 +767,12 @@ async def _stream_service(
 
 
 async def _stream_p2p(
-    request, node: P2PNode, provider, params, model, cors=(), sse=None
+    request, node: P2PNode, provider, params, model, cors=(), sse=None,
+    tenant=None,
 ) -> web.StreamResponse:
     import asyncio
 
     frame = _make_frame(sse)
-    resp = web.StreamResponse(
-        headers={
-            "Content-Type": "text/event-stream" if sse else "application/x-ndjson",
-            **dict(cors),
-        }
-    )
-    await resp.prepare(request)
     q: asyncio.Queue = asyncio.Queue()
 
     def on_chunk(text):
@@ -704,13 +788,34 @@ async def _stream_p2p(
             stream=True,
             on_chunk=on_chunk,
             extra=_sampling_extra(params),
+            tenant=tenant,
         )
     )
+    resp = None
+    getter = asyncio.create_task(q.get())
     while True:
-        getter = asyncio.create_task(q.get())
         done, _ = await asyncio.wait({getter, gen_task}, return_when=asyncio.FIRST_COMPLETED)
+        if resp is None:
+            # the FIRST event decides the response: a failure arriving
+            # before any chunk (typed remote shed, dead provider) must
+            # surface as a real HTTP status — the middleware turns an
+            # AdmissionReject into 429/503 + Retry-After — not as a 200
+            # whose body smuggles an error line no backoff logic reads
+            if getter not in done and gen_task.exception() is not None:
+                getter.cancel()
+                raise gen_task.exception()
+            resp = web.StreamResponse(
+                headers={
+                    "Content-Type": (
+                        "text/event-stream" if sse else "application/x-ndjson"
+                    ),
+                    **dict(cors),
+                }
+            )
+            await resp.prepare(request)
         if getter in done:
             await resp.write(frame(getter.result()))
+            getter = asyncio.create_task(q.get())
             continue
         getter.cancel()
         try:
@@ -719,6 +824,8 @@ async def _stream_p2p(
                 await resp.write(frame(q.get_nowait()))
             await resp.write(frame(json.dumps({"done": True}) + "\n"))
         except Exception as e:
+            # mid-stream failure: the 200 is already on the wire — the
+            # in-stream error line is all that's left to say
             await resp.write(
                 frame(json.dumps({"status": "error", "message": str(e)}) + "\n")
             )

@@ -28,10 +28,57 @@ import aiohttp
 DEFAULT_TIMEOUT_S = 300.0  # matches the mesh request timeout
 # idempotent-GET retry policy: transient CONNECTION failures (refused /
 # reset / dropped mid-flight — aiohttp.ClientConnectionError) retry with
-# exponential backoff + jitter. POSTs never retry (a generate may have
-# executed), and HTTP error statuses never retry (they're answers).
+# exponential backoff + jitter, and typed 429/503 overload answers retry
+# honoring the server's Retry-After (bounded by MAX_RETRY_AFTER_S and the
+# client's own deadline). POSTs never retry (a generate may have
+# executed); non-overload HTTP error statuses never retry (they're
+# answers).
 DEFAULT_GET_RETRIES = 2
 DEFAULT_RETRY_BACKOFF_S = 0.2
+MAX_RETRY_AFTER_S = 30.0  # cap on honoring a server's Retry-After hint
+
+
+class MeshOverloaded(RuntimeError):
+    """Typed 429/503 from a node's admission controller (docs/SERVING.md):
+    the node is shedding, not broken. Carries the machine-readable
+    rejection so callers can back off intelligently instead of parsing
+    an HTTP error string."""
+
+    def __init__(self, message: str, status: int,
+                 error_kind: str | None = None,
+                 retry_after_s: float | None = None):
+        super().__init__(message)
+        self.status = status
+        self.error_kind = error_kind
+        self.retry_after_s = retry_after_s
+
+
+async def _raise_if_overloaded(r) -> None:
+    """Map a 429/503 response onto MeshOverloaded, folding in the typed
+    body (error_kind / retry_after_s) and the Retry-After header."""
+    if r.status not in (429, 503):
+        return
+    kind, retry_after, detail = None, None, f"HTTP {r.status}"
+    try:
+        body = await r.json()
+        err = body.get("error") if isinstance(body.get("error"), dict) else body
+        kind = err.get("error_kind")
+        if err.get("retry_after_s") is not None:
+            retry_after = float(err["retry_after_s"])
+        detail = err.get("detail") or err.get("message") or detail
+    except Exception:  # noqa: BLE001 — a proxy's bare 503 has no JSON body
+        pass
+    if retry_after is None:
+        hdr = r.headers.get("Retry-After")
+        if hdr is not None:
+            try:
+                retry_after = float(hdr)
+            except ValueError:
+                pass
+    raise MeshOverloaded(
+        f"mesh overloaded ({detail})", r.status,
+        error_kind=kind, retry_after_s=retry_after,
+    )
 
 
 class _Base:
@@ -69,19 +116,29 @@ class _Base:
 
     async def _get(self, path: str, **params) -> dict:
         """GETs are idempotent: transient connection errors retry with
-        exponential backoff + jitter, bounded by self.retries AND by the
-        client's configured total timeout — retrying must not multiply
-        the caller's time budget (slow failures give up early)."""
+        exponential backoff + jitter, and typed 429/503 overload answers
+        retry honoring the server's Retry-After (jittered, capped) —
+        both bounded by self.retries AND by the client's configured total
+        timeout, so retrying never multiplies the caller's time budget
+        (slow failures give up early)."""
         total = self.timeout.total
         deadline = (time.monotonic() + total) if total else None
         attempt = 0
         while True:
             try:
                 return await self._get_once(path, **params)
-            except aiohttp.ClientConnectionError:
+            except (aiohttp.ClientConnectionError, MeshOverloaded) as e:
                 attempt += 1
                 delay = (self.retry_backoff_s * 2 ** (attempt - 1)
                          * (1.0 + random.random() * 0.25))
+                if isinstance(e, MeshOverloaded) and e.retry_after_s:
+                    # honor the server's hint, jittered so a shed burst
+                    # doesn't return in lockstep; capped so a hostile or
+                    # misconfigured hint can't park the client
+                    delay = max(delay, min(
+                        e.retry_after_s * (1.0 + random.random() * 0.25),
+                        MAX_RETRY_AFTER_S,
+                    ))
                 if attempt > self.retries or (
                     deadline is not None
                     and time.monotonic() + delay >= deadline
@@ -95,14 +152,19 @@ class _Base:
                 f"{self.base_url}{path}", headers=self._headers,
                 params={k: v for k, v in params.items() if v is not None},
             ) as r:
+                await _raise_if_overloaded(r)
                 r.raise_for_status()
                 return await r.json()
 
     async def _post(self, path: str, body: dict) -> dict:
+        """POSTs never retry (a generate may have executed) — but a typed
+        429/503 still surfaces as MeshOverloaded so callers get the
+        rejection kind and Retry-After instead of a bare HTTP error."""
         async with self._sess() as s:
             async with s.post(
                 f"{self.base_url}{path}", json=body, headers=self._headers
             ) as r:
+                await _raise_if_overloaded(r)
                 r.raise_for_status()
                 return await r.json()
 
@@ -173,6 +235,7 @@ class NodeClient(_Base):
             async with s.post(
                 f"{self.base_url}/chat", json=body, headers=self._headers
             ) as r:
+                await _raise_if_overloaded(r)
                 r.raise_for_status()
                 async for line in r.content:
                     line = line.strip()

@@ -649,7 +649,7 @@ class InferenceEngine:
         self, prompt, max_new_tokens, temperature, top_k, top_p, stop_tokens,
         stream: bool = False, repetition_penalty: float = 1.0,
         presence_penalty: float = 0.0, frequency_penalty: float = 0.0,
-        min_p: float = 0.0,
+        min_p: float = 0.0, tenant: str = "default",
     ):
         from .scheduler import Request
 
@@ -686,6 +686,7 @@ class InferenceEngine:
             presence_penalty=presence_penalty,
             frequency_penalty=frequency_penalty,
             min_p=min_p,
+            tenant=tenant,
         )
 
     def _build_result(self, req) -> GenerationResult:
@@ -755,6 +756,7 @@ class InferenceEngine:
         presence_penalty: float = 0.0,
         frequency_penalty: float = 0.0,
         min_p: float = 0.0,
+        tenant: str = "default",
     ) -> Iterator[dict]:
         """Yield {"token": last_id, "tokens": ids, "text": piece} per decode
         chunk, then {"done": True, "result": GenerationResult}. Streaming
@@ -767,6 +769,7 @@ class InferenceEngine:
             presence_penalty=presence_penalty,
             frequency_penalty=frequency_penalty,
             min_p=min_p,
+            tenant=tenant,
         )
         if req.max_new_tokens <= 0:
             req.timing.t_first = req.timing.t_done = time.perf_counter()
@@ -803,6 +806,7 @@ class InferenceEngine:
             presence_penalty=kw.get("presence_penalty", 0.0),
             frequency_penalty=kw.get("frequency_penalty", 0.0),
             min_p=kw.get("min_p", 0.0),
+            tenant=kw.get("tenant", "default"),
         )
         if req.max_new_tokens <= 0:
             req.timing.t_first = req.timing.t_done = time.perf_counter()

@@ -60,6 +60,8 @@ import numpy as np
 
 from ..health import get_recorder
 from ..metrics import get_registry
+from ..router.fairness import WdrrQueue
+from ..router.tenants import load_tenant_config
 from ..tracing import get_tracer
 
 logger = logging.getLogger("bee2bee_tpu.scheduler")
@@ -117,8 +119,13 @@ class Request:
         presence_penalty: float = 0.0,
         frequency_penalty: float = 0.0,
         min_p: float = 0.0,
+        tenant: str = "default",
     ):
         self.stream = stream
+        # fairness identity (router/tenants.py): keys the scheduler's WDRR
+        # submit queue, so one tenant's burst can't starve another past
+        # its configured weight even below the admission layer
+        self.tenant = str(tenant or "default")
         # set by an abandoning consumer (generate_stream closed early);
         # plain bool write cross-thread — the scheduler thread reads it at
         # chunk boundaries and retires the row
@@ -289,7 +296,19 @@ class BatchScheduler:
         self.engine = engine
         self.max_batch = max_batch
         self.stats = SchedulerStats()
-        self._queue: deque[Request] = deque()
+        # submit queue with per-tenant weighted-deficit fairness
+        # (router/fairness.py): deque-compatible, FIFO within a tenant,
+        # WDRR across tenants — cost is the request's token budget, so a
+        # 4:1-weighted tenant pair drains at ~4:1 in TOKENS under
+        # saturation. Weights come from the same BEE2BEE_TENANTS config
+        # the admission controller reads; with no tenants configured every
+        # request shares the default queue and order stays pure FIFO.
+        self._queue: WdrrQueue = WdrrQueue(
+            weights={
+                name: spec.weight
+                for name, spec in load_tenant_config().items()
+            }
+        )
         self._cond = threading.Condition()
         self._shutdown = False
 
@@ -454,11 +473,22 @@ class BatchScheduler:
 
     # ------------------------------------------------------------ public
 
+    def set_tenant_weights(self, weights: dict) -> None:
+        """Adopt the owning node's resolved tenant weights (P2PNode
+        .add_service pushes its TenantRegistry here), so a registry
+        replaced at runtime can't drift from the env-seeded defaults."""
+        with self._cond:
+            self._queue.set_weights(weights)
+
     def submit(self, req: Request) -> Request:
         with self._cond:
             if self._shutdown:
                 raise RuntimeError("scheduler is shut down")
-            self._queue.append(req)
+            self._queue.append(
+                req,
+                tenant=req.tenant,
+                cost=max(1.0, float(req.max_new_tokens)),
+            )
             self._cond.notify()
         return req
 
@@ -857,6 +887,13 @@ class BatchScheduler:
                 req.finish = "cancelled"
                 req.timing.t_first = req.timing.t_done = time.perf_counter()
                 req.events.put({"done": True, "result": e._build_result(req)})
+                # the pop charged this tenant's WDRR deficit for tokens
+                # that will never decode — refund, same as admission does
+                # for abandoned waiters
+                with self._cond:
+                    self._queue.refund(
+                        req.tenant, max(1.0, float(req.max_new_tokens))
+                    )
                 continue
             req.timing.t_admit = time.perf_counter()
             if self.active == self._bsz:
@@ -973,7 +1010,12 @@ class BatchScheduler:
                 # request can never fit the configured pool: fail it.
                 if self.active > 0 or placed:
                     with self._cond:
-                        self._queue.appendleft(req)
+                        # front requeue refunds the WDRR cost charged at
+                        # the pop, so the retry isn't double-billed
+                        self._queue.appendleft(
+                            req, tenant=req.tenant,
+                            cost=max(1.0, float(req.max_new_tokens)),
+                        )
                     self.stats.paged_alloc_waits += 1
                     break
                 req.finish = "error"

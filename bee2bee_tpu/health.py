@@ -44,7 +44,7 @@ from typing import Any, Callable
 
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry, get_registry
 from .tracing import current_trace_ctx, get_tracer, stitch_trace
-from .utils import bee2bee_home, new_id
+from .utils import bee2bee_home, load_json_source, new_id
 
 logger = logging.getLogger("bee2bee_tpu.health")
 
@@ -394,13 +394,10 @@ def parse_slo_config(entries) -> list[SloObjective]:
 def load_slo_config(source: str | None = None) -> list[SloObjective]:
     """SLO objectives from `source`, the ``BEE2BEE_SLO_CONFIG`` env var
     (inline JSON array, or a path to a JSON file), or the defaults."""
-    raw = source if source is not None else os.environ.get("BEE2BEE_SLO_CONFIG")
-    if not raw:
+    data = load_json_source(source, "BEE2BEE_SLO_CONFIG", opener="[")
+    if data is None:
         return parse_slo_config(DEFAULT_SLO_CONFIG)
-    text = raw.strip()
-    if not text.startswith("["):
-        text = Path(text).read_text()
-    return parse_slo_config(json.loads(text))
+    return parse_slo_config(data)
 
 
 # burn-rate gauges (bee2bee_slo_* after prefixing): labeled by objective
@@ -568,6 +565,17 @@ class SloTracker:
     def status(self) -> list[dict]:
         """A fresh evaluation (what ``GET /slo`` serves)."""
         return self.evaluate()
+
+    def max_fast_burn(self) -> float:
+        """Highest fast-window burn rate across objectives from the LAST
+        evaluation (the monitor loop refreshes it on the ping cadence) —
+        the shed signal the admission controller (router/admission.py)
+        gates on. 0.0 before any evaluation: a node must not shed on no
+        evidence."""
+        return max(
+            (float(e.get("burn_rate_fast") or 0.0) for e in self._last_eval),
+            default=0.0,
+        )
 
     def brief(self) -> dict:
         """Compact per-objective summary for the gossip digest."""

@@ -41,6 +41,17 @@ from ..health import HealthStore, SloTracker, build_digest, get_recorder, load_s
 from ..joinlink import generate_join_link, parse_join_link
 from ..metrics import get_registry
 from ..pieces import ShardManifest
+from ..router import (
+    AdmissionController,
+    AdmissionReject,
+    PrefixTracker,
+    RouterPolicy,
+    TenantRegistry,
+    load_admission_config,
+    load_tenant_config,
+    paged_pool_free_fraction,
+    static_sort,
+)
 from ..tracing import extract_trace, get_tracer, inject_trace, use_trace_ctx
 from ..utils import (
     MetricsAggregator,
@@ -154,6 +165,24 @@ class P2PNode(StageTaskMixin):
         # garbage later
         self.slo = SloTracker(
             objectives=load_slo_config(), on_trip=self._on_slo_trip
+        )
+
+        # SLO-aware front door (router/): tenant identity + budgets from
+        # BEE2BEE_TENANTS, telemetry-scored provider picking, and typed
+        # 429/503 admission at both ingress surfaces. All three loaders
+        # raise on malformed config — same fail-at-construction contract
+        # as the SLO config above.
+        self.tenants = TenantRegistry(load_tenant_config())
+        self.router = RouterPolicy()
+        self.prefixes = PrefixTracker()
+        self.admission = AdmissionController(
+            config=load_admission_config(),
+            weights=self.tenants.weights(),
+            budgets=self.tenants.budgets(),
+            # this node's OWN burn state (not the process-global registry):
+            # the monitor loop refreshes it on the ping cadence
+            slo_burn=lambda: self.slo.max_fast_burn(),
+            pool_free_fraction=paged_pool_free_fraction,
         )
 
         # piece store: hash -> bytes (optionally spilled to piece_dir)
@@ -626,6 +655,12 @@ class P2PNode(StageTaskMixin):
         slo = self.slo.brief()
         if slo:
             digest["slo"] = slo
+        # prefix-cache locality hints (router/prefixmap.py): the chained
+        # leading-block hashes of recently-served prompts, so peers can
+        # route repeat prefixes here and hit the CoW prefix cache
+        prefixes = self.prefixes.advertised()
+        if prefixes:
+            digest["prefix_hashes"] = prefixes
         return digest
 
     async def gossip_telemetry(self) -> int:
@@ -690,6 +725,13 @@ class P2PNode(StageTaskMixin):
 
     def add_service(self, svc) -> None:
         self.local_services[svc.name] = svc
+        # ONE tenant-weight source: an engine-backed service's scheduler
+        # adopts this node's resolved registry (its constructor only
+        # env-seeds the same config; a runtime-replaced TenantRegistry
+        # would otherwise drift from the engine's WDRR weights)
+        sched = getattr(getattr(svc, "engine", None), "scheduler", None)
+        if sched is not None and hasattr(sched, "set_tenant_weights"):
+            sched.set_tenant_weights(self.tenants.weights())
 
     async def announce_service(self, svc) -> int:
         self.add_service(svc)
@@ -723,19 +765,42 @@ class P2PNode(StageTaskMixin):
             ]
         return out
 
-    def pick_provider(self, model: str | None = None) -> dict | None:
-        """Cheapest, then lowest-latency (reference p2p_runtime.py:744-746);
-        local services count as zero latency."""
+    def pick_provider(
+        self,
+        model: str | None = None,
+        prompt: str | None = None,
+        exclude=(),
+        remote_only: bool = False,
+    ) -> dict | None:
+        """Telemetry-scored provider pick (router/policy.py): queue-wait,
+        batch-fill headroom, paged-pool pressure, SLO burn state, RTT and
+        prompt-prefix locality from the gossiped health digests. Falls
+        back to the reference's static cheapest-then-lowest-latency sort
+        when NO candidate has a fresh digest — the regime where nothing
+        better is knowable (and where the old ``_latency or 1e9`` wart is
+        contained: a never-pinged peer under the scored path gets the
+        explicit unknown tier instead of permanent last place)."""
         cands = self.list_providers(model)
+        if remote_only:
+            cands = [p for p in cands if not p["local"]]
+        if exclude:
+            cands = [p for p in cands if p["provider_id"] not in exclude]
         if not cands:
             return None
-        return sorted(
-            cands,
-            key=lambda p: (
-                p.get("price_per_token") or 0.0,
-                0.0 if p["local"] else (p.get("_latency") or 1e9),
-            ),
-        )[0]
+        fresh = self.health.fresh()
+        if not any(p["provider_id"] in fresh for p in cands if not p["local"]):
+            # no live telemetry about any remote candidate: legacy sort
+            # (local-only candidate lists land here too — the local node
+            # needs no digest to pick itself)
+            return static_sort(cands)
+        local_digest = (
+            self.telemetry_digest()
+            if any(p["local"] for p in cands) else None
+        )
+        winner, _decision = self.router.pick(
+            cands, fresh, local_digest=local_digest, prompt=prompt
+        )
+        return winner
 
     # ------------------------------------------------------------ generation
 
@@ -752,6 +817,8 @@ class P2PNode(StageTaskMixin):
         extra: dict | None = None,  # sampling knobs (top_k/top_p/penalties):
         # ride the wire as plain message keys — the reference ignores
         # unknown keys, so the frame stays wire-compatible
+        tenant: str | None = None,  # per-tenant identity (router/): the
+        # serving node's admission bills the same tenant the gateway did
     ) -> dict:
         params = {
             "prompt": prompt,
@@ -798,6 +865,10 @@ class P2PNode(StageTaskMixin):
                         max_tokens=max_new_tokens,  # reference reads this key
                         temperature=temperature,
                         stream=bool(stream or on_chunk),
+                        # omitted when absent (the sampling-knob
+                        # convention): a null tenant is wire noise the
+                        # receiver would only clamp away
+                        **({"tenant": tenant} if tenant is not None else {}),
                         **(extra or {}),
                     )),
                 )
@@ -805,6 +876,15 @@ class P2PNode(StageTaskMixin):
                 # raise inside the span so remote-error results count as
                 # span errors in /trace, same as timeouts do
                 if isinstance(result, dict) and result.get("error"):
+                    if result.get("error_kind"):
+                        # a typed admission shed must SURVIVE the hop: the
+                        # gateway maps this back onto 429/503+Retry-After
+                        # instead of a 500 that defeats client backoff
+                        raise AdmissionReject(
+                            result["error_kind"],
+                            float(result.get("retry_after_s") or 0.0),
+                            detail=str(result["error"]),
+                        )
                     raise RuntimeError(result["error"])
         except asyncio.TimeoutError:
             raise RuntimeError("request_timed_out")
@@ -839,6 +919,9 @@ class P2PNode(StageTaskMixin):
         # SLO event accounting wraps the whole serve: every locally-served
         # generation (HTTP, /v1, p2p, relay target) funnels through here
         _C_GEN_REQUESTS.inc()
+        # prefix-locality advertisement (router/prefixmap.py): what this
+        # node just served is what its prefix cache plausibly holds
+        self.prefixes.note(params.get("prompt"))
         try:
             return await self._execute_local_inner(svc, params, stream, on_chunk)
         except Exception:
@@ -949,6 +1032,27 @@ class P2PNode(StageTaskMixin):
         }
         protocol.copy_sampling(data, params)
         if svc is not None:
+            # p2p ingress admission (router/admission.py): the frame's
+            # tenant claim is clamped to a CONFIGURED name — an arbitrary
+            # wire string must not mint queues or metric series
+            tenant = self.tenants.clamp(data.get("tenant"))
+            params["tenant"] = tenant
+            try:
+                ticket = await self.admission.acquire(
+                    tenant, cost_tokens=params["max_new_tokens"]
+                )
+            except AdmissionReject as rej:
+                # typed shed over the wire: error_kind + retry_after_s ride
+                # the GEN_ERROR frame (declared in analysis/schema.py), the
+                # p2p twin of the HTTP 429/503 + Retry-After contract
+                with contextlib.suppress(Exception):
+                    await self._send(ws, protocol.msg(
+                        protocol.GEN_ERROR, rid=rid,
+                        error=f"admission_rejected: {rej.detail}",
+                        error_kind=rej.kind,
+                        retry_after_s=rej.retry_after_s,
+                    ))
+                return
             try:
                 if data.get("stream"):
                     send_q: asyncio.Queue = asyncio.Queue()
@@ -966,9 +1070,11 @@ class P2PNode(StageTaskMixin):
                             ws, protocol.msg(protocol.GEN_CHUNK, rid=rid, text=text)
                         ),
                     )
+                    ticket.note_tokens(result.get("tokens") or 0)
                     await self._send(ws, protocol.msg(protocol.GEN_SUCCESS, rid=rid, **result))
                 else:
                     result = await self._execute_local(svc, params, False, None)
+                    ticket.note_tokens(result.get("tokens") or 0)
                     await self._send(ws, protocol.msg(protocol.GEN_SUCCESS, rid=rid, **result))
             except Exception as e:
                 # a failed generation is a typed incident: snapshot the ring
@@ -983,15 +1089,19 @@ class P2PNode(StageTaskMixin):
                     await self._send(
                         ws, protocol.msg(protocol.GEN_ERROR, rid=rid, error=f"local_error: {e}")
                     )
+            finally:
+                ticket.release()
             return
         # swarm relay: one extra hop through another provider
-        # (reference p2p_runtime.py:634-655)
+        # (reference p2p_runtime.py:634-655) — telemetry-scored like any
+        # other pick, never bouncing the request back to its requester
         requester = await self._peer_for(ws)
-        cand = None
-        for p in self.list_providers(model):
-            if not p["local"] and p["provider_id"] != requester:
-                cand = p
-                break
+        cand = self.pick_provider(
+            model,
+            prompt=params["prompt"],
+            exclude={requester} if requester else (),
+            remote_only=True,
+        )
         if cand is None:
             await self._send(
                 ws,
@@ -1018,6 +1128,9 @@ class P2PNode(StageTaskMixin):
                         stream=True,
                         on_chunk=relay_q.put_nowait,
                         extra=protocol.copy_sampling(params, {}),
+                        # the ORIGINAL claim, unclamped: the serving node
+                        # clamps against its own tenant config
+                        tenant=data.get("tenant"),
                     )
                 )
                 result = await pump_queue_until(
@@ -1035,10 +1148,22 @@ class P2PNode(StageTaskMixin):
                     max_new_tokens=params["max_new_tokens"],
                     temperature=params["temperature"],
                     extra=protocol.copy_sampling(params, {}),
+                    tenant=data.get("tenant"),
                 )
             # the inner result carries its own rid — replace it with ours
             fwd = {k: v for k, v in result.items() if k not in ("rid", "task_id", "type")}
             await self._send(ws, protocol.msg(protocol.GEN_RESULT, rid=rid, **fwd))
+        except AdmissionReject as rej:
+            # the relay TARGET shed: forward the typed rejection intact
+            # (error_kind + retry_after_s on GEN_RESULT, schema-declared)
+            # so the originating gateway still answers 429/503 +
+            # Retry-After instead of a generic relay failure
+            await self._send(ws, protocol.msg(
+                protocol.GEN_RESULT, rid=rid,
+                error=f"relay_admission_rejected: {rej.detail}",
+                error_kind=rej.kind,
+                retry_after_s=rej.retry_after_s,
+            ))
         except Exception as e:
             await self._send(
                 ws, protocol.msg(protocol.GEN_RESULT, rid=rid, error=f"relay_link_failure: {e}")

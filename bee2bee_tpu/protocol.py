@@ -184,6 +184,14 @@ def decode_binary(raw: bytes) -> tuple[dict, dict]:
     return header, tensors
 
 
+# per-tenant serving identity (router/): resolved from the API key at the
+# gateway, riding GEN_REQUEST (and relay hops) as an optional key so the
+# serving node's admission controller and scheduler fairness see the SAME
+# tenant the front door billed. Old peers ignore it; receivers clamp
+# unconfigured claims to the default tenant (TenantRegistry.clamp) so a
+# hostile frame can't mint metric series. Declared in analysis/schema.py.
+TENANT = "tenant"
+
 # cross-node trace propagation (tracing.py): the originating request's
 # (trace_id, span_id) rides gen_request / task / result frames under this
 # optional key so worker-side spans parent under the request that caused

@@ -1,0 +1,252 @@
+"""SLO-aware routing policy: score providers on live telemetry digests.
+
+Replaces ``pick_provider``'s static "cheapest, then lowest-latency" sort
+(the reference's rule, PAPER.md L3) with a scorer over the signals the
+health plane already gossips fleet-wide (health.py digests on the ping
+cadence):
+
+- queue-wait p95 (``hist["engine.queue_wait_ms"]``) — requests already
+  waiting there will wait in front of ours;
+- batch-fill (``gauge["engine.batch_fill"]``) — headroom in the decode
+  batch;
+- paged-pool pressure (``engine.paged_blocks_free / _total``) — a nearly
+  dry pool means admission backpressure is imminent;
+- SLO burn state (the digest's ``slo`` brief) — a peer burning its error
+  budget is EXCLUDED outright (sending it more traffic melts it faster),
+  unless every candidate is excluded (degraded service beats none);
+- RTT to the peer (the hello/ping bookkeeping) and price as weak signals;
+- prompt-prefix locality (router/prefixmap.py): a peer advertising the
+  prompt's leading-block hashes gets a bonus per matched block, so CoW
+  prefix sharing actually gets hit across the mesh.
+
+Scores are penalties — lower wins. Every signal is normalized to [0, 1]
+via soft knees (``x / (x + ref)``) so one hot metric can't saturate the
+sum. A peer with NO fresh digest scores the explicit **unknown tier**
+(neutral 0.5 on the load signals) instead of the old ``_latency or 1e9``
+sort key that permanently deprioritized never-pinged peers; when no
+candidate has a fresh digest at all, the caller falls back to the legacy
+static sort (meshnet/node.pick_provider keeps it).
+
+Weights are env-tunable (``BEE2BEE_ROUTER``, inline JSON or a path) and
+validated loudly, same contract as the SLO config.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, fields
+
+from ..metrics import get_registry
+from ..utils import load_json_source
+from .prefixmap import match_depth, prompt_prefix_hashes
+
+# routing decision counters: mode is a closed set, so cardinality is fixed
+_C_DECISIONS = get_registry().counter(
+    "router.decisions", "provider picks by mode (scored / static fallback)"
+)
+_C_PREFIX_PREFERRED = get_registry().counter(
+    "router.prefix_preferred",
+    "scored picks whose winner matched the prompt's prefix blocks",
+)
+_C_SLO_EXCLUDED = get_registry().counter(
+    "router.slo_excluded", "candidates excluded for burning their SLO budget"
+)
+
+MODE_SCORED = "scored"
+MODE_STATIC = "static_fallback"
+
+
+@dataclass(frozen=True)
+class RouterWeights:
+    """Penalty weights + normalization knees; lower total score wins."""
+
+    queue: float = 0.30        # queue-wait p95 penalty weight
+    fill: float = 0.25         # batch-fill penalty weight
+    pool: float = 0.20         # paged-pool pressure penalty weight
+    rtt: float = 0.10          # network distance penalty weight
+    price: float = 0.05        # price tie-break weight
+    prefix_bonus: float = 0.08  # score credit per matched prefix block
+    prefix_max_blocks: int = 2  # cap on credited blocks ("within tolerance":
+    # a prefix match may beat at most ~prefix_bonus*max/fill of batch-fill
+    # difference, never a peer that is outright loaded)
+    queue_ref_ms: float = 500.0  # soft knee: p95 at the knee scores 0.5
+    rtt_ref_ms: float = 100.0
+    unknown: float = 0.5       # the explicit unknown tier for digest-less peers
+
+
+def parse_router_weights(obj) -> RouterWeights:
+    if not isinstance(obj, dict):
+        raise ValueError(f"router config must be a JSON object, got {type(obj).__name__}")
+    known = {f.name for f in fields(RouterWeights)}
+    unknown = set(obj) - known
+    if unknown:
+        raise ValueError(f"router config: unknown keys {sorted(unknown)}")
+    kwargs = {}
+    for k, v in obj.items():
+        kwargs[k] = int(v) if k == "prefix_max_blocks" else float(v)
+        if kwargs[k] < 0:
+            raise ValueError(f"router config: {k} must be >= 0")
+    return RouterWeights(**kwargs)
+
+
+def load_router_weights(source: str | None = None) -> RouterWeights:
+    """Weights from `source`, ``BEE2BEE_ROUTER`` (inline JSON or a path),
+    or the defaults; malformed config raises at node construction."""
+    data = load_json_source(source, "BEE2BEE_ROUTER")
+    return parse_router_weights(data) if data is not None else RouterWeights()
+
+
+def _soft(value: float, ref: float) -> float:
+    """x/(x+ref): 0 at 0, 0.5 at the knee, asymptotically 1."""
+    v = max(float(value), 0.0)
+    return v / (v + ref) if ref > 0 else 1.0
+
+
+def _slo_burning(digest: dict | None) -> bool:
+    """True when the peer's own SLO brief reports any objective burning or
+    tripped — the shed-before-melt contract seen from the outside."""
+    if not digest:
+        return False
+    brief = digest.get("slo")
+    if not isinstance(brief, dict):
+        return False
+    return any(
+        isinstance(e, dict) and e.get("status") in ("burning", "tripped")
+        for e in brief.values()
+    )
+
+
+class RouterPolicy:
+    """Scores ``list_providers()`` candidates against HealthStore digests;
+    ``pick`` returns the winner or None."""
+
+    def __init__(self, weights: RouterWeights | None = None):
+        self.weights = weights or load_router_weights()
+
+    # ------------------------------------------------------------- scoring
+
+    def score(self, cand: dict, digest: dict | None, rtt_ms: float | None,
+              max_price: float, prompt_hashes: list[str]) -> tuple[float, dict]:
+        """(penalty score, breakdown) for one candidate. ``digest`` is the
+        peer's fresh telemetry digest (the node's own live digest for the
+        local candidate); None selects the unknown tier."""
+        w = self.weights
+        if digest is None:
+            queue = fill = pool = w.unknown
+            matched = 0
+        else:
+            hist = digest.get("hist") or {}
+            qw = hist.get("engine.queue_wait_ms") or {}
+            queue = _soft(qw.get("p95") or 0.0, w.queue_ref_ms)
+            gauge = digest.get("gauge") or {}
+            # absent batch-fill/pool gauges mean the subsystem isn't
+            # running (health.build_digest contract) — no pressure, not
+            # unknown pressure
+            fill = min(max(float(gauge.get("engine.batch_fill") or 0.0), 0.0), 1.0)
+            total = float(gauge.get("engine.paged_blocks_total") or 0.0)
+            if total > 0:
+                free = float(gauge.get("engine.paged_blocks_free") or 0.0)
+                pool = 1.0 - min(max(free / total, 0.0), 1.0)
+            else:
+                pool = 0.0
+            matched = min(
+                match_depth(prompt_hashes, digest.get("prefix_hashes")),
+                w.prefix_max_blocks,
+            )
+        rtt = 0.0 if cand.get("local") else (
+            _soft(rtt_ms, w.rtt_ref_ms) if rtt_ms is not None else w.unknown
+        )
+        price = float(cand.get("price_per_token") or 0.0)
+        pnorm = price / max_price if max_price > 0 else 0.0
+        score = (
+            w.queue * queue + w.fill * fill + w.pool * pool
+            + w.rtt * rtt + w.price * pnorm
+            - w.prefix_bonus * matched
+        )
+        return score, {
+            "queue": round(queue, 4), "fill": round(fill, 4),
+            "pool": round(pool, 4), "rtt": round(rtt, 4),
+            "price": round(pnorm, 4), "prefix_blocks": matched,
+            "unknown": digest is None, "score": round(score, 4),
+        }
+
+    # --------------------------------------------------------------- pick
+
+    def pick(
+        self,
+        candidates: list[dict],
+        fresh_digests: dict[str, dict],
+        local_digest: dict | None = None,
+        prompt: str | None = None,
+    ) -> tuple[dict | None, dict]:
+        """Pick from candidates using fresh digests; returns
+        ``(winner | None, decision)``. The caller handles the no-fresh-
+        digest case (static fallback) — this method assumes scoring is
+        worthwhile, i.e. at least one candidate has a digest."""
+        ph = prompt_prefix_hashes(prompt)
+        max_price = max(
+            (float(c.get("price_per_token") or 0.0) for c in candidates),
+            default=0.0,
+        )
+        scored: list[tuple[float, int, dict, dict]] = []
+        excluded = 0
+        for i, cand in enumerate(candidates):
+            digest = (
+                local_digest if cand.get("local")
+                else fresh_digests.get(cand.get("provider_id"))
+            )
+            if _slo_burning(digest):
+                excluded += 1
+                _C_SLO_EXCLUDED.inc()
+                continue
+            s, breakdown = self.score(
+                cand, digest, cand.get("_latency"), max_price, ph
+            )
+            # deterministic tie-break: local first, then provider id
+            scored.append((s, i, cand, breakdown))
+        if not scored and excluded:
+            # every candidate is burning: serve SOMEWHERE — degraded
+            # routing beats a routable-provider deadlock
+            for i, cand in enumerate(candidates):
+                digest = (
+                    local_digest if cand.get("local")
+                    else fresh_digests.get(cand.get("provider_id"))
+                )
+                s, breakdown = self.score(
+                    cand, digest, cand.get("_latency"), max_price, ph
+                )
+                breakdown["slo_override"] = True
+                scored.append((s, i, cand, breakdown))
+        if not scored:
+            return None, {"mode": MODE_SCORED, "candidates": 0}
+        scored.sort(key=lambda t: (
+            t[0], not t[2].get("local"), str(t[2].get("provider_id"))
+        ))
+        best_score, _, winner, breakdown = scored[0]
+        _C_DECISIONS.inc(mode=MODE_SCORED)
+        if breakdown.get("prefix_blocks"):
+            _C_PREFIX_PREFERRED.inc()
+        return winner, {
+            "mode": MODE_SCORED,
+            "candidates": len(candidates),
+            "slo_excluded": excluded,
+            "winner": winner.get("provider_id"),
+            "breakdown": breakdown,
+        }
+
+
+def static_sort(candidates: list[dict]) -> dict | None:
+    """The legacy sort (reference p2p_runtime.py:744-746): cheapest, then
+    lowest-latency, local as zero latency. Kept as the explicit fallback
+    for when no telemetry digest is fresh — with its known stale-latency
+    wart (``or 1e9`` deprioritizes never-pinged peers) contained to the
+    no-telemetry regime where nothing better is knowable."""
+    if not candidates:
+        return None
+    _C_DECISIONS.inc(mode=MODE_STATIC)
+    return sorted(
+        candidates,
+        key=lambda p: (
+            p.get("price_per_token") or 0.0,
+            0.0 if p.get("local") else (p.get("_latency") or 1e9),
+        ),
+    )[0]

@@ -22,6 +22,8 @@ class FakeService(BaseService):
         chunk_size: int = 4,
         fail_with: str | None = None,
         delay_s: float = 0.0,  # per-chunk stream delay (chaos/latency tests)
+        exec_delay_s: float = 0.0,  # whole-execute() delay: makes a node
+        # saturable for admission/fairness tests and the bench rung
     ):
         super().__init__("fake")
         self.model_name = model_name
@@ -30,6 +32,7 @@ class FakeService(BaseService):
         self.chunk_size = chunk_size
         self.fail_with = fail_with
         self.delay_s = delay_s
+        self.exec_delay_s = exec_delay_s
         self.calls: list[dict] = []
 
     def get_metadata(self) -> dict[str, Any]:
@@ -66,6 +69,8 @@ class FakeService(BaseService):
 
             raise ServiceError(self.fail_with)
         t0 = time.time()
+        if self.exec_delay_s:
+            time.sleep(self.exec_delay_s)  # runs in the node's executor
         text = self._reply_for(params)
         n = len(text.split())
         out = self.result_dict(text, n, t0, self.price_per_token)

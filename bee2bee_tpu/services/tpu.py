@@ -95,6 +95,8 @@ class TPUService(BaseService):
             "repetition_penalty": float(params.get("repetition_penalty", 1.0)),
             "presence_penalty": float(params.get("presence_penalty", 0.0)),
             "frequency_penalty": float(params.get("frequency_penalty", 0.0)),
+            # fairness identity (router/): keys the scheduler's WDRR queue
+            "tenant": str(params.get("tenant") or "default"),
         }
 
     def execute(self, params: dict[str, Any]) -> dict[str, Any]:

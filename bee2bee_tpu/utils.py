@@ -57,6 +57,23 @@ def save_json(path: Path | str, obj: Any) -> None:
         raise
 
 
+def load_json_source(source: str | None, env_var: str,
+                     opener: str = "{") -> Any:
+    """THE inline-JSON-or-file-path config convention (BEE2BEE_SLO_CONFIG,
+    BEE2BEE_TENANTS, BEE2BEE_ADMISSION, BEE2BEE_ROUTER share it): `source`
+    wins, else the env var; a value starting with `opener` parses inline,
+    anything else is a path read and parsed. Returns None when no source
+    is configured at all; parse/read errors raise — these configs fail
+    the node at construction, never route on garbage."""
+    raw = source if source is not None else os.environ.get(env_var)
+    if not raw:
+        return None
+    text = raw.strip()
+    if not text.startswith(opener):
+        text = Path(text).read_text()
+    return json.loads(text)
+
+
 def load_json(path: Path | str, default: Any = None) -> Any:
     try:
         with open(path) as f:

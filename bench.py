@@ -335,6 +335,103 @@ def bench_spec(msl: int, new_tokens: int) -> dict:
     return out
 
 
+def bench_router_fairness(duration_s: float = 6.0) -> dict:
+    """Router-fairness rung (ISSUE 7 acceptance): two tenants at 4:1
+    weights drive an open-loop load (scripts/loadgen.py) against ONE
+    saturated loopback node — admission max_concurrent=1, a FakeService
+    with a fixed per-request delay — and the rung reports per-tenant
+    completed tokens / TTFT / typed-shed counts plus the gold:bronze
+    token ratio, which WDRR fairness should hold near 4.0 under
+    saturation. No model, no accelerator: this rung is platform-
+    independent and runnable standalone via ``python bench.py
+    router_fairness``."""
+    import asyncio
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent))
+    from scripts.loadgen import TenantLoad, run_loadgen
+
+    async def run() -> dict:
+        from aiohttp.test_utils import TestServer
+
+        from bee2bee_tpu.api import build_app
+        from bee2bee_tpu.meshnet.node import P2PNode
+        from bee2bee_tpu.router import (
+            AdmissionConfig,
+            AdmissionController,
+            TenantRegistry,
+            parse_tenant_config,
+        )
+        from bee2bee_tpu.services.fake import FakeService
+
+        node = P2PNode(host="127.0.0.1", port=0)
+        await node.start()
+        server = None
+        try:
+            # 32 tokens/request at ~40 ms each through ONE slot ≈ 25 req/s
+            # capacity; two tenants offering ~25/s each = 2x saturation
+            node.add_service(FakeService(
+                "bench-model", reply="tok " * 32, exec_delay_s=0.04
+            ))
+            node.tenants = TenantRegistry(parse_tenant_config({
+                "gold": {"api_key": "k-gold", "weight": 4},
+                "bronze": {"api_key": "k-bronze", "weight": 1},
+            }))
+            node.admission = AdmissionController(
+                config=AdmissionConfig(
+                    max_concurrent=1, max_queue=512, tenant_queue=400,
+                    queue_timeout_s=duration_s + 60.0,
+                ),
+                weights=node.tenants.weights(),
+            )
+            server = TestServer(build_app(node))
+            await server.start_server()
+            report = await run_loadgen(
+                f"http://127.0.0.1:{server.port}",
+                [
+                    TenantLoad("gold", "k-gold", rate_per_s=25.0,
+                               max_new_tokens=32),
+                    TenantLoad("bronze", "k-bronze", rate_per_s=25.0,
+                               max_new_tokens=32),
+                ],
+                duration_s=duration_s,
+            )
+            gold = report["tenants"]["gold"]
+            bronze = report["tenants"]["bronze"]
+            report["weights"] = {"gold": 4.0, "bronze": 1.0}
+            # the IN-WINDOW ratio: after arrivals stop, draining the
+            # backlog serves everyone regardless of weight, so the total
+            # ratio converges to the arrival ratio — only completions
+            # inside the saturated window show the WDRR allocation
+            report["token_ratio_gold_bronze"] = (
+                round(
+                    gold["completed_tokens_in_window"]
+                    / bronze["completed_tokens_in_window"], 3,
+                )
+                if bronze["completed_tokens_in_window"] else None
+            )
+            report["admission_tenant_tokens"] = dict(
+                node.admission.tenant_tokens
+            )
+            return report
+        finally:
+            if server is not None:
+                await server.close()
+            await node.stop()
+
+    out = asyncio.run(run())
+    log(
+        f"router_fairness rung: gold:bronze in-window token ratio "
+        f"{out.get('token_ratio_gold_bronze')} at 4:1 weights "
+        f"(gold {out['tenants']['gold']['completed_tokens_in_window']:g} "
+        f"tok, bronze "
+        f"{out['tenants']['bronze']['completed_tokens_in_window']:g} tok, "
+        f"rejected {out['tenants']['gold']['rejected']} / "
+        f"{out['tenants']['bronze']['rejected']})"
+    )
+    return out
+
+
 def bench_reference_path() -> float:
     """The reference's hot loop: HF transformers greedy generate on torch CPU
     (reference hf.py:35-44 minus tokenization — token ids in, ids out)."""
@@ -410,6 +507,15 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — the rung must not kill the bench
         log(f"spec rung failed: {e}")
         extras["spec_distilgpt2"] = {"error": str(e)}
+
+    # per-tenant fairness rung (ISSUE 7 acceptance: ~4:1 completed-token
+    # ratio at 4:1 weights under saturation) — model-free and platform-
+    # independent, so it runs on every round
+    try:
+        extras["router_fairness"] = bench_router_fairness()
+    except Exception as e:  # noqa: BLE001 — the rung must not kill the bench
+        log(f"router_fairness rung failed: {e}")
+        extras["router_fairness"] = {"error": str(e)}
 
     if platform == "tpu":
         def rung(key: str, **kw) -> None:
@@ -514,4 +620,10 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    # `python bench.py router_fairness`: the model-free fairness rung
+    # standalone (no accelerator probe, no jax import) — prints the rung's
+    # JSON alone so CI can gate on the token ratio directly
+    if len(sys.argv) > 1 and sys.argv[1] == "router_fairness":
+        print(json.dumps(bench_router_fairness()), flush=True)
+        sys.exit(0)
     main()

@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""Async open-loop load generator with per-tenant arrival rates.
+
+Drives a node's HTTP gateway (``POST /chat``) the way real multi-tenant
+traffic does: each tenant fires requests on its OWN arrival clock
+(exponential inter-arrivals around the configured rate) without waiting
+for completions — an open loop, so a slowing server sees GROWING
+concurrency instead of the self-throttling a closed loop hides behind.
+
+Per tenant it records completions (latency, tokens), typed 429/503
+rejections by ``error_kind`` (the admission contract docs/SERVING.md
+documents), and transport errors. ``bench.py router_fairness`` wires this
+against a saturated loopback node to measure whether two tenants at 4:1
+weights actually complete ~4:1 tokens; it also runs standalone::
+
+    python scripts/loadgen.py http://127.0.0.1:4002 \
+        --tenant gold:k-gold:20 --tenant bronze:k-bronze:20 \
+        --duration 10 --max-new-tokens 32
+
+Only the stdlib + aiohttp — no model, no jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import random
+import sys
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class TenantLoad:
+    """One tenant's traffic shape + credentials."""
+
+    name: str
+    api_key: str | None = None
+    rate_per_s: float = 5.0
+    prompt: str = "loadgen: say hi"
+    max_new_tokens: int = 32
+
+
+@dataclass
+class TenantStats:
+    sent: int = 0
+    completed: int = 0
+    completed_tokens: float = 0.0
+    rejected: dict = field(default_factory=dict)  # error_kind -> count
+    errors: int = 0
+    latencies_s: list = field(default_factory=list)
+    finishes: list = field(default_factory=list)  # (t_done, tokens)
+
+    def summary(self, window_end: float | None = None) -> dict:
+        lats = sorted(self.latencies_s)
+
+        def pct(q: float):
+            return round(lats[min(int(q * len(lats)), len(lats) - 1)], 4) if lats else None
+
+        out = {
+            "sent": self.sent,
+            "completed": self.completed,
+            "completed_tokens": self.completed_tokens,
+            "rejected": dict(self.rejected),
+            "errors": self.errors,
+            # non-streamed requests against a fast backend: latency ≈ TTFT
+            "ttft_p50_s": pct(0.50),
+            "ttft_p95_s": pct(0.95),
+            "throughput_tok_per_s": None,  # filled by run_loadgen (needs wall)
+        }
+        if window_end is not None:
+            # completions inside the offered-load window: THE fairness
+            # measurement. After arrivals stop, the drain phase serves the
+            # whole backlog regardless of weights (nothing competes), so
+            # total completions converge to the ARRIVAL ratio — only the
+            # saturated window shows the WDRR service allocation.
+            in_w = [(t, n) for t, n in self.finishes if t <= window_end]
+            out["completed_in_window"] = len(in_w)
+            out["completed_tokens_in_window"] = float(sum(n for _, n in in_w))
+        return out
+
+
+async def _fire(session, base_url: str, t: TenantLoad, stats: TenantStats):
+    import aiohttp
+
+    headers = {"X-API-KEY": t.api_key} if t.api_key else {}
+    body = {
+        "prompt": t.prompt,
+        "max_new_tokens": t.max_new_tokens,
+        "stream": False,
+        "temperature": 0.0,
+    }
+    t0 = time.perf_counter()
+    try:
+        async with session.post(
+            f"{base_url}/chat", json=body, headers=headers,
+            timeout=aiohttp.ClientTimeout(total=120),
+        ) as r:
+            if r.status in (429, 503):
+                try:
+                    err = await r.json()
+                except ValueError:
+                    err = {}
+                kind = err.get("error_kind") or f"http_{r.status}"
+                stats.rejected[kind] = stats.rejected.get(kind, 0) + 1
+                return
+            if r.status != 200:
+                stats.errors += 1
+                return
+            result = await r.json()
+    except Exception:  # noqa: BLE001 — a dropped socket is a data point
+        stats.errors += 1
+        return
+    t_done = time.perf_counter()
+    stats.completed += 1
+    stats.completed_tokens += float(result.get("tokens") or 0)
+    stats.latencies_s.append(t_done - t0)
+    stats.finishes.append((t_done, float(result.get("tokens") or 0)))
+
+
+async def _tenant_loop(session, base_url: str, t: TenantLoad,
+                       stats: TenantStats, until: float, tasks: set):
+    """Open loop: fire-and-track on an exponential arrival clock."""
+    while time.perf_counter() < until:
+        stats.sent += 1
+        task = asyncio.ensure_future(_fire(session, base_url, t, stats))
+        tasks.add(task)
+        task.add_done_callback(tasks.discard)
+        # exponential inter-arrival around 1/rate — Poisson-ish traffic,
+        # so bursts and gaps both happen (fixed spacing flatters WDRR)
+        await asyncio.sleep(random.expovariate(t.rate_per_s))
+
+
+async def run_loadgen(base_url: str, tenants: list[TenantLoad],
+                      duration_s: float = 10.0,
+                      drain_s: float = 30.0) -> dict:
+    """Drive every tenant concurrently for duration_s, then wait (bounded)
+    for in-flight requests to drain; returns {tenant: summary}."""
+    import aiohttp
+
+    base_url = base_url.rstrip("/")
+    stats = {t.name: TenantStats() for t in tenants}
+    inflight: set = set()
+    until = time.perf_counter() + duration_s
+    t_start = time.perf_counter()
+    async with aiohttp.ClientSession() as session:
+        await asyncio.gather(*(
+            _tenant_loop(session, base_url, t, stats[t.name], until, inflight)
+            for t in tenants
+        ))
+        if inflight:
+            await asyncio.wait(set(inflight), timeout=drain_s)
+        for task in list(inflight):
+            task.cancel()
+    wall = time.perf_counter() - t_start
+    out = {}
+    for t in tenants:
+        s = stats[t.name].summary(window_end=until)
+        s["offered_rate_per_s"] = t.rate_per_s
+        s["throughput_tok_per_s"] = (
+            round(stats[t.name].completed_tokens / wall, 2) if wall > 0 else 0.0
+        )
+        out[t.name] = s
+    return {"wall_s": round(wall, 3), "window_s": duration_s, "tenants": out}
+
+
+def _parse_tenant(spec: str) -> TenantLoad:
+    """name[:api_key[:rate_per_s]]"""
+    parts = spec.split(":")
+    t = TenantLoad(name=parts[0])
+    if len(parts) > 1 and parts[1]:
+        t.api_key = parts[1]
+    if len(parts) > 2 and parts[2]:
+        t.rate_per_s = float(parts[2])
+    return t
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("base_url")
+    ap.add_argument("--tenant", action="append", default=[],
+                    help="name[:api_key[:rate_per_s]] (repeatable)")
+    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--max-new-tokens", type=int, default=32)
+    ap.add_argument("--prompt", default="loadgen: say hi")
+    args = ap.parse_args()
+    tenants = [_parse_tenant(s) for s in args.tenant] or [TenantLoad("default")]
+    for t in tenants:
+        t.max_new_tokens = args.max_new_tokens
+        t.prompt = args.prompt
+    report = asyncio.run(run_loadgen(args.base_url, tenants, args.duration))
+    print(json.dumps(report, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

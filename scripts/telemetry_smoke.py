@@ -18,7 +18,11 @@ exercises the health plane (ISSUE 6):
   reports both peers' digests (and the Prometheus view carries one
   ``peer``-labeled series per fresh peer);
 - ``/slo`` parses, with every configured objective present and carrying
-  a burn-rate evaluation.
+  a burn-rate evaluation;
+- telemetry-driven routing (router/policy.py) actually consumes the
+  gossip: with a's digest fresh in b's HealthStore, ``b.pick_provider``
+  takes the SCORED path (not the static fallback) and picks the live
+  serving peer.
 
 No model loads, no accelerator touched — this must stay cheap enough to
 run before every boot. Exit 0 on success, 1 with a reason on failure.
@@ -180,6 +184,20 @@ async def run_mesh_health_smoke() -> None:
                 assert "burn_rate_fast" in o and "status" in o, (
                     f"objective {o.get('name')} missing burn-rate fields"
                 )
+
+        # /mesh/health-driven routing: b holds a's FRESH digest, so the
+        # scored path (not the static fallback) must pick the live peer
+        from bee2bee_tpu.metrics import get_registry
+
+        scored0 = get_registry().counter("router.decisions").value(mode="scored")
+        prov = b.pick_provider("smoke-model", prompt="smoke")
+        assert prov is not None and prov["provider_id"] == a.peer_id, (
+            f"router picked {prov!r}, expected the serving peer {a.peer_id}"
+        )
+        assert (
+            get_registry().counter("router.decisions").value(mode="scored")
+            == scored0 + 1
+        ), "pick_provider did not take the telemetry-scored path"
     finally:
         for client in clients:
             await client.close()

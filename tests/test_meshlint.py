@@ -354,6 +354,51 @@ def test_sampling_keys_are_in_the_declared_universe():
     assert set(protocol.SAMPLING_KEYS) <= declared_key_universe()
 
 
+def test_tenant_and_admission_keys_are_declared():
+    """ISSUE 7: the tenant identity field rides gen_request, and every
+    admission rejection (the typed 429/503 contract over p2p) carries
+    error_kind + retry_after_s on GEN_ERROR — pinned here so a protocol
+    change can't drop them from the registry silently."""
+    assert protocol.TENANT in FRAME_SCHEMAS[protocol.GEN_REQUEST].allowed_keys()
+    gen_error = FRAME_SCHEMAS[protocol.GEN_ERROR]
+    assert {"error_kind", "retry_after_s"} <= gen_error.allowed_keys()
+    assert {protocol.TENANT, "error_kind", "retry_after_s"} <= declared_key_universe()
+
+
+def test_admission_rejection_fixture_pins_typed_fields():
+    """A GEN_ERROR admission rejection with a typo'd retry field (the
+    header-style `retry_after` instead of the wire's `retry_after_s`) is
+    exactly the silently-dropped-key class meshlint exists for; the
+    correctly-typed construction passes clean."""
+    bad = '''
+from .. import protocol
+
+async def reject(ws, rid, rej):
+    await ws.send(protocol.encode(protocol.msg(
+        protocol.GEN_ERROR, rid=rid, error="admission_rejected: rate",
+        error_kind="rate_limited", retry_after=1.0)))
+'''
+    rules = _rules(analyze_source(bad, "meshnet/fixture.py"))
+    assert "ML-F001" in rules, rules
+    good = bad.replace("retry_after=1.0", "retry_after_s=1.0")
+    assert analyze_source(good, "meshnet/fixture.py") == []
+
+
+def test_seeded_admission_rejection_typo_is_caught_in_real_node():
+    """Seed the retry_after_s typo into node.py's REAL admission-reject
+    frame literal: the frames pass must flag it (proves the real
+    construction is statically checked, not spread-exempted)."""
+    src = (PACKAGE_ROOT / "meshnet" / "node.py").read_text()
+    seeded = src.replace(
+        "retry_after_s=rej.retry_after_s,", "retry_after=rej.retry_after_s,", 1
+    )
+    assert seeded != src, "node.py admission-reject literal moved; update the seed"
+    assert any(
+        f.rule == "ML-F001" and "retry_after" in f.message
+        for f in analyze_source(seeded, "meshnet/node.py")
+    )
+
+
 def test_rule_catalog_covers_all_emitted_rules():
     cat = rule_catalog()
     for rule in ("ML-F001", "ML-F002", "ML-F003", "ML-F004",

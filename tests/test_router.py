@@ -1,0 +1,895 @@
+"""SLO-aware front door tests (ISSUE 7): routing policy scoring, WDRR
+fairness, admission control's typed 429/503 contract, tenant identity
+flow, client Retry-After handling, and the 3-node loopback mesh
+acceptance walk (requests drain to the unloaded node)."""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from bee2bee_tpu.router import (
+    AdmissionConfig,
+    AdmissionController,
+    AdmissionReject,
+    PrefixTracker,
+    RouterPolicy,
+    RouterWeights,
+    TenantRegistry,
+    WdrrQueue,
+    load_admission_config,
+    load_router_weights,
+    load_tenant_config,
+    match_depth,
+    parse_tenant_config,
+    prompt_prefix_hashes,
+)
+from bee2bee_tpu.router.admission import (
+    KIND_POOL,
+    KIND_QUEUE,
+    KIND_RATE,
+    KIND_SLO,
+    KIND_TENANT_QUEUE,
+    KIND_TIMEOUT,
+)
+
+# ------------------------------------------------------------ WDRR fairness
+
+
+def test_wdrr_ratio_tracks_weights_under_saturation():
+    q = WdrrQueue(weights={"gold": 4.0, "bronze": 1.0}, quantum=32.0)
+    for i in range(100):
+        q.append(("gold", i), tenant="gold", cost=32.0)
+        q.append(("bronze", i), tenant="bronze", cost=32.0)
+    served = [q.popleft()[0] for _ in range(50)]
+    gold = served.count("gold")
+    # 4:1 weights with equal costs: 40 of the first 50 pops are gold
+    assert gold == 40, served
+    assert len(q) == 150
+
+
+def test_wdrr_cost_weighted_fairness_in_tokens():
+    """Fairness is in TOKENS: a tenant asking 4x longer generations gets
+    ~4x fewer slots at equal weights."""
+    q = WdrrQueue(quantum=64.0)
+    for i in range(50):
+        q.append(("big", i), tenant="big", cost=256.0)
+        q.append(("small", i), tenant="small", cost=64.0)
+    served = [q.popleft()[0] for _ in range(25)]
+    assert served.count("small") == pytest.approx(4 * served.count("big"), abs=2)
+
+
+def test_wdrr_deficit_resets_on_drain():
+    """An idle tenant must not bank credit: after its queue drains, its
+    deficit resets, so returning traffic competes from zero."""
+    q = WdrrQueue(weights={"a": 10.0, "b": 1.0}, quantum=100.0)
+    q.append("a1", tenant="a", cost=1.0)
+    assert q.popleft() == "a1"  # drains a; deficit resets to 0
+    assert q._deficit["a"] == 0.0
+    q.append("b1", tenant="b", cost=1.0)
+    assert q.popleft() == "b1"
+
+
+def test_wdrr_appendleft_refunds_cost():
+    """The scheduler's pool-backpressure requeue must not double-bill:
+    appendleft refunds the cost charged at the original pop."""
+    q = WdrrQueue(quantum=8.0)
+    q.append("r1", tenant="t", cost=64.0)
+    got = q.popleft()
+    q.appendleft(got, tenant="t", cost=64.0)
+    # immediately affordable again — no quantum accumulation rounds needed
+    assert q._deficit["t"] >= 64.0
+    assert q.popleft() == "r1"
+
+
+def test_wdrr_refund_restores_share_for_abandoned_items():
+    """A popped-then-abandoned item (timed-out waiter, cancelled request)
+    refunds its deficit so the tenant's live work keeps its weighted
+    share; with nothing left queued the refund is dropped (no banking)."""
+    q = WdrrQueue(weights={"a": 1.0, "b": 1.0}, quantum=32.0)
+    for i in range(4):
+        q.append(("a", i), tenant="a", cost=32.0)
+        q.append(("b", i), tenant="b", cost=32.0)
+    popped = q.popleft()  # charges 32 to its tenant
+    tenant = popped[0]
+    before = q._deficit[tenant]
+    q.refund(tenant, 32.0)
+    assert q._deficit[tenant] == before + 32.0
+    q.clear()
+    q.refund("a", 32.0)  # nothing queued: dropped, no banked credit
+    assert q._deficit.get("a", 0.0) == 0.0
+
+
+def test_wdrr_deque_protocol():
+    q = WdrrQueue()
+    with pytest.raises(IndexError):
+        q.popleft()
+    q.append("x")
+    q.append("y", tenant="other")
+    assert len(q) == 2 and bool(q)
+    assert set(q) == {"x", "y"}
+    q.clear()
+    assert not q and list(q) == []
+
+
+# ------------------------------------------------------------------ tenants
+
+
+def test_parse_tenant_config_validates_loudly():
+    specs = parse_tenant_config({
+        "acme": {"api_key": "k1", "weight": 4, "rate_tokens_per_min": 600},
+        "hobby": {"api_key": "k2"},
+    })
+    assert specs["acme"].weight == 4.0
+    assert specs["acme"].rate_tokens_per_s == pytest.approx(10.0)
+    assert specs["acme"].burst == 600.0  # default burst = one minute of rate
+    assert specs["hobby"].weight == 1.0
+    with pytest.raises(ValueError, match="unknown keys"):
+        parse_tenant_config({"t": {"wieght": 2}})
+    with pytest.raises(ValueError, match="weight"):
+        parse_tenant_config({"t": {"weight": 0}})
+    with pytest.raises(ValueError, match="reused"):
+        parse_tenant_config({"a": {"api_key": "k"}, "b": {"api_key": "k"}})
+    with pytest.raises(ValueError, match="JSON object"):
+        parse_tenant_config(["not", "a", "dict"])
+
+
+def test_tenant_registry_resolution_and_clamp():
+    reg = TenantRegistry(parse_tenant_config({
+        "acme": {"api_key": "k1", "weight": 4},
+    }))
+    assert reg.resolve_key("k1") == "acme"
+    assert reg.resolve_key("nope") is None
+    assert reg.resolve_key(None) is None
+    # wire claims clamp to configured names — unbounded peer-controlled
+    # strings must not mint queues or metric series
+    assert reg.clamp("acme") == "acme"
+    assert reg.clamp("made-up-by-a-peer") == "default"
+    assert reg.clamp(None) == "default"
+    assert reg.weights() == {"acme": 4.0}
+    assert reg.budgets() == {}
+
+
+def test_load_tenant_config_env(monkeypatch):
+    monkeypatch.setenv(
+        "BEE2BEE_TENANTS", '{"t1": {"api_key": "k", "weight": 2}}'
+    )
+    assert load_tenant_config()["t1"].weight == 2.0
+    monkeypatch.delenv("BEE2BEE_TENANTS")
+    assert load_tenant_config() == {}
+    assert load_admission_config().max_concurrent == 32
+    assert load_router_weights().queue == pytest.approx(0.30)
+
+
+# ---------------------------------------------------------------- prefixmap
+
+
+def test_prefix_hashes_are_chained_and_blocked():
+    p = "a" * 600  # 2 full 256-char blocks
+    h = prompt_prefix_hashes(p)
+    assert len(h) == 2
+    # chained: a longer prompt with the same leading blocks shares them
+    assert prompt_prefix_hashes("a" * 1024)[:2] == h
+    # a different first block changes EVERY hash downstream
+    assert prompt_prefix_hashes("b" + "a" * 599)[0] != h[0]
+    assert prompt_prefix_hashes("short") == []
+    assert prompt_prefix_hashes(None) == []
+
+
+def test_prefix_tracker_and_match_depth():
+    tr = PrefixTracker(capacity=8, advertise=4)
+    tr.note("x" * 1200)  # 4 blocks
+    adv = tr.advertised()
+    assert len(adv) == 4
+    assert match_depth(prompt_prefix_hashes("x" * 1200), adv) == 4
+    # a prompt sharing only the first block matches at depth 1
+    probe = prompt_prefix_hashes("x" * 256 + "y" * 512)
+    assert match_depth(probe, adv) == 1
+    assert match_depth([], adv) == 0
+    for i in range(10):  # capacity bound holds under churn
+        tr.note(f"{i}" * 600)
+    assert len(tr) <= 8
+
+
+# ------------------------------------------------------------ policy scoring
+
+
+def _cand(pid, price=0.0, rtt=20.0, local=False):
+    return {"provider_id": pid, "local": local, "price_per_token": price,
+            "_latency": None if local else rtt, "models": ["m"]}
+
+
+def test_scorer_headroom_beats_price():
+    """A loaded cheap peer loses to a pricier idle one — the exact
+    blindness of the reference's cheapest-first sort."""
+    pol = RouterPolicy(RouterWeights())
+    cheap_loaded = _cand("cheap", price=0.1)
+    pricey_idle = _cand("pricey", price=0.5)
+    fresh = {
+        "cheap": {"gauge": {"engine.batch_fill": 0.9},
+                  "hist": {"engine.queue_wait_ms": {"p95": 2000.0}}},
+        "pricey": {"gauge": {"engine.batch_fill": 0.0},
+                   "hist": {"engine.queue_wait_ms": {"p95": 1.0}}},
+    }
+    winner, decision = pol.pick([cheap_loaded, pricey_idle], fresh)
+    assert winner["provider_id"] == "pricey"
+    assert decision["mode"] == "scored"
+
+
+def test_scorer_prefix_match_beats_headroom_within_tolerance():
+    pol = RouterPolicy(RouterWeights())
+    prompt = "x" * 600  # 2 blocks
+    warm = _cand("warm")
+    cold = _cand("cold")
+    fresh = {
+        "warm": {"gauge": {"engine.batch_fill": 0.62},
+                 "prefix_hashes": prompt_prefix_hashes(prompt)},
+        "cold": {"gauge": {"engine.batch_fill": 0.50}},
+    }
+    # slightly busier but holds the prompt's prefix: warm wins
+    winner, decision = pol.pick([warm, cold], fresh, prompt=prompt)
+    assert winner["provider_id"] == "warm"
+    assert decision["breakdown"]["prefix_blocks"] == 2
+    # OUTRIGHT loaded: the prefix bonus must not override real headroom
+    fresh["warm"]["gauge"]["engine.batch_fill"] = 0.95
+    fresh["cold"]["gauge"]["engine.batch_fill"] = 0.0
+    winner, _ = pol.pick([warm, cold], fresh, prompt=prompt)
+    assert winner["provider_id"] == "cold"
+
+
+def test_scorer_burning_slo_peer_excluded():
+    pol = RouterPolicy()
+    burning_idle = _cand("burning")
+    healthy_loaded = _cand("healthy")
+    fresh = {
+        "burning": {"gauge": {"engine.batch_fill": 0.0},
+                    "slo": {"ttft_p95": {"status": "burning",
+                                         "burn_fast": 8.0, "burn_slow": 7.0}}},
+        "healthy": {"gauge": {"engine.batch_fill": 0.8}},
+    }
+    winner, decision = pol.pick([burning_idle, healthy_loaded], fresh)
+    assert winner["provider_id"] == "healthy"
+    assert decision["slo_excluded"] == 1
+    # every candidate burning: exclusion is waived — degraded routing
+    # beats a routable-provider deadlock
+    fresh["healthy"]["slo"] = {"e": {"status": "tripped"}}
+    winner, _ = pol.pick([burning_idle, healthy_loaded], fresh)
+    assert winner is not None
+
+
+def test_scorer_unknown_tier_fixes_stale_latency_bug():
+    """The pick_provider bug class: a never-pinged peer (no RTT, no
+    digest) used to sort at _latency=1e9 — permanently last. Under the
+    scored path it gets the neutral unknown tier and beats a peer that is
+    DEMONSTRABLY loaded."""
+    pol = RouterPolicy()
+    known_loaded = _cand("known", rtt=20.0)
+    never_pinged = _cand("fresh-joiner", rtt=None)
+    fresh = {
+        "known": {"gauge": {"engine.batch_fill": 0.9},
+                  "hist": {"engine.queue_wait_ms": {"p95": 4000.0}}},
+        # fresh-joiner has no digest at all
+    }
+    winner, decision = pol.pick([known_loaded, never_pinged], fresh)
+    assert winner["provider_id"] == "fresh-joiner"
+    assert decision["breakdown"]["unknown"] is True
+
+
+# ------------------------------------------------------- admission control
+
+
+async def test_admission_admit_and_release_slots():
+    ctrl = AdmissionController(AdmissionConfig(max_concurrent=2))
+    t1 = await ctrl.acquire("default", cost_tokens=16)
+    t2 = await ctrl.acquire("default", cost_tokens=16)
+    assert ctrl.inflight == 2
+    t1.release()
+    t1.release()  # idempotent
+    assert ctrl.inflight == 1
+    async with await ctrl.acquire("default") as t3:
+        assert ctrl.inflight == 2
+        t3.note_tokens(32)
+    assert ctrl.inflight == 1
+    assert ctrl.tenant_tokens["default"] == 32.0
+    t2.release()
+
+
+async def test_admission_queue_grants_in_wdrr_order():
+    ctrl = AdmissionController(
+        AdmissionConfig(max_concurrent=1, quantum=64.0),
+        weights={"gold": 4.0, "bronze": 1.0},
+    )
+    first = await ctrl.acquire("gold", cost_tokens=64)
+    order: list[str] = []
+
+    async def worker(tenant):
+        t = await ctrl.acquire(tenant, cost_tokens=64)
+        order.append(tenant)
+        t.release()
+
+    tasks = [asyncio.ensure_future(worker("gold")) for _ in range(8)]
+    tasks += [asyncio.ensure_future(worker("bronze")) for _ in range(8)]
+    await asyncio.sleep(0)  # let every worker enqueue
+    assert ctrl.queued == 16
+    first.release()
+    await asyncio.gather(*tasks)
+    # 4:1 weights at equal cost: 8 of the first 10 grants are gold
+    assert order[:10].count("gold") == 8, order
+    assert ctrl.queued == 0 and ctrl.inflight == 0
+
+
+async def test_admission_rate_budget_rejects_429_with_eta():
+    ctrl = AdmissionController(
+        AdmissionConfig(),
+        budgets={"acme": (10.0, 100.0)},  # 10 tok/s, burst 100
+    )
+    t = await ctrl.acquire("acme", cost_tokens=100)  # burst spent
+    t.release()
+    with pytest.raises(AdmissionReject) as ei:
+        await ctrl.acquire("acme", cost_tokens=50)
+    rej = ei.value
+    assert rej.kind == KIND_RATE and rej.status == 429
+    # 50 tokens at 10/s ≈ 5 s refill ETA rides Retry-After
+    assert 3.0 <= rej.retry_after_s <= 6.0
+    # an unbudgeted tenant is unaffected
+    (await ctrl.acquire("default", cost_tokens=10_000)).release()
+
+
+async def test_admission_queue_bounds_and_timeout():
+    ctrl = AdmissionController(AdmissionConfig(
+        max_concurrent=1, max_queue=2, tenant_queue=1, queue_timeout_s=0.1,
+    ))
+    held = await ctrl.acquire("default")
+    w1 = asyncio.ensure_future(ctrl.acquire("a", cost_tokens=1))
+    await asyncio.sleep(0)
+    # per-tenant bound: tenant "a" already has its share queued -> 429
+    with pytest.raises(AdmissionReject) as ei:
+        await ctrl.acquire("a")
+    assert ei.value.kind == KIND_TENANT_QUEUE and ei.value.status == 429
+    w2 = asyncio.ensure_future(ctrl.acquire("b", cost_tokens=1))
+    await asyncio.sleep(0)
+    # node-wide bound -> 503
+    with pytest.raises(AdmissionReject) as ei:
+        await ctrl.acquire("c")
+    assert ei.value.kind == KIND_QUEUE and ei.value.status == 503
+    # the held slot never frees: both waiters age out typed -> 503,
+    # nobody hangs
+    with pytest.raises(AdmissionReject) as ei:
+        await w1
+    assert ei.value.kind == KIND_TIMEOUT and ei.value.status == 503
+    with pytest.raises(AdmissionReject):
+        await w2
+    # ghost waiters must not keep occupying the queue bounds: a stalled
+    # node rejecting new arrivals against a queue of DEAD waiters would
+    # make the advertised Retry-After a lie
+    assert ctrl.queued == 0
+    w3 = asyncio.ensure_future(ctrl.acquire("a", cost_tokens=1))
+    await asyncio.sleep(0)
+    assert ctrl.queued == 1  # tenant "a"'s share is free again
+    held.release()
+    (await w3).release()
+    # abandoned waiters must not leak the freed slot
+    (await ctrl.acquire("default")).release()
+
+
+async def test_admission_budget_refunded_on_timeout_and_skipped_on_bounds():
+    """Overload must not become a rate-limit lockout: a queue-timed-out
+    request refunds its charged tokens, and a bound-rejected request is
+    never charged at all."""
+    ctrl = AdmissionController(
+        AdmissionConfig(max_concurrent=1, max_queue=1, queue_timeout_s=0.05),
+        budgets={"acme": (1.0, 100.0)},  # 100-token burst, slow refill
+    )
+    held = await ctrl.acquire("default")
+    with pytest.raises(AdmissionReject) as ei:
+        await ctrl.acquire("acme", cost_tokens=100)  # queued, then aged out
+    assert ei.value.kind == KIND_TIMEOUT
+    # a second saturated attempt hits the node-wide bound BEFORE the
+    # budget — also uncharged
+    blocker = asyncio.ensure_future(ctrl.acquire("default", cost_tokens=1))
+    await asyncio.sleep(0)
+    with pytest.raises(AdmissionReject) as ei:
+        await ctrl.acquire("acme", cost_tokens=100)
+    assert ei.value.kind == KIND_QUEUE
+    held.release()
+    (await blocker).release()
+    # the full burst is still there: the failed attempts cost nothing
+    (await ctrl.acquire("acme", cost_tokens=100)).release()
+
+
+async def test_admission_oversized_ask_clamps_to_burst():
+    """A cost above the burst must stay SATISFIABLE (charging the whole
+    burst), not be rejected forever with a finite Retry-After that
+    well-behaved clients obey in a futile loop."""
+    now = {"t": 1000.0}
+    ctrl = AdmissionController(
+        AdmissionConfig(),
+        budgets={"small": (10.0, 100.0)},  # burst 100 < default 2048 ask
+        now=lambda: now["t"],
+    )
+    (await ctrl.acquire("small", cost_tokens=2048)).release()  # admits
+    with pytest.raises(AdmissionReject) as ei:
+        await ctrl.acquire("small", cost_tokens=2048)  # bucket drained
+    # the ETA is for the CLAMPED ask — finite and honest
+    assert ei.value.retry_after_s == pytest.approx(10.0, abs=0.5)
+    now["t"] += 11.0  # refill the burst at 10 tok/s
+    (await ctrl.acquire("small", cost_tokens=2048)).release()
+
+
+async def test_admission_slo_shed_and_pool_shed():
+    burn = {"v": 0.0}
+    pool = {"v": None}
+    ctrl = AdmissionController(
+        AdmissionConfig(max_concurrent=1, shed_burn_rate=6.0,
+                        pool_free_frac_min=0.05),
+        slo_burn=lambda: burn["v"],
+        pool_free_fraction=lambda: pool["v"],
+    )
+    (await ctrl.acquire("default")).release()  # healthy: admits
+    burn["v"] = 7.5
+    with pytest.raises(AdmissionReject) as ei:
+        await ctrl.acquire("default")
+    assert ei.value.kind == KIND_SLO and ei.value.status == 503
+    assert ei.value.retry_after_s == pytest.approx(5.0)
+    burn["v"] = 0.0
+    # pool pressure sheds ONLY when every slot is busy too
+    pool["v"] = 0.01
+    held = await ctrl.acquire("default")
+    with pytest.raises(AdmissionReject) as ei:
+        await ctrl.acquire("default")
+    assert ei.value.kind == KIND_POOL and ei.value.status == 503
+    held.release()
+    (await ctrl.acquire("default")).release()  # slots free again: admits
+
+
+# ------------------------------------------------------ client typed errors
+
+
+async def _one_route_app(handler, path="/", method="GET"):
+    app = web.Application()
+    app.router.add_route(method, path, handler)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+async def test_client_get_honors_retry_after_on_429():
+    from bee2bee_tpu.client import NodeClient
+
+    calls = {"n": 0}
+
+    async def handler(request):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return web.json_response(
+                {"detail": "busy", "error_kind": "queue_full",
+                 "retry_after_s": 0.02},
+                status=429, headers={"Retry-After": "1"},
+            )
+        return web.json_response({"status": "ok"})
+
+    server = await _one_route_app(handler)
+    try:
+        c = NodeClient(str(server.make_url("/")), retries=2,
+                       retry_backoff_s=0.01)
+        out = await c._get("/")
+        assert out == {"status": "ok"}
+        assert calls["n"] == 2  # one typed 429, one retry after backoff
+    finally:
+        await server.close()
+
+
+async def test_client_post_never_retries_but_types_the_overload():
+    from bee2bee_tpu.client import MeshOverloaded, NodeClient
+
+    calls = {"n": 0}
+
+    async def handler(request):
+        calls["n"] += 1
+        return web.json_response(
+            {"detail": "pool dry", "error_kind": "pool_exhausted",
+             "retry_after_s": 5.0},
+            status=503, headers={"Retry-After": "5"},
+        )
+
+    server = await _one_route_app(handler, path="/chat", method="POST")
+    try:
+        c = NodeClient(str(server.make_url("/")), retries=3)
+        with pytest.raises(MeshOverloaded) as ei:
+            await c.chat("hi")
+        err = ei.value
+        assert err.status == 503
+        assert err.error_kind == "pool_exhausted"
+        assert err.retry_after_s == pytest.approx(5.0)
+        assert calls["n"] == 1, "a POST (generate may have run) must not retry"
+    finally:
+        await server.close()
+
+
+# --------------------------------------------------------- API integration
+
+
+async def _node_app(node, api_key=None):
+    from bee2bee_tpu.api import build_app
+
+    client = TestClient(TestServer(build_app(node, api_key=api_key)))
+    await client.start_server()
+    return client
+
+
+async def test_api_admission_rejection_is_typed_with_retry_after():
+    from bee2bee_tpu.meshnet.node import P2PNode
+    from bee2bee_tpu.services.fake import FakeService
+
+    node = P2PNode(host="127.0.0.1", port=0)
+    await node.start()
+    client = None
+    try:
+        node.add_service(FakeService("m", reply="ok"))
+        node.admission = AdmissionController(
+            AdmissionConfig(), slo_burn=lambda: 99.0
+        )
+        client = await _node_app(node)
+        r = await client.post("/chat", json={"prompt": "x", "model": "m"})
+        assert r.status == 503
+        assert r.headers["Retry-After"] == "5"
+        body = await r.json()
+        assert body["error_kind"] == KIND_SLO
+        assert body["retry_after_s"] == pytest.approx(5.0)
+        # the /v1 surface wraps the same contract in an OpenAI error object
+        r = await client.post(
+            "/v1/completions", json={"prompt": "x", "model": "m"}
+        )
+        assert r.status == 503 and "Retry-After" in r.headers
+        body = await r.json()
+        assert body["error"]["error_kind"] == KIND_SLO
+    finally:
+        if client is not None:
+            await client.close()
+        await node.stop()
+
+
+async def test_api_tenant_key_authenticates_and_flows_to_service():
+    from bee2bee_tpu.meshnet.node import P2PNode
+    from bee2bee_tpu.services.fake import FakeService
+
+    node = P2PNode(host="127.0.0.1", port=0)
+    await node.start()
+    client = None
+    try:
+        svc = FakeService("m", reply="ok")
+        node.add_service(svc)
+        node.tenants = TenantRegistry(parse_tenant_config({
+            "acme": {"api_key": "k-acme", "weight": 4},
+        }))
+        client = await _node_app(node, api_key="node-key")
+        # a tenant key opens the door it is billed through
+        r = await client.post("/chat", json={"prompt": "x", "model": "m"},
+                              headers={"X-API-KEY": "k-acme"})
+        assert r.status == 200
+        assert svc.calls[-1]["tenant"] == "acme"
+        # the node key still works and bills the default tenant
+        r = await client.post("/chat", json={"prompt": "x", "model": "m"},
+                              headers={"X-API-KEY": "node-key"})
+        assert r.status == 200
+        assert svc.calls[-1]["tenant"] == "default"
+        # a wrong key is still a 401
+        r = await client.post("/chat", json={"prompt": "x", "model": "m"},
+                              headers={"X-API-KEY": "wrong"})
+        assert r.status == 401
+        # STREAMED completions bill the tenant too (the done line carries
+        # the real token count)
+        before = node.admission.tenant_tokens.get("acme", 0.0)
+        r = await client.post(
+            "/chat", json={"prompt": "x", "model": "m", "stream": True},
+            headers={"X-API-KEY": "k-acme"},
+        )
+        assert r.status == 200
+        await r.read()  # drain the stream to completion
+        assert node.admission.tenant_tokens.get("acme", 0.0) > before
+    finally:
+        if client is not None:
+            await client.close()
+        await node.stop()
+
+
+async def test_remote_admission_rejection_keeps_typed_status_at_gateway():
+    """A shed one hop away must stay a 429/503 + Retry-After at the
+    gateway's HTTP surface — not collapse into a 500 that defeats client
+    backoff."""
+    from bee2bee_tpu.meshnet.node import P2PNode
+    from bee2bee_tpu.services.fake import FakeService
+    from tests.test_meshnet import _settle
+
+    serving = P2PNode(host="127.0.0.1", port=0)
+    gateway = P2PNode(host="127.0.0.1", port=0)
+    await serving.start()
+    await gateway.start()
+    client = None
+    try:
+        serving.add_service(FakeService("m", reply="never"))
+        serving.admission = AdmissionController(
+            AdmissionConfig(), slo_burn=lambda: 50.0
+        )
+        assert await gateway.connect_bootstrap(serving.addr)
+        assert await _settle(lambda: gateway.providers)
+        client = await _node_app(gateway)  # gateway has NO local service
+        r = await client.post("/chat", json={"prompt": "x", "model": "m"})
+        assert r.status == 503
+        assert "Retry-After" in r.headers
+        body = await r.json()
+        assert body["error_kind"] == KIND_SLO
+        # STREAMING must keep the contract too: the shed arrives before
+        # any chunk, so the response is a real 503 — not a 200 whose body
+        # smuggles an error line past every client's backoff logic
+        r = await client.post(
+            "/chat", json={"prompt": "x", "model": "m", "stream": True}
+        )
+        assert r.status == 503
+        assert "Retry-After" in r.headers
+        body = await r.json()
+        assert body["error_kind"] == KIND_SLO
+    finally:
+        if client is not None:
+            await client.close()
+        await gateway.stop()
+        await serving.stop()
+
+
+# ----------------------------------------------------------- mesh routing
+
+
+async def test_mesh_routing_drains_to_unloaded_node():
+    """The acceptance walk: three live nodes, two providers — one
+    artificially loaded (its gossiped digest reports a deep queue and a
+    full batch). ≥80% of new sessions must land on the unloaded node.
+
+    In-process loopback nodes share the process-global metrics registry,
+    so the LOAD differential is injected at the HealthStore (the exact
+    surface real gossip writes through)."""
+    from bee2bee_tpu.meshnet.node import P2PNode
+    from bee2bee_tpu.services.fake import FakeService
+    from tests.test_meshnet import _settle
+
+    nodes = [P2PNode(host="127.0.0.1", port=0) for _ in range(3)]
+    for n in nodes:
+        await n.start()
+    router_node, idle, loaded = nodes
+    client = None
+    try:
+        svc_idle = FakeService("route-model", reply="from idle")
+        svc_loaded = FakeService("route-model", reply="from loaded")
+        idle.add_service(svc_idle)
+        loaded.add_service(svc_loaded)
+        assert await router_node.connect_bootstrap(idle.addr)
+        assert await router_node.connect_bootstrap(loaded.addr)
+        assert await _settle(lambda: len(router_node.providers) == 2)
+
+        # the load differential, via the surface telemetry gossip writes
+        router_node.health.update(idle.peer_id, {
+            "v": 1, "ts": time.time(),
+            "hist": {"engine.queue_wait_ms": {"count": 50, "p95": 4.0}},
+            "gauge": {"engine.batch_fill": 0.1},
+        })
+        router_node.health.update(loaded.peer_id, {
+            "v": 1, "ts": time.time(),
+            "hist": {"engine.queue_wait_ms": {"count": 50, "p95": 6000.0}},
+            "gauge": {"engine.batch_fill": 1.0},
+        })
+
+        client = await _node_app(router_node)
+        for _ in range(10):
+            r = await client.post(
+                "/chat", json={"prompt": "route me", "model": "route-model"}
+            )
+            assert r.status == 200
+        total = len(svc_idle.calls) + len(svc_loaded.calls)
+        assert total == 10
+        assert len(svc_idle.calls) >= 8, (
+            f"router sent only {len(svc_idle.calls)}/10 sessions to the "
+            "unloaded node"
+        )
+    finally:
+        if client is not None:
+            await client.close()
+        for n in nodes:
+            await n.stop()
+
+
+async def test_pick_provider_static_fallback_then_scored():
+    """No fresh digest → the legacy static sort (counter says so); a
+    digest arriving flips the SAME call onto the scored path."""
+    from bee2bee_tpu.metrics import get_registry
+    from bee2bee_tpu.meshnet.node import P2PNode
+    from bee2bee_tpu.services.fake import FakeService
+    from tests.test_meshnet import _settle
+
+    a = P2PNode(host="127.0.0.1", port=0)
+    b = P2PNode(host="127.0.0.1", port=0)
+    await a.start()
+    await b.start()
+    try:
+        a.add_service(FakeService("m", price_per_token=0.2))
+        assert await b.connect_bootstrap(a.addr)
+        assert await _settle(lambda: b.providers)
+        reg = get_registry()
+        static0 = reg.counter("router.decisions").value(mode="static_fallback")
+        scored0 = reg.counter("router.decisions").value(mode="scored")
+        pick = b.pick_provider("m")
+        assert pick["provider_id"] == a.peer_id
+        assert reg.counter("router.decisions").value(
+            mode="static_fallback") == static0 + 1
+        b.health.update(a.peer_id, {"v": 1, "ts": time.time(),
+                                    "gauge": {"engine.batch_fill": 0.2}})
+        pick = b.pick_provider("m", prompt="hello")
+        assert pick["provider_id"] == a.peer_id
+        assert reg.counter("router.decisions").value(mode="scored") == scored0 + 1
+    finally:
+        await b.stop()
+        await a.stop()
+
+
+async def test_p2p_admission_rejection_rides_typed_gen_error_frame():
+    """The p2p twin of the HTTP contract: a rejected gen_request answers
+    with a GEN_ERROR frame carrying error_kind + retry_after_s (the
+    fields analysis/schema.py declares), and the requester's await fails
+    typed instead of hanging."""
+    from bee2bee_tpu import protocol
+    from bee2bee_tpu.meshnet.node import P2PNode
+    from bee2bee_tpu.services.fake import FakeService
+    from tests.test_meshnet import _settle
+
+    a = P2PNode(host="127.0.0.1", port=0)
+    b = P2PNode(host="127.0.0.1", port=0)
+    await a.start()
+    await b.start()
+    try:
+        a.add_service(FakeService("m", reply="never"))
+        a.admission = AdmissionController(
+            AdmissionConfig(), slo_burn=lambda: 50.0
+        )
+        sent_frames: list[dict] = []
+        orig_send = a._send
+
+        async def spy(ws, message):
+            if isinstance(message, dict):
+                sent_frames.append(message)
+            await orig_send(ws, message)
+
+        a._send = spy
+        assert await b.connect_bootstrap(a.addr)
+        assert await _settle(lambda: b.providers)
+        with pytest.raises(RuntimeError, match="admission_rejected"):
+            await b.request_generation(a.peer_id, "hi", model="m", timeout=10.0)
+        frame = next(
+            f for f in sent_frames if f.get("type") == protocol.GEN_ERROR
+        )
+        assert frame["error_kind"] == KIND_SLO
+        assert frame["retry_after_s"] == pytest.approx(5.0)
+    finally:
+        await b.stop()
+        await a.stop()
+
+
+async def test_tenant_rides_gen_request_frame_to_serving_node():
+    """Tenant identity flows api-key → gen_request frame → the serving
+    node's service params (clamped against the SERVING node's config)."""
+    from bee2bee_tpu.meshnet.node import P2PNode
+    from bee2bee_tpu.services.fake import FakeService
+    from tests.test_meshnet import _settle
+
+    a = P2PNode(host="127.0.0.1", port=0)
+    b = P2PNode(host="127.0.0.1", port=0)
+    await a.start()
+    await b.start()
+    try:
+        svc = FakeService("m", reply="ok")
+        a.add_service(svc)
+        a.tenants = TenantRegistry(parse_tenant_config({
+            "acme": {"api_key": "k", "weight": 2},
+        }))
+        assert await b.connect_bootstrap(a.addr)
+        assert await _settle(lambda: b.providers)
+        sent_frames: list[dict] = []
+        orig_send = b._send
+
+        async def spy(ws, message):
+            if isinstance(message, dict):
+                sent_frames.append(message)
+            await orig_send(ws, message)
+
+        b._send = spy
+        await b.request_generation(a.peer_id, "hi", model="m", tenant="acme")
+        assert svc.calls[-1]["tenant"] == "acme"
+        # an unconfigured claim clamps to default on the SERVING node
+        await b.request_generation(a.peer_id, "hi", model="m", tenant="evil")
+        assert svc.calls[-1]["tenant"] == "default"
+        # no tenant passed: the key is OMITTED (present-and-not-None
+        # convention), not serialized as null wire noise
+        await b.request_generation(a.peer_id, "hi", model="m")
+        gen_frames = [
+            f for f in sent_frames if f.get("type") == "gen_request"
+        ]
+        assert gen_frames[-2]["tenant"] == "evil"  # explicit claims ride
+        assert "tenant" not in gen_frames[-1]
+    finally:
+        await b.stop()
+        await a.stop()
+
+
+async def test_relay_forwards_typed_admission_rejection():
+    """Three hops: requester → relay (no service) → shedding target. The
+    typed rejection must survive BOTH hops — the relay forwards
+    error_kind/retry_after_s on GEN_RESULT instead of flattening into
+    relay_link_failure, and the requester raises AdmissionReject."""
+    from bee2bee_tpu.meshnet.node import P2PNode
+    from bee2bee_tpu.services.fake import FakeService
+    from tests.test_meshnet import _settle
+
+    target = P2PNode(host="127.0.0.1", port=0)
+    relay = P2PNode(host="127.0.0.1", port=0)
+    requester = P2PNode(host="127.0.0.1", port=0)
+    for n in (target, relay, requester):
+        await n.start()
+    try:
+        target.add_service(FakeService("m", reply="never"))
+        target.admission = AdmissionController(
+            AdmissionConfig(), slo_burn=lambda: 50.0
+        )
+        assert await relay.connect_bootstrap(target.addr)
+        assert await _settle(lambda: relay.providers)
+        assert await requester.connect_bootstrap(relay.addr)
+        assert await _settle(lambda: requester.peers)
+        with pytest.raises(AdmissionReject) as ei:
+            await requester.request_generation(
+                relay.peer_id, "hi", model="m", timeout=10.0
+            )
+        assert ei.value.kind == KIND_SLO and ei.value.status == 503
+        assert ei.value.retry_after_s == pytest.approx(5.0)
+    finally:
+        for n in (requester, relay, target):
+            await n.stop()
+
+
+# ------------------------------------------------------- scheduler plumbing
+
+
+async def test_add_service_pushes_tenant_weights_into_scheduler():
+    """One weight source: a runtime-replaced TenantRegistry must reach an
+    engine-backed service's WDRR queue through add_service."""
+    from types import SimpleNamespace
+
+    from bee2bee_tpu.meshnet.node import P2PNode
+
+    node = P2PNode(host="127.0.0.1", port=0)
+    node.tenants = TenantRegistry(parse_tenant_config({
+        "gold": {"weight": 4},
+    }))
+    pushed: list[dict] = []
+    svc = SimpleNamespace(
+        name="tpu",
+        engine=SimpleNamespace(
+            scheduler=SimpleNamespace(set_tenant_weights=pushed.append)
+        ),
+    )
+    node.add_service(svc)
+    assert pushed == [{"gold": 4.0}]
+
+
+def test_request_carries_tenant_for_scheduler_fairness():
+    from bee2bee_tpu.engine.scheduler import Request
+
+    class _Tok:
+        def decode(self, ids):
+            return ""
+
+        eos_token_id = None
+
+    req = Request([1, 2], 8, 0.0, 0, 1.0, set(), None, _Tok(), tenant="gold")
+    assert req.tenant == "gold"
+    req2 = Request([1], 8, 0.0, 0, 1.0, set(), None, _Tok())
+    assert req2.tenant == "default"
