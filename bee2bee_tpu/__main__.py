@@ -156,14 +156,16 @@ def cli():
                    "train --lora-rank)")
 @click.option("--mesh-shape", default=None, help='e.g. "data:1,model:8" or "seq:4,model:2"')
 @click.option("--attention", type=click.Choice(["auto", "dense", "flash", "sp"]), default=None,
-              help="auto (flash on TPU when supported) | dense | flash (pallas)"
-                   " | sp (seq-sharded long-context cache)")
+              help="auto (flash on TPU when supported) | dense | flash "
+                   "(ragged paged pallas kernel; composes with --spec) | sp "
+                   "(pool slot dim sharded over seq for long context)")
 @click.option("--quantize", type=click.Choice(["none", "int8"]), default=None,
               help="weight-only quantization (int8 halves decode HBM traffic)")
 @click.option("--paged", is_flag=True, default=False,
-              help="paged KV cache: per-step cache HBM traffic scales with "
-                   "live tokens, not max_batch*max_seq; prefix-cache hits "
-                   "share prompt blocks copy-on-write (dense attention only)")
+              help="DEPRECATED no-op: the paged KV block pool is now the "
+                   "only cache layout (per-step cache HBM traffic scales "
+                   "with live tokens; prefix-cache hits share prompt "
+                   "blocks copy-on-write, under every attention impl)")
 @click.option("--spec", "spec_tokens", type=int, default=None,
               help="self-speculative decoding: draft up to N tokens per "
                    "step by n-gram lookup over the request's own "

@@ -19,8 +19,11 @@ parallel/:
 
 "jit-reachable" is resolved statically: functions decorated with
 ``@jax.jit`` (directly or via partial), functions/methods wrapped as
-``x = jax.jit(fn)``, lambdas inside ``jax.jit(...)``, and bodies passed
-to ``jax.lax.scan/cond/while_loop/fori_loop/switch``.
+``x = jax.jit(fn)``, lambdas inside ``jax.jit(...)``, bodies passed
+to ``jax.lax.scan/cond/while_loop/fori_loop/switch``, shard_map bodies,
+and pallas kernel bodies passed to ``pl.pallas_call(kernel, ...)``
+(directly or via partial) — a host sync inside a pallas kernel fails to
+lower on real TPU and silently de-optimizes interpret mode.
 """
 
 from __future__ import annotations
@@ -108,13 +111,37 @@ class JaxHygienePass:
         for node in ast.walk(tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 by_name.setdefault(node.name, []).append(node)
+        # `kernel = functools.partial(_kernel, ...)` then
+        # `pl.pallas_call(kernel, ...)` — the ops/ kernel wiring binds the
+        # partial to a local first, so follow Name→partial hops. Keyed by
+        # bare name across the file, so two functions reusing the same
+        # local name collide: keep EVERY binding and mark them all — an
+        # over-approximation scans extra functions, never misses one.
+        partial_bindings: dict[str, list] = {}
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+                and _dotted(node.value.func) in ("partial", "functools.partial")
+                and node.value.args
+            ):
+                partial_bindings.setdefault(node.targets[0].id, []).append(
+                    node.value.args[0]
+                )
         roots: list = []
+        visited_bindings: set[int] = set()  # no revisit loop on cycles
 
         def mark(expr: ast.AST):
             if isinstance(expr, ast.Lambda):
                 roots.append(expr)
             elif isinstance(expr, ast.Name):
                 roots.extend(by_name.get(expr.id, ()))
+                for bound in partial_bindings.get(expr.id, ()):
+                    if id(bound) not in visited_bindings:
+                        visited_bindings.add(id(bound))
+                        mark(bound)
             elif isinstance(expr, ast.Attribute):  # self._decode_fn
                 roots.extend(by_name.get(expr.attr, ()))
             elif isinstance(expr, ast.Call) and expr.args and _dotted(
@@ -142,6 +169,12 @@ class JaxHygienePass:
                 elif name.rsplit(".", 1)[-1] == "shard_map" and node.args:
                     # SPMD bodies are traced exactly like jit bodies (the
                     # compat shim resolves to jax's shard_map either way)
+                    mark(node.args[0])
+                elif name.rsplit(".", 1)[-1] == "pallas_call" and node.args:
+                    # pallas kernels (ops/flash.py, ops/ragged.py) are
+                    # traced into Mosaic: host syncs / Python branches on
+                    # traced values fail to lower on real TPU — the kernel
+                    # body (often functools.partial(kernel, ...)) is a root
                     mark(node.args[0])
                 elif (
                     name.rsplit(".", 1)[-1] in _LAX_WRAPPERS

@@ -80,9 +80,8 @@ class NodeConfig:
     prefix_cache_entries: int = 0
     # weight-only quantization: "none" | "int8" (halves decode HBM traffic)
     quantize: str = "none"
-    # paged KV cache: block-pool cache + per-row block tables — per-step
-    # cache HBM traffic scales with live tokens instead of
-    # max_batch * max_seq (EngineConfig.paged; dense attention only)
+    # DEPRECATED no-op (kept so BEE2BEE_PAGED / stored configs parse):
+    # the paged block pool is now the engine's only cache layout
     paged: bool = False
     kv_block_size: int = 16  # tokens per pool block (EngineConfig knob)
     # self-speculative decoding: draft up to this many tokens per step

@@ -1,5 +1,5 @@
-"""Inference engine: jit-compiled prefill/decode with a fixed-capacity KV
-cache, bucketed shapes, on-device sampling, and token streaming. This is the
+"""Inference engine: jit-compiled prefill/decode over a paged KV block
+pool, bucketed shapes, on-device sampling, and token streaming. This is the
 TPU-native replacement for the reference's torch `model.generate` thread
 (reference hf.py:84-108)."""
 

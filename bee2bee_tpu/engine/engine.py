@@ -7,12 +7,15 @@ TPU-first structure (SURVEY §7 step 2, hard part 1):
   true length is overwritten by decode exactly when it would enter the
   causal window, so no separate validity mask is needed.
 - **Continuous batching** (engine/scheduler.py): concurrent requests share
-  one fixed-capacity [max_batch, max_seq_len] KV cache, donated through
-  every decode step so XLA updates it in place in HBM; rows admit/retire
-  between chunks and a request stops paying compute at EOS. With
-  ``paged=True`` the shared cache is a block pool + per-row block tables
-  instead (engine/paged.py): per-step cache traffic follows live tokens
-  and prompt prefixes are shared block-level copy-on-write.
+  ONE paged KV block pool + per-row block tables (engine/paged.py),
+  donated through every decode step so XLA updates it in place in HBM;
+  rows admit/retire between chunks, a request stops paying compute at
+  EOS, per-step cache traffic follows live tokens, and prompt prefixes
+  are shared block-level copy-on-write. The old rectangular
+  [max_batch, max_seq_len] cache is gone: dense attention serves the
+  gathered block view, ``attention="flash"`` runs the ragged
+  paged-attention kernel (ops/ragged.py) straight off the pool, and
+  ``attention="sp"`` shards the pool's slot dim over the `seq` axis.
 - **On-device sampling** inside the jit'd step: one fused
   forward+sample+cache-update program per token; the only host transfer per
   chunk is the sampled token ids (needed for streaming/stop anyway).
@@ -93,16 +96,22 @@ class EngineConfig:
     # waste is max_inflight_chunks * decode_chunk tokens, never the rest
     # of max_new_tokens like the round-1 engine.
     max_inflight_chunks: int = 8
-    # "dense": einsum attention (models/core._attention, XLA-fused);
-    # "flash": pallas tiled kernel (ops/flash.py) — no [T,S] score
-    # materialization, VMEM-resident online softmax;
-    # "sp": sequence-parallel serving (parallel/sp_serving.py) — the KV
-    # cache's capacity dim is sharded over the mesh's `seq` axis and
-    # attention merges per-shard online-softmax partials via psum; cache
-    # HBM and the quadratic prefill term scale 1/seq. Needs seq > 1.
+    # "dense": einsum attention (models/core._attention) over the
+    # gathered block view — covers every score variant incl. ALiBi;
+    # "flash": the ragged paged-attention pallas kernel (ops/ragged.py)
+    # reading K/V straight from the block pool — no gathered view, no
+    # [T,S] score materialization, VMEM-resident online softmax; serves
+    # decode, spec-verify and ragged prefill chunks from one kernel and
+    # carries sliding windows / logit softcap / the gemma score scale
+    # via the dense path's own mask + scalar params;
+    # "sp": sequence-parallel serving (parallel/sp_serving.py) — the
+    # pool's slot dim is sharded over the mesh's `seq` axis
+    # (partition.paged_cache_spec) and attention merges per-shard
+    # online-softmax partials via psum over the gathered view; pool HBM
+    # and the quadratic prefill term scale 1/seq. Needs seq > 1.
     # "auto": flash when on TPU and the head layout supports the kernel
-    # (ops.flash.validate_flash_mesh), dense otherwise — resolved once at
-    # engine build (interpret-mode pallas off-TPU would be far slower
+    # (ops.ragged.validate_ragged_mesh), dense otherwise — resolved once
+    # at engine build (interpret-mode pallas off-TPU would be far slower
     # than XLA's fused dense path).
     attention: str = "dense"
     # chunked prefill: process the prompt in fixed chunks of this many
@@ -128,15 +137,13 @@ class EngineConfig:
     # block. Pinned blocks are reclaimed LRU-first under pool pressure.
     # 0 = disabled.
     prefix_cache_entries: int = 0
-    # paged KV cache (engine/paged.py): replace the rectangular
-    # [max_batch, max_seq] cache with a block pool + per-row block tables
-    # so per-step cache HBM traffic scales with LIVE tokens, not
-    # max_batch * max_seq — short/idle rows stop taxing every decode step
-    # (the rectangular path measured 4x decode cost at bsz=8 with one
-    # active row). Dense attention only: flash reads a contiguous row
-    # layout and "sp" shards capacity over the seq axis — both stay on
-    # the rectangular path and are rejected with paged=True.
-    paged: bool = False
+    # DEPRECATED no-op: the paged block pool (engine/paged.py) is now the
+    # ONLY cache layout — per-step cache HBM traffic scales with LIVE
+    # tokens under every attention impl (the old rectangular cache
+    # measured 4x decode cost at bsz=8 with one active row and is
+    # deleted). The field is accepted so existing configs/knobs
+    # (--paged / BEE2BEE_PAGED) keep parsing.
+    paged: bool = True
     # tokens per pool block. Smaller blocks track live length tighter
     # (less over-allocation, finer sharing granularity); larger blocks
     # shrink the table/gather overhead. 16 matches the TPU second-minor
@@ -153,10 +160,11 @@ class EngineConfig:
     # prompt+output, verify them all in ONE [B, K+1] forward, accept the
     # longest exact prefix. Greedy non-penalized rows only (token-for-
     # token parity with plain greedy decode); sampled/penalized rows in
-    # the same batch keep the normal decode windows. 0 = off. Dense
-    # attention only — the verify chunk rides the dense cache write
-    # paths (rectangular and paged); under flash/sp the scheduler logs
-    # and decodes normally.
+    # the same batch keep the normal decode windows. 0 = off. Composes
+    # with attention="dense" AND "flash" — the verify chunk rides the
+    # paged write path and the ragged kernel serves the [B, K+1] shape
+    # natively; only "sp" lacks the capability (the scheduler detects it
+    # off the active attn path and logs once).
     spec_tokens: int = 0
     # suffix n-gram lengths the drafter tries, longest first. A longer
     # match predicts the continuation better; min_match=2 keeps single
@@ -176,7 +184,7 @@ class EngineConfig:
         # never advances
         if self.prefill_chunk is not None and self.prefill_chunk < 1:
             self.prefill_chunk = None
-        if self.paged and self.kv_block_size < 1:
+        if self.kv_block_size < 1:
             raise ValueError(f"kv_block_size must be >= 1, got {self.kv_block_size}")
         if self.spec_tokens < 0:  # NodeConfig's 0-means-disabled sentinel
             self.spec_tokens = 0
@@ -283,13 +291,6 @@ class InferenceEngine:
         self.params = partition.shard_params(params, self.mesh, cfg=self.model_cfg)
         self.tokenizer = tokenizer or load_tokenizer(checkpoint_path, self.model_cfg.vocab_size)
 
-        self._cache_sharding = NamedSharding(
-            self.mesh,
-            partition.cache_spec(
-                self.model_cfg, self.mesh,
-                seq_sharded=self.engine_cfg.attention == "sp",
-            ),
-        )
         self._replicated = NamedSharding(self.mesh, P())
         # one jit object; it specializes per tokens shape (= per bucket)
         self._prefill = jax.jit(self._prefill_fn, donate_argnums=(2,))
@@ -311,13 +312,16 @@ class InferenceEngine:
 
     def _attn_fn(self):
         """attn_fn for core.forward per the engine's attention setting.
-        Under a non-trivial mesh the pallas kernel runs per-shard via
-        shard_map (ops.flash.make_flash_attn_fn) — pallas_call has no SPMD
-        partitioning rule, so sharding propagation would all-gather it."""
+        "flash" is the ragged paged kernel (ops/ragged.py) — it reads the
+        block pool directly (core.forward detects the `ragged` marker and
+        skips the gathered-view build). Under a non-trivial mesh the
+        pallas kernel runs per-shard via shard_map — pallas_call has no
+        SPMD partitioning rule, so sharding propagation would all-gather
+        it."""
         if self.engine_cfg.attention == "flash":
-            from ..ops.flash import make_flash_attn_fn
+            from ..ops.ragged import make_ragged_attn_fn
 
-            return make_flash_attn_fn(self.mesh)
+            return make_ragged_attn_fn(self.mesh)
         if self.engine_cfg.attention == "sp":
             from ..parallel.sp_serving import make_sp_attn_fn
 
@@ -325,73 +329,57 @@ class InferenceEngine:
         return None
 
     def _resolve_auto_attention(self) -> str:
-        """attention='auto' → 'flash' when THIS engine's mesh devices are
-        TPU and the head layout supports the kernel, else 'dense'.
-        Measured rationale (docs/PERF.md r4): flash's whole-graph compile
-        is ~2x faster than dense's, and its per-row causal block skip
-        reads only the live prefix of the KV cache during decode where
-        dense reads every slot. On non-TPU devices the kernel runs in
-        pallas interpret mode — orders of magnitude slower than XLA's
+        """attention='auto' → 'flash' (the ragged paged kernel) when THIS
+        engine's mesh devices are TPU and the head layout supports it,
+        'sp' on a seq-sharded mesh, else 'dense'. Measured rationale
+        (docs/PERF.md r4): flash's whole-graph compile is ~2x faster than
+        dense's, and the ragged kernel never materializes the gathered
+        block view or [T, S] scores. On non-TPU devices the kernel runs
+        in pallas interpret mode — orders of magnitude slower than XLA's
         fused dense einsum — so those resolve to dense. The platform
         comes from the mesh, not jax.devices(): an explicit CPU mesh on
-        a TPU-default host must not pick flash."""
-        from ..ops.flash import validate_flash_mesh
+        a TPU-default host must not pick flash. Sliding windows and the
+        gemma-2 score math ride the ragged kernel (mask + scalar params);
+        only ALiBi stays dense-only."""
+        from ..ops.ragged import validate_ragged_mesh
 
-        if self.engine_cfg.paged:
-            # the seq-mesh rejection lives in _validate_attention_impl
-            # (it must hold for explicit 'dense' too, not just 'auto')
-            logger.info("attention=auto -> dense (paged KV cache: the block "
-                        "gather is a dense-path feature; flash/sp stay on "
-                        "the rectangular cache)")
-            return "dense"
-        if self.model_cfg.pos_embedding == "alibi":
-            if self.mesh.shape.get("seq", 1) > 1:
+        if self.mesh.shape.get("seq", 1) > 1:
+            # a seq axis exists for exactly one reason: sequence-parallel
+            # pool sharding. flash/dense would leave the pool replicated
+            # across the seq group (paged_cache_spec seq-shards only
+            # under "sp") — silent 1/seq HBM-scaling loss
+            if self.model_cfg.pos_embedding == "alibi":
                 raise ValueError(
                     "no attention impl supports ALiBi on a seq-sharded "
                     "mesh; drop the seq axis"
                 )
-            logger.info("attention=auto -> dense (ALiBi bias: only the "
-                        "dense path implements it)")
-            return "dense"
-        if self._gemma2_score_math():
-            if self.mesh.shape.get("seq", 1) > 1:
+            if self._gemma2_score_math():
                 raise ValueError(
                     "no attention impl supports gemma-2 score math "
                     "(softcap / attn_scale / alternating windows) on a "
                     "seq-sharded mesh; drop the seq axis"
                 )
-            logger.info("attention=auto -> dense (gemma-2 score math: "
-                        "only the dense path implements it)")
-            return "dense"
-        if self._window_binds():
-            if self.mesh.shape.get("seq", 1) > 1:
-                # no impl supports seq-sharded cache + sliding window:
-                # silently-dense would replicate the cache across the seq
-                # group — the exact loss the seq axis exists to avoid
+            if self._window_binds():
                 raise ValueError(
                     f"no attention impl supports sliding_window="
                     f"{self.model_cfg.sliding_window} on a seq-sharded mesh; "
                     "drop the seq axis or serve full-causal"
                 )
-            logger.info("attention=auto -> dense (sliding window binds at "
-                        "this context; flash/sp do not implement it)")
-            return "dense"
-        if self.mesh.shape.get("seq", 1) > 1:
-            # a seq axis exists for exactly one reason: sequence-parallel
-            # cache sharding. flash/dense would leave the cache replicated
-            # across the seq group (cache_spec seq-shards only under "sp")
-            # — silent 1/seq HBM-scaling loss on the long-context mesh
             logger.info("attention=auto -> sp (mesh has a seq axis)")
             return "sp"
+        if self.model_cfg.pos_embedding == "alibi":
+            logger.info("attention=auto -> dense (ALiBi bias: only the "
+                        "dense path implements it)")
+            return "dense"
         if self.mesh.devices.flat[0].platform != "tpu":
             logger.info("attention=auto -> dense (mesh devices are not TPU)")
             return "dense"
         try:
-            validate_flash_mesh(self.model_cfg, self.mesh)
+            validate_ragged_mesh(self.model_cfg, self.mesh)
         except ValueError as e:  # unsupported head layout
             logger.info("attention=auto -> dense (%s)", e)
             return "dense"
-        logger.info("attention=auto -> flash")
+        logger.info("attention=auto -> flash (ragged paged kernel)")
         return "flash"
 
     def _gemma2_score_math(self) -> bool:
@@ -415,28 +403,6 @@ class InferenceEngine:
         return bool(w) and w < self.max_seq_len
 
     def _validate_attention_impl(self):
-        if self.engine_cfg.paged and self.mesh.shape.get("seq", 1) > 1:
-            # checked here, not only in the 'auto' resolution: an explicit
-            # attention='dense' must not silently serve a seq-sharded mesh
-            # with a capacity-replicated pool — the exact loss the seq
-            # axis exists to avoid
-            raise ValueError(
-                "paged=True does not support a seq-sharded mesh (the "
-                "block pool is unsharded along capacity); drop the seq "
-                "axis or serve paged=False with attention='sp'"
-            )
-        if self.engine_cfg.paged and self.engine_cfg.attention in ("flash", "sp"):
-            # explicit selection + paged is a contradiction, not a silent
-            # fallback: flash's pallas kernel reads a contiguous [B, S]
-            # cache row and sp shards cache capacity over the seq axis —
-            # neither understands a block-scattered pool. The paged win
-            # (gather only live blocks) is implemented on the dense path.
-            raise ValueError(
-                f"attention={self.engine_cfg.attention!r} is not supported "
-                "with paged=True — the paged block pool is served by the "
-                "dense path only; use attention='dense' (or 'auto'), or "
-                "disable paged"
-            )
         if (self.engine_cfg.attention in ("flash", "sp")
                 and self.model_cfg.pos_embedding == "alibi"):
             raise ValueError(
@@ -445,28 +411,41 @@ class InferenceEngine:
                 "attention='dense' (the kernels would silently drop the "
                 "per-head position bias)"
             )
-        if (self.engine_cfg.attention in ("flash", "sp")
-                and self._gemma2_score_math()):
+        if self.engine_cfg.attention == "sp" and self._gemma2_score_math():
+            # the RAGGED kernel (flash) carries softcap/attn_scale as
+            # scalar params and the window alternation via the dense
+            # path's mask; sp's partial-merge math hardcodes 1/sqrt(hd)
             raise ValueError(
-                f"attention={self.engine_cfg.attention!r} does not implement "
-                f"gemma-2's score math ({self.model_cfg.name!r}: attention "
-                "softcap / query_pre_attn_scalar / alternating windows); "
-                "use attention='dense' — the kernels hardcode 1/sqrt(hd) "
-                "and no tanh cap, so logits would silently diverge"
+                f"attention='sp' does not implement gemma-2's score math "
+                f"({self.model_cfg.name!r}: attention softcap / "
+                "query_pre_attn_scalar / alternating windows); use "
+                "attention='dense' or 'flash' — the sp partials hardcode "
+                "1/sqrt(hd) and no tanh cap, so logits would silently "
+                "diverge"
             )
-        if self.engine_cfg.attention in ("flash", "sp") and self._window_binds():
+        if self.engine_cfg.attention == "sp" and self._window_binds():
             raise ValueError(
-                f"attention={self.engine_cfg.attention!r} does not implement "
-                f"sliding_window={self.model_cfg.sliding_window} at context "
-                f"{self.max_seq_len} ({self.model_cfg.name!r}); "
-                "use attention='dense' (the "
-                "kernels derive causal masks internally and would silently "
-                "attend beyond the window)"
+                f"attention='sp' does not implement sliding_window="
+                f"{self.model_cfg.sliding_window} at context "
+                f"{self.max_seq_len} ({self.model_cfg.name!r}); use "
+                "attention='dense' or 'flash' (sp would silently attend "
+                "beyond the window)"
+            )
+        if (self.engine_cfg.attention in ("dense", "flash")
+                and self.mesh.shape.get("seq", 1) > 1):
+            # a seq axis shards the pool's slot dim only under 'sp';
+            # dense/flash would silently serve a pool REPLICATED across
+            # the whole seq group — the exact 1/seq HBM loss the axis
+            # exists to avoid (the pre-round-8 paged guard, re-anchored)
+            raise ValueError(
+                f"attention={self.engine_cfg.attention!r} does not shard "
+                "the paged pool over a seq axis; use attention='sp' or "
+                "drop the seq axis"
             )
         if self.engine_cfg.attention == "flash":
-            from ..ops.flash import validate_flash_mesh
+            from ..ops.ragged import validate_ragged_mesh
 
-            validate_flash_mesh(self.model_cfg, self.mesh)
+            validate_ragged_mesh(self.model_cfg, self.mesh)
         elif self.engine_cfg.attention == "sp":
             from ..parallel.sp_serving import validate_sp_mesh
 
@@ -551,28 +530,16 @@ class InferenceEngine:
             for i, e in enumerate(spec)
         ])
 
-    def new_cache(self, batch: int = 1):
-        cache = core.init_cache(
-            self.model_cfg, batch, self.max_seq_len, jnp.dtype(self.engine_cfg.cache_dtype)
-        )
-        spec = partition.cache_spec(
-            self.model_cfg, self.mesh,
-            seq_sharded=self.engine_cfg.attention == "sp",
-        )
-        fitted = self._fit_spec(spec, cache["k"].shape)
-        return jax.device_put(cache, NamedSharding(self.mesh, fitted))
-
     # ---- paged-pool geometry (engine/paged.py holds the allocator) ----
 
     @property
     def blocks_per_row(self) -> int:
         """Max pool blocks one row can map: capacity plus the decode-chunk
         overshoot (a readback window may write up to decode_chunk - 2
-        positions past capacity before the host sees the stop; the
-        rectangular path absorbs that via dynamic_update_slice clamping,
-        the paged path by owning real blocks for it — an out-of-table
-        position would otherwise depend on jax's OOB gather/scatter
-        defaults instead of landing in a block the row owns)."""
+        positions past capacity before the host sees the stop; the row
+        owns real blocks for that overshoot — an out-of-table position
+        would otherwise depend on jax's OOB gather/scatter defaults
+        instead of landing in a block the row owns)."""
         from .paged import ceil_div
 
         return ceil_div(
@@ -598,12 +565,17 @@ class InferenceEngine:
 
     def new_pool(self):
         """The paged KV block pool, placed with the kv-head `model` spec
-        (partition.paged_cache_spec) so TP serving gathers stay local."""
+        (partition.paged_cache_spec) so TP serving gathers stay local;
+        under attention='sp' the slot dim additionally shards over `seq`
+        (per-device pool memory 1/seq — the long-context scaling)."""
         pool = core.init_paged_pool(
             self.model_cfg, self.pool_blocks, self.engine_cfg.kv_block_size,
             jnp.dtype(self.engine_cfg.cache_dtype),
         )
-        spec = partition.paged_cache_spec(self.model_cfg, self.mesh)
+        spec = partition.paged_cache_spec(
+            self.model_cfg, self.mesh,
+            seq_sharded=self.engine_cfg.attention == "sp",
+        )
         fitted = self._fit_spec(spec, pool["k"].shape)
         return jax.device_put(pool, NamedSharding(self.mesh, fitted))
 

@@ -69,8 +69,8 @@ def pow2_at_least(n: int) -> int:
 
 
 def best_prefix_key(keys, ids) -> tuple[tuple | None, int]:
-    """THE prefix-cache match scan, shared by scheduler.PrefixCache and
-    PagedPrefixCache: the key with the longest usable prefix of ``ids``
+    """THE prefix-cache match scan (PagedPrefixCache, and any other
+    longest-prefix lookup): the key with the longest usable prefix of ``ids``
     (usable length = min(len(key), len(ids) - 1) — the final prompt
     token always prefills so admission gets its first-sample logits; an
     entry only matches when its WHOLE usable prefix equals the prompt's).
@@ -183,13 +183,13 @@ class BlockAllocator:
 class PagedPrefixCache:
     """Block-level prompt prefix cache: key = token-id tuple, value = the
     pool block ids covering positions [0, len(key)). Entries PIN their
-    blocks via allocator refcounts — a put costs zero HBM (unlike the
-    rectangular PrefixCache's full row-cache snapshot); the cost is pool
-    blocks staying out of the free list until eviction.
+    blocks via allocator refcounts — a put costs zero HBM (the deleted
+    rectangular cache snapshotted a full batch-1 row per entry); the cost
+    is pool blocks staying out of the free list until eviction.
 
-    Same match contract as scheduler.PrefixCache: longest usable prefix,
-    capped at len(prompt) - 1 so the final token always prefills for its
-    first-sample logits. The scheduler thread owns all access."""
+    Match contract: longest usable prefix, capped at len(prompt) - 1 so
+    the final token always prefills for its first-sample logits. The
+    scheduler thread owns all access."""
 
     def __init__(self, capacity: int, allocator: BlockAllocator):
         self.capacity = capacity

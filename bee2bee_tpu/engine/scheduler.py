@@ -7,26 +7,28 @@ were independent batch-1 programs contending for the chip. This scheduler
 replaces both (the reference's torch path stops at EOS per request but has
 no batching at all — reference hf.py:84-108):
 
-- **One shared KV cache** plus per-row device state (current token, write
-  offset). All rows decode together in one compiled program per chunk; on
-  TPU, decode is HBM-bandwidth-bound on the weights, so batched rows ride
-  along nearly free — this is the route to the BASELINE throughput
-  ladder, not bigger single streams. Two layouts: the rectangular
-  ``[L, bsz, S, Hkv, hd]`` cache (default), or with ``paged=True`` a
-  block pool ``[L, num_blocks, block_size, Hkv, hd]`` + per-row block
-  tables (engine/paged.py) where blocks are allocated lazily and
-  attention gathers only live blocks — per-step cache HBM traffic scales
-  with live tokens instead of ``bsz * max_seq`` (the rectangular path's
-  measured 4x idle-row tax below), and batch resize/compaction become
-  host table moves instead of device row copies.
+- **One shared paged KV pool** plus per-row device state (current token,
+  write offset). All rows decode together in one compiled program per
+  chunk; on TPU, decode is HBM-bandwidth-bound on the weights, so batched
+  rows ride along nearly free — this is the route to the BASELINE
+  throughput ladder, not bigger single streams. The ONE cache layout is
+  the block pool ``[L, Hkv, num_blocks, block_size, hd]`` + per-row block
+  tables (engine/paged.py): blocks are allocated lazily, attention
+  touches only live blocks — per-step cache HBM traffic scales with live
+  tokens instead of ``bsz * max_seq`` (the deleted rectangular layout's
+  measured 4x idle-row tax) — and batch resize/compaction are host table
+  moves, zero device copies. The rect/paged mode split is GONE: dense
+  attention serves the gathered block view, ``attention="flash"`` runs
+  the ragged paged kernel (ops/ragged.py) straight off the pool, and
+  ``attention="sp"`` shards the pool's slot dim over `seq`
+  (partition.paged_cache_spec) and merges per-shard softmax partials
+  over the gathered view — every combination, plus speculative decode,
+  composes in a single batch.
 - **Adaptive batch bucketing**: ``bsz`` tracks the active row count in
   power-of-two buckets (grow on admission, shrink on retirement, capped at
-  max_batch). Idle rows are not free — each dead row still streams its
-  full cache slice through HBM every step (measured 4x decode cost at
-  bsz=8 with one active row on a v5e chip) — so a solo request decodes at
-  bsz=1 speed. Active rows are kept compacted in [0, active) by moving the
-  highest row into retirement holes (one row-copy per retirement). Each
-  bucket size compiles the decode program once.
+  max_batch). Each bucket size compiles the decode program once; active
+  rows are kept compacted in [0, active) by host table moves into
+  retirement holes.
 - **Rolling admission**: new requests prefill into a private row cache
   (bucketed, compile-bounded) and are spliced into a free batch row via one
   donated dynamic_update_slice program. Admission happens between decode
@@ -213,13 +215,12 @@ class SchedulerStats:
     peak_active: int = 0
     prefix_hits: int = 0
     prefix_tokens_saved: int = 0
-    # paged-cache observability (all zero on the rectangular path).
-    # blocks_read_last_step is what the decode gather actually touches per
-    # layer per step (bsz * table-width bucket); live_blocks is the sum of
-    # blocks mapped by active rows — the two tracking each other is the
-    # "cache HBM reads scale with live tokens" property. The rectangular
-    # equivalent is bsz * ceil(max_seq / block_size) regardless of
-    # occupancy.
+    # paged-pool observability. blocks_read_last_step is what the decode
+    # step actually touches per layer (bsz * table-width bucket);
+    # live_blocks is the sum of blocks mapped by active rows — the two
+    # tracking each other is the "cache HBM reads scale with live tokens"
+    # property. The deleted rectangular layout's equivalent was
+    # bsz * ceil(max_seq / block_size) regardless of occupancy.
     paged_blocks_in_use: int = 0
     paged_blocks_hwm: int = 0
     paged_blocks_copied: int = 0  # CoW copies (<= 1 per prefix hit)
@@ -248,47 +249,6 @@ class _PoolExhausted(RuntimeError):
     request, never the whole scheduler."""
 
 
-class PrefixCache:
-    """LRU of prompt K/V snapshots: key = token-id tuple, value = a batch-1
-    row cache valid for positions [0, len(key)).
-
-    Lookup returns the entry sharing the longest common prefix with the
-    incoming prompt, capped at len(prompt) - 1 — the final prompt token
-    always prefills so admission gets its last_logits for the first
-    sample. A key LONGER than the prompt is usable too (identical-prompt
-    repeats, a truncated retry): its positions beyond the match are stale
-    but the engine's causal invariant already guarantees any position >=
-    the write offset is either masked or overwritten at write time.
-    Entries are device pytrees; the scheduler thread owns all access, so
-    no locking. Capacity is small (entries are row-cache-sized in HBM);
-    the linear prefix scan over <= capacity keys is noise."""
-
-    def __init__(self, capacity: int):
-        self.capacity = capacity
-        self._entries: dict[tuple, object] = {}  # insertion-ordered (LRU)
-
-    def match(self, ids: list[int]):
-        """-> (m, row_cache | None): longest usable cached prefix."""
-        from .paged import best_prefix_key
-
-        best_key, best_m = best_prefix_key(self._entries, ids)
-        if best_key is None:
-            return 0, None
-        entry = self._entries.pop(best_key)  # LRU touch
-        self._entries[best_key] = entry
-        return best_m, entry
-
-    def has(self, ids: list[int]) -> bool:
-        return tuple(ids) in self._entries
-
-    def put(self, ids: list[int], row_cache) -> None:
-        key = tuple(ids)
-        self._entries.pop(key, None)
-        self._entries[key] = row_cache
-        while len(self._entries) > self.capacity:
-            self._entries.pop(next(iter(self._entries)))
-
-
 class BatchScheduler:
     """Owns the shared cache + row table; see module docstring."""
 
@@ -314,21 +274,17 @@ class BatchScheduler:
 
         e = engine
         self._bsz = 1  # current batch bucket (pow2-ish, <= max_batch)
-        # paged mode: ONE block pool for every row + host-side tables; the
-        # pool never resizes with the batch bucket (row identity lives in
-        # the block table), so grow/shrink/compaction cost zero device
-        # copies and per-step cache traffic follows the table width.
-        self._paged = bool(e.engine_cfg.paged)
-        if self._paged:
-            from .paged import BlockAllocator
+        # ONE block pool for every row + host-side tables; the pool never
+        # resizes with the batch bucket (row identity lives in the block
+        # table), so grow/shrink/compaction cost zero device copies and
+        # per-step cache traffic follows the table width.
+        from .paged import BlockAllocator
 
-            self._block_size = e.engine_cfg.kv_block_size
-            self._alloc = BlockAllocator(e.pool_blocks)
-            self._tables = np.zeros((max_batch, e.blocks_per_row), np.int32)
-            self._row_blocks: list[list[int]] = [[] for _ in range(max_batch)]
-            self._cache = e.new_pool()
-        else:
-            self._cache = e.new_cache(self._bsz)
+        self._block_size = e.engine_cfg.kv_block_size
+        self._alloc = BlockAllocator(e.pool_blocks)
+        self._tables = np.zeros((max_batch, e.blocks_per_row), np.int32)
+        self._row_blocks: list[list[int]] = [[] for _ in range(max_batch)]
+        self._cache = e.new_pool()
         # cur/offsets live as HOST numpy mirrors: every eager device op is
         # a blocking round trip on a tunneled chip (~1 s each, measured),
         # so the scheduler never runs eager jnp — host state goes in as
@@ -349,42 +305,8 @@ class BatchScheduler:
         self._counts = None
         self._vocab = e.model_cfg.vocab_size
 
-        # splice a batch-1 prefill cache into batch row b (donate the big
-        # cache so XLA updates it in place in HBM)
-        def insert(cache, row_cache, b):
-            def ins(big, row):
-                idx = (0, b) + (0,) * (big.ndim - 2)
-                return jax.lax.dynamic_update_slice(big, row.astype(big.dtype), idx)
-
-            return jax.tree.map(ins, cache, row_cache)
-
-        # copy batch row src -> dst (compaction move on retirement)
-        def move_row(cache, src, dst):
-            def mv(big):
-                row = jax.lax.dynamic_slice(
-                    big, (0, src) + (0,) * (big.ndim - 2), (big.shape[0], 1) + big.shape[2:]
-                )
-                return jax.lax.dynamic_update_slice(
-                    big, row, (0, dst) + (0,) * (big.ndim - 2)
-                )
-
-            return jax.tree.map(mv, cache)
-
-        # old-bucket cache -> new-bucket cache (grow: splice into the fresh
-        # larger cache; shrink: slice the leading rows)
-        def grow(dst, src):
-            return jax.tree.map(
-                lambda d, s: jax.lax.dynamic_update_slice(d, s, (0,) * d.ndim),
-                dst,
-                src,
-            )
-
-        def shrink(src, n):
-            return jax.tree.map(lambda s: s[:, :n], src)
-
-        # counts live [B, 2, V] (batch leading, unlike the [L, B, ...]
-        # cache; channel 0 = prompt occurrences, 1 = generated), so they
-        # get their own row helpers
+        # counts live [B, 2, V] (batch leading; channel 0 = prompt
+        # occurrences, 1 = generated), so they get their own row helpers
         V = self._vocab
 
         def c_insert(c, row, b):
@@ -394,14 +316,28 @@ class BatchScheduler:
             row = jax.lax.dynamic_slice(c, (src, 0, 0), (1, 2, V))
             return jax.lax.dynamic_update_slice(c, row, (dst, 0, 0))
 
+        # CoW single-block copy: one dim-1 slice of the pool's block dim
+        # ([L, Hkv, NB, BS, hd] dim 2) copied src -> dst, donating the pool
+        def copy_block(cache, src, dst):
+            def cp(big):
+                sizes = big.shape[:2] + (1,) + big.shape[3:]
+                row = jax.lax.dynamic_slice(
+                    big, (0, 0, src) + (0,) * (big.ndim - 3), sizes
+                )
+                return jax.lax.dynamic_update_slice(
+                    big, row, (0, 0, dst) + (0,) * (big.ndim - 3)
+                )
+
+            return jax.tree.map(cp, cache)
+
         from .sampling import sample_batched
 
-        self._insert = jax.jit(insert, donate_argnums=(0,))
-        self._move_row = jax.jit(move_row, donate_argnums=(0,))
-        self._grow = jax.jit(grow, donate_argnums=(0,))
-        self._shrink = jax.jit(shrink, static_argnums=(1,))
         self._counts_zeros = jax.jit(
             lambda b: jnp.zeros((b, 2, V), jnp.int32), static_argnums=0
+        )
+        self._counts_grow = jax.jit(
+            lambda d, s: jax.lax.dynamic_update_slice(d, s, (0, 0, 0)),
+            donate_argnums=(0,),
         )
         self._counts_insert = jax.jit(c_insert, donate_argnums=(0,))
         self._counts_move = jax.jit(c_move, donate_argnums=(0,))
@@ -416,36 +352,33 @@ class BatchScheduler:
         # jitted: sample_batched run eagerly is ~15 tiny ops = ~15 round
         # trips through a tunneled chip per admission
         self._sample_first = jax.jit(sample_batched)
-        # jitted device-side deep copy (explicit jnp.copy — a bare identity
-        # could alias buffers): snapshots for / restores from the prefix cache
-        self._copy_cache = jax.jit(lambda c: jax.tree.map(jnp.copy, c))
-        # CoW single-block copy is move_row applied to the pool's block dim
-        # (both copy one dim-1 slice src -> dst, donating the big array)
-        self._copy_block = self._move_row
+        self._copy_block = jax.jit(copy_block, donate_argnums=(0,))
         if e.engine_cfg.prefix_cache_entries > 0:
-            if self._paged:
-                from .paged import PagedPrefixCache
+            from .paged import PagedPrefixCache
 
-                self._prefix_cache = PagedPrefixCache(
-                    e.engine_cfg.prefix_cache_entries, self._alloc
-                )
-            else:
-                self._prefix_cache = PrefixCache(e.engine_cfg.prefix_cache_entries)
+            self._prefix_cache = PagedPrefixCache(
+                e.engine_cfg.prefix_cache_entries, self._alloc
+            )
         else:
             self._prefix_cache = None
 
         # self-speculative decoding (engine/spec.py): greedy rows draft
         # from their own prompt+output and one [B, K+1] verify call
-        # replaces up to K+1 sequential decode steps. The verify chunk
-        # rides the dense cache write paths (rectangular vmapped write /
-        # paged block scatter); flash reads a contiguous row layout and
-        # sp shards capacity, so those engines decode normally.
+        # replaces up to K+1 sequential decode steps. Capability is
+        # detected off the ACTIVE attention path, not the config string:
+        # the verify chunk is a [B, K+1] forward through the paged write
+        # path, served by dense attention over the gathered view and by
+        # the ragged paged kernel alike (attn fns carrying the `ragged`
+        # marker). Only 'sp' remains out — its partial-merge shard_map
+        # hardcodes 1/sqrt(hd) full-causal scoring and has no paged
+        # capability marker — and only then does the log fire.
         self._spec = None
         if e.engine_cfg.spec_tokens > 0:
-            if e.engine_cfg.attention != "dense":
+            attn_fn = e._attn_fn()
+            if not (attn_fn is None or getattr(attn_fn, "ragged", False)):
                 logger.info(
-                    "speculative decoding disabled: attention=%r (the "
-                    "[B, K+1] verify chunk is a dense-path feature)",
+                    "speculative decoding disabled: attention=%r has no "
+                    "paged [B, K+1] verify capability",
                     e.engine_cfg.attention,
                 )
             elif e.engine_cfg.spec_tokens + 1 >= e.max_seq_len:
@@ -603,33 +536,29 @@ class BatchScheduler:
             req.finish = "error"
             req.events.put({"done": True, "result": None, "error": reason})
         self._queue.clear()
-        if self._paged:
-            for b, r in enumerate(self._rows):
-                if r is not None:
-                    self._release_row(b)
+        for b, r in enumerate(self._rows):
+            if r is not None:
+                self._release_row(b)
         self._rows = [None] * self._bsz
 
     def _reset_device_state(self):
-        """Recover to an empty bucket-1 batch after a device-side failure
-        (the old cache may hold donated/poisoned buffers). In paged mode
+        """Recover to an empty bucket-1 batch after a device-side failure:
         the whole pool/allocator/prefix-pin state is rebuilt — the pool
-        was donated through the failed call too."""
-        self._bsz = 1
-        if self._paged:
-            from .paged import BlockAllocator, PagedPrefixCache
+        was donated through the failed call and may hold poisoned
+        buffers."""
+        from .paged import BlockAllocator, PagedPrefixCache
 
-            e = self.engine
-            self._alloc = BlockAllocator(e.pool_blocks)
-            self._tables[:] = 0
-            self._row_blocks = [[] for _ in range(self.max_batch)]
-            if self._prefix_cache is not None:
-                self._prefix_cache = PagedPrefixCache(
-                    e.engine_cfg.prefix_cache_entries, self._alloc
-                )
-            self._cache = e.new_pool()
-            self.stats.paged_blocks_in_use = 0
-        else:
-            self._cache = self.engine.new_cache(1)
+        self._bsz = 1
+        e = self.engine
+        self._alloc = BlockAllocator(e.pool_blocks)
+        self._tables[:] = 0
+        self._row_blocks = [[] for _ in range(self.max_batch)]
+        if self._prefix_cache is not None:
+            self._prefix_cache = PagedPrefixCache(
+                e.engine_cfg.prefix_cache_entries, self._alloc
+            )
+        self._cache = e.new_pool()
+        self.stats.paged_blocks_in_use = 0
         self._cur = np.zeros((1,), np.int32)
         self._offsets = np.zeros((1,), np.int32)
         self._rows = [None]
@@ -642,8 +571,6 @@ class BatchScheduler:
         """Drop row b's block references (shared blocks survive via their
         other refs — prefix pins, CoW donors) and null its table row so
         dead-row decode writes land in the null block."""
-        if not self._paged:
-            return
         if self._row_blocks[b]:
             self._alloc.deref(self._row_blocks[b])
             self._row_blocks[b] = []
@@ -691,23 +618,20 @@ class BatchScheduler:
     # ------------------------------------------------------- batch resizing
 
     def _resize(self, new_bsz: int):
-        """Move to a new batch bucket. Active rows live in [0, active) —
-        the copy of min(old, new) leading rows carries them all."""
+        """Move to a new batch bucket. The pool is batch-bucket-
+        independent (row identity lives in the block table), so only the
+        host mirrors and the counts resize — zero cache copies. Active
+        rows live in [0, active); the copy of min(old, new) leading rows
+        carries them all."""
         old = self._bsz
         if new_bsz == old:
             return
-        if new_bsz > old:
-            if not self._paged:  # the paged pool is batch-bucket-independent
-                fresh = self.engine.new_cache(new_bsz)
-                self._cache = self._grow(fresh, self._cache)
-            if self._counts is not None:
-                self._counts = self._grow(
+        if self._counts is not None:
+            if new_bsz > old:
+                self._counts = self._counts_grow(
                     self._counts_zeros(new_bsz), self._counts
                 )
-        else:
-            if not self._paged:
-                self._cache = self._shrink(self._cache, new_bsz)
-            if self._counts is not None:
+            else:
                 self._counts = self._counts_shrink(self._counts, new_bsz)
         cur = np.zeros((new_bsz,), np.int32)
         offs = np.zeros((new_bsz,), np.int32)
@@ -733,16 +657,11 @@ class BatchScheduler:
             )
             if hole is None or last is None or last < hole:
                 break
-            if self._paged:
-                # compaction is a host table move — zero device copies
-                self._tables[hole] = self._tables[last]
-                self._tables[last] = 0
-                self._row_blocks[hole] = self._row_blocks[last]
-                self._row_blocks[last] = []
-            else:
-                self._cache = self._move_row(
-                    self._cache, np.int32(last), np.int32(hole)
-                )
+            # compaction is a host table move — zero device copies
+            self._tables[hole] = self._tables[last]
+            self._tables[last] = 0
+            self._row_blocks[hole] = self._row_blocks[last]
+            self._row_blocks[last] = []
             if self._counts is not None:
                 self._counts = self._counts_move(
                     self._counts, np.int32(last), np.int32(hole)
@@ -754,13 +673,9 @@ class BatchScheduler:
             self._row_params_dirty = True
         A = self.active
         if A == 0 and self._bsz > 1:
-            if self._paged:
-                # the pool and prefix pins persist across idle — only the
-                # host bucket shrinks (no device state to rebuild)
-                self._resize(1)
-            else:
-                # idle: fresh bucket-1 cache, nothing to carry over
-                self._reset_device_state()
+            # the pool and prefix pins persist across idle — only the
+            # host bucket shrinks (no device state to rebuild)
+            self._resize(1)
         elif self._bsz > 1 and A * 2 <= self._bsz // 2:
             # quarter-occupancy hysteresis: halve without thrashing at the
             # boundary (A*2 <= bsz/2  ⇔  A <= bsz/4)
@@ -834,8 +749,8 @@ class BatchScheduler:
                     temp_ref.clear()
                 self.stats.prefix_hits += 1
                 self.stats.prefix_tokens_saved += start
-            # same chunk walk as the rectangular path (shared generator —
-            # the precheck above simulated exactly these windows). The
+            # the chunk walk (paged.prefill_chunk_positions — the
+            # precheck above simulated exactly these windows). The
             # capacity re-anchor can re-feed tokens BELOW `start`;
             # recomputed K/V under a different chunk geometry is not
             # guaranteed bit-identical, so the write floor keeps shared
@@ -921,51 +836,15 @@ class BatchScheduler:
                     prefix=start,
                 ):
                     # np arguments throughout: jit converts them on entry
-                    # (one small transfer), no eager ops, no blocking
-                    if self._paged:
-                        # prefill straight into the shared pool through the
-                        # row's block table; prefix hits share the donor's
-                        # full blocks CoW (engine/paged.py)
-                        last_logits = self._paged_prefill(
-                            req, b, bucket, start, cached
-                        )
-                    else:
-                        if cached is not None:
-                            row_cache = self._copy_cache(cached)
-                            self.stats.prefix_hits += 1
-                            self.stats.prefix_tokens_saved += start
-                        else:
-                            start = 0
-                            row_cache = e.new_cache(1)
-                        # walk the prompt in bucket-sized chunks writing the
-                        # row cache at the running offset; a single whole-
-                        # prompt bucket is the one-chunk case of the same
-                        # loop. The walk (incl. the capacity re-anchor,
-                        # where re-fed tokens recompute identical K/V in
-                        # the PRIVATE row cache) is the shared generator
-                        # paged admission prechecks against.
-                        from .paged import prefill_chunk_positions
-
-                        for pos in prefill_chunk_positions(
-                            n, start, bucket, e.max_seq_len
-                        ):
-                            chunk = req.ids[pos:pos + bucket]
-                            tokens = np.zeros((1, bucket), np.int32)
-                            tokens[0, :len(chunk)] = chunk
-                            row_cache, last_logits = e._prefill(
-                                e.params, tokens, row_cache,
-                                np.asarray([len(chunk)], np.int32),
-                                np.int32(pos),
-                            )
-                        if self._prefix_cache is not None and not self._prefix_cache.has(req.ids):
-                            # snapshot BEFORE _insert donates row_cache away;
-                            # an exact-key hit skips the redundant re-snapshot
-                            # (match already LRU-touched it)
-                            self._prefix_cache.put(
-                                req.ids, self._copy_cache(row_cache)
-                            )
-                    # one arg tuple for both branches: a marshalling
-                    # change must hit penalized and plain rows identically
+                    # (one small transfer), no eager ops, no blocking.
+                    # Prefill straight into the shared pool through the
+                    # row's block table; prefix hits share the donor's
+                    # full blocks CoW (engine/paged.py)
+                    last_logits = self._paged_prefill(
+                        req, b, bucket, start, cached
+                    )
+                    # one arg tuple for plain and penalized rows: a
+                    # marshalling change must hit both identically
                     sample_args = [
                         last_logits,
                         e._next_key(),
@@ -999,8 +878,6 @@ class BatchScheduler:
                             np.asarray([req.frequency_penalty], np.float32),
                         ]
                     first = self._sample_first(*sample_args)
-                    if not self._paged:
-                        self._cache = self._insert(self._cache, row_cache, np.int32(b))
             except _PoolExhausted as err:
                 # backpressure, not failure: _paged_prefill released the
                 # row's blocks before raising. With work in flight (or a
@@ -1204,8 +1081,8 @@ class BatchScheduler:
         _window_size pin so they can never disagree: no penalized row
         (penalty counts ride only the window graphs) and no active row
         within K+1 of capacity (ineligible rows still ride the [B, K+1]
-        forward, and the rectangular write would clamp at S-(K+1) and
-        corrupt their earlier positions). A window pinned to 1 chunk
+        forward, and its write extent past capacity would demand pool
+        blocks past blocks_per_row). A window pinned to 1 chunk
         while every spec step is vetoed would be pure sync-cadence loss."""
         e = self.engine
         K = e.engine_cfg.spec_tokens
@@ -1238,8 +1115,7 @@ class BatchScheduler:
         the plain/penalized window instead: no row drafted anything, a
         penalized row is active (penalty counts ride only the window
         graphs), or any active row is too close to capacity for the
-        fixed [B, K+1] write extent (the rectangular write would clamp
-        and corrupt earlier positions)."""
+        fixed [B, K+1] write extent (_spec_possible)."""
         e = self.engine
         K = e.engine_cfg.spec_tokens
         if not self._spec_possible():
@@ -1278,15 +1154,13 @@ class BatchScheduler:
             return False
         drafts, lens = proposal
         e = self.engine
-        tables = None
-        if self._paged:
-            # cover the whole [offset, offset+K+1) write extent — blocks
-            # claimed for later-rejected slots stay owned by the row
-            # (over-allocated tail) and free normally at retirement
-            tables = self._prepare_window_tables(e.engine_cfg.spec_tokens + 1)
-            if tables is None:
-                self._compact_and_shrink()
-                return True  # nothing left to decode this step
+        # cover the whole [offset, offset+K+1) write extent — blocks
+        # claimed for later-rejected slots stay owned by the row
+        # (over-allocated tail) and free normally at retirement
+        tables = self._prepare_window_tables(e.engine_cfg.spec_tokens + 1)
+        if tables is None:
+            self._compact_and_shrink()
+            return True  # nothing left to decode this step
         temps, topks, topps = self._row_sampling_arrays()
         minps = self._minps if self._minps.any() else None
         self._set_fill_gauges()
@@ -1329,8 +1203,7 @@ class BatchScheduler:
 
     def _set_fill_gauges(self):
         """Batch utilization snapshot before a device step: how full the
-        bucket is (idle rows are not free on the rectangular path) and
-        the absolute active-row count."""
+        bucket is and the absolute active-row count."""
         a = self.active
         _G_ACTIVE_ROWS.set(a)
         _G_BATCH_FILL.set(a / self._bsz if self._bsz else 0.0)
@@ -1374,12 +1247,10 @@ class BatchScheduler:
             return
         W = self._window_size()
         K = e.engine_cfg.decode_chunk
-        tables = None
-        if self._paged:
-            tables = self._prepare_window_tables(W * K)
-            if tables is None:
-                self._compact_and_shrink()
-                return
+        tables = self._prepare_window_tables(W * K)
+        if tables is None:
+            self._compact_and_shrink()
+            return
         temps, topks, topps = self._row_sampling_arrays()
         pen = self._counts is not None and any(
             r is not None and r.penalized for r in self._rows

@@ -21,6 +21,7 @@ Partition rules over the same paths live in partition.py.
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Any
 
@@ -612,6 +613,24 @@ def make_layer_mask(cfg: ModelConfig, positions, T: int, S: int | None = None,
                                  mask, mask_full)
 
 
+def make_layer_window(cfg: ModelConfig):
+    """Per-layer effective sliding window as a [1] int32 (0 = full
+    causal) — the ragged paged kernel's compact replacement for the bool
+    mask (ops/ragged.py derives causality and ragged lengths from the
+    per-row offsets, so the window is the ONLY mask information it needs,
+    and a 16-lane bool mask block would not tile on TPU anyway). The
+    per-layer selection uses the SAME is_sliding_layer rule as
+    make_layer_mask, so the gemma-2/3 local/global alternation is
+    identical across the dense and ragged paths."""
+    w = int(cfg.sliding_window or 0)
+    if not (w and cfg.sliding_window_every > 1):
+        const = jnp.full((1,), w, jnp.int32)
+        return lambda idx: const
+    return lambda idx: jnp.where(
+        is_sliding_layer(cfg, idx), w, 0
+    ).astype(jnp.int32).reshape(1)
+
+
 def forward(
     params: Params,
     cfg: ModelConfig,
@@ -632,17 +651,24 @@ def forward(
     the chunk — the training/scoring path.
 
     With ``block_tables`` [B, MB], the cache is a PAGED pool
-    {"k","v"}: [L, num_blocks, block_size, Hkv, hd] (init_paged_pool) and
+    {"k","v"}: [L, Hkv, num_blocks, block_size, hd] (init_paged_pool) and
     row b's logical cache position p lives at pool slot
-    (block_tables[b, p // block_size], p % block_size). Writes scatter the
-    chunk into the mapped blocks; attention gathers ONLY the MB mapped
-    blocks per row, so cache HBM traffic per step scales with the table
-    width the caller passes (live blocks, bucketed) instead of the pool
-    capacity. The position→slot map is order-preserving, so every mask
-    (causal, sliding-window, gemma alternation) and the ALiBi bias apply
-    unchanged over the gathered [B, MB*block_size] view. Table entries
-    past a row's live extent must map to blocks whose positions are
-    causally masked (the engine pads with the reserved null block 0).
+    (block_tables[b, p // block_size], p % block_size) of every kv head.
+    Writes scatter the chunk into the mapped blocks. Attention depends on
+    the attn_fn: a RAGGED attn_fn (ops/ragged.make_ragged_attn_fn, marked
+    by its ``ragged`` attribute) reads the pool directly — the kv_hook
+    hands the per-layer pool slices through untouched and the kernel
+    gathers one block per grid step, so neither the [B, S, Hkv, hd] view
+    nor the [T, S] scores ever materialize. The dense path (attn_fn None)
+    gathers the MB mapped blocks per row into that view; either way cache
+    traffic per step scales with the table width the caller passes (live
+    blocks, bucketed) instead of the pool capacity. The position→slot map
+    is order-preserving, so every mask (causal, sliding-window, gemma
+    alternation) and the ALiBi bias apply unchanged over the gathered
+    [B, MB*block_size] coordinate space — the ragged kernel consumes the
+    SAME mask, blocked per page. Table entries past a row's live extent
+    must map to blocks whose positions are causally masked (the engine
+    pads with the reserved null block 0).
 
     ``paged_write_floor`` / ``paged_write_ceil`` (paged only): scatter
     writes outside [floor, ceil) are redirected to the null block — reads
@@ -665,7 +691,7 @@ def forward(
 
     if block_tables is not None:
         bt = jnp.asarray(block_tables, jnp.int32)
-        BS = cache["k"].shape[2]  # pool block size
+        BS = cache["k"].shape[3]  # pool block size
         S = bt.shape[1] * BS  # gathered view width = logical positions
         wfloor = (
             jnp.asarray(paged_write_floor, jnp.int32)
@@ -678,7 +704,16 @@ def forward(
     else:
         bt = None
         S = cache["k"].shape[2] if cache is not None else None
-    layer_mask = make_layer_mask(cfg, positions, T, S)
+    # pool-direct attention: the ragged kernel gathers blocks itself, so
+    # it needs the tables; kv_hook then skips the gathered-view build and
+    # the per-layer "mask" becomes the compact window selector — nothing
+    # S-wide is materialized on this path at all
+    ragged = bt is not None and getattr(attn_fn, "ragged", False)
+    if ragged:
+        attn_fn = functools.partial(attn_fn, block_tables=bt)
+        layer_mask = make_layer_window(cfg)
+    else:
+        layer_mask = make_layer_mask(cfg, positions, T, S)
 
     def rope_flag(layer_idx):
         if cfg.local_rope_theta is None:
@@ -705,10 +740,9 @@ def forward(
 
             if bt is not None:
                 # paged: scatter each position into its mapped (block, slot)
-                # and attend over the gathered per-row block views. Rows
-                # own disjoint blocks (the engine's allocator invariant),
-                # so the scatter indices never collide across rows except
-                # in the garbage null block 0.
+                # of every kv head. Rows own disjoint blocks (the engine's
+                # allocator invariant), so the scatter indices never
+                # collide across rows except in the garbage null block 0.
                 Hkv, hd = k.shape[-2], k.shape[-1]
                 blk = jnp.take_along_axis(bt, positions // BS, axis=1)
                 slot = positions % BS  # [B, T]
@@ -723,16 +757,27 @@ def forward(
                     # (an out-of-table lookup above may have produced a
                     # fill value; this rewrites it to the real null block)
                     blk = jnp.where(positions < wceil, blk, 0)
-                ck = cache_k[layer_idx].at[blk, slot].set(
-                    k.astype(cache_k.dtype)
+                # pool layer [Hkv, NB, BS, hd]: the leading slice before
+                # the (blk, slot) index arrays keeps the head dim in
+                # place, so the update operand is k as [Hkv, B, T, hd]
+                ck = cache_k[layer_idx].at[:, blk, slot].set(
+                    jnp.transpose(k, (2, 0, 1, 3)).astype(cache_k.dtype)
                 )
-                cv = cache_v[layer_idx].at[blk, slot].set(
-                    v.astype(cache_v.dtype)
+                cv = cache_v[layer_idx].at[:, blk, slot].set(
+                    jnp.transpose(v, (2, 0, 1, 3)).astype(cache_v.dtype)
                 )
                 cache_k = cache_k.at[layer_idx].set(ck)
                 cache_v = cache_v.at[layer_idx].set(cv)
-                k_eff = ck[bt].reshape(B, S, Hkv, hd)
-                v_eff = cv[bt].reshape(B, S, Hkv, hd)
+                if ragged:
+                    # the kernel gathers straight from the pool — no
+                    # [B, S, Hkv, hd] view, no [T, S] scores
+                    return ck, cv
+                k_eff = jnp.transpose(ck[:, bt], (1, 2, 3, 0, 4)).reshape(
+                    B, S, Hkv, hd
+                )
+                v_eff = jnp.transpose(cv[:, bt], (1, 2, 3, 0, 4)).reshape(
+                    B, S, Hkv, hd
+                )
                 return k_eff, v_eff
 
             def write(cache_row, new_row, start):
@@ -831,7 +876,12 @@ def restack_layers(params: Params) -> Params:
 
 
 def init_cache(cfg: ModelConfig, batch: int, max_len: int | None = None, dtype=jnp.bfloat16):
-    """Preallocate the fixed-capacity KV cache: {"k","v"}: [L,B,S,Hkv,hd]."""
+    """Preallocate a fixed-capacity KV cache: {"k","v"}: [L,B,S,Hkv,hd].
+
+    Model-level utility for forward()'s contiguous cache path (per-stage
+    pipeline caches, scoring/offline use). The SERVING engine no longer
+    allocates these — its one cache layout is the paged block pool
+    (init_paged_pool; engine/scheduler.py)."""
     S = max_len or cfg.max_seq_len
     shape = (cfg.n_layers, batch, S, cfg.n_kv_heads, cfg.head_dim)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
@@ -841,9 +891,15 @@ def init_paged_pool(
     cfg: ModelConfig, num_blocks: int, block_size: int, dtype=jnp.bfloat16
 ):
     """Preallocate the paged KV block pool:
-    {"k","v"}: [L, num_blocks, block_size, Hkv, hd]. Block 0 is the
+    {"k","v"}: [L, Hkv, num_blocks, block_size, hd]. Block 0 is the
     engine's reserved null block (padding target for table entries past a
     row's live extent); rows map logical positions onto blocks via the
-    block tables forward() takes."""
-    shape = (cfg.n_layers, num_blocks, block_size, cfg.n_kv_heads, cfg.head_dim)
+    block tables forward() takes.
+
+    Head-major layout: the ragged kernel (ops/ragged.py) gathers one
+    (kv_head, block) tile per grid step, and Mosaic needs the trailing
+    two dims of that tile to be (block_size, hd) — a head axis blocked
+    at 1 in trailing position fails to lower, the same constraint that
+    shaped ops/flash.py's head-major transpose."""
+    shape = (cfg.n_layers, cfg.n_kv_heads, num_blocks, block_size, cfg.head_dim)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}

@@ -180,17 +180,27 @@ def cache_spec(
     return P(None, "data", seq, "model", None)
 
 
-def paged_cache_spec(cfg: ModelConfig | None = None, mesh: Mesh | None = None) -> P:
-    """Paged KV pool [L, num_blocks, block_size, Hkv, hd]: kv heads on
-    `model`, like the rectangular cache — attention over gathered blocks
-    stays collective-free per shard. The block and slot dims are never
-    sharded: any row gathers arbitrary pool blocks, so splitting them
-    would turn every gather into a cross-device reshard (the engine
-    refuses paged + seq-sharded meshes for the same reason). MQA meshes
-    (kv_replicated) replicate the kv-head dim to match wk/wv."""
+def paged_cache_spec(
+    cfg: ModelConfig | None = None,
+    mesh: Mesh | None = None,
+    seq_sharded: bool = False,
+) -> P:
+    """Paged KV pool [L, Hkv, num_blocks, block_size, hd]: kv heads on
+    `model` — attention over the pool (ragged kernel) or its gathered
+    view (dense) stays collective-free per shard. The BLOCK dim is never
+    sharded: any row gathers arbitrary pool blocks, so splitting it would
+    turn every gather into a cross-device reshard. With ``seq_sharded``
+    (the engine sets it iff attention='sp') the SLOT dim shards over
+    `seq`: per-device pool memory is 1/seq — the long-context capacity
+    scaling of parallel/sp_serving — and the block gather stays local
+    (it indexes only the block dim); XLA reshards the gathered view into
+    the sp shard_map's contiguous [B, S/seq] layout per step, which is
+    the collective sp attention pays anyway. MQA meshes (kv_replicated)
+    replicate the kv-head dim to match wk/wv."""
+    seq = "seq" if seq_sharded and mesh is not None and mesh.shape.get("seq", 1) > 1 else None
     if cfg is not None and mesh is not None and kv_replicated(cfg, mesh):
-        return P(None, None, None, None, None)
-    return P(None, None, None, "model", None)
+        return P(None, None, None, seq, None)
+    return P(None, "model", None, seq, None)
 
 
 def flat_partition_specs(

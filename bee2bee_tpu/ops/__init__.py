@@ -10,3 +10,4 @@ suite exercises them on the CPU mesh.
 """
 
 from .flash import flash_attention  # noqa: F401
+from .ragged import ragged_paged_attention  # noqa: F401

@@ -26,7 +26,6 @@ import math
 
 import jax
 import jax.numpy as jnp
-from ..compat import shard_map
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -213,56 +212,13 @@ def flash_attention(
     return jnp.transpose(out[:, :, :T], (0, 2, 1, 3)).reshape(B, T, H * hd)
 
 
-# ----------------------------------------------------- TP/mesh wrapper
-
-
-def make_flash_attn_fn(mesh=None, interpret: bool | None = None):
-    """Build an attn_fn (core.transformer_block ABI) running the pallas
-    kernel per mesh shard.
-
-    pallas_call has no SPMD partitioning rule, so under a non-trivial mesh
-    the kernel must run inside shard_map with the engine's own layout
-    (models/partition.py): q heads sharded over `model`; K/V sharded over
-    `model` when n_kv_heads divides it, replicated otherwise (the MQA path
-    — partition.kv_replicated); batch over `data` when it divides. The
-    `expert` axis never shards attention tensors: every expert-group
-    device runs the same shard redundantly, matching the dense path's
-    effective layout.
-    """
-    from jax.sharding import PartitionSpec as P
-
-    def attn(q, k, v, mask, cfg, positions=None):
-        offset = positions[:, 0] if positions is not None else None
-        if mesh is None or all(n == 1 for n in mesh.shape.values()):
-            return flash_attention(q, k, v, offset=offset, interpret=interpret)
-        B, _, H, _ = q.shape
-        Hkv = k.shape[2]
-        tp = mesh.shape.get("model", 1)
-        data = mesh.shape.get("data", 1)
-        batch_ax = "data" if data > 1 and B % data == 0 else None
-        head_ax = "model" if tp > 1 else None
-        kv_ax = "model" if tp > 1 and Hkv % tp == 0 else None
-        off = jnp.broadcast_to(
-            jnp.asarray(offset if offset is not None else 0, jnp.int32).reshape(-1),
-            (B,),
-        )
-        mapped = shard_map(
-            lambda q_, k_, v_, o_: flash_attention(
-                q_, k_, v_, offset=o_, interpret=interpret
-            ),
-            mesh=mesh,
-            in_specs=(
-                P(batch_ax, None, head_ax, None),
-                P(batch_ax, None, kv_ax, None),
-                P(batch_ax, None, kv_ax, None),
-                P(batch_ax),
-            ),
-            out_specs=P(batch_ax, None, head_ax),
-            check_vma=False,
-        )
-        return mapped(q, k, v, off)
-
-    return attn
+# ----------------------------------------------------- mesh validation
+# (make_flash_attn_fn — the rectangular-cache engine wrapper — is gone
+# with the rectangular cache itself: the engine's attention="flash" now
+# runs the ragged paged kernel, ops/ragged.make_ragged_attn_fn, which
+# reuses this kernel's head-layout rules below. flash_attention stays as
+# the contiguous-K/V kernel: scoring/offline shapes and the kernel-level
+# numerics tests.)
 
 
 def validate_flash_mesh(cfg, mesh) -> None:
@@ -293,9 +249,9 @@ def validate_flash_mesh(cfg, mesh) -> None:
         )
 
 
-# Decode (T=1) rides the SAME kernel: the engine's attn_fn calls
-# flash_attention with a [B, 1, H, hd] query and offset = write position,
-# which block_q=min(128, max(1, 8))=8 pads to one 8-row q block per head.
-# A separate decode_attention wrapper existed through round 3 but was
-# byte-identical in behavior and used by nothing — deleted (VERDICT r3
-# item 3); tests/test_ops_flash.py covers the T=1 contract directly.
+# Decode (T=1) rides the SAME kernel shape: flash_attention with a
+# [B, 1, H, hd] query and offset = write position pads to one 8-row q
+# block per head. The ENGINE's decode no longer comes through here — the
+# paged pool is the only cache layout and attention="flash" runs the
+# ragged paged kernel (ops/ragged.py) — but the T=1 contract stays
+# tested in tests/test_ops_flash.py as the contiguous-K/V reference.

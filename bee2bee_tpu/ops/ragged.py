@@ -1,0 +1,324 @@
+"""Ragged paged-attention pallas kernel: one decode path for paged +
+flash + spec.
+
+The dense paged path (models/core.forward's ``block_tables`` branch)
+gathers every mapped block into a rectangular [B, S, Hkv, hd] view and
+materializes [B, H, T, S] scores — the block pool saved cache HBM but
+attention still paid the dense rectangle. This kernel (after "Ragged
+Paged Attention" — PAPERS.md, arxiv 2604.15464) reads K/V straight from
+the pool:
+
+- **Pool-direct gather**: the pool is head-major ``[Hkv, NB, BS, hd]``
+  (per-layer slice of core.init_paged_pool's ``[L, Hkv, NB, BS, hd]``)
+  and the grid's page dimension DMAs exactly one block per step via a
+  scalar-prefetched block-table lookup in the BlockSpec index_map —
+  ``(h, tables[b, j], 0, 0)``. No gathered view, no [T, S] score
+  materialization; per-step cache traffic is the table width, same as
+  the pool's design point. Every tensor operand's trailing block dims
+  are ``(rows, hd)`` — Mosaic-tileable (the [NB, BS, Hkv, hd] layout
+  would put a 1-blocked head axis second-to-last and fail to lower, and
+  a bool-mask operand blocked per 16-lane page would violate the same
+  rule — the constraint that shaped ops/flash.py's head-major layout).
+- **One kernel, every chunk shape**: queries fold to ``[B, Hkv, G*T,
+  hd]`` rows (GQA group g major, chunk position t minor), so [B, 1]
+  decode, [B, K+1] spec verify and ragged prefill chunks are all just
+  different row counts of the same program. Rows tile over a q grid
+  dimension so long prefill chunks bound VMEM.
+- **Scalar-compact semantics**: no mask array at all. Causality and
+  per-row ragged lengths derive from the prefetched per-row ``offset``;
+  the sliding window (and the gemma-2/3 per-layer local/global
+  alternation) arrives as ONE prefetched int32 ``window`` (0 = full
+  causal) that core.forward selects per layer with the SAME
+  is_sliding_layer rule the dense mask builder uses; logit softcap and
+  the gemma score-scale override are scalar params. Null-block table
+  entries past a row's live extent are beyond ``offset + T`` and
+  therefore causally masked by construction. Two block-level skip
+  predicates (page past the causal frontier / entirely below the
+  window) avoid the dead MXU/VPU work on those pages — the BlockSpec
+  gather still DMAs every table-width page into VMEM (skipping the DMA
+  itself needs an index_map that can remap dead pages, a follow-up) —
+  so the compute cost of windowed decode follows ~ceil(w/BS) pages
+  while cache traffic remains the (pow2-bucketed) table width. ALiBi
+  stays dense-only (the bias needs absolute key positions per head;
+  the engine validates).
+- **Online softmax** over the page iterations with f32 m/l/acc VMEM
+  scratch, f32 MXU accumulation, storage dtype out — exactly
+  ops/flash.py's numerics, so greedy parity with the dense path holds
+  token-for-token.
+
+Off-TPU the kernel runs in pallas interpret mode (the `_on_tpu()` /
+`interpret` pattern from ops/flash.py), so the CPU test suite exercises
+the exact kernel code path.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..compat import shard_map
+from .flash import NEG_INF, _LANES, _on_tpu, validate_flash_mesh
+
+
+def _ragged_kernel(
+    tables_ref,  # SMEM [B, MB] int32 (scalar-prefetch): per-row block tables
+    off_ref,  # SMEM [B] int32 (scalar-prefetch): position of q[:, 0]
+    win_ref,  # SMEM [1] int32 (scalar-prefetch): sliding window (0 = none)
+    q_ref,  # [1, 1, BQ, hd]   q rows: GQA group g major, chunk pos t minor
+    k_ref,  # [1, 1, BS, hd]   one pool block, gathered via index_map
+    v_ref,  # [1, 1, BS, hd]
+    o_ref,  # [1, 1, BQ, hd]
+    m_ref,  # VMEM [BQ, 128] f32 running max
+    l_ref,  # VMEM [BQ, 128] f32 running sum
+    acc_ref,  # VMEM [BQ, hd] f32
+    *,
+    sm_scale: float,
+    softcap: float,
+    block_size: int,
+    block_q: int,
+    chunk: int,  # T: query positions per row (row r is chunk position r % T)
+):
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    off = off_ref[b]
+    win = win_ref[0]
+    # block-level skips, mirroring ops/flash.py's above-diagonal skip:
+    # a page starting past the causal frontier (every query position is
+    # <= off + chunk - 1) or ending below every query's window start
+    # (>= off - win + 1 when the window binds) contributes nothing
+    past_causal = j * block_size > off + chunk - 1
+    below_window = (win > 0) & (j * block_size + block_size - 1 < off - win + 1)
+
+    @pl.when(jnp.logical_not(past_causal | below_window))
+    def _attend():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        s = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * sm_scale
+        )  # [BQ, BS]
+        if softcap:  # gemma-2: tanh cap BEFORE masking, like core._attention
+            s = jnp.tanh(s / softcap) * softcap
+        # visibility from scalars: query row r sits at chunk position
+        # (i*BQ + r) % T, key column c at pool position j*BS + c
+        row = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_size), 0)
+        qpos = off + (i * block_q + row) % chunk
+        kvpos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_size), 1
+        )
+        msk = kvpos <= qpos
+        msk = msk & ((win <= 0) | (kvpos > qpos - win))
+        s = jnp.where(msk, s, NEG_INF)
+
+        m_prev = m_ref[:, 0]
+        l_prev = l_ref[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        # a fully-masked ROW would otherwise contribute exp(-1e30+1e30)=1
+        p = jnp.where(msk, jnp.exp(s - m_new[:, None]), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+
+        v = v_ref[0, 0]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + pv
+        m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    @pl.when(j == pl.num_programs(3) - 1)
+    def _finalize():
+        # l == 0 only for rows with nothing visible (every page skipped —
+        # can't happen for live rows, but a dead batch row's stale offset
+        # may land there): emit 0, not 0/0 = NaN
+        l = l_ref[:, 0][:, None]
+        o_ref[0, 0] = (
+            acc_ref[:] / jnp.where(l == 0.0, 1.0, l)
+        ).astype(o_ref.dtype)
+
+
+def ragged_paged_attention(
+    q,  # [B, T, H, hd]
+    k_pool,  # [Hkv, NB, BS, hd] — per-layer slice of the paged pool
+    v_pool,  # [Hkv, NB, BS, hd]
+    block_tables,  # [B, MB] int32: pool block ids per row (0 = null block)
+    offset,  # [] or [B] int32: global position of q[:, 0]
+    window=None,  # [] or [1] int32 (traced ok) or python int: sliding
+    #               window for THIS call's layer; None/0 = full causal
+    sm_scale: float | None = None,
+    logit_softcap: float = 0.0,
+    block_q: int = 256,
+    interpret: bool | None = None,
+):
+    """Causal attention for a [B, T] chunk over the paged pool; returns
+    [B, T, H*hd] (core._attention ABI). T=1 is decode, T=K+1 spec verify,
+    T=bucket a ragged prefill chunk — one compiled program per (T, table
+    width) pair, both already bucketed by the engine."""
+    B, T, H, hd = q.shape
+    Hkv, NB, BS, _ = k_pool.shape
+    MB = block_tables.shape[1]
+    G = H // Hkv
+    sm_scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(hd)
+    interpret = (not _on_tpu()) if interpret is None else interpret
+
+    nq = G * T
+    bq = min(block_q, max(nq, 8))
+    nqp = -(-nq // bq) * bq
+    # [B, T, H, hd] -> [B, Hkv, G*T, hd]: head h = kvh*G + g attends kv
+    # head kvh = h // G, so heads of one group are contiguous rows
+    qT = q.reshape(B, T, Hkv, G, hd).transpose(0, 2, 3, 1, 4).reshape(B, Hkv, nq, hd)
+    if nqp != nq:
+        qT = jnp.pad(qT, ((0, 0), (0, 0), (0, nqp - nq), (0, 0)))
+
+    tables = jnp.asarray(block_tables, jnp.int32)
+    off = jnp.broadcast_to(
+        jnp.asarray(offset if offset is not None else 0, jnp.int32).reshape(-1),
+        (B,),
+    )
+    win = jnp.asarray(window if window is not None else 0, jnp.int32).reshape(-1)[:1]
+
+    grid = (B, Hkv, nqp // bq, MB)
+    kernel = functools.partial(
+        _ragged_kernel,
+        sm_scale=sm_scale,
+        softcap=float(logit_softcap or 0.0),
+        block_size=BS,
+        block_q=bq,
+        chunk=T,
+    )
+    # index maps take the scalar-prefetch refs as trailing args; the K/V
+    # maps ARE the gather — page j of row b reads pool block tables[b, j]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, bq, hd), lambda b, h, i, j, tb, off, w: (b, h, i, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, BS, hd), lambda b, h, i, j, tb, off, w: (h, tb[b, j], 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, BS, hd), lambda b, h, i, j, tb, off, w: (h, tb[b, j], 0, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, bq, hd), lambda b, h, i, j, tb, off, w: (b, h, i, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, nqp, hd), q.dtype),
+        interpret=interpret,
+    )(tables, off, win, qT, k_pool, v_pool)
+    # [B, Hkv, nqp, hd] -> [B, T, H*hd]
+    out = out[:, :, :nq].reshape(B, Hkv, G, T, hd).transpose(0, 3, 1, 2, 4)
+    return out.reshape(B, T, H * hd)
+
+
+# ----------------------------------------------------- TP/mesh wrapper
+
+
+def make_ragged_attn_fn(mesh=None, interpret: bool | None = None):
+    """Build an attn_fn (core.transformer_block ABI) that reads the paged
+    pool directly. core.forward marks it via the ``ragged`` attribute: on
+    the block-tables path the kv_hook hands the POOL SLICES through as
+    (k, v), forward partials in the block tables, and the per-layer mask
+    argument becomes the compact [1] int32 window selector
+    (core.make_layer_window) instead of a bool mask — nothing S-wide is
+    ever built.
+
+    Under a non-trivial mesh the kernel runs per-shard via shard_map
+    (pallas_call has no SPMD partitioning rule): q heads and the pool's
+    kv-head dim shard over `model` (replicated for MQA — the flash
+    kernel's head-layout rules, enforced by validate_flash_mesh),
+    batch/tables/offsets over `data` when it divides; the window scalar
+    replicates. The pool's block/slot dims never shard here — any row
+    gathers arbitrary blocks (partition.paged_cache_spec).
+
+    Called WITHOUT block tables (a no-cache forward that still passes an
+    attn_fn), it falls back to the dense reference — correctness over
+    speed on a path that never serves decode (`mask` is a REAL bool mask
+    there; core.forward only swaps in the window selector on the
+    block-tables path).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    def attn(q, k, v, mask, cfg, positions=None, block_tables=None):
+        if block_tables is None:
+            from ..models.core import _attention
+
+            return _attention(q, k, v, mask, cfg)
+        window = mask  # the ragged path's per-layer [1] int32 selector
+        offset = positions[:, 0] if positions is not None else None
+        sm_scale = 1.0 / math.sqrt(cfg.attn_scale or cfg.head_dim)
+        softcap = float(cfg.attn_logit_softcap or 0.0)
+        if mesh is None or all(n == 1 for n in mesh.shape.values()):
+            return ragged_paged_attention(
+                q, k, v, block_tables, offset, window,
+                sm_scale=sm_scale, logit_softcap=softcap, interpret=interpret,
+            )
+        B = q.shape[0]
+        Hkv = k.shape[0]
+        tp = mesh.shape.get("model", 1)
+        data = mesh.shape.get("data", 1)
+        batch_ax = "data" if data > 1 and B % data == 0 else None
+        head_ax = "model" if tp > 1 else None
+        kv_ax = "model" if tp > 1 and Hkv % tp == 0 else None
+        off = jnp.broadcast_to(
+            jnp.asarray(offset if offset is not None else 0, jnp.int32).reshape(-1),
+            (B,),
+        )
+        win = jnp.asarray(
+            window if window is not None else 0, jnp.int32
+        ).reshape(-1)[:1]
+        mapped = shard_map(
+            lambda q_, k_, v_, t_, o_, w_: ragged_paged_attention(
+                q_, k_, v_, t_, o_, w_,
+                sm_scale=sm_scale, logit_softcap=softcap, interpret=interpret,
+            ),
+            mesh=mesh,
+            in_specs=(
+                P(batch_ax, None, head_ax, None),
+                P(kv_ax),
+                P(kv_ax),
+                P(batch_ax),
+                P(batch_ax),
+                P(),
+            ),
+            out_specs=P(batch_ax, None, head_ax),
+            check_vma=False,
+        )
+        return mapped(q, k, v, jnp.asarray(block_tables, jnp.int32), off, win)
+
+    attn.ragged = True
+    return attn
+
+
+def validate_ragged_mesh(cfg, mesh) -> None:
+    """Head-layout rules for the pool-direct kernel — identical to the
+    rectangular flash kernel's (q heads divide `model`; GQA KV must shard,
+    only MQA may replicate), so the one validator serves both."""
+    validate_flash_mesh(cfg, mesh)

@@ -5,19 +5,24 @@ parallel/ring.py gives training its ring attention; this module gives the
 *serving* engine the same first-class long-context story (the reference
 has nothing here — SURVEY §5 "Long-context: absent"). Design:
 
-- The KV cache [L, B, S, Hkv, hd] is sharded over the `seq` mesh axis on
-  its capacity dim S (models/partition.cache_spec), so per-device cache
-  HBM is S/n — max context scales linearly with devices.
+- The paged KV pool [L, Hkv, NB, BS, hd] is sharded over the `seq` mesh
+  axis on its SLOT dim BS (models/partition.paged_cache_spec with
+  seq_sharded=True — the engine sets it iff attention='sp'), so
+  per-device pool HBM is 1/n — max context scales linearly with
+  devices. The block gather stays local (it indexes only the block
+  dim); XLA reshards the gathered [B, S, Hkv, hd] view into this
+  shard_map's contiguous S/n layout, the collective sp attention pays
+  anyway.
 - Attention runs as a shard_map: every device scores the (replicated)
-  queries against ITS S/n cache shard with an online-softmax partial
+  queries against ITS S/n view shard with an online-softmax partial
   (o_unnormalized, m, l), then one pmax + two psums over `seq` combine
   the partials exactly — the all-to-all-free flash-style merge. Score
   memory per device is [T, S/n]: the quadratic prefill term is divided
   by the axis size too.
 - Everything else (projections, MLP, sampling) stays in the engine's
-  single jit program; XLA's partitioner handles the seq-sharded
-  dynamic_update_slice cache writes. The continuous-batching scheduler
-  composes unchanged — its cache ops never touch the S dim.
+  single jit program; XLA's partitioner handles the seq-sharded block
+  scatter writes. The continuous-batching scheduler composes unchanged
+  — its allocator/table ops never touch the slot dim.
 
 Composes with TP (`model` axis shards heads, same rules as ops/flash:
 GQA needs n_kv_heads % tp == 0, MQA replicates KV) and with DP on batch.
@@ -113,6 +118,18 @@ def validate_sp_mesh(cfg, engine_cfg, mesh) -> None:
         raise ValueError(
             f"attention='sp' needs max_seq_len={S} divisible by the seq "
             f"axis {sp} (the cache capacity dim is sharded over it)"
+        )
+    bs = getattr(engine_cfg, "kv_block_size", 0) or 0
+    if bs % sp:
+        # the pool's SLOT dim carries the seq sharding and the gathered
+        # view's width is table_width * kv_block_size: a block size the
+        # axis doesn't divide would silently drop the 1/seq pool sharding
+        # (engine._fit_spec falls back to replicated) AND crash the first
+        # decode when shard_map can't split the narrow gathered view
+        raise ValueError(
+            f"attention='sp' needs kv_block_size={bs} divisible by the "
+            f"seq axis {sp} (the pool's slot dim is sharded over it and "
+            "every gathered-view width is a multiple of the block size)"
         )
     tp = mesh.shape.get("model", 1)
     if tp > 1:

@@ -44,6 +44,10 @@ BASELINE_NEW_TOKENS = 64  # torch-CPU is slow; rate is stable over 64
 P50_REQUESTS = 8
 P50_NEW_TOKENS = 64
 V5E_PEAK_BF16 = 197e12  # one v5e chip, bf16 FLOP/s
+# THE repetitive-prompt workload of the spec and ragged rungs: one
+# period, tiled to PROMPT_LEN — both rungs must draft over the SAME
+# prompt or their acceptance numbers stop being comparable across rounds
+SPEC_PERIOD = [11, 23, 5, 99, 42, 7, 310, 18]
 
 
 def log(msg: str) -> None:
@@ -287,8 +291,7 @@ def bench_spec(msl: int, new_tokens: int) -> dict:
 
     from bee2bee_tpu.engine import EngineConfig, InferenceEngine
 
-    period = [11, 23, 5, 99, 42, 7, 310, 18]
-    prompt = (period * (PROMPT_LEN // len(period) + 1))[:PROMPT_LEN]
+    prompt = (SPEC_PERIOD * (PROMPT_LEN // len(SPEC_PERIOD) + 1))[:PROMPT_LEN]
     out: dict = {"platform": jax.devices()[0].platform}
     for label, k in (("off", 0), ("on", 8)):
         eng = InferenceEngine(
@@ -331,6 +334,94 @@ def bench_spec(msl: int, new_tokens: int) -> dict:
         f"spec rung: {on} tok/s with spec vs {off} without "
         f"(x{out['speedup']}, acceptance "
         f"{out['spec_on'].get('acceptance')})"
+    )
+    return out
+
+
+def bench_ragged(msl: int, new_tokens: int) -> dict:
+    """Ragged paged-attention rung (ISSUE 8): the kernel OFF (dense
+    attention over the gathered block view) vs ON (attention='flash' —
+    ops/ragged.py reading the pool directly), same paged pool both ways,
+    single-stream greedy. Two workloads per side: plain decode tok/s,
+    and spec decode (--spec 8 on the repetitive prompt) reporting
+    acceptance and acceptance-weighted tok/s (tok/s × acceptance — the
+    share of throughput that arrived via verified drafts), so rounds can
+    judge the paged+flash+spec composition as one number. Per-rung
+    platform stamp (PR 6 bench hygiene): CPU rungs run the interpret-mode
+    kernel and are NOT comparable to TPU rungs — judged per-platform."""
+    import time as _time
+
+    import jax
+
+    from bee2bee_tpu.engine import EngineConfig, InferenceEngine
+
+    platform = jax.devices()[0].platform
+    if platform != "tpu":
+        # interpret-mode pallas on CPU is orders of magnitude slower than
+        # the compiled kernel — smoke-scale so the rung still lands
+        new_tokens = min(new_tokens, 16)
+    # the spec cells need enough decode for the model's own output to
+    # develop the repetition the drafter feeds on (bench_spec measured
+    # acceptance 1.0 at 32 tokens on this workload; 16 is too short)
+    spec_new_tokens = max(new_tokens, 32)
+    rep_prompt = (SPEC_PERIOD * (PROMPT_LEN // len(SPEC_PERIOD) + 1))[:PROMPT_LEN]
+    plain_prompt = [1 + j % 500 for j in range(PROMPT_LEN)]
+    out: dict = {"platform": platform}
+    for label, attn in (("off", "dense"), ("on", "flash")):
+        for mode, spec in (("decode", 0), ("spec", 8)):
+            eng = InferenceEngine(
+                "distilgpt2",
+                engine_config=EngineConfig(
+                    max_seq_len=msl, max_batch=1, attention=attn,
+                    spec_tokens=spec,
+                ),
+            )
+            try:
+                prompt = rep_prompt if spec else plain_prompt
+                eng.generate(prompt, max_new_tokens=4, temperature=0.0)
+                st = eng.scheduler.stats
+                d0, a0 = st.spec_drafted, st.spec_accepted
+                t0 = _time.perf_counter()
+                r = eng.generate(
+                    prompt,
+                    max_new_tokens=spec_new_tokens if spec else new_tokens,
+                    temperature=0.0,
+                )
+                wall = _time.perf_counter() - t0
+                entry = {
+                    "tok_per_s": (
+                        round(r.new_tokens / wall, 2) if wall > 0 else 0.0
+                    ),
+                    "new_tokens": r.new_tokens,
+                }
+                if spec:
+                    drafted = st.spec_drafted - d0
+                    accepted = st.spec_accepted - a0
+                    acc = accepted / drafted if drafted else 0.0
+                    entry.update(
+                        spec_tokens=spec,
+                        drafted=drafted,
+                        accepted=accepted,
+                        acceptance=round(acc, 3),
+                        acceptance_weighted_tok_per_s=round(
+                            entry["tok_per_s"] * acc, 2
+                        ),
+                    )
+                out[f"ragged_{label}_{mode}"] = entry
+            finally:
+                eng.close()
+    off, on = (
+        out["ragged_off_decode"]["tok_per_s"],
+        out["ragged_on_decode"]["tok_per_s"],
+    )
+    out["decode_speedup"] = round(on / off, 3) if off > 0 else 0.0
+    log(
+        f"ragged rung [{platform}]: decode {on} tok/s kernel-on vs {off} "
+        f"kernel-off (x{out['decode_speedup']}); spec-on acceptance "
+        f"{out['ragged_on_spec'].get('acceptance')} "
+        f"(acceptance-weighted "
+        f"{out['ragged_on_spec'].get('acceptance_weighted_tok_per_s')} "
+        f"tok/s)"
     )
     return out
 
@@ -507,6 +598,15 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — the rung must not kill the bench
         log(f"spec rung failed: {e}")
         extras["spec_distilgpt2"] = {"error": str(e)}
+
+    # ragged paged-attention rung (ISSUE 8 acceptance: paged + flash +
+    # spec composed — decode tok/s and spec acceptance-weighted tok/s,
+    # kernel off vs on, judged per the rung's own platform stamp)
+    try:
+        extras["ragged_distilgpt2"] = bench_ragged(msl, tokens)
+    except Exception as e:  # noqa: BLE001 — the rung must not kill the bench
+        log(f"ragged rung failed: {e}")
+        extras["ragged_distilgpt2"] = {"error": str(e)}
 
     # per-tenant fairness rung (ISSUE 7 acceptance: ~4:1 completed-token
     # ratio at 4:1 weights under saturation) — model-free and platform-

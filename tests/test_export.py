@@ -537,15 +537,31 @@ def test_gemma2_cached_decode_matches_uncached_forward():
         eng.close()
 
 
-def test_gemma2_rejects_flash_and_auto_resolves_dense():
-    """flash/sp hardcode 1/sqrt(hd) with no softcap — gemma-2 configs
-    must refuse them loudly and resolve attention=auto to dense."""
+def test_gemma2_flash_matches_dense_and_sp_rejects():
+    """The ragged paged kernel carries gemma-2's score math (softcap,
+    query_pre_attn_scalar, alternating windows arrive as scalar params +
+    the dense path's own per-layer mask), so attention='flash' must now
+    serve gemma-2 with greedy parity vs dense; sp still hardcodes
+    1/sqrt(hd) and refuses loudly. auto on CPU resolves to dense (the
+    interpret-mode kernel would be slower than the fused einsum)."""
     from bee2bee_tpu.engine import EngineConfig, InferenceEngine
 
+    kw = dict(max_seq_len=64, prefill_buckets=(16,), dtype="float32",
+              cache_dtype="float32")
+    prompt = [1, 7, 42, 99, 3, 250, 8, 17, 61]  # > window 4: binding
+    dense = InferenceEngine("tiny-gemma2", engine_config=EngineConfig(**kw))
+    want = dense.generate(prompt, max_new_tokens=6, temperature=0.0).token_ids
+    dense.close()
+    flash = InferenceEngine(
+        "tiny-gemma2", engine_config=EngineConfig(attention="flash", **kw)
+    )
+    got = flash.generate(prompt, max_new_tokens=6, temperature=0.0).token_ids
+    flash.close()
+    assert got == want
     with pytest.raises(ValueError, match="score math"):
         InferenceEngine(
             "tiny-gemma2",
-            engine_config=EngineConfig(max_seq_len=64, attention="flash",
+            engine_config=EngineConfig(max_seq_len=64, attention="sp",
                                        dtype="float32",
                                        cache_dtype="float32"),
         )

@@ -258,6 +258,47 @@ def test_jax_pass_covers_spec_module_and_real_verify_is_clean():
     assert analyze_paths([spec_py, engine_py]) == []
 
 
+def test_jax_pass_sees_pallas_call_kernel_roots():
+    """ops/ragged.py wires its kernel as
+    ``pl.pallas_call(functools.partial(_kernel, ...), ...)`` — pin that
+    the root collector resolves the pallas_call body through the partial:
+    a host sync or traced-value branch seeded into a fixture with exactly
+    that wiring must be flagged (a collector regression would silently
+    stop scanning the engine's hottest kernel), and the REAL ragged +
+    flash kernel modules lint clean so the ratchet baseline stays empty."""
+    src = '''
+import functools
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _kernel(tables_ref, q_ref, o_ref, *, scale):
+    n = int(scale)
+    v = q_ref[0].item()
+    if jnp.any(q_ref[0] > 0):
+        v = v + 1
+    o_ref[0] = v
+
+
+def wrapper(q, tables):
+    kernel = functools.partial(_kernel, scale=2.0)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+    )(tables, q)
+'''
+    rules = _rules(analyze_source(src, "ops/fixture.py"))
+    assert "ML-J001" in rules and "ML-J002" in rules
+    from bee2bee_tpu.analysis.jaxhygiene import JaxHygienePass
+
+    assert JaxHygienePass().applies("ops/ragged.py")
+    ragged_py = PACKAGE_ROOT / "ops" / "ragged.py"
+    flash_py = PACKAGE_ROOT / "ops" / "flash.py"
+    assert "pallas_call" in ragged_py.read_text()  # the root exists
+    assert analyze_paths([ragged_py, flash_py]) == []
+
+
 def test_jax_pass_sees_decorators_and_scan_bodies():
     src = '''
 import jax

@@ -192,21 +192,28 @@ def test_engine_flash_on_ep_mesh():
 
 def test_engine_flash_matches_dense_generation():
     """Greedy generation with attention='flash' must produce the same
-    tokens as the dense engine."""
+    tokens as the dense engine. Compared at f32 compute/cache: under
+    bf16 the two paths round logits differently (the kernel accumulates
+    its online softmax in f32 where dense rounds the materialized bf16
+    scores), and near-tied argmax pairs then flip on rounding noise —
+    a tie-break artifact, not an attention bug. f32 makes the parity
+    exact and deterministic (the long-standing tier-1 bf16 flake)."""
     from bee2bee_tpu.engine.engine import EngineConfig, InferenceEngine
 
     cfg = get_config("tiny-gpt2")
-    params = core.init_params(cfg, jax.random.key(0))
+    params = core.init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    kw = dict(max_seq_len=128, decode_chunk=4, dtype="float32",
+              cache_dtype="float32")
     dense = InferenceEngine(
-        cfg, params,
-        engine_config=EngineConfig(max_seq_len=128, decode_chunk=4, attention="dense"),
+        cfg, params, engine_config=EngineConfig(attention="dense", **kw)
     )
     flash = InferenceEngine(
-        cfg, params,
-        engine_config=EngineConfig(max_seq_len=128, decode_chunk=4, attention="flash"),
+        cfg, params, engine_config=EngineConfig(attention="flash", **kw)
     )
     out_d = dense.generate("hello flash world", max_new_tokens=12, temperature=0.0)
     out_f = flash.generate("hello flash world", max_new_tokens=12, temperature=0.0)
+    dense.close()
+    flash.close()
     assert out_d.token_ids == out_f.token_ids
 
 

@@ -1,7 +1,9 @@
-"""Paged KV cache (engine/paged.py + core.forward block_tables path):
+"""Paged KV cache (engine/paged.py + core.forward block_tables path —
+the engine's ONLY cache layout since the rectangular cache was deleted):
 
-- token parity vs the rectangular cache (greedy, same seeds) across
-  model families including GQA/MQA, sliding windows, and the gemma-3
+- token parity between the pool's two attention paths (dense over the
+  gathered view vs the ragged paged kernel) across model families
+  including GQA/MQA, sliding windows, and the gemma-3
   dual-rope/alternating-mask stack;
 - free-list allocator exhaustion -> admission backpressure -> reuse;
 - block-level copy-on-write prefix sharing (at most ONE partial-block
@@ -93,13 +95,20 @@ def test_pow2_and_ceil_helpers():
         pytest.param("tiny-mistral", marks=pytest.mark.slow),  # window only
     ],
 )
-def test_paged_matches_rectangular_greedy(name):
+def test_paged_dense_vs_ragged_flash_greedy(name):
+    """Family sweep over THE two pool attention paths: dense attention
+    over the gathered block view vs the ragged paged kernel reading the
+    pool directly (attention='flash') — token-for-token greedy parity,
+    including the gemma-3 alternating local/global masks and dual-theta
+    rope, which ride the kernel via the dense path's own per-layer mask."""
     prompt = _prompt(0, n=21)  # crosses a block boundary (block_size 16)
     ref = InferenceEngine(name, engine_config=EngineConfig(**KW))
     want = ref.generate(prompt, max_new_tokens=10, temperature=0.0).token_ids
     ref.close()
 
-    eng = InferenceEngine(name, engine_config=EngineConfig(paged=True, **KW))
+    eng = InferenceEngine(
+        name, engine_config=EngineConfig(attention="flash", **KW)
+    )
     got = eng.generate(prompt, max_new_tokens=10, temperature=0.0).token_ids
     eng.close()
     assert got == want
@@ -510,21 +519,30 @@ def test_paged_parity_on_tp_mesh():
         assert got == want, name
 
 
-def test_paged_rejects_flash_and_sp():
-    with pytest.raises(ValueError, match="paged"):
-        InferenceEngine(
-            "tiny-llama",
-            engine_config=EngineConfig(paged=True, attention="sp", **KW),
-        )
-    with pytest.raises(ValueError, match="paged"):
-        InferenceEngine(
-            "tiny-llama",
-            engine_config=EngineConfig(paged=True, attention="flash", **KW),
-        )
-    # auto resolves to dense instead of refusing
+def test_paged_composes_with_flash_and_auto():
+    """The mode matrix is gone: the pool is the only cache layout and
+    attention='flash' (the ragged paged kernel) serves it directly —
+    greedy parity with the dense gathered-view path, same pool counters.
+    auto still resolves to dense on CPU (interpret-mode pallas would be
+    slower than the fused dense einsum)."""
+    prompt = _prompt(7, n=21)
+    dense = InferenceEngine(
+        "tiny-llama", engine_config=EngineConfig(paged=True, **KW)
+    )
+    want = dense.generate(prompt, max_new_tokens=8, temperature=0.0).token_ids
+    dense.close()
     eng = InferenceEngine(
+        "tiny-llama",
+        engine_config=EngineConfig(paged=True, attention="flash", **KW),
+    )
+    got = eng.generate(prompt, max_new_tokens=8, temperature=0.0).token_ids
+    st = eng.scheduler.stats
+    assert got == want
+    assert st.paged_blocks_in_use == 0  # released at retirement
+    eng.close()
+    auto = InferenceEngine(
         "tiny-llama",
         engine_config=EngineConfig(paged=True, attention="auto", **KW),
     )
-    assert eng.engine_cfg.attention == "dense"
-    eng.close()
+    assert auto.engine_cfg.attention == "dense"
+    auto.close()

@@ -4,25 +4,31 @@ K/V and still match a fresh engine token-for-token."""
 import numpy as np
 
 from bee2bee_tpu.engine import EngineConfig, InferenceEngine
-from bee2bee_tpu.engine.scheduler import PrefixCache
+from bee2bee_tpu.engine.paged import BlockAllocator, PagedPrefixCache
 
 KW = dict(max_seq_len=128, dtype="float32", cache_dtype="float32")
 
 
 def test_prefix_cache_lru_and_matching():
-    pc = PrefixCache(2)
-    pc.put([1, 2, 3], "A")
-    pc.put([1, 2], "B")
+    """The longest-usable-prefix contract on THE prefix cache (the paged
+    pool's block-pinning cache — the rectangular snapshot cache is
+    deleted): longest common prefix wins, capped at len(ids)-1, and
+    capacity evicts LRU-first (dropping the evicted entry's pins)."""
+    alloc = BlockAllocator(16)
+    a, b, c = alloc.alloc(1), alloc.alloc(1), alloc.alloc(1)
+    pc = PagedPrefixCache(2, alloc)
+    pc.put([1, 2, 3], a)
+    pc.put([1, 2], b)
     # longest common prefix wins, capped at len(ids)-1
-    assert pc.match([1, 2, 3, 4]) == (3, "A")
+    assert pc.match([1, 2, 3, 4]) == (3, tuple(a))
     m, entry = pc.match([1, 2, 3])  # both keys usable up to n-1: tie
-    assert m == 2 and entry in ("A", "B")
+    assert m == 2 and entry in (tuple(a), tuple(b))
     m, entry = pc.match([1, 2])  # longer keys still match n-1 tokens
-    assert m == 1 and entry in ("A", "B")
+    assert m == 1 and entry in (tuple(a), tuple(b))
     assert pc.match([9, 9]) == (0, None)
-    pc.put([7], "C")  # capacity 2: evicts LRU ("B" was never touched... )
-    assert len(pc._entries) == 2
-    assert pc.match([7, 8]) == (1, "C")
+    pc.put([7], c)  # capacity 2: evicts LRU (its pin drops)
+    assert len(pc) == 2
+    assert pc.match([7, 8]) == (1, tuple(c))
 
 
 def test_repeat_prompt_hits_prefix_cache():

@@ -61,19 +61,36 @@ def test_windowed_cached_decode_matches_forward():
     eng.close()
 
 
-@pytest.mark.parametrize("impl", ["flash", "sp"])
-def test_flash_and_sp_reject_window(impl):
+def test_sp_rejects_window_but_flash_serves_it():
+    """sp's partial-merge math still hardcodes full-causal scoring and
+    refuses a binding window; the ragged paged kernel (flash) consumes
+    the dense path's own window mask, so windowed decode under flash
+    must match dense token-for-token."""
     with pytest.raises(ValueError, match="sliding_window"):
         InferenceEngine(
             CFG,
             engine_config=EngineConfig(
-                max_seq_len=32, attention=impl, dtype="float32",
+                max_seq_len=32, attention="sp", dtype="float32",
                 cache_dtype="float32",
             ),
         )
+    kw = dict(max_seq_len=32, prefill_buckets=(8,), dtype="float32",
+              cache_dtype="float32")
+    prompt = [1, 7, 42, 9, 3, 17, 250, 8, 99]  # 9 > window 4: binding
+    dense = InferenceEngine(CFG, engine_config=EngineConfig(**kw))
+    want = dense.generate(prompt, max_new_tokens=6, temperature=0.0).token_ids
+    dense.close()
+    flash = InferenceEngine(
+        CFG, engine_config=EngineConfig(attention="flash", **kw)
+    )
+    got = flash.generate(prompt, max_new_tokens=6, temperature=0.0).token_ids
+    flash.close()
+    assert got == want
 
 
-def test_auto_resolution_avoids_kernels_for_windowed_models():
+def test_auto_resolution_keeps_flash_for_windowed_models():
+    """The ragged kernel carries the window via the mask, so a binding
+    window no longer forces dense on TPU."""
     import types
 
     eng = InferenceEngine.__new__(InferenceEngine)
@@ -82,7 +99,7 @@ def test_auto_resolution_avoids_kernels_for_windowed_models():
     eng.max_seq_len = min(eng.engine_cfg.max_seq_len, CFG.max_seq_len)
     dev = types.SimpleNamespace(platform="tpu")
     eng.mesh = types.SimpleNamespace(devices=np.array([dev]), shape={})
-    assert eng._resolve_auto_attention() == "dense"
+    assert eng._resolve_auto_attention() == "flash"
 
 
 def test_non_binding_window_keeps_flash():

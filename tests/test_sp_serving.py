@@ -94,14 +94,20 @@ def test_sp_engine_matches_single_device(axes):
     assert got == want
 
 
-def test_sp_cache_is_sharded_over_seq():
-    """The point of the layout: per-device cache bytes must be S/n —
-    but ONLY under attention='sp'; dense/flash on a seq mesh must keep
-    the cache unsharded (no silent per-step reshard)."""
+def test_sp_pool_is_sharded_over_seq():
+    """The point of the layout: per-device pool bytes must be 1/n — the
+    paged pool's SLOT dim shards over `seq` under attention='sp' ONLY;
+    dense/flash keep the pool unsharded (no silent per-step reshard).
+    cache_spec (the per-stage pipeline cache) keeps the same contract on
+    its capacity dim."""
+    from bee2bee_tpu.models.partition import paged_cache_spec
+
     mesh = _mesh(seq=4)
-    spec = cache_spec(get_config("tiny-llama"), mesh, seq_sharded=True)
-    assert spec[2] == "seq"
-    assert cache_spec(get_config("tiny-llama"), mesh)[2] is None
+    cfg = get_config("tiny-llama")
+    assert paged_cache_spec(cfg, mesh, seq_sharded=True)[3] == "seq"
+    assert paged_cache_spec(cfg, mesh)[3] is None
+    assert cache_spec(cfg, mesh, seq_sharded=True)[2] == "seq"
+    assert cache_spec(cfg, mesh)[2] is None
     eng = InferenceEngine(
         "tiny-llama",
         mesh=mesh,
@@ -109,9 +115,10 @@ def test_sp_cache_is_sharded_over_seq():
             attention="sp", max_seq_len=64, dtype="float32", cache_dtype="float32"
         ),
     )
-    cache = eng.new_cache(1)
-    shard_shape = cache["k"].sharding.shard_shape(cache["k"].shape)
-    assert shard_shape[2] == 64 // 4
+    pool = eng.new_pool()
+    shard_shape = pool["k"].sharding.shard_shape(pool["k"].shape)
+    # [L, Hkv, NB, BS, hd]: the slot dim is BS/4 per device
+    assert shard_shape[3] == pool["k"].shape[3] // 4
     eng.close()
 
 
@@ -122,6 +129,15 @@ def test_sp_validation_errors():
     with pytest.raises(ValueError, match="divisible by the seq"):
         validate_sp_mesh(
             cfg, EngineConfig(attention="sp", max_seq_len=130), _mesh(seq=4)
+        )
+    # the pool's slot dim carries the seq sharding: a block size the axis
+    # doesn't divide would silently drop the 1/seq pool sharding and
+    # crash the first decode's shard_map split — refuse at build
+    with pytest.raises(ValueError, match="kv_block_size"):
+        validate_sp_mesh(
+            cfg,
+            EngineConfig(attention="sp", max_seq_len=64, kv_block_size=6),
+            _mesh(seq=4),
         )
     # engine constructor runs the validation too
     with pytest.raises(ValueError, match="seq > 1"):

@@ -56,7 +56,7 @@ class _StubEngine:
     def generate(self, **kw):
         return types.SimpleNamespace(
             text=self.text, new_tokens=5, tokens_per_sec=1.0, ttft_s=0.01,
-            finish_reason="length", prompt_tokens=3,
+            finish_reason="length", prompt_tokens=3, timings={},
         )
 
     def generate_stream(self, **kw):
