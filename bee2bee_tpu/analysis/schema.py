@@ -129,7 +129,16 @@ FRAME_SCHEMAS: dict[str, FrameSchema] = {
             | TENANT_KEYS,
             allow_sampling=True,
         ),
-        _fs(P.GEN_CHUNK, required=frozenset({"text"}), required_any=(ID_KEYS,)),
+        # `tokens`: migration resume streams (meshnet/migrate.py) carry the
+        # accepted token IDS alongside the text so the source node can feed
+        # its original Request's accounting exactly (text alone would force
+        # a lossy re-tokenization at the bridge)
+        _fs(
+            P.GEN_CHUNK,
+            required=frozenset({"text"}),
+            required_any=(ID_KEYS,),
+            optional=frozenset({"tokens"}),
+        ),
         _fs(P.GEN_SUCCESS, required_any=(ID_KEYS,), optional=RESULT_FIELDS),
         _fs(
             P.GEN_ERROR,
@@ -156,7 +165,36 @@ FRAME_SCHEMAS: dict[str, FrameSchema] = {
         # health-plane gossip (health.build_digest rides the ping cadence);
         # the digest is ONE opaque dict on the wire — its internal layout
         # is versioned by health.DIGEST_VERSION, not by frame schema
+        # (drain state and the disagg role ride INSIDE it as digest keys)
         _fs(P.TELEMETRY, required=frozenset({"peer_id", "digest"})),
+        # live generation migration (meshnet/migrate.py). `gen` is the
+        # generation snapshot (one opaque dict, layout versioned by its
+        # own "v" key — engine/scheduler._snapshot_meta); `sig` the
+        # source engine's pool-compat signature; `kv_chunks` how many
+        # KV_BLOCKS frames follow (0 = re-prefill import, no KV ships);
+        # `reason` the migration cause (drain/prefill_handoff/...).
+        _fs(
+            P.KV_EXPORT,
+            required=frozenset({"rid", "model", "gen"}),
+            optional=frozenset({"svc", "sig", "kv_chunks", "reason"})
+            | TENANT_KEYS
+            | TRACE_KEYS,
+        ),
+        # one chunk of exported pool blocks: binary tensor frame whose
+        # header carries per-tensor sha256 (`hashes`, pieces.py-style) the
+        # importer verifies before any block touches its pool
+        _fs(
+            P.KV_BLOCKS,
+            required=frozenset({"rid", "seq"}),
+            optional=frozenset({"done", "hashes"}),
+        ),
+        # the target's typed verdict: ok, or error + error_kind so the
+        # source picks the right fallback rung (re-prefill vs typed fail)
+        _fs(
+            P.KV_IMPORT_ACK,
+            required=frozenset({"rid"}),
+            optional=frozenset({"ok"}) | ADMISSION_KEYS | frozenset({"error"}),
+        ),
         # task protocol: per-kind field contracts live in TASK_SCHEMAS —
         # the TASK envelope itself only promises kind + correlation id
         _fs(P.TASK, required=frozenset({"kind", "task_id"}), allow_extra=True),

@@ -449,6 +449,51 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
             }
         )
 
+    async def admin_drain(request):
+        """Graceful drain (docs/ROBUSTNESS.md "Live migration & drain"):
+        flips the node to draining — new requests 503 typed ``draining``
+        with Retry-After, the drain state rides the telemetry digest so
+        peers stop routing here — and migrates every in-flight generation
+        to scored-healthy peers (KV export; re-prefill fallback). Body:
+        ``{"stop": true}`` additionally exits the node with a clean
+        GOODBYE once the last bridged stream finishes; ``{"wait": false}``
+        returns immediately with ``pending`` instead of blocking until
+        the bridged generations complete (long generations can hold the
+        default waiting response open for minutes — poll GET /admin/drain
+        for progress then).
+
+        ADMIN surface: the first destructive action the API exposes.
+        Tenant API keys (which open the serving routes) do NOT open it —
+        only the node key, or loopback when no key is configured
+        (_auth_ok with tenants=None is exactly that rule)."""
+        if not _auth_ok(request, api_key, None):
+            return web.json_response(
+                {"detail": "drain requires the node API key"},
+                status=403, headers=cors,
+            )
+        body = {}
+        if request.can_read_body:
+            with_suppress = False
+            try:
+                body = await request.json()
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                with_suppress = True
+            if with_suppress or not isinstance(body, dict):
+                return web.json_response(
+                    {"detail": "invalid JSON body"}, status=400
+                )
+        summary = await node.begin_drain(
+            stop=bool(body.get("stop")),
+            wait=bool(body.get("wait", True)),
+        )
+        return web.json_response(summary)
+
+    async def admin_drain_status(request):
+        return web.json_response({
+            "draining": node.draining,
+            "migration": dict(node.migration.stats),
+        })
+
     async def debug_incidents(request):
         """Flight-recorder surface: ``?id=<incident id>`` fetches one full
         on-disk bundle; otherwise the newest-first bundle index plus the
@@ -596,6 +641,8 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
     app.router.add_get("/mesh/health", mesh_health)
     app.router.add_get("/slo", slo)
     app.router.add_get("/debug/incidents", debug_incidents)
+    app.router.add_post("/admin/drain", admin_drain)
+    app.router.add_get("/admin/drain", admin_drain_status)
     app.router.add_post("/connect", connect)
     app.router.add_post("/chat", chat)
     app.router.add_post("/generate", chat)  # alias (reference api.py:190-191)

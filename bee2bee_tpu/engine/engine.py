@@ -791,6 +791,129 @@ class InferenceEngine:
                     raise RuntimeError(ev.get("error", "generation failed"))
                 return ev["result"]
 
+    # ---------------------------------------------------- live migration
+
+    def migration_signature(self) -> dict:
+        """Pool-compat fingerprint a KV import is validated against: two
+        engines whose signatures match have bit-compatible pool block
+        layouts (same per-layer K/V geometry, block size and storage
+        dtype), so exported blocks scatter straight in."""
+        cfg = self.model_cfg
+        return {
+            "model": cfg.name,
+            "n_layers": cfg.n_layers,
+            "n_kv_heads": cfg.n_kv_heads,
+            "head_dim": cfg.head_dim,
+            "block_size": self.engine_cfg.kv_block_size,
+            "cache_dtype": str(jnp.dtype(self.engine_cfg.cache_dtype)),
+        }
+
+    def import_generation(self, snap: dict, kv: dict | None = None):
+        """Resume a migrated generation (scheduler.checkpoint's snapshot):
+        rebuild the Request, prime its accepted output, and submit it on
+        the import path — with ``kv`` (host {"k","v"} block arrays) the
+        scheduler scatters the shipped blocks and decodes on with ZERO
+        prefill; without, it re-prefills prompt + accepted (the fallback
+        rung). Returns the live Request; its events queue carries
+        {"imported": True} on success, then the usual token/done events.
+        Raises ValueError on a snapshot this engine cannot host."""
+        from .scheduler import Request
+
+        ids = [int(t) for t in snap.get("ids") or []]
+        out = [int(t) for t in snap.get("out") or []]
+        if not ids:
+            raise ValueError("import: empty prompt")
+        if snap.get("model") and snap["model"] != self.model_cfg.name:
+            raise ValueError(
+                f"import: snapshot is for model {snap['model']!r}, "
+                f"this engine serves {self.model_cfg.name!r}"
+            )
+        req = Request(
+            ids,
+            int(snap.get("max_new_tokens") or 0),
+            snap.get("temperature", 0.0),
+            int(snap.get("top_k") or 0),
+            float(snap.get("top_p") if snap.get("top_p") is not None else 1.0),
+            set(int(t) for t in snap.get("stop") or []),
+            None if snap.get("eos") is None else int(snap["eos"]),
+            self.tokenizer,
+            stream=True,  # the migration bridge reads token events
+            repetition_penalty=float(snap.get("repetition_penalty") or 1.0),
+            presence_penalty=float(snap.get("presence_penalty") or 0.0),
+            frequency_penalty=float(snap.get("frequency_penalty") or 0.0),
+            min_p=float(snap.get("min_p") or 0.0),
+            tenant=str(snap.get("tenant") or "default"),
+        )
+        req.out_ids = out
+        # the already-streamed text was emitted at the SOURCE; the local
+        # delta decoder must start past it or the first resumed chunk
+        # would replay the whole output
+        req._flushed_text = self.tokenizer.decode(out) if out else ""
+        if kv is not None:
+            if not out:
+                raise ValueError("import: KV snapshot without accepted tokens")
+            offset = int(snap.get("offset") or 0)
+            if offset != len(ids) + len(out) - 1:
+                raise ValueError(
+                    f"import: offset {offset} breaks the live-row invariant "
+                    f"(prompt {len(ids)} + out {len(out)} - 1)"
+                )
+            if offset + 1 >= self.max_seq_len:
+                raise ValueError(
+                    f"import: offset {offset} leaves no room in "
+                    f"max_seq_len={self.max_seq_len}"
+                )
+            if int(snap.get("block_size") or 0) != self.engine_cfg.kv_block_size:
+                raise ValueError(
+                    f"import: block_size {snap.get('block_size')} != "
+                    f"{self.engine_cfg.kv_block_size}"
+                )
+            # the block arrays must match the pool geometry EXACTLY —
+            # a malformed/mismatched export must reject typed here, not
+            # raise on the scheduler thread (whose catch-all would fail
+            # every in-flight request on this node)
+            from .paged import ceil_div
+
+            cfg = self.model_cfg
+            want = (
+                cfg.n_layers, cfg.n_kv_heads,
+                ceil_div(offset, self.engine_cfg.kv_block_size),
+                self.engine_cfg.kv_block_size, cfg.head_dim,
+            )
+            cache_dt = jnp.dtype(self.engine_cfg.cache_dtype)
+            for name in ("k", "v"):
+                arr = kv.get(name) if isinstance(kv, dict) else None
+                shape = tuple(getattr(arr, "shape", ()))
+                if shape != want:
+                    raise ValueError(
+                        f"import: kv[{name!r}] shape {shape} != pool "
+                        f"geometry {want}"
+                    )
+                if jnp.dtype(getattr(arr, "dtype", None)) != cache_dt:
+                    # wrong-dtype bytes pass the sha256 (it hashes what
+                    # was sent) but would scatter garbage bit patterns
+                    raise ValueError(
+                        f"import: kv[{name!r}] dtype {arr.dtype} != pool "
+                        f"cache dtype {cache_dt}"
+                    )
+            req.import_state = {
+                "offset": offset, "cur": int(snap["cur"]), "kv": kv,
+            }
+        elif out:
+            # re-prefill rung: the KV for prompt + out[:-1] is recomputed
+            # locally; out[-1] is the resume token (its K/V is written by
+            # the first decode forward, same as any freshly sampled token)
+            seq = ids + out[:-1]
+            if len(seq) + 1 >= self.max_seq_len:
+                raise ValueError(
+                    f"import: {len(seq)} accepted positions leave no room "
+                    f"in max_seq_len={self.max_seq_len}"
+                )
+            req.import_state = {"seq": seq, "cur": out[-1], "kv": None}
+        # else: nothing was ever decoded — a plain fresh admission
+        self.scheduler.submit(req)
+        return req
+
     def score(self, token_ids: list[int]):
         """Per-token logprobs of a sequence (no cache, full forward) — the
         scoring/training-parity path."""

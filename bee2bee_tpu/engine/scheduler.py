@@ -132,6 +132,11 @@ class Request:
         # plain bool write cross-thread — the scheduler thread reads it at
         # chunk boundaries and retires the row
         self.cancelled = False
+        # live-migration import state (engine.import_generation): admission
+        # takes the import path instead of prefill when set — either
+        # {"offset","cur","kv"} (shipped pool blocks scatter in) or
+        # {"seq","cur","kv":None} (re-prefill prompt+accepted locally)
+        self.import_state: dict | None = None
         self.ids = ids
         self.max_new_tokens = max_new_tokens
         self.temperature = float(temperature if temperature is not None else 0.0)
@@ -236,6 +241,15 @@ class SchedulerStats:
     spec_steps: int = 0
     spec_drafted: int = 0
     spec_accepted: int = 0
+    # live generation migration (meshnet/migrate.py). The acceptance
+    # contract of the drain path pins on these: a happy-path migration is
+    # migrated_out on the source + migrated_in on the target with
+    # import_reprefills UNCHANGED — zero re-prefill forwards; the
+    # fallback ladder's re-prefill rung is exactly import_reprefills.
+    migrated_out: int = 0       # rows checkpointed + released for export
+    migrated_in: int = 0        # rows imported (KV or re-prefill)
+    import_reprefills: int = 0  # imports that had to re-prefill (no KV)
+    prefill_handoffs: int = 0   # disagg: rows handed off after prefill
     history: deque = field(default_factory=lambda: deque(maxlen=64))
 
     @property
@@ -271,6 +285,20 @@ class BatchScheduler:
         )
         self._cond = threading.Condition()
         self._shutdown = False
+        # live-migration plumbing (meshnet/migrate.py). checkpoint() posts
+        # (req, reply queue) pairs here; the scheduler thread services them
+        # at chunk boundaries — the only moment row state is consistent.
+        self._checkpoints: list[tuple[Request, queue.Queue]] = []
+        # node-side hook: migrate_cb(req, snapshot, reason) -> bool, called
+        # ON THE SCHEDULER THREAD when a row wants to leave (disagg
+        # prefill handoff, mid-decode pool exhaustion). Returning True
+        # transfers ownership of req (and its events queue) to the hook —
+        # the row is released and the scheduler never touches req again.
+        # The hook must be fast and thread-safe (it schedules async work).
+        self.migrate_cb = None
+        # disagg prefill role: freshly prefilled rows are offered to
+        # migrate_cb instead of decoding locally (reason "prefill_handoff")
+        self.handoff_after_prefill = False
 
         e = engine
         self._bsz = 1  # current batch bucket (pow2-ish, <= max_batch)
@@ -353,6 +381,25 @@ class BatchScheduler:
         # trips through a tunneled chip per admission
         self._sample_first = jax.jit(sample_batched)
         self._copy_block = jax.jit(copy_block, donate_argnums=(0,))
+
+        # migration block transfer (pool block dim = axis 2): gather reads
+        # a row's blocks out for host export (no donation — the pool keeps
+        # serving), scatter writes imported blocks into freshly allocated
+        # slots. Index arrays pad to pow2 widths (null block 0 / zero
+        # data) so compile variants stay O(log) like the table widths;
+        # pad writes land in the null block, which dead-row decode
+        # scribbles on by design anyway.
+        def gather_blocks(cache, idx):
+            return {"k": cache["k"][:, :, idx], "v": cache["v"][:, :, idx]}
+
+        def scatter_blocks(cache, kk, vv, idx):
+            return {
+                "k": cache["k"].at[:, :, idx].set(kk),
+                "v": cache["v"].at[:, :, idx].set(vv),
+            }
+
+        self._gather_blocks = jax.jit(gather_blocks)
+        self._scatter_blocks = jax.jit(scatter_blocks, donate_argnums=(0,))
         if e.engine_cfg.prefix_cache_entries > 0:
             from .paged import PagedPrefixCache
 
@@ -424,6 +471,34 @@ class BatchScheduler:
             )
             self._cond.notify()
         return req
+
+    def checkpoint(self, req: Request, timeout: float = 30.0) -> dict | None:
+        """Thread-safe: ask the scheduler thread to snapshot `req`'s state
+        (prompt/output ids, sampling params, write offset, last token, and
+        the referenced pool blocks as host arrays under "_kv") and RELEASE
+        its row at the next chunk boundary. A still-queued request is
+        pulled out of the submit queue instead (snapshot without KV).
+        Returns the snapshot, or None when the request already finished —
+        on a snapshot the caller owns req and its events queue from here
+        (the scheduler will never emit on it again)."""
+        done: queue.Queue = queue.Queue()
+        with self._cond:
+            if self._shutdown:
+                return None
+            self._checkpoints.append((req, done))
+            self._cond.notify()
+        try:
+            return done.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def live_requests(self) -> list[Request]:
+        """Admitted + queued requests (drain enumerates these). Best-effort
+        snapshot: a request may retire between this read and a
+        checkpoint() — checkpoint then returns None."""
+        with self._cond:
+            queued = list(self._queue)
+        return [r for r in self._rows if r is not None] + queued
 
     def shutdown(self):
         with self._cond:
@@ -500,12 +575,14 @@ class BatchScheduler:
     def _loop(self):
         while True:
             with self._cond:
-                while not self._queue and self.active == 0 and not self._shutdown:
+                while (not self._queue and self.active == 0
+                       and not self._checkpoints and not self._shutdown):
                     self._cond.wait()
                 if self._shutdown:
                     self._fail_all("engine shut down")
                     return
             try:
+                self._service_checkpoints()
                 self._admit()
                 if self.active:
                     self._step()
@@ -536,6 +613,11 @@ class BatchScheduler:
             req.finish = "error"
             req.events.put({"done": True, "result": None, "error": reason})
         self._queue.clear()
+        # blocked checkpoint() callers get their None verdict too — a
+        # dead scheduler must not make a drain wait out its timeout
+        for _req, done in self._checkpoints:
+            done.put(None)
+        self._checkpoints.clear()
         for b, r in enumerate(self._rows):
             if r is not None:
                 self._release_row(b)
@@ -615,6 +697,178 @@ class BatchScheduler:
 
         return min(pow2_at_least(nblocks), self.engine.blocks_per_row)
 
+    # ------------------------------------------------------------ migration
+
+    def _service_checkpoints(self):
+        """Serve pending checkpoint() calls (scheduler thread, between
+        windows — the only point rows/offsets/pool agree)."""
+        with self._cond:
+            if not self._checkpoints:
+                return
+            pending, self._checkpoints = self._checkpoints, []
+        for req, done in pending:
+            snap = None
+            try:
+                snap = self._checkpoint_one(req)
+            except Exception:  # noqa: BLE001 — a failed snapshot must
+                # still answer the blocked checkpoint() caller
+                logger.exception("checkpoint failed")
+            done.put(snap)
+
+    def _checkpoint_one(self, req: Request) -> dict | None:
+        b = next((i for i, r in enumerate(self._rows) if r is req), None)
+        if b is not None:
+            snap = self._snapshot_row(b, req)
+            self._rows[b] = None
+            self._release_row(b)
+            self._row_params_dirty = True
+            self.stats.migrated_out += 1
+            self._compact_and_shrink()
+            return snap
+        with self._cond:
+            removed = self._queue.remove(req)
+        if not removed:
+            return None  # already retired (or unknown): nothing to move
+        # still queued: no device state exists — the snapshot is metadata
+        # only and imports as a plain fresh admission on the target
+        return self._snapshot_meta(req)
+
+    def _snapshot_meta(self, req: Request) -> dict:
+        """The wire-portable half of a snapshot (meshnet/migrate.py ships
+        it as the KV_EXPORT `gen` field; engine.import_generation rebuilds
+        a Request from it). Occurrence counts are NOT here — they rebuild
+        exactly from ids+out at import."""
+        return {
+            "v": 1,
+            "model": self.engine.model_cfg.name,
+            "ids": [int(t) for t in req.ids],
+            "out": [int(t) for t in req.out_ids],
+            "max_new_tokens": int(req.max_new_tokens),
+            "temperature": req.temperature,
+            "top_k": req.top_k,
+            "top_p": req.top_p,
+            "min_p": req.min_p,
+            "repetition_penalty": req.repetition_penalty,
+            "presence_penalty": req.presence_penalty,
+            "frequency_penalty": req.frequency_penalty,
+            "stop": sorted(int(t) for t in req.stop),
+            "eos": None if req.eos is None else int(req.eos),
+            "tenant": req.tenant,
+            "block_size": self._block_size,
+            "offset": 0,
+            "cur": None,
+            "kv_blocks": 0,
+        }
+
+    def _snapshot_row(self, b: int, req: Request) -> dict:
+        """Snapshot an ADMITTED row: metadata plus the pool blocks holding
+        its live KV, read back as host arrays under "_kv" (the caller
+        splits that off before the metadata rides the wire). Pure read —
+        releasing the row is the caller's move. Live-row invariant:
+        offset == len(ids) + len(out) - 1 and cur == out[-1] (the last
+        sampled token's K/V is written by the NEXT forward), so the
+        blocks covering [0, offset) are the complete recoverable state."""
+        from .paged import ceil_div, pow2_at_least
+
+        snap = self._snapshot_meta(req)
+        offset = int(self._offsets[b])
+        nb = ceil_div(offset, self._block_size)
+        snap.update(offset=offset, cur=int(self._cur[b]), kv_blocks=nb)
+        if nb:
+            width = min(pow2_at_least(nb), self.engine.blocks_per_row)
+            idx = np.zeros((width,), np.int32)
+            idx[:nb] = self._row_blocks[b][:nb]
+            got = jax.device_get(self._gather_blocks(self._cache, idx))
+            snap["_kv"] = {
+                "k": np.asarray(got["k"][:, :, :nb]),
+                "v": np.asarray(got["v"][:, :, :nb]),
+            }
+        return snap
+
+    def _paged_import(self, req: Request, b: int, st: dict):
+        """Admit an IMPORTED request onto row b (engine.import_generation
+        built it): either scatter its shipped KV blocks into freshly
+        allocated pool slots (the happy path — zero prefill compute, the
+        decode that follows is token-for-token the unmigrated rollout) or,
+        KV-less, re-prefill prompt+accepted through the normal chunk walk
+        (the fallback rung, counted in import_reprefills). Raises
+        _PoolExhausted with the row released — imports never requeue: the
+        exporting node needs a fast typed verdict to try its next rung."""
+        from .paged import ceil_div, pow2_at_least
+
+        e = self.engine
+        BS = self._block_size
+        kv = st.get("kv")
+        try:
+            if kv is not None:
+                offset = int(st["offset"])
+                need = ceil_div(offset, BS)
+                assert need <= e.blocks_per_row, (need, offset)
+                fresh = self._alloc_or_evict(need)
+                self._row_blocks[b] = list(fresh)
+                self._tables[b, :] = 0
+                self._tables[b, :need] = fresh
+                width = min(pow2_at_least(need), e.blocks_per_row)
+                idx = np.zeros((width,), np.int32)
+                idx[:need] = fresh
+                kk = np.zeros(
+                    kv["k"].shape[:2] + (width,) + kv["k"].shape[3:],
+                    kv["k"].dtype,
+                )
+                vv = np.zeros_like(kk)
+                kk[:, :, :need] = kv["k"]
+                vv[:, :, :need] = kv["v"]
+                self._cache = self._scatter_blocks(self._cache, kk, vv, idx)
+                self._offsets[b] = offset
+                self._cur[b] = int(st["cur"])
+                # prefix pins travel WITH the generation: the imported
+                # prompt K/V is exactly what a local prefill would have
+                # pinned, so repeat prompts hit CoW on the target too
+                n = len(req.ids)
+                if (self._prefix_cache is not None and offset >= n
+                        and not self._prefix_cache.has(req.ids)):
+                    self._prefix_cache.put(req.ids, fresh[:ceil_div(n, BS)])
+            else:
+                seq = [int(t) for t in st["seq"]]
+                start, cached = (
+                    self._prefix_cache.match(seq)
+                    if self._prefix_cache is not None else (0, None)
+                )
+                C = e.engine_cfg.prefill_chunk
+                remaining = len(seq) - (start if cached is not None else 0)
+                if C is not None and remaining > C:
+                    bucket = C
+                else:
+                    bucket = e._bucket_for(remaining)
+                req.bucket = bucket
+                # last_logits discarded: the next token is already known
+                # (cur = out[-1]); decode resumes from it
+                self._paged_prefill(req, b, bucket, start, cached, seq=seq)
+                self._offsets[b] = len(seq)
+                self._cur[b] = int(st["cur"])
+                self.stats.import_reprefills += 1
+            if req.penalized:
+                if self._counts is None:
+                    self._counts = self._counts_zeros(self._bsz)
+                ch0 = np.bincount(
+                    np.asarray(req.ids, np.int64), minlength=self._vocab
+                )[:self._vocab].astype(np.int32)
+                if req.out_ids:
+                    ch1 = np.bincount(
+                        np.asarray(req.out_ids, np.int64),
+                        minlength=self._vocab,
+                    )[:self._vocab].astype(np.int32)
+                else:
+                    ch1 = np.zeros_like(ch0)
+                self._counts = self._counts_insert(
+                    self._counts, np.stack([ch0, ch1])[None], np.int32(b)
+                )
+            self.stats.migrated_in += 1
+            self.stats.paged_blocks_in_use = self._alloc.used_count
+        except _PoolExhausted:
+            self._release_row(b)
+            raise
+
     # ------------------------------------------------------- batch resizing
 
     def _resize(self, new_bsz: int):
@@ -682,7 +936,7 @@ class BatchScheduler:
             self._resize(max(1, self._bsz // 2))
 
     def _paged_prefill(self, req: Request, b: int, bucket: int, start: int,
-                       cached) -> object:
+                       cached, seq: list | None = None) -> object:
         """Admit one request onto the paged pool: wire row b's block table
         (sharing a matched prefix's full blocks, CoW-copying at most its
         final partial block), chunk-prefill the remainder straight into
@@ -692,12 +946,18 @@ class BatchScheduler:
         can requeue the request cleanly — and the raise happens BEFORE any
         device work (block sufficiency is prechecked), so a requeue-retry
         cycle under pool pressure never redoes CoW copies or prefill
-        chunks, and never double-counts prefix stats."""
+        chunks, and never double-counts prefix stats.
+
+        ``seq`` overrides the token sequence prefilled (default: the
+        prompt). The re-prefill import rung (_paged_import) passes
+        prompt + accepted-so-far — one chunk walk, two consumers."""
         from .paged import ceil_div, prefill_chunk_positions
 
         e = self.engine
         BS = self._block_size
-        n = len(req.ids)
+        if seq is None:
+            seq = req.ids
+        n = len(seq)
         if cached is None:
             start = 0
         row: list[int] = []
@@ -761,7 +1021,7 @@ class BatchScheduler:
                 # scatters into null-block writes, so the row only ever
                 # claims blocks covering real prompt positions
                 self._ensure_blocks(b, min(pos + bucket, n))
-                chunk = req.ids[pos:pos + bucket]
+                chunk = seq[pos:pos + bucket]
                 tokens = np.zeros((1, bucket), np.int32)
                 tokens[0, :len(chunk)] = chunk
                 tw = self._table_width(len(row))
@@ -771,10 +1031,10 @@ class BatchScheduler:
                     np.asarray([len(chunk)], np.int32),
                     np.int32(pos), tbl, np.int32(start), np.int32(n),
                 )
-            if self._prefix_cache is not None and not self._prefix_cache.has(req.ids):
+            if self._prefix_cache is not None and not self._prefix_cache.has(seq):
                 # pinning is free (refcounts, no snapshot): the entry
-                # claims the blocks covering exactly the prompt positions
-                self._prefix_cache.put(req.ids, row[:ceil_div(n, BS)])
+                # claims the blocks covering exactly the prefilled positions
+                self._prefix_cache.put(seq, row[:ceil_div(n, BS)])
                 # a capacity eviction inside put() may have freed blocks
                 self.stats.paged_blocks_in_use = self._alloc.used_count
             return last_logits
@@ -814,6 +1074,52 @@ class BatchScheduler:
             if self.active == self._bsz:
                 self._resize(min(self._bsz * 2, self.max_batch))
             b = next(i for i, r in enumerate(self._rows) if r is None)
+
+            st = getattr(req, "import_state", None)
+            if st is not None:
+                # migrated-in generation (meshnet/migrate.py): no first-
+                # token sample — cur is the already-emitted last token and
+                # decode resumes from it on the next window
+                try:
+                    with get_tracer().span(
+                        "engine.import", row=b,
+                        offset=int(st.get("offset") or 0),
+                        kv=st.get("kv") is not None,
+                    ):
+                        self._paged_import(req, b, st)
+                except _PoolExhausted as err:
+                    # typed, immediate: the exporter's fallback ladder
+                    # (re-prefill elsewhere) beats parking the import on
+                    # backpressure that may never clear
+                    req.finish = "error"
+                    req.events.put({
+                        "done": True, "result": None,
+                        "error": f"import failed: {err}",
+                        "error_kind": "pool_exhausted",
+                    })
+                    # the pop charged this tenant's WDRR deficit for
+                    # tokens that will never decode — refund, same as the
+                    # cancelled path above
+                    with self._cond:
+                        self._queue.refund(
+                            req.tenant, max(1.0, float(req.max_new_tokens))
+                        )
+                    continue
+                except Exception as err:
+                    req.finish = "error"
+                    req.events.put({
+                        "done": True, "result": None,
+                        "error": f"import failed: {err!r}",
+                    })
+                    raise
+                self._rows[b] = req
+                req.timing.t_first = time.perf_counter()
+                self.stats.admitted += 1
+                self._row_params_dirty = True
+                self.stats.peak_active = max(self.stats.peak_active, self.active)
+                # the import verdict the serving node's ACK rides on
+                req.events.put({"imported": True})
+                continue
 
             n = len(req.ids)
             # longest cached prompt prefix: admit from there and prefill
@@ -959,6 +1265,32 @@ class BatchScheduler:
             self._cur[b] = tok
             self._row_params_dirty = True
             self.stats.peak_active = max(self.stats.peak_active, self.active)
+        # disaggregated prefill→decode: a prefill-designated node offers
+        # every freshly prefilled row to the migration hook; an accepted
+        # row ships its prompt KV to a decode peer and never decodes here
+        # (the hook owns req from the True return on). TTFT stays local —
+        # the first token was sampled above — so the existing histograms
+        # measure the handoff regime unchanged.
+        if self.handoff_after_prefill and self.migrate_cb is not None:
+            for req, b, _i in placed:
+                if self._rows[b] is not req or req.done or req.cancelled:
+                    continue
+                if req.max_new_tokens - len(req.out_ids) < 2:
+                    continue  # nothing left worth shipping
+                try:
+                    snap = self._snapshot_row(b, req)
+                    accepted = bool(
+                        self.migrate_cb(req, snap, "prefill_handoff")
+                    )
+                except Exception:  # noqa: BLE001 — keep decoding locally
+                    logger.exception("prefill handoff failed")
+                    continue
+                if accepted:
+                    self._rows[b] = None
+                    self._release_row(b)
+                    self._row_params_dirty = True
+                    self.stats.migrated_out += 1
+                    self.stats.prefill_handoffs += 1
         self._compact_and_shrink()
 
     def _row_sampling_arrays(self):
@@ -1036,10 +1368,28 @@ class BatchScheduler:
             try:
                 self._ensure_blocks(b, int(self._offsets[b]) + extra)
             except _PoolExhausted as err:
+                # migration-based failover: a row the pool can no longer
+                # grow is fully recoverable state — offer it to the
+                # migration hook (a peer with headroom resumes it KV-
+                # intact) before the terminal typed error
+                migrated = False
+                if self.migrate_cb is not None and not req.cancelled:
+                    try:
+                        snap = self._snapshot_row(b, req)
+                        # hook failures degrade to the typed error below —
+                        # never into the loop's catch-all (_fail_all)
+                        migrated = bool(
+                            self.migrate_cb(req, snap, "pool_exhausted")
+                        )
+                    except Exception:  # noqa: BLE001
+                        logger.exception("pool-pressure migration failed")
+                    if migrated:
+                        self.stats.migrated_out += 1
                 self._rows[b] = None
                 self._release_row(b)
                 self._row_params_dirty = True
-                self._retire_error(req, str(err))
+                if not migrated:
+                    self._retire_error(req, str(err))
         live = [
             len(self._row_blocks[b])
             for b, r in enumerate(self._rows) if r is not None

@@ -43,6 +43,103 @@ async def hard_kill(node) -> None:
         t.cancel()
 
 
+class ChaosMigration:
+    """Deterministic fault injection for live generation migration
+    (meshnet/migrate.py). The satellite contract: every faulted path
+    degrades down the fallback ladder (KV → re-prefill → typed error)
+    with a ``migration:<reason>`` incident bundle, never a hung
+    generation.
+
+    action:
+      - "kill_link":      close the source→target connection after
+                          ``at_chunk`` KV_BLOCKS frames left (mid-stream
+                          transport death: the source's ladder re-prefills
+                          on another peer; the target abandons its partial
+                          import on the drop).
+      - "kill_source":    hard_kill the whole SOURCE node at that point
+                          (process death: nothing falls back — the target
+                          must still clean up, nothing may hang).
+      - "corrupt_piece":  flip a payload byte of chunk ``at_chunk`` so its
+                          sha256 fails at the target (typed hash_mismatch
+                          reject → re-prefill fallback).
+      - "exhaust_target": wrap the TARGET node's engine schedulers so the
+                          next KV import raises pool-exhausted (typed
+                          reject → re-prefill fallback elsewhere).
+
+    ``triggered`` is an asyncio.Event for deterministic sequencing;
+    ``restore()`` unwraps everything (no-op after "kill_source").
+    """
+
+    def __init__(self, node, action: str = "kill_link", at_chunk: int = 0):
+        if action not in (
+            "kill_link", "kill_source", "corrupt_piece", "exhaust_target"
+        ):
+            raise ValueError(f"unknown chaos action {action!r}")
+        self.node = node
+        self.action = action
+        self.at_chunk = int(at_chunk)
+        self.triggered = asyncio.Event()
+        self._restores: list = []
+        if action in ("kill_link", "kill_source", "corrupt_piece"):
+            mgr = node.migration
+            orig = mgr._send_chunk
+
+            async def wrapped(ws, frame: bytes, seq: int):
+                if seq >= self.at_chunk and action == "kill_source":
+                    if not self.triggered.is_set():
+                        self.triggered.set()
+                        await hard_kill(node)
+                    raise ConnectionError("chaos: source killed mid-stream")
+                if seq >= self.at_chunk and action == "kill_link":
+                    self.triggered.set()
+                    with contextlib.suppress(Exception):
+                        await ws.close()
+                    raise ConnectionError("chaos: link dropped mid-stream")
+                if seq == self.at_chunk and action == "corrupt_piece":
+                    self.triggered.set()
+                    frame = frame[:-1] + bytes([frame[-1] ^ 0xFF])
+                await orig(ws, frame, seq)
+
+            mgr._send_chunk = wrapped
+            self._restores.append(lambda: setattr(mgr, "_send_chunk", orig))
+        else:  # exhaust_target
+            from ..engine.scheduler import _PoolExhausted
+
+            # the wrapper below runs on the ENGINE SCHEDULER THREAD;
+            # asyncio.Event.set is not thread-safe, so the trigger hops
+            # back onto the loop that owns the event
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:  # constructed outside a loop (sync test)
+                loop = None
+
+            for svc in node.local_services.values():
+                eng = getattr(svc, "engine", None)
+                sch = getattr(eng, "scheduler", None) if eng else None
+                if sch is None:
+                    continue
+                orig_import = sch._paged_import
+
+                def failing(req, b, st, _sch=sch, _orig=orig_import):
+                    if loop is not None:
+                        loop.call_soon_threadsafe(self.triggered.set)
+                    else:
+                        self.triggered.set()
+                    raise _PoolExhausted("chaos: import pool exhausted")
+
+                sch._paged_import = failing
+                self._restores.append(
+                    lambda _sch=sch, _orig=orig_import: setattr(
+                        _sch, "_paged_import", _orig
+                    )
+                )
+
+    def restore(self) -> None:
+        for undo in self._restores:
+            undo()
+        self._restores.clear()
+
+
 class ChaosStage:
     """Wrap one stage worker node's task handler with a scheduled fault.
 

@@ -26,6 +26,7 @@ import asyncio
 import contextlib
 import contextvars
 import logging
+import os
 import time
 from pathlib import Path
 from typing import Any, Callable
@@ -61,6 +62,7 @@ from ..utils import (
     pump_queue_until,
     sha256_hex,
 )
+from .migrate import MigrationManager
 from .pipeline import StageDead, StageTaskMixin
 
 logger = logging.getLogger("bee2bee_tpu.mesh")
@@ -135,6 +137,10 @@ class P2PNode(StageTaskMixin):
         accept_stages: bool = True,  # advertise pipeline-stage capacity in
         # hello: failover re-placement prefers peers that said yes (set
         # False on client-only nodes that must never host model layers)
+        disagg_role: str | None = None,  # "prefill" | "decode" | None —
+        # disaggregated serving role (BEE2BEE_DISAGG): a prefill node
+        # hands freshly prefilled generations to decode-designated peers
+        # via KV migration; a decode node advertises itself as the target
     ):
         self.host = host
         self.accept_stages = accept_stages
@@ -175,6 +181,22 @@ class P2PNode(StageTaskMixin):
         self.tenants = TenantRegistry(load_tenant_config())
         self.router = RouterPolicy()
         self.prefixes = PrefixTracker()
+        # live generation migration (meshnet/migrate.py): graceful drain,
+        # disaggregated prefill→decode handoff, migration-based failover.
+        # `draining` gates admission (typed 503) and rides the telemetry
+        # digest so RouterPolicy stops routing here.
+        self.draining = False
+        role = (
+            disagg_role
+            if disagg_role is not None
+            else (os.environ.get("BEE2BEE_DISAGG") or "").strip().lower()
+        ) or None
+        if role not in (None, "prefill", "decode"):
+            raise ValueError(
+                f"disagg_role must be 'prefill', 'decode' or unset, got {role!r}"
+            )
+        self.disagg_role = role
+        self.migration = MigrationManager(self)
         self.admission = AdmissionController(
             config=load_admission_config(),
             weights=self.tenants.weights(),
@@ -183,6 +205,7 @@ class P2PNode(StageTaskMixin):
             # the monitor loop refreshes it on the ping cadence
             slo_burn=lambda: self.slo.max_fast_burn(),
             pool_free_fraction=paged_pool_free_fraction,
+            draining=lambda: self.draining,
         )
 
         # piece store: hash -> bytes (optionally spilled to piece_dir)
@@ -262,6 +285,9 @@ class P2PNode(StageTaskMixin):
         return generate_join_link(self.peer_id, [self.addr])
 
     async def start(self):
+        # the migration scheduler hook (a foreign thread) schedules async
+        # work onto this loop — capture it once at boot
+        self._loop = asyncio.get_running_loop()
         self._server = await websockets.serve(
             self._handle_connection,
             self.host,
@@ -277,6 +303,8 @@ class P2PNode(StageTaskMixin):
 
     async def stop(self):
         self._stopped = True
+        # fail outstanding migrations typed before sockets go away
+        self.migration.close()
         # say goodbye and close sockets FIRST — cancelling reader tasks
         # first would purge the peer table before anything gets closed,
         # leaving outbound connections dangling on the remote side
@@ -400,6 +428,9 @@ class P2PNode(StageTaskMixin):
                 logger.exception("handler error for %s", data.get("type"))
 
     async def _drop_peer(self, ws):
+        # migrations riding this connection fail typed NOW (the fallback
+        # ladder re-prefills elsewhere instead of waiting out a timeout)
+        self.migration.on_ws_drop(ws)
         async with self._lock:
             dead = [pid for pid, info in self.peers.items() if info["ws"] is ws]
             for pid in dead:
@@ -518,6 +549,9 @@ class P2PNode(StageTaskMixin):
             protocol.PIECE_HAVE: self._handle_piece_have,
             protocol.GOODBYE: self._handle_goodbye,
             protocol.TELEMETRY: self._handle_telemetry,
+            protocol.KV_EXPORT: self._handle_kv_export,
+            protocol.KV_BLOCKS: self._handle_kv_blocks,
+            protocol.KV_IMPORT_ACK: self._handle_kv_import_ack,
             protocol.TASK: self._handle_task,
             protocol.RESULT: self._handle_result,
             protocol.TASK_ERROR: self._handle_result,
@@ -661,6 +695,13 @@ class P2PNode(StageTaskMixin):
         prefixes = self.prefixes.advertised()
         if prefixes:
             digest["prefix_hashes"] = prefixes
+        # drain state rides the digest so RouterPolicy excludes draining
+        # peers on the same gossip the rest of the scoring reads; the
+        # disagg role is how prefill nodes find decode-designated targets
+        if self.draining:
+            digest["draining"] = True
+        if self.disagg_role:
+            digest["disagg_role"] = self.disagg_role
         return digest
 
     async def gossip_telemetry(self) -> int:
@@ -732,6 +773,9 @@ class P2PNode(StageTaskMixin):
         sched = getattr(getattr(svc, "engine", None), "scheduler", None)
         if sched is not None and hasattr(sched, "set_tenant_weights"):
             sched.set_tenant_weights(self.tenants.weights())
+        # live-migration hook: drain/handoff/pool-pressure rows leave via
+        # this node's migration plane (no-op for engine-less services)
+        self.migration.wire_scheduler(svc)
 
     async def announce_service(self, svc) -> int:
         self.add_service(svc)
@@ -1171,6 +1215,11 @@ class P2PNode(StageTaskMixin):
 
     async def _handle_gen_chunk(self, ws, data):
         rid = data.get("rid") or data.get("task_id")
+        # migration resume streams ride GEN_CHUNK under the migration rid:
+        # the bridge feeds the ORIGINAL request's event queue (token ids,
+        # not just text) — checked first, it owns its rids exclusively
+        if self.migration.feed_chunk(rid, data):
+            return
         async with self._pending_lock:
             cb = self._chunk_cbs.get(rid)
         if cb and data.get("text"):
@@ -1178,11 +1227,31 @@ class P2PNode(StageTaskMixin):
 
     async def _handle_gen_result(self, ws, data):
         rid = data.get("rid") or data.get("task_id")
+        if self.migration.feed_result(rid, data):
+            return
         async with self._pending_lock:
             fut = self._pending.get(rid)
         if fut and not fut.done():
             payload = {k: v for k, v in data.items() if k not in ("type",)}
             fut.set_result(payload)
+
+    # ------------------------------------------------------- live migration
+
+    async def _handle_kv_export(self, ws, data):
+        # adopt the exporter's trace context so the import/resume spans
+        # stitch under the original request's timeline
+        with use_trace_ctx(extract_trace(data)):
+            await self.migration.handle_export(ws, data)
+
+    async def _handle_kv_blocks(self, ws, data):
+        await self.migration.handle_blocks(ws, data)
+
+    async def _handle_kv_import_ack(self, ws, data):
+        self.migration.handle_ack(data)
+
+    async def begin_drain(self, stop: bool = False, wait: bool = True) -> dict:
+        """Graceful drain (POST /admin/drain): see MigrationManager.drain."""
+        return await self.migration.drain(stop=stop, wait=wait)
 
     # ------------------------------------------------------------ pieces
 

@@ -60,6 +60,16 @@ _C_EPOCH_BUMPS = get_registry().counter(
 _C_SESSION_FAILOVERS = get_registry().counter(
     "pipeline.session_failovers", "batched-session failover attempts"
 )
+# migration-preferring failover (ISSUE 9): when the chain is still ALIVE
+# (StageTimeout/StageError, epoch unchanged), the stage KV caches hold
+# every written position — resume decode in place instead of releasing
+# and re-prefilling prompt+accepted. State "migrates" zero bytes: it
+# stays where it is. Re-prefill remains the rung for unrecoverable state
+# (StageDead: the dead stage's cache is gone with its process).
+_C_RESUMES_IN_PLACE = get_registry().counter(
+    "pipeline.resumes_in_place",
+    "failovers resumed on live stage caches without re-prefill",
+)
 
 DEFAULT_STEP_TIMEOUT = 120.0
 # generation-level failover policy defaults (PipelineCoordinator knobs)
@@ -889,6 +899,7 @@ class PipelineCoordinator:
         eos_token_id, on_token, rng, deadline,
     ) -> list[int]:
         attempt = 0
+        resume_in_place = False
         try:
             while True:
                 # the epoch this attempt's chains run under: if a failure
@@ -899,10 +910,26 @@ class PipelineCoordinator:
                     return await self._generate_attempt(
                         rid, prompt_ids, out, max_new_tokens, temperature,
                         eos_token_id, on_token, rng,
+                        resume_in_place=resume_in_place,
                     )
                 except StageError as e:
                     attempt += 1
                     remaining = deadline - time.time()
+                    # migration-preferring rung: an ALIVE chain (typed
+                    # timeout/error, no re-placement happened, tokens
+                    # accepted) keeps every stage's KV — resume decode in
+                    # place. One try per generation: a second failure
+                    # escalates to the release+recover+re-prefill rung
+                    # (and StageDead skips straight there — a dead
+                    # stage's cache is unrecoverable state).
+                    resume_in_place = (
+                        bool(out)
+                        and not isinstance(e, StageDead)
+                        and self.epoch == attempt_epoch
+                        and attempt == 1
+                        and attempt <= self.max_failover_retries
+                        and remaining > 0
+                    )
                     # flight-recorder incident BEFORE the terminal check:
                     # both a failover and a final failure leave a bundle.
                     # We're inside the pipeline.generate span, so the
@@ -916,6 +943,7 @@ class PipelineCoordinator:
                             "accepted_tokens": len(out),
                             "model": self.model,
                             "epoch": attempt_epoch,
+                            "resume_in_place": resume_in_place,
                             "terminal": attempt > self.max_failover_retries
                             or remaining <= 0,
                         },
@@ -924,14 +952,25 @@ class PipelineCoordinator:
                         raise
                     logger.warning(
                         "pipeline generation hit %s (%s); failover attempt "
-                        "%d/%d with %d tokens accepted",
+                        "%d/%d with %d tokens accepted%s",
                         type(e).__name__, e, attempt,
                         self.max_failover_retries, len(out),
+                        " (resuming in place)" if resume_in_place else "",
                     )
                     await asyncio.sleep(min(
                         self.failover_backoff_s * 2 ** (attempt - 1),
                         max(remaining, 0.0),
                     ))
+                    if resume_in_place:
+                        # re-check AFTER the sleep: a concurrent failover
+                        # may have rebuilt the chain meanwhile — this
+                        # rid's stage caches are gone on replacements, so
+                        # an in-place resume would decode over garbage
+                        if self.epoch != attempt_epoch:
+                            resume_in_place = False
+                        else:
+                            _C_RESUMES_IN_PLACE.inc()
+                            continue  # same rid: stage caches stay live
                     # every recovery step is capped by the REMAINING
                     # deadline budget: a wedged stage that also swallows
                     # release/part_load must not stretch time-to-failure
@@ -961,13 +1000,30 @@ class PipelineCoordinator:
 
     async def _generate_attempt(
         self, rid, prompt_ids, out, max_new_tokens, temperature,
-        eos_token_id, on_token, rng,
+        eos_token_id, on_token, rng, resume_in_place: bool = False,
     ) -> list[int]:
         """One pass of the decode loop. `out` accumulates ACROSS attempts:
         on resume, prompt + accepted tokens re-prefill in one chain call
-        and decode continues from where the failure struck."""
+        and decode continues from where the failure struck.
+
+        ``resume_in_place`` (alive-chain failover): skip the prefill —
+        the stage caches under this SAME rid already hold K/V for every
+        position below the frontier. Re-chaining the last accepted token
+        at its own offset rewrites at most one position with identical
+        values (idempotent) and yields the next sample; positions a
+        half-finished step wrote past the frontier are overwritten or
+        causally masked exactly like bucketed-prefill padding."""
         full = list(prompt_ids) + out
         n = len(full)
+        if resume_in_place and out:
+            logits = await self._chain(
+                rid, np.asarray([[full[-1]]], np.int32), offset=n - 1
+            )
+            tok = self._sample(logits[0, -1], temperature, rng)
+            return await self._decode_loop(
+                rid, out, max_new_tokens, temperature, eos_token_id,
+                on_token, rng, tok, offset=n,
+            )
         # pow2 prompt bucket bounds worker recompiles; pad K/V past n is
         # overwritten by decode exactly when it enters the causal window
         # (same trick as the engine's bucketed prefill)
@@ -992,7 +1048,18 @@ class PipelineCoordinator:
                 temperature=temperature,
                 seed=int(rng.integers(2**31)),
             )
-        offset = n
+        return await self._decode_loop(
+            rid, out, max_new_tokens, temperature, eos_token_id, on_token,
+            rng, tok, offset=n,
+        )
+
+    async def _decode_loop(
+        self, rid, out, max_new_tokens, temperature, eos_token_id,
+        on_token, rng, tok, offset: int,
+    ) -> list[int]:
+        """The per-token chain loop, shared by the fresh-prefill and
+        resume-in-place entries (tok = next unchained sample, offset =
+        the cache position its K/V will occupy)."""
         while True:
             if eos_token_id is not None and tok == eos_token_id:
                 break
@@ -1287,6 +1354,8 @@ class PipelineSession:
             "chains": 0, "steps": 0, "prefills": 0, "tokens": 0,
             "tasks_sent": 0,  # coordinator sends: chains x stages, or
             # chains x 1 under relay — the wire-cost metric tests assert
+            "resumes_in_place": 0,  # alive-chain failovers that kept the
+            # stage caches (no re-prefill) — the migration-preferred rung
         }
 
     # ------------------------------------------------------------- public
@@ -1548,11 +1617,53 @@ class PipelineSession:
 
     async def _on_step_failure(self, e: Exception,
                                admitting: "_SessionReq | None") -> None:
-        """A chain call failed. Pull every in-flight row out of the
-        groups, rotate the session id, and either FAIL OVER (typed stage
-        failure, attempts left: recover the chain and requeue the rows —
-        admission re-prefills prompt + accepted-so-far) or fail the rows
-        with the typed error."""
+        """A chain call failed. Migration-preferring ladder: while the
+        chain is ALIVE (typed timeout/error, not StageDead, no rebuild
+        happened elsewhere), resume IN PLACE — rows keep their stage
+        caches and the loop simply retries the step (a re-chained
+        position rewrites identical K/V; see _generate_attempt's resume
+        note). Otherwise pull every in-flight row out of the groups,
+        rotate the session id, and either FAIL OVER (recover the chain
+        and requeue the rows — admission re-prefills prompt +
+        accepted-so-far) or fail the rows with the typed error."""
+        if (
+            not self._closed
+            and isinstance(e, StageError)
+            and not isinstance(e, StageDead)
+            and self._failovers == 0
+            and self.max_failovers > 0
+            and (self.coordinator is None
+                 or self.coordinator.epoch == self.epoch)
+        ):
+            # one in-place try per failure burst (_failovers resets on a
+            # whole successful step); a repeat escalates to re-prefill
+            self._failovers += 1
+            await asyncio.sleep(self.failover_backoff_s)
+            # re-check AFTER the sleep: a coordinator-level failover may
+            # have rebuilt the chain meanwhile, invalidating this sid's
+            # stage caches — fall through to the full requeue path then,
+            # bounded by the already-incremented _failovers
+            if (self.coordinator is None
+                    or self.coordinator.epoch == self.epoch):
+                if admitting is not None:
+                    # the popped request never finished admission: its
+                    # masked prefill re-runs against the same sid
+                    # (idempotent row writes), resumed rows are untouched
+                    self._pending.insert(0, admitting)
+                self.stats["resumes_in_place"] = (
+                    self.stats.get("resumes_in_place", 0) + 1
+                )
+                _C_RESUMES_IN_PLACE.inc()
+                logger.warning(
+                    "session step failed (%s: %s); resuming in place on "
+                    "live stage caches", type(e).__name__, e,
+                )
+                return
+            logger.warning(
+                "session step failed (%s: %s); chain rebuilt during "
+                "backoff — requeueing rows instead of resuming in place",
+                type(e).__name__, e,
+            )
         # the popped-but-not-yet-admitted request is in neither _pending
         # nor a group — collect it with the rest so it can't hang
         inflight: list[_SessionReq] = [admitting] if admitting is not None else []

@@ -36,6 +36,19 @@ GOODBYE = "goodbye"
 # ping cadence — NOT in the reference message set, but safe on the wire
 # because the reference ignores unknown message types entirely
 TELEMETRY = "telemetry"
+# live generation migration (meshnet/migrate.py): a node exports an
+# in-flight generation's KV blocks + decode state to a peer, which
+# imports them into its own paged pool and resumes decoding token-for-
+# token — drain/rebalance without re-prefill. KV_EXPORT carries the
+# generation snapshot (JSON), KV_BLOCKS the hashed pool-block tensors
+# (binary tensor frames, pieces.py-style sha256 per buffer), and
+# KV_IMPORT_ACK the target's typed accept/reject. The resumed stream
+# rides the existing GEN_CHUNK / GEN_SUCCESS / GEN_ERROR plumbing under
+# the migration rid. Not in the reference message set (ignored by old
+# peers — a migration to one simply times out and falls back).
+KV_EXPORT = "kv_export"
+KV_BLOCKS = "kv_blocks"
+KV_IMPORT_ACK = "kv_import_ack"
 
 # ---- coordinator/worker task protocol (reference protocol.py:25-53, node.py:89+)
 REGISTER = "register"
@@ -85,6 +98,9 @@ MESSAGE_TYPES = frozenset(
         PIECE_HAVE,
         GOODBYE,
         TELEMETRY,
+        KV_EXPORT,
+        KV_BLOCKS,
+        KV_IMPORT_ACK,
         REGISTER,
         INFO,
         TASK,

@@ -64,9 +64,10 @@ KIND_QUEUE = "queue_full"
 KIND_TIMEOUT = "queue_timeout"
 KIND_POOL = "pool_exhausted"
 KIND_SLO = "slo_shed"
+KIND_DRAINING = "draining"
 
 # 429: the CALLER's contract (its budget, its share of the queue);
-# 503: the NODE's state (overload, pool, SLO) — retry elsewhere/later
+# 503: the NODE's state (overload, pool, SLO, drain) — retry elsewhere/later
 _STATUS = {
     KIND_RATE: 429,
     KIND_TENANT_QUEUE: 429,
@@ -74,6 +75,7 @@ _STATUS = {
     KIND_TIMEOUT: 503,
     KIND_POOL: 503,
     KIND_SLO: 503,
+    KIND_DRAINING: 503,
 }
 
 
@@ -248,6 +250,8 @@ class AdmissionController:
         budgets: dict[str, tuple[float, float]] | None = None,
         slo_burn=None,
         pool_free_fraction=None,
+        draining=None,  # callable -> bool: node drain state (migrate.py);
+        # True rejects every new acquisition 503 `draining` + Retry-After
         now=time.monotonic,
     ):
         self.config = config or AdmissionConfig()
@@ -257,6 +261,7 @@ class AdmissionController:
         }
         self._slo_burn = slo_burn
         self._pool_free = pool_free_fraction
+        self._draining = draining
         self._free = int(self.config.max_concurrent)
         self._waiters = WdrrQueue(weights or {}, quantum=self.config.quantum)
         self._queued_total = 0
@@ -289,9 +294,21 @@ class AdmissionController:
     def queued(self) -> int:
         return self._queued_total
 
-    def _check_shed(self) -> None:
+    def _check_shed(self, migration: bool = False) -> None:
         cfg = self.config
-        if self._slo_burn is not None:
+        if self._draining is not None and self._draining():
+            # draining precedes every other check: the node is leaving —
+            # in-flight generations migrate out, new work goes elsewhere
+            # (and it must not ACCEPT migrations while exporting its own)
+            self._reject(
+                KIND_DRAINING, cfg.shed_retry_after_s,
+                "node is draining; retry against another peer",
+            )
+        if not migration and self._slo_burn is not None:
+            # migration imports skip ONLY this clause: evacuated state
+            # must land somewhere, the exporter's router already
+            # deprioritizes burning peers, and the pool/queue bounds
+            # below still protect the target
             burn = self._slo_burn()
             if burn is not None and burn >= cfg.shed_burn_rate:
                 self._reject(
@@ -338,13 +355,17 @@ class AdmissionController:
             bucket.refund(cost)
 
     async def acquire(self, tenant: str = "default",
-                      cost_tokens: float = 1.0) -> AdmissionTicket:
+                      cost_tokens: float = 1.0,
+                      migration: bool = False) -> AdmissionTicket:
         """Admit one generation (await a slot if saturated) or raise a
         typed AdmissionReject. ``cost_tokens`` is the request's token ask
-        (max_new_tokens) — the unit budgets and WDRR fairness run in."""
+        (max_new_tokens) — the unit budgets and WDRR fairness run in.
+        ``migration`` marks a KV import (meshnet/migrate.py): it is not
+        new demand but state being EVACUATED, so the SLO shed does not
+        apply — draining, queue and pool bounds still do."""
         tenant = str(tenant or "default")
         cost = max(float(cost_tokens), 1.0)
-        self._check_shed()
+        self._check_shed(migration=migration)
         if self._free > 0 and self._queued_total == 0:
             self._charge_budget(tenant, cost)
             self._free -= 1
@@ -393,7 +414,10 @@ class AdmissionController:
             if fut.done() and not fut.cancelled():
                 # granted between the caller's cancellation and this frame
                 # resuming: _dispatch already uncounted it and took the
-                # slot — hand the slot straight back
+                # slot — hand the slot straight back, and refund the
+                # budget like every other work-never-ran path (cancel
+                # storms must not convert into a rate-limit lockout)
+                self._refund_budget(tenant, cost)
                 self._release()
             else:
                 self._unqueue(tenant)

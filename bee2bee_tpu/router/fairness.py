@@ -93,6 +93,17 @@ class WdrrQueue:
                 if q:
                     self._deficit[tenant] += self.quantum * self.weight(tenant)
 
+    def remove(self, item) -> bool:
+        """Remove one queued item by identity (a drain pulls un-admitted
+        requests out of the submit queue to forward them whole). Deficit
+        is untouched — append never charged any."""
+        for q in self._queues.values():
+            for entry in q:
+                if entry[0] is item:
+                    q.remove(entry)
+                    return True
+        return False
+
     def refund(self, tenant: str, cost: float) -> None:
         """Return deficit charged for a popped item that never ran (a
         timed-out admission waiter, a cancelled request): without this,

@@ -50,6 +50,9 @@ _C_PREFIX_PREFERRED = get_registry().counter(
 _C_SLO_EXCLUDED = get_registry().counter(
     "router.slo_excluded", "candidates excluded for burning their SLO budget"
 )
+_C_DRAIN_EXCLUDED = get_registry().counter(
+    "router.drain_excluded", "candidates excluded for draining"
+)
 
 MODE_SCORED = "scored"
 MODE_STATIC = "static_fallback"
@@ -194,6 +197,13 @@ class RouterPolicy:
                 local_digest if cand.get("local")
                 else fresh_digests.get(cand.get("provider_id"))
             )
+            if digest is not None and digest.get("draining"):
+                # a draining peer is LEAVING: its admission 503s every
+                # new request anyway — unlike the SLO exclusion below,
+                # there is no all-burning waiver back in (routing to it
+                # just converts one hop into a guaranteed typed shed)
+                _C_DRAIN_EXCLUDED.inc()
+                continue
             if _slo_burning(digest):
                 excluded += 1
                 _C_SLO_EXCLUDED.inc()
@@ -205,12 +215,15 @@ class RouterPolicy:
             scored.append((s, i, cand, breakdown))
         if not scored and excluded:
             # every candidate is burning: serve SOMEWHERE — degraded
-            # routing beats a routable-provider deadlock
+            # routing beats a routable-provider deadlock (draining peers
+            # stay out even here: they reject typed regardless)
             for i, cand in enumerate(candidates):
                 digest = (
                     local_digest if cand.get("local")
                     else fresh_digests.get(cand.get("provider_id"))
                 )
+                if digest is not None and digest.get("draining"):
+                    continue
                 s, breakdown = self.score(
                     cand, digest, cand.get("_latency"), max_price, ph
                 )

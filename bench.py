@@ -523,6 +523,163 @@ def bench_router_fairness(duration_s: float = 6.0) -> dict:
     return out
 
 
+def bench_migration(duration_tokens: int = 96, n_streams: int = 3) -> dict:
+    """Live-migration rung (ISSUE 9 acceptance): a 3-node loopback mesh
+    under concurrent streaming load; node A drains mid-decode and the
+    rung reports TTFT + inter-token gaps per mode, the MIGRATION PAUSE
+    (widest inter-chunk gap — the client-visible cost of the handoff)
+    for KV-resume vs forced re-prefill failover, and the scheduler
+    counters pinning zero re-prefill on the happy path. tiny-llama with
+    random-init weights (identical rng seeds stand in for a shared
+    checkpoint), so the rung runs on any platform; judge per the rung's
+    own platform stamp. Standalone: ``python bench.py migration``."""
+    import asyncio
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    async def one_mode(force_reprefill: bool) -> dict:
+        from bee2bee_tpu.engine import EngineConfig, InferenceEngine
+        from bee2bee_tpu.meshnet.node import P2PNode
+        from bee2bee_tpu.services.tpu import TPUService
+
+        cfg = dict(
+            max_seq_len=256, prefill_buckets=(16, 32, 64),
+            decode_chunk=4, max_batch=max(4, n_streams),
+        )
+        nodes, svcs = [], []
+        try:
+            for _ in range(3):
+                node = P2PNode(host="127.0.0.1", port=0)
+                node.ping_interval_s = 0.2
+                await node.start()
+                svc = TPUService("tiny-llama", engine=InferenceEngine(
+                    "tiny-llama", engine_config=EngineConfig(**cfg)
+                ))
+                node.add_service(svc)
+                nodes.append(node)
+                svcs.append(svc)
+            for node in nodes[1:]:
+                await node.connect_bootstrap(nodes[0].addr)
+            await asyncio.sleep(0.3)
+            for node, svc in zip(nodes, svcs):
+                await node.announce_service(svc)
+            for node in nodes:
+                await node.gossip_telemetry()
+            await asyncio.sleep(0.3)
+            a = nodes[0]
+            a.migration.force_reprefill = force_reprefill
+            # warm every engine's compile paths: the source's CONCURRENT
+            # batch shapes (the measured run admits n_streams rows) and
+            # each target's batch-1 prefill/decode — so the measured
+            # pause is the migration, not first-compile
+            await asyncio.gather(*[
+                asyncio.to_thread(
+                    svcs[0].engine.generate, f"warm {i}", max_new_tokens=8
+                )
+                for i in range(n_streams)
+            ])
+            for svc in svcs[1:]:
+                await asyncio.to_thread(
+                    svc.engine.generate, "warm target", max_new_tokens=8
+                )
+
+            # timestamp TOKEN events, not text chunks: the fallback
+            # tokenizer's UTF-8 holdback can delay text flushes, while
+            # token events fire per decode chunk (and per bridged chunk
+            # after the migration) — exactly the client-visible cadence
+            chunk_ts: list[list[float]] = [[] for _ in range(n_streams)]
+            t_submit = [0.0] * n_streams
+
+            def consume(i):
+                for ev in svcs[0].engine.generate_stream(
+                    f"stream {i} counts tokens over and over",
+                    max_new_tokens=duration_tokens,
+                ):
+                    if ev.get("done"):
+                        return ev["result"]
+                    chunk_ts[i].append(_time.perf_counter())
+
+            tasks = []
+            for i in range(n_streams):
+                t_submit[i] = _time.perf_counter()
+                tasks.append(asyncio.create_task(asyncio.to_thread(consume, i)))
+            # let every stream admit AND produce a few chunks, then drain
+            # mid-decode (a request still inside its admission burst is
+            # invisible to checkpoint and would be silently kept local)
+            for _ in range(1500):
+                await asyncio.sleep(0.02)
+                rows = svcs[0].engine.scheduler.live_requests()
+                if (len(rows) >= n_streams
+                        and all(len(ts) >= 2 for ts in chunk_ts)):
+                    break
+            t_drain = _time.perf_counter()
+            summary = await a.begin_drain()
+            results = await asyncio.gather(*tasks, return_exceptions=True)
+            ok = [r for r in results if not isinstance(r, BaseException)]
+            ttft_ms, pause_ms, e2e_s = [], [], []
+            for i, ts in enumerate(chunk_ts):
+                if not ts:
+                    continue
+                ttft_ms.append((ts[0] - t_submit[i]) * 1000.0)
+                e2e_s.append(ts[-1] - t_submit[i])
+                post = [t for t in ts if t > t_drain]
+                pre = [t for t in ts if t <= t_drain]
+                if post and pre:
+                    pause_ms.append((post[0] - pre[-1]) * 1000.0)
+            sched = svcs[0].engine.scheduler.stats
+            imported = sum(s.engine.scheduler.stats.migrated_in
+                           for s in svcs[1:])
+            reprefills = sum(s.engine.scheduler.stats.import_reprefills
+                             for s in svcs[1:])
+            return {
+                "completed": len(ok),
+                "drain_summary": {k: v for k, v in summary.items()
+                                  if k != "draining"},
+                "migrated_out": sched.migrated_out,
+                "migrated_in": imported,
+                "import_reprefills": reprefills,
+                "ttft_ms_mean": round(np.mean(ttft_ms), 1) if ttft_ms else None,
+                "migration_pause_ms_mean": (
+                    round(np.mean(pause_ms), 1) if pause_ms else None
+                ),
+                "migration_pause_ms_max": (
+                    round(max(pause_ms), 1) if pause_ms else None
+                ),
+                "e2e_s_mean": round(np.mean(e2e_s), 3) if e2e_s else None,
+            }
+        finally:
+            for node in nodes:
+                try:
+                    await node.stop()
+                except Exception:  # noqa: BLE001
+                    pass
+            for svc in svcs:
+                if svc.engine is not None:
+                    svc.engine.close()
+
+    resume = asyncio.run(one_mode(force_reprefill=False))
+    reprefill = asyncio.run(one_mode(force_reprefill=True))
+    out = {
+        "platform": jax.devices()[0].platform,
+        "platform_fallback": os.environ.get(
+            "_BEE2BEE_BENCH_CPU_FALLBACK") == "1",
+        "streams": n_streams,
+        "new_tokens": duration_tokens,
+        "migration_resume": resume,
+        "reprefill_failover": reprefill,
+    }
+    log(
+        f"migration rung: drain pause mean "
+        f"{resume.get('migration_pause_ms_mean')} ms (KV resume, "
+        f"{resume.get('import_reprefills')} re-prefills) vs "
+        f"{reprefill.get('migration_pause_ms_mean')} ms (re-prefill "
+        f"failover); TTFT mean {resume.get('ttft_ms_mean')} ms"
+    )
+    return out
+
+
 def bench_reference_path() -> float:
     """The reference's hot loop: HF transformers greedy generate on torch CPU
     (reference hf.py:35-44 minus tokenization — token ids in, ids out)."""
@@ -616,6 +773,16 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — the rung must not kill the bench
         log(f"router_fairness rung failed: {e}")
         extras["router_fairness"] = {"error": str(e)}
+
+    # live-migration rung (ISSUE 9 acceptance: drain pause for KV resume
+    # vs re-prefill failover on a 3-node loopback mesh under load; the
+    # happy path must show zero re-prefills). tiny-model, any platform —
+    # judged per the rung's own platform stamp
+    try:
+        extras["migration"] = bench_migration()
+    except Exception as e:  # noqa: BLE001 — the rung must not kill the bench
+        log(f"migration rung failed: {e}")
+        extras["migration"] = {"error": str(e)}
 
     if platform == "tpu":
         def rung(key: str, **kw) -> None:
@@ -725,5 +892,10 @@ if __name__ == "__main__":
     # JSON alone so CI can gate on the token ratio directly
     if len(sys.argv) > 1 and sys.argv[1] == "router_fairness":
         print(json.dumps(bench_router_fairness()), flush=True)
+        sys.exit(0)
+    # `python bench.py migration`: the live-migration drain rung standalone
+    # (tiny random-init model — runs on whatever backend jax resolves)
+    if len(sys.argv) > 1 and sys.argv[1] == "migration":
+        print(json.dumps(bench_migration()), flush=True)
         sys.exit(0)
     main()

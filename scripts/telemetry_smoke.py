@@ -205,10 +205,79 @@ async def run_mesh_health_smoke() -> None:
         await a.stop()
 
 
+async def run_drain_smoke() -> None:
+    """Drain plumbing (ISSUE 9, model-free half): POST /admin/drain flips
+    the node — new requests answer typed 503 ``draining`` + Retry-After,
+    the drain flag rides the gossiped digest, and the peer's router
+    excludes the draining node."""
+    import asyncio as aio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from bee2bee_tpu.api import build_app
+    from bee2bee_tpu.meshnet.node import P2PNode
+    from bee2bee_tpu.services.fake import FakeService
+
+    a = P2PNode(host="127.0.0.1", port=0)
+    b = P2PNode(host="127.0.0.1", port=0)
+    await a.start()
+    await b.start()
+    client = None
+    try:
+        a.add_service(FakeService("smoke-model", reply="drain smoke ok"))
+        assert await b.connect_bootstrap(a.addr), "bootstrap connect failed"
+        for _ in range(100):
+            if a.peers and b.peers:
+                break
+            await aio.sleep(0.05)
+        await a.gossip_telemetry()
+        for _ in range(100):
+            if b.health.fresh():
+                break
+            await aio.sleep(0.05)
+        assert b.pick_provider("smoke-model") is not None
+
+        client = TestClient(TestServer(build_app(a)))
+        await client.start_server()
+        r = await client.post("/admin/drain", json={})
+        assert r.status == 200, f"/admin/drain returned {r.status}"
+        assert (await r.json())["draining"] is True
+
+        r = await client.post(
+            "/chat", json={"prompt": "x", "model": "smoke-model"}
+        )
+        assert r.status == 503, f"draining /chat returned {r.status}"
+        body = await r.json()
+        assert body.get("error_kind") == "draining", body
+        assert int(r.headers.get("Retry-After", 0)) >= 1, (
+            "draining 503 missing Retry-After"
+        )
+
+        # the drain flag rides the digest; the peer's router excludes us
+        await a.gossip_telemetry()
+        for _ in range(100):
+            d = b.health.fresh().get(a.peer_id)
+            if d and d.get("draining"):
+                break
+            await aio.sleep(0.05)
+        assert b.health.fresh()[a.peer_id].get("draining") is True, (
+            "drain state never reached the peer's digest store"
+        )
+        assert b.pick_provider("smoke-model", remote_only=True) is None, (
+            "router still picks the draining node"
+        )
+    finally:
+        if client is not None:
+            await client.close()
+        await b.stop()
+        await a.stop()
+
+
 def main() -> int:
     try:
         asyncio.run(run_smoke())
         asyncio.run(run_mesh_health_smoke())
+        asyncio.run(run_drain_smoke())
     except AssertionError as e:
         print(f"[telemetry-smoke] FAIL: {e}", file=sys.stderr)
         return 1
