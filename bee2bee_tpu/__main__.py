@@ -276,10 +276,11 @@ def serve_stage(model, n_stages, stage, checkpoint, max_seq_len, quantize, **kw)
 @click.option("--max-batch", type=int, default=8,
               help="continuous-batching rows in the pipeline session")
 @click.option("--microbatches", default="auto", callback=_microbatches_arg,
-              help="'auto' (2 when stages run on distinct hosts, else 1) "
-                   "or an int >= 1; >1 overlaps microbatch groups across "
-                   "stages (GPipe-style over the wire; costs proportionally "
-                   "more hops)")
+              help="'auto' (a compute-vs-hop depth from gossiped stage "
+                   "timings on distinct hosts, legacy 2 without telemetry, "
+                   "1 on a shared host) or an int >= 1; >1 runs that many "
+                   "free-running microbatch groups whose chains interleave "
+                   "across stages (costs proportionally more hops)")
 @click.option("--quantize", type=click.Choice(["none", "int8"]), default="none",
               help="each stage int8-quantizes its slice at part_load")
 @_common_opts

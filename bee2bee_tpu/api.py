@@ -23,6 +23,7 @@ from aiohttp import web
 import math
 
 from . import __version__
+from . import health
 from .health import fleet_view, render_fleet_prom
 from .meshnet.node import P2PNode
 from .metrics import PROMETHEUS_CONTENT_TYPE, get_registry
@@ -393,6 +394,11 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
             # serve the last measured p50 as if it were current (the
             # pre-registry exposition omitted the line in this case too)
             _G_P50_LATENCY.clear()
+        # pipeline stage idleness (ISSUE 10): bee2bee_pipeline_bubble_
+        # fraction is DERIVED from the tracer's stage.task spans, so a
+        # scrape recomputes it over the trailing window (and clears it
+        # when this node served no stage traffic — never-throw inside)
+        health.local_stage_idleness()
 
     async def metrics(request):
         """The node's metrics registry (metrics.py): Prometheus text

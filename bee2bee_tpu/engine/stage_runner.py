@@ -27,10 +27,21 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..metrics import get_registry
 from ..models import config as model_config
 from ..models import core, stages
 
 STALE_CACHE_S = 600.0  # drop request caches untouched this long
+
+# serving forward time (jit dispatch + host readback), measured INSIDE
+# the concurrency gate so queue/semaphore wait never inflates it: the
+# digest p50 of this series is the "stage compute" the coordinator's
+# microbatch auto-depth heuristic divides by (meshnet/pipeline.py
+# resolve_microbatches; health.DIGEST_HISTOGRAMS carries it).
+_H_STAGE_TASK_MS = get_registry().histogram(
+    "pipeline.stage_task_ms",
+    "stage forward compute + readback time (excludes queue wait)",
+)
 
 
 class StageRunner:
@@ -53,6 +64,13 @@ class StageRunner:
         epoch: int = 0,  # stage epoch (pipeline failover): tasks stamped
         # with a different epoch are rejected, so late traffic routed to a
         # replaced occupant can never corrupt the rebuilt chain
+        max_concurrent_forwards: int = 4,  # concurrent jit dispatches this
+        # stage will run: an interleaved coordinator free-runs one chain
+        # per microbatch group, and without a bound a deep group fan-out
+        # (or several coordinators sharing a worker) queues unbounded
+        # compute on the device while earlier dispatches still hold HBM
+        # scratch. Excess callers BLOCK on their executor thread — the
+        # wire-level backpressure the coordinator's sliding window rides
     ):
         # same any-checkpoint rule as the engine
         # (`serve-stage --model auto --checkpoint <dir>`)
@@ -126,6 +144,8 @@ class StageRunner:
         self._fwd = jax.jit(_wrapped, donate_argnums=(2,))
         self._caches: dict[str, dict] = {}  # request_id -> {"cache", "touched"}
         self._lock = threading.Lock()
+        self.max_concurrent_forwards = max(1, int(max_concurrent_forwards))
+        self._fwd_sem = threading.BoundedSemaphore(self.max_concurrent_forwards)
 
         # ---- cross-peer pipeline TRAINING (TPU-native realization of the
         # reference's layer_forward_train/layer_backward worker tasks,
@@ -180,6 +200,10 @@ class StageRunner:
             # a worker that outlived a coordinator restart reports the
             # epoch it is at; the coordinator adopts the max and re-loads
             "epoch": self.epoch,
+            # stage-side concurrency cap: how many chains this worker
+            # will run at once (the interleaved session's window should
+            # not be sized past the fleet's smallest cap)
+            "max_concurrent_forwards": self.max_concurrent_forwards,
         }
 
     def matches_load(self, data: dict) -> bool:
@@ -255,7 +279,9 @@ class StageRunner:
             else jnp.asarray(np.asarray(gather, np.int32))
         )
         try:
-            out, cache = self._fwd(self.params, xj, cache, off, mask, gat)
+            with self._fwd_sem:
+                t0 = time.perf_counter()
+                out, cache = self._fwd(self.params, xj, cache, off, mask, gat)
         except Exception:
             # free the slot: leaving the None entry would burn a max_batch
             # row for stale_cache_s and turn retries into misleading
@@ -270,8 +296,11 @@ class StageRunner:
         # in the compute dtype (bf16 halves inter-peer bandwidth, the
         # stages.py design point)
         if self.spec.is_last:
-            return np.asarray(jax.device_get(out), np.float32)
-        return np.asarray(jax.device_get(out.astype(self.dtype)))
+            host = np.asarray(jax.device_get(out), np.float32)
+        else:
+            host = np.asarray(jax.device_get(out.astype(self.dtype)))
+        _H_STAGE_TASK_MS.observe((time.perf_counter() - t0) * 1000.0)
+        return host
 
     # ----------------------------------------------------------- training
 

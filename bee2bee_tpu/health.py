@@ -61,6 +61,10 @@ DIGEST_HISTOGRAMS = (
     "engine.inter_token_ms",
     "engine.e2e_latency_ms",
     "service.execute_ms",
+    # worker-side stage compute (engine/stage_runner.py, measured inside
+    # the concurrency gate): its p50 feeds the coordinator's microbatch
+    # auto-depth heuristic (resolve_microbatches)
+    "pipeline.stage_task_ms",
 )
 DIGEST_GAUGES = (
     "engine.batch_fill",
@@ -83,15 +87,178 @@ DIGEST_COUNTERS = (
 # bubble-fraction analysis needs per-stage task counts, not one total)
 DIGEST_STAGE_TASKS = "pipeline.stage_tasks"
 
+# ------------------------------------------------- pipeline bubble fraction
+#
+# ISSUE 10: the MPMD serving analogue of arxiv 2412.14374's bubble
+# analysis. A stage worker's stage.task spans (meshnet/pipeline.py) record
+# exactly when its compute was busy; everything else inside the
+# observation window is bubble — the stage sat idle while its neighbors
+# computed. Derived, never sampled: the gauges below are recomputed from
+# the local tracer ring at digest-build/scrape time, and the same interval
+# math serves stitched cross-node traces (bench + /trace consumers).
+
+BUBBLE_WINDOW_S = 30.0
+
+# stage.task spans that count as BUSY serving compute. part_load
+# (checkpoint read + XLA compile) and part_release also run inside
+# stage.task spans; counting a failover reload as "busy" would report
+# ~zero bubble during exactly the incident when the pipeline is
+# maximally stalled. Literal protocol task-kind values (health cannot
+# import meshnet.pipeline — it imports health for the recorder).
+_BUBBLE_TASK_KINDS = ("part_forward", "part_forward_relay", "decode_run")
+
+_G_BUBBLE = get_registry().gauge(
+    "pipeline.bubble_fraction",
+    "fraction of the observation window this node's pipeline stages sat "
+    "idle (1 - busy; from stage.task spans)",
+)
+_G_STAGE_BUSY = get_registry().gauge(
+    "pipeline.stage_busy_fraction",
+    "per-stage busy fraction over the observation window",
+)
+
+
+def _merge_busy_ms(intervals: list[tuple[float, float]]) -> float:
+    """Total covered milliseconds of possibly-overlapping [a, b) spans —
+    concurrent forwards on one stage must not double-count busy time."""
+    busy = 0.0
+    cur_a = cur_b = None
+    for a, b in sorted(intervals):
+        if cur_b is None or a > cur_b:
+            if cur_b is not None:
+                busy += cur_b - cur_a
+            cur_a, cur_b = a, b
+        else:
+            cur_b = max(cur_b, b)
+    if cur_b is not None:
+        busy += cur_b - cur_a
+    return busy
+
+
+def bubble_from_spans(
+    spans: list[dict],
+    window_start_ms: float | None = None,
+    window_end_ms: float | None = None,
+) -> dict | None:
+    """Bubble fraction from ``stage.task`` span dicts (local tracer
+    output OR a stitched cross-node timeline — spans may carry a ``node``
+    key). Spans are clipped to the window (default: the spans' own
+    extent); per-stage busy intervals merge before summing, so concurrent
+    chains never count a stage >100% busy. Returns None when no completed
+    stage.task span lands in the window.
+
+    ``bubble_fraction`` is 1 - mean per-stage busy fraction: 0.0 means
+    every stage computed wall-to-wall, 0.5 means the average stage sat
+    idle half the window — the number the interleaved scheduler exists
+    to drive toward zero."""
+    stage_spans = []
+    for s in spans or []:
+        if s.get("name") != "stage.task":
+            continue
+        kind = (s.get("attrs") or {}).get("kind")
+        if kind is not None and kind not in _BUBBLE_TASK_KINDS:
+            continue  # loads/releases are stall time, not serving compute
+        d = s.get("duration_ms")
+        a = s.get("start_ms")
+        if d is None or a is None or d < 0:
+            continue  # open/malformed spans carry no busy interval
+        stage_spans.append(s)
+    if not stage_spans:
+        return None
+    if window_start_ms is None:
+        window_start_ms = min(s["start_ms"] for s in stage_spans)
+    if window_end_ms is None:
+        window_end_ms = max(s["start_ms"] + s["duration_ms"]
+                            for s in stage_spans)
+    window_ms = window_end_ms - window_start_ms
+    if window_ms <= 0:
+        return None
+    per: dict[str, list[tuple[float, float]]] = {}
+    tasks: dict[str, int] = {}
+    for s in stage_spans:
+        a = max(s["start_ms"], window_start_ms)
+        b = min(s["start_ms"] + s["duration_ms"], window_end_ms)
+        if b <= a:
+            continue
+        stage = (s.get("attrs") or {}).get("stage")
+        node = s.get("node")
+        key = (f"{node}/" if node else "") + (
+            str(stage) if stage is not None else "?"
+        )
+        per.setdefault(key, []).append((a, b))
+        tasks[key] = tasks.get(key, 0) + 1
+    if not per:
+        return None
+    stages = {
+        key: {
+            "busy_fraction": round(
+                min(_merge_busy_ms(iv) / window_ms, 1.0), 4
+            ),
+            "tasks": tasks[key],
+        }
+        for key, iv in per.items()
+    }
+    mean_busy = sum(v["busy_fraction"] for v in stages.values()) / len(stages)
+    return {
+        "window_s": round(window_ms / 1000.0, 3),
+        "bubble_fraction": round(max(0.0, 1.0 - mean_busy), 4),
+        "stages": stages,
+    }
+
+
+def local_stage_idleness(
+    window_s: float = BUBBLE_WINDOW_S, tracer=None
+) -> dict | None:
+    """This node's bubble fraction over the trailing ``window_s``,
+    refreshed into the ``pipeline.bubble_fraction`` /
+    ``pipeline.stage_busy_fraction{stage=}`` gauges. With no stage.task
+    span in the window the gauges CLEAR (the empty-gauge contract: a
+    stage that stopped serving drops out instead of freezing its last
+    reading) and None is returned."""
+    try:
+        tr = tracer or get_tracer()
+        now_ms = time.time() * 1000.0
+        info = bubble_from_spans(
+            tr.recent(limit=2048, name="stage.task"),
+            now_ms - window_s * 1000.0, now_ms,
+        )
+        if info is None:
+            _G_BUBBLE.clear()
+            for labels, _v in _G_STAGE_BUSY.series():
+                _G_STAGE_BUSY.clear(**dict(labels))
+            return None
+        _G_BUBBLE.set(info["bubble_fraction"])
+        fresh = set()
+        for key, entry in info["stages"].items():
+            _G_STAGE_BUSY.set(entry["busy_fraction"], stage=key)
+            fresh.add((("stage", key),))
+        for labels, _v in _G_STAGE_BUSY.series():
+            if tuple(labels) not in fresh:
+                _G_STAGE_BUSY.clear(**dict(labels))
+        return info
+    except Exception:  # noqa: BLE001 — telemetry never breaks the caller
+        return None
+
 
 def build_digest(registry: MetricsRegistry | None = None) -> dict:
     """Fold the metrics registry into a compact wire-portable summary.
 
     Missing metrics (e.g. a client-only node that never imported the
     engine) are simply absent from the digest — receivers treat absent
-    keys as "this node doesn't run that subsystem", not as zero."""
+    keys as "this node doesn't run that subsystem", not as zero.
+
+    On the live path (no explicit registry) the digest also carries
+    ``pipeline_bubble`` — this node's stage-idleness breakdown derived
+    from its tracer's stage.task spans — so ``/mesh/health`` shows
+    fleet-wide pipeline bubbles without another scrape. Unit digests
+    built from throwaway registries stay pure registry summaries."""
+    live = registry is None
     reg = registry or get_registry()
     digest: dict[str, Any] = {"v": DIGEST_VERSION, "ts": time.time()}
+    if live:
+        bubble = local_stage_idleness()
+        if bubble is not None:
+            digest["pipeline_bubble"] = bubble
     hists: dict[str, dict] = {}
     for name in DIGEST_HISTOGRAMS:
         m = reg.get(name)
@@ -217,6 +384,7 @@ def fleet_view(local_peer_id: str, local_digest: dict, store: HealthStore) -> di
         peers[pid] = {**digest, "age_s": round(age, 3) if age is not None else None}
     agg: dict[str, float] = {"nodes": len(peers)}
     p95s, queue_p95s, tokens, blocks, rows = [], [], 0.0, 0.0, 0.0
+    bubbles = []
     for d in peers.values():
         hist = d.get("hist") or {}
         ttft = hist.get("engine.ttft_ms")
@@ -230,10 +398,17 @@ def fleet_view(local_peer_id: str, local_digest: dict, store: HealthStore) -> di
         gauge = d.get("gauge") or {}
         blocks += float(gauge.get("engine.paged_blocks_in_use") or 0.0)
         rows += float(gauge.get("engine.active_rows") or 0.0)
+        bubble = (d.get("pipeline_bubble") or {}).get("bubble_fraction")
+        if bubble is not None:
+            bubbles.append(float(bubble))
     if p95s:
         agg["ttft_p95_ms_max"] = max(p95s)
     if queue_p95s:
         agg["queue_wait_p95_ms_max"] = max(queue_p95s)
+    if bubbles:
+        # fleet-wide stage idleness: the mean of the stage-hosting peers'
+        # bubble fractions (nodes with no stage traffic report nothing)
+        agg["bubble_fraction_mean"] = round(sum(bubbles) / len(bubbles), 4)
     agg["tokens_generated_total"] = tokens
     agg["paged_blocks_in_use_total"] = blocks
     agg["active_rows_total"] = rows
@@ -264,6 +439,9 @@ def render_fleet_prom(view: dict) -> str:
     toks = reg.gauge("mesh.peer_tokens_generated", "peer-reported tokens generated")
     errs = reg.gauge("mesh.peer_gen_errors", "peer-reported failed generations")
     acc = reg.gauge("mesh.peer_spec_acceptance", "peer-reported spec acceptance")
+    bub = reg.gauge(
+        "mesh.peer_bubble_fraction", "peer-reported pipeline bubble fraction"
+    )
     for pid, d in (view.get("peers") or {}).items():
         up.set(1, peer=pid)
         if d.get("age_s") is not None:
@@ -291,6 +469,9 @@ def render_fleet_prom(view: dict) -> str:
             errs.set(counter["gen.errors"], peer=pid)
         if d.get("spec_acceptance") is not None:
             acc.set(d["spec_acceptance"], peer=pid)
+        bubble = d.get("pipeline_bubble") or {}
+        if bubble.get("bubble_fraction") is not None:
+            bub.set(bubble["bubble_fraction"], peer=pid)
     return reg.render()
 
 

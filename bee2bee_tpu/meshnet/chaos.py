@@ -151,11 +151,19 @@ class ChaosStage:
                      is the StageTimeout path.
       - "delay":     sleep `delay_s` before handling each matching task
                      from `at_step` on (latency injection).
+      - "error":     answer every matching task from `at_step` on with a
+                     typed TASK_ERROR instead of running it — the
+                     StageError path (the node stays alive and serves
+                     everything the fault does NOT match).
 
     Steps count tasks whose kind is in `kinds` (default: the forward /
-    relay / ring-decode serving kinds). `triggered` is an asyncio.Event
-    tests can await for deterministic sequencing; `steps_seen` exposes
-    the count. `restore()` un-wraps the handler (no-op after "kill").
+    relay / ring-decode serving kinds) AND that pass `match` (an optional
+    ``match(data) -> bool`` predicate — e.g. scope the fault to ONE
+    microbatch group's request_id, which is how the group-scoped failover
+    tests fail one group's chain while the others keep decoding).
+    `triggered` is an asyncio.Event tests can await for deterministic
+    sequencing; `steps_seen` exposes the count. `restore()` un-wraps the
+    handler (no-op after "kill").
     """
 
     def __init__(
@@ -165,21 +173,43 @@ class ChaosStage:
         at_step: int = 1,
         delay_s: float = 1.0,
         kinds: tuple[str, ...] = FORWARD_KINDS,
+        match=None,  # optional predicate over the task frame dict
     ):
-        if action not in ("kill", "blackhole", "delay"):
+        if action not in ("kill", "blackhole", "delay", "error"):
             raise ValueError(f"unknown chaos action {action!r}")
         self.node = node
         self.action = action
         self.at_step = int(at_step)
         self.delay_s = float(delay_s)
         self.kinds = tuple(kinds)
+        self.match = match
         self.steps_seen = 0
         self.triggered = asyncio.Event()
         self._orig = node._handle_task
         node._handle_task = self._handle_task
 
+    async def _answer_error(self, ws, data) -> None:
+        """Route a typed TASK_ERROR the way a real failed task would: a
+        relayed task reports to the ORIGIN coordinator (which is the peer
+        awaiting the reply), a first-hop task answers the sender."""
+        origin = data.get("origin_peer")
+        task_id = data.get("origin_task_id") if origin else data.get("task_id")
+        reply_ws = ws
+        if origin:
+            info = self.node.peers.get(origin)
+            if info is None:
+                return  # origin gone: nothing awaits the reply
+            reply_ws = info["ws"]
+        await self.node._send(reply_ws, protocol.msg(
+            protocol.TASK_ERROR, task_id=task_id,
+            error="chaos: injected stage error",
+            error_kind=protocol.ERR_KIND_ERROR,
+        ))
+
     async def _handle_task(self, ws, data):
-        if data.get("kind") in self.kinds:
+        if data.get("kind") in self.kinds and (
+            self.match is None or self.match(data)
+        ):
             self.steps_seen += 1
             if self.steps_seen >= self.at_step:
                 if self.action == "kill":
@@ -190,6 +220,10 @@ class ChaosStage:
                 if self.action == "blackhole":
                     self.triggered.set()
                     return  # connected but mute: the timeout path
+                if self.action == "error":
+                    self.triggered.set()
+                    await self._answer_error(ws, data)
+                    return
                 self.triggered.set()
                 await asyncio.sleep(self.delay_s)
         await self._orig(ws, data)

@@ -30,6 +30,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+from collections import deque
 from urllib.parse import urlparse
 
 import numpy as np
@@ -70,6 +71,13 @@ _C_RESUMES_IN_PLACE = get_registry().counter(
     "pipeline.resumes_in_place",
     "failovers resumed on live stage caches without re-prefill",
 )
+# worker-side stage task timing (ISSUE 10) rides the telemetry digest
+# (health.DIGEST_HISTOGRAMS) so a coordinator can weigh stage compute
+# against hop latency when resolving the microbatch depth. The histogram
+# itself is observed in engine/stage_runner.py, INSIDE the concurrency
+# gate: queue/semaphore wait must not inflate the p50 the heuristic
+# divides by, or a saturated worker reads as "slow compute" and the auto
+# depth under-resolves exactly when more overlap would pay.
 
 DEFAULT_STEP_TIMEOUT = 120.0
 # generation-level failover policy defaults (PipelineCoordinator knobs)
@@ -145,7 +153,13 @@ class StageTaskMixin:
         with use_trace_ctx(extract_trace(data)):
             with get_tracer().span(
                 "stage.task", kind=data.get("kind"), model=data.get("model")
-            ):
+            ) as sp:
+                # the stage index rides the span so the bubble-fraction
+                # derivation (health.bubble_from_spans) can attribute
+                # busy time per stage, not just per node
+                runner = self.stage_runners.get(data.get("model"))
+                if runner is not None:
+                    sp.attrs["stage"] = runner.spec.stage
                 await self._dispatch_task(ws, data)
 
     async def _dispatch_task(self, ws, data):
@@ -578,14 +592,33 @@ class StageTaskMixin:
 # ------------------------------------------------------------- coordinator
 
 
-def resolve_microbatches(stage_addrs: list) -> int:
+def resolve_microbatches(
+    stage_addrs: list,
+    stage_task_ms: list | None = None,
+    hop_rtt_ms: list | None = None,
+    max_depth: int = 4,
+) -> int:
     """The `--microbatches auto` heuristic: microbatch overlap pays only
     when stages compute in PARALLEL, i.e. they run on different hosts —
     then group g+1's stage-0 compute genuinely overlaps group g's stage-1
     compute. Stages sharing one host contend for the same cores, so the
     M× extra wire messages buy nothing (measured on the loopback split:
     docs/PERF.md "Microbatch overlap"). Unknown topology resolves to 1 —
-    never gamble hop cost on a guess."""
+    never gamble hop cost on a guess.
+
+    With telemetry (ISSUE 10) the distinct-host answer graduates from the
+    binary guess to a DEPTH: `stage_task_ms` (per-stage p50 task time from
+    the gossiped digests' `pipeline.stage_task_ms` histogram) and
+    `hop_rtt_ms` (coordinator→stage ping RTTs) give the classic pipeline
+    fill bound — to keep S stages busy, the in-flight window must cover
+    one token's full wall time, S·(compute + hop), at `compute` per stage:
+
+        depth ≈ round(S · (1 + hop / compute))
+
+    clamped to [2, max_depth] (the session clamps to max_batch again).
+    Pure compute-bound stages (hop ≪ compute) resolve to the stage count;
+    hop-dominated topologies ask for more in-flight chains to hide the
+    wire. Absent/empty telemetry falls back to the legacy answer of 2."""
     hosts = set()
     for a in stage_addrs:
         if not a:
@@ -599,7 +632,16 @@ def resolve_microbatches(stage_addrs: list) -> int:
             # localhost/127.0.0.1 worker flags must not read as two hosts
             host = "localhost"
         hosts.add(host)
-    return 2 if len(hosts) >= 2 else 1
+    if len(hosts) < 2:
+        return 1
+    timings = [float(t) for t in (stage_task_ms or []) if t]
+    rtts = [float(r) for r in (hop_rtt_ms or []) if r]
+    if not timings or not rtts:
+        return 2  # distinct hosts, no telemetry: the legacy binary guess
+    compute = sorted(timings)[len(timings) // 2]  # median stage compute
+    hop = sorted(rtts)[len(rtts) // 2] / 2.0  # one-way hop estimate
+    depth = round(len(stage_addrs) * (1.0 + hop / max(compute, 1e-3)))
+    return max(2, min(depth, max_depth))
 
 
 class PipelineCoordinator:
@@ -1203,19 +1245,51 @@ class PipelineCoordinator:
         p /= p.sum()
         return int(rng.choice(len(p), p=p))
 
+    def _stage_telemetry(self) -> tuple[list, list]:
+        """(per-stage task-time p50s, hop RTTs) for the auto-depth
+        heuristic: task timings come from the stage peers' gossiped
+        digests (health.HealthStore), hop latency from the node's ping
+        bookkeeping. Missing readings are simply absent — the heuristic
+        degrades to the binary guess."""
+        store = getattr(self.node, "health", None)
+        fresh = store.fresh() if store is not None else {}
+        timings = []
+        for pid in self.stage_peers:
+            hist = ((fresh.get(pid) or {}).get("hist") or {}).get(
+                "pipeline.stage_task_ms"
+            ) or {}
+            p50 = hist.get("p50")
+            if p50:
+                timings.append(float(p50))
+        rtts = [
+            (self.node.peers.get(pid) or {}).get("rtt_ms")
+            for pid in self.stage_peers
+        ]
+        return timings, [float(r) for r in rtts if r]
+
     def session(
-        self, max_batch: int = 8, n_microbatches: int | str = "auto"
+        self,
+        max_batch: int = 8,
+        n_microbatches: int | str = "auto",
+        interleave: bool = True,
+        inflight_window: int | None = None,
     ) -> "PipelineSession":
         """A continuous-batching session over this coordinator's stages.
-        n_microbatches="auto" resolves from the stage topology
-        (resolve_microbatches): 2 when stages live on distinct hosts,
-        else 1."""
+        n_microbatches="auto" resolves from the stage topology plus the
+        gossiped stage-task timings (resolve_microbatches): 1 on a shared
+        host, else a compute-vs-hop depth (legacy 2 without telemetry)."""
         if n_microbatches in (None, "auto"):
             addrs = [
                 (self.node.peers.get(pid) or {}).get("addr")
                 for pid in self.stage_peers
             ]
-            n_microbatches = resolve_microbatches(addrs)
+            try:
+                timings, rtts = self._stage_telemetry()
+            except Exception:  # noqa: BLE001 — telemetry is advisory
+                timings, rtts = [], []
+            n_microbatches = resolve_microbatches(
+                addrs, stage_task_ms=timings, hop_rtt_ms=rtts
+            )
         return PipelineSession(
             self.node,
             self.model,
@@ -1231,6 +1305,8 @@ class PipelineCoordinator:
             # max_failover_retries=0 really disables failover everywhere
             max_failovers=self.max_failover_retries,
             failover_backoff_s=self.failover_backoff_s,
+            interleave=interleave,
+            inflight_window=inflight_window,
         )
 
 
@@ -1258,51 +1334,107 @@ class _SessionReq:
         self.last_tok = 0
 
 
+class _Group:
+    """One microbatch group: a fixed-size row table backed by its OWN
+    per-stage KV cache (request_id = ``rid``) and, under the interleaved
+    scheduler, its own free-running decode task. ``len()``/iteration
+    expose the row table, so callers can treat a group as its rows."""
+
+    __slots__ = ("idx", "rows", "rid", "queue", "wake", "task",
+                 "failovers", "tokens", "prefills", "reprefills", "chains")
+
+    def __init__(self, idx: int, size: int, rid: str):
+        self.idx = idx
+        self.rows: list[_SessionReq | None] = [None] * size
+        self.rid = rid
+        self.queue: deque[_SessionReq] = deque()
+        self.wake = asyncio.Event()
+        self.task: asyncio.Task | None = None
+        self.failovers = 0  # consecutive; reset by a successful step
+        # per-group progress counters: the straggler-isolation and
+        # group-scoped failover tests pin behavior on these, never on
+        # racy wall-clock thresholds
+        self.tokens = 0
+        self.prefills = 0  # admission chains run (incl. retried admissions)
+        self.reprefills = 0  # admissions of rows that already held accepted
+        # tokens — the failover re-prefill cost ("zero re-prefills" pins)
+        self.chains = 0
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def __iter__(self):
+        return iter(self.rows)
+
+    def active(self) -> list[int]:
+        return [i for i, r in enumerate(self.rows) if r is not None]
+
+    def free_row(self) -> int | None:
+        for i, r in enumerate(self.rows):
+            if r is None:
+                return i
+        return None
+
+    def load(self) -> int:
+        """Rows this group is responsible for (admitted + queued) — the
+        admission-spread key."""
+        return len(self.active()) + len(self.queue)
+
+
 class PipelineSession:
     """Continuous-batching decode across pipeline stages.
 
     The unbatched PipelineCoordinator.generate pays a full
     coordinator→stage0→…→coordinator round trip PER TOKEN PER REQUEST —
     n_requests × n_tokens × n_stages wire hops. This session keeps ONE
-    [B]-row KV cache per stage (request_id = session id) and drives all
-    active rows through a single [B, 1] chain per decode step: the wire
-    cost per step is n_stages hops REGARDLESS of how many requests ride
-    in the batch — the cross-peer realization of the engine's
-    continuous-batching scheduler (engine/scheduler.py), which the
-    reference's worker hops (reference node.py:249-277, strictly
-    batch-1 text-in/hidden-out) never attempted.
+    [B]-row KV cache per microbatch group per stage and drives all of a
+    group's active rows through a single [B, 1] chain per decode step:
+    the wire cost per step is n_stages hops REGARDLESS of how many
+    requests ride in the batch — the cross-peer realization of the
+    engine's continuous-batching scheduler (engine/scheduler.py).
 
     Mechanics:
-    - admission: a new request prefills into a free row with
-      write_mask=[row] (stage caches update only that row; other rows'
-      outputs from the admission chain are discarded) and
-      gather=[n_i - 1] so the last stage returns [B, V], not the full
-      [B, bucket, V] logits.
+    - admission: a new request joins the least-loaded group's queue and
+      prefills into a free row with write_mask=[row] (stage caches update
+      only that row) and gather=[n_i - 1] so the last stage returns
+      [B, V], not the full [B, bucket, V] logits.
     - decode: x = last tokens [B, 1], per-row offsets [B], write_mask =
       active rows, gather = 0 → one chain, one sample per active row.
     - retirement: EOS / budget resolves the row's future and frees the
       row between steps; stale K/V from a previous occupant is never
       attended (positions ≥ the new row's offset sit outside the causal
       mask until decode overwrites them — the bucketed-prefill argument).
-    - failover: a typed stage failure rotates the session id, asks the
-      coordinator to recover() (re-place dead stages, bump the epoch),
-      and REQUEUES the in-flight rows — admission prefills prompt +
-      accepted-so-far, so each row resumes exactly where it stopped.
-      Bounded attempts; past them (or when recovery itself fails) all
-      in-flight rows fail with the typed error and the session id
-      rotates so the next admission starts from fresh stage caches.
-    - microbatch overlap (`n_microbatches` > 1): rows split into M groups,
-      each with its OWN per-stage cache (request_id "{sid}:mN"), and the
-      M decode chains run concurrently — while stage 1 computes group 0,
-      stage 0 already computes group 1, so stages don't idle waiting for
-      their neighbor (GPipe-style, across the wire). The tradeoff is M×
-      the wire messages per step, so it pays on real networks where stage
-      compute dominates hop latency — default is 1 (max amortization;
-      loopback tests measure hops, not overlap).
 
-    `stats` counts chains/steps/prefills so tests can assert the
-    amortization deterministically (wire hops per token), without racy
-    wall-clock thresholds.
+    **Interleaved scheduling (ISSUE 10, the default).** Each microbatch
+    group owns an independent, free-running decode task: the moment group
+    g's chain leaves stage 0, group g+1's chain can enter it — no
+    per-step barrier, so a straggler group (or a long admission prefill,
+    which is just another chain in that group's stream) never stalls the
+    other groups' token emission. In-flight chains across groups are
+    bounded by a sliding window (``inflight_window``, an asyncio
+    semaphore): each group holds at most one slot at a time, so any
+    window > 1 preserves straggler isolation while capping how much
+    concurrent work the coordinator can pile onto a stage (whose runner
+    enforces its own ``max_concurrent_forwards``). The pre-interleave
+    barrier loop survives as ``interleave=False`` — the A/B baseline the
+    ``pipeline_interleave`` bench rung measures bubble fraction against.
+
+    **Group-scoped failover.** A typed stage failure rides a per-group
+    ladder (see ``_on_group_failure``): epoch adoption (a concurrent
+    rebuild without re-placement keeps surviving stages' caches) →
+    resume-in-place on the live caches → release + rotate THIS group's
+    rid, recover() the chain, and requeue only this group's rows for
+    re-prefill (prompt + accepted-so-far, exact resume for greedy).
+    Healthy groups keep decoding through another group's failover; they
+    are evacuated only when recover() actually RE-PLACED a stage, whose
+    process death took every group's caches with it. Past the bounded
+    attempts the failed group's rows fail with the typed error — other
+    groups are untouched.
+
+    `stats` counts chains/steps/prefills session-wide and each group
+    carries its own tokens/prefills/chains progress counters
+    (``group_progress()``), so tests can assert amortization and
+    straggler isolation deterministically.
     """
 
     def __init__(
@@ -1320,9 +1452,16 @@ class PipelineSession:
         max_failovers: int = DEFAULT_FAILOVER_RETRIES,
         failover_backoff_s: float = 0.2,
         # cap on one recovery's part_load round; None = the coordinator's
-        # load_timeout. The session loop (and every queued row) blocks for
-        # at most this long per failover attempt before rows fail typed.
+        # load_timeout. The failed group (and every row queued on it)
+        # blocks for at most this long per failover attempt.
         failover_load_timeout: float | None = None,
+        # False: the pre-ISSUE-10 lockstep barrier loop (admission parks
+        # decode; all groups advance behind one per-step gather) — kept
+        # selectable as the bench baseline for the bubble measurement
+        interleave: bool = True,
+        # sliding window of concurrently in-flight chains across groups;
+        # None = 2 per stage (each group occupies one slot per chain)
+        inflight_window: int | None = None,
     ):
         self.node = node
         self.model = model
@@ -1337,18 +1476,25 @@ class PipelineSession:
         self.failover_backoff_s = failover_backoff_s
         self.failover_load_timeout = failover_load_timeout
         self.epoch = getattr(coordinator, "epoch", 0)
-        self._failovers = 0  # consecutive; reset by a successful step
+        self.interleave = bool(interleave)
         self.sid = new_id("ppsess")
         M = max(1, min(n_microbatches, max_batch))
         base, extra = divmod(max_batch, M)
-        sizes = [base + (1 if m < extra else 0) for m in range(M)]
-        # groups[m] is a fixed-size row table backed by its own stage cache
-        self.groups: list[list[_SessionReq | None]] = [
-            [None] * s for s in sizes if s > 0
+        sizes = [s for s in (base + (1 if m < extra else 0) for m in range(M))
+                 if s > 0]
+        self.groups: list[_Group] = [
+            _Group(i, s, self.sid if len(sizes) == 1 else f"{self.sid}:m{i}")
+            for i, s in enumerate(sizes)
         ]
-        self._pending: list[_SessionReq] = []
+        if inflight_window is None:
+            # cover every group (so neither scheduler is throttled by
+            # default — the lockstep baseline gathers all M chains per
+            # step) with 2-per-stage as the floor
+            inflight_window = max(2, 2 * len(stage_peers), len(self.groups))
+        self.inflight_window = max(1, int(inflight_window))
+        self._window = asyncio.Semaphore(self.inflight_window)
         self._wake = asyncio.Event()
-        self._task: asyncio.Task | None = None
+        self._task: asyncio.Task | None = None  # lockstep-mode driver
         self._closed = False
         self.stats = {
             "chains": 0, "steps": 0, "prefills": 0, "tokens": 0,
@@ -1356,6 +1502,9 @@ class PipelineSession:
             # chains x 1 under relay — the wire-cost metric tests assert
             "resumes_in_place": 0,  # alive-chain failovers that kept the
             # stage caches (no re-prefill) — the migration-preferred rung
+            "reprefills": 0,  # failover re-prefills of rows that already
+            # held accepted tokens (healthy groups must stay at zero
+            # through another group's failover)
         }
 
     # ------------------------------------------------------------- public
@@ -1381,9 +1530,12 @@ class PipelineSession:
             return []
         req = _SessionReq(prompt_ids, max_new_tokens, temperature,
                           eos_token_id, on_token)
-        self._pending.append(req)
-        if self._task is None or self._task.done():
-            self._task = asyncio.get_running_loop().create_task(self._loop())
+        # admission spread: the least-loaded group takes the new row, so
+        # microbatch caches fill evenly and overlap has groups to overlap
+        g = min(self.groups, key=lambda gr: (gr.load(), gr.idx))
+        g.queue.append(req)
+        self._ensure_running()
+        g.wake.set()
         self._wake.set()
         try:
             return await req.future
@@ -1391,119 +1543,154 @@ class PipelineSession:
             # abandoned consumer: shrink the budget to what's already out
             # so the row retires at the next step instead of decoding the
             # rest of its budget into a dead future
-            if req in self._pending:
-                self._pending.remove(req)
+            if req in g.queue:
+                g.queue.remove(req)
             req.max_new_tokens = len(req.out)
             raise
+
+    def group_progress(self) -> list[dict]:
+        """Per-group progress counters (tokens emitted, prefills run,
+        chains sent, rows live/queued) — the deterministic instrument for
+        'a straggler group must not stall the others'."""
+        return [
+            {
+                "group": g.idx, "tokens": g.tokens, "prefills": g.prefills,
+                "reprefills": g.reprefills,
+                "chains": g.chains, "active": len(g.active()),
+                "queued": len(g.queue), "failovers": g.failovers,
+            }
+            for g in self.groups
+        ]
 
     async def close(self) -> None:
         self._closed = True
         self._wake.set()
-        if self._task is not None:
-            try:
-                await asyncio.wait_for(self._task, timeout=10.0)
-            except (asyncio.TimeoutError, asyncio.CancelledError):
-                self._task.cancel()
+        for g in self.groups:
+            g.wake.set()
+        tasks = [
+            t for t in [self._task, *(g.task for g in self.groups)]
+            if t is not None and not t.done()
+        ]
+        if tasks:
+            _done, pending = await asyncio.wait(tasks, timeout=10.0)
+            for t in pending:
+                t.cancel()
         # fail whatever was still in flight — an awaiting generate() must
         # see the close, not hang until the service-layer timeout
         err = RuntimeError("pipeline session closed")
-        for rows in self.groups:
-            for i, req in enumerate(rows):
+        for g in self.groups:
+            for i, req in enumerate(g.rows):
                 if req is not None:
-                    rows[i] = None
+                    g.rows[i] = None
                     if not req.future.done():
                         req.future.set_exception(err)
-        for req in self._pending:
-            if not req.future.done():
-                req.future.set_exception(err)
-        self._pending.clear()
-        await self._release()
+            for req in g.queue:
+                if not req.future.done():
+                    req.future.set_exception(err)
+            g.queue.clear()
+        await self._release_all()
 
     # ------------------------------------------------------------ internal
 
-    def _rid(self, g: int) -> str:
-        return f"{self.sid}:m{g}" if len(self.groups) > 1 else self.sid
-
-    def _active(self, g: int) -> list[int]:
-        return [i for i, r in enumerate(self.groups[g]) if r is not None]
+    def _ensure_running(self) -> None:
+        loop = asyncio.get_running_loop()
+        if self.interleave:
+            for g in self.groups:
+                if g.task is None or g.task.done():
+                    g.task = loop.create_task(self._group_loop(g))
+        elif self._task is None or self._task.done():
+            self._task = loop.create_task(self._lockstep_loop())
 
     @property
     def _any_active(self) -> bool:
-        return any(r is not None for rows in self.groups for r in rows)
+        return any(g.active() for g in self.groups)
 
-    def _free_slot(self) -> tuple[int, int] | None:
-        """(group, row) of a free slot — emptiest group first, so load
-        spreads across microbatch caches."""
-        best = None
-        for g, rows in enumerate(self.groups):
-            free = [i for i, r in enumerate(rows) if r is None]
-            if free and (best is None or len(free) > best[2]):
-                best = (g, free[0], len(free))
-        return (best[0], best[1]) if best else None
+    @property
+    def _any_pending(self) -> bool:
+        return any(g.queue for g in self.groups)
 
-    async def _release(self) -> None:
+    async def _release_rid(self, rid: str) -> None:
         try:
             await asyncio.gather(
                 *(
                     self.node.run_stage_task(
                         peer, "part_release",
-                        {"model": self.model, "request_id": self._rid(g)},
+                        {"model": self.model, "request_id": rid},
                         timeout=self.step_timeout,
                     )
                     for peer in self.stage_peers
-                    for g in range(len(self.groups))
                 ),
                 return_exceptions=True,
             )
         except Exception:  # noqa: BLE001 — release is best-effort
             pass
 
-    async def _chain(self, g: int, x, offsets, mask, gather) -> np.ndarray:
-        self.stats["chains"] += 1
-        fields = {
-            "model": self.model,
-            "request_id": self._rid(g),
-            "offset": [int(o) for o in offsets],
-            "write_mask": [bool(m) for m in mask],
-            "epoch": self.epoch,
-        }
-        if self.relay:
-            # one send, one receive: stages hand hidden states to each
-            # other; the LAST stage answers us (gather rides the chain).
-            # Timeout budgets per stage — one await covers the whole chain
+    async def _release_all(self) -> None:
+        await asyncio.gather(
+            *(self._release_rid(g.rid) for g in self.groups),
+            return_exceptions=True,
+        )
+
+    async def _chain(self, g: _Group, x, offsets, mask, gather) -> np.ndarray:
+        # the sliding window bounds chains concurrently in flight across
+        # groups — each group holds at most one slot, so a straggler
+        # parks one slot, never the scheduler
+        async with self._window:
+            self.stats["chains"] += 1
+            g.chains += 1
+            fields = {
+                "model": self.model,
+                "request_id": g.rid,
+                "offset": [int(o) for o in offsets],
+                "write_mask": [bool(m) for m in mask],
+                "epoch": self.epoch,
+            }
+            if self.relay:
+                # one send, one receive: stages hand hidden states to each
+                # other; the LAST stage answers us (gather rides the
+                # chain). Timeout budgets per stage — one await covers the
+                # whole chain
+                self.stats["tasks_sent"] += 1
+                result = await self.node.run_stage_task(
+                    self.stage_peers[0], protocol.TASK_PART_FORWARD_RELAY,
+                    {**fields, "gather": [int(g_) for g_ in gather]},
+                    tensors={"x": x},
+                    timeout=self.step_timeout * len(self.stage_peers),
+                    reply_from=self.stage_peers[-1],
+                )
+                return result["_tensors"]["out"]
+            for peer in self.stage_peers[:-1]:
+                self.stats["tasks_sent"] += 1
+                result = await self.node.run_stage_task(
+                    peer, protocol.TASK_PART_FORWARD, fields,
+                    tensors={"x": x}, timeout=self.step_timeout,
+                )
+                x = result["_tensors"]["out"]
             self.stats["tasks_sent"] += 1
             result = await self.node.run_stage_task(
-                self.stage_peers[0], protocol.TASK_PART_FORWARD_RELAY,
+                self.stage_peers[-1],
+                protocol.TASK_PART_FORWARD,
                 {**fields, "gather": [int(g_) for g_ in gather]},
                 tensors={"x": x},
-                timeout=self.step_timeout * len(self.stage_peers),
-                reply_from=self.stage_peers[-1],
-            )
-            return result["_tensors"]["out"]
-        for peer in self.stage_peers[:-1]:
-            self.stats["tasks_sent"] += 1
-            result = await self.node.run_stage_task(
-                peer, protocol.TASK_PART_FORWARD, fields, tensors={"x": x},
                 timeout=self.step_timeout,
             )
-            x = result["_tensors"]["out"]
-        self.stats["tasks_sent"] += 1
-        result = await self.node.run_stage_task(
-            self.stage_peers[-1],
-            protocol.TASK_PART_FORWARD,
-            {**fields, "gather": [int(g_) for g_ in gather]},
-            tensors={"x": x},
-            timeout=self.step_timeout,
-        )
-        return result["_tensors"]["out"]  # [B, V]
+            return result["_tensors"]["out"]  # [B, V]
 
-    async def _admit(self, g: int, row: int, req: _SessionReq) -> None:
+    async def _admit(self, g: _Group, row: int, req: _SessionReq) -> None:
         """Masked prefill of one request into `row` of group `g`'s cache.
         A row requeued by failover carries accepted tokens in req.out:
         prefilling prompt + accepted resumes its decode exactly where the
-        failure struck (offsets in _step_group are n + len(out) already)."""
+        failure struck (offsets in _step_group are n + len(out) already).
+        Under the interleaved scheduler this chain is just another chunk
+        in the group's stream — other groups keep decoding through it."""
         self.stats["prefills"] += 1
-        B = len(self.groups[g])
+        g.prefills += 1
+        if req.out:
+            # a requeued row resuming by re-prefill (prompt + accepted) —
+            # the cost the group-scoped ladder confines to the failed group
+            self.stats["reprefills"] += 1
+            g.reprefills += 1
+        B = len(g.rows)
         full = list(req.ids) + req.out
         n_full = len(full)
         bucket = 16
@@ -1521,14 +1708,15 @@ class PipelineSession:
         req.last_tok = PipelineCoordinator._sample(
             logits[row], req.temperature, req.rng
         )
-        self.groups[g][row] = req
+        g.rows[row] = req
 
-    def _accept(self, req: _SessionReq, tok: int) -> bool:
+    def _accept(self, g: _Group, req: _SessionReq, tok: int) -> bool:
         """Book one sampled token for a row; False retires the row."""
         if req.eos is not None and tok == req.eos:
             return False
         req.out.append(tok)
         self.stats["tokens"] += 1
+        g.tokens += 1
         if req.on_token is not None:
             try:
                 req.on_token(tok)
@@ -1536,180 +1724,317 @@ class PipelineSession:
                 logger.exception("on_token callback failed")
         return len(req.out) < req.max_new_tokens
 
-    def _retire(self, g: int, row: int) -> None:
-        req = self.groups[g][row]
-        self.groups[g][row] = None
+    def _retire(self, g: _Group, row: int) -> None:
+        req = g.rows[row]
+        g.rows[row] = None
         if not req.future.done():
             req.future.set_result(req.out)
 
-    async def _step_group(self, g: int) -> None:
-        """One decode step over group g's active rows."""
-        rows = self.groups[g]
-        B = len(rows)
-        x = np.zeros((B, 1), np.int32)
-        offsets = np.zeros(B, np.int32)
-        mask = np.zeros(B, bool)
-        for i in self._active(g):
-            req = rows[i]
-            x[i, 0] = req.last_tok
-            offsets[i] = req.n + len(req.out)
-            mask[i] = True
-        logits = await self._chain(g, x, offsets, mask, np.zeros(B, np.int32))
-        for i in self._active(g):
-            req = rows[i]
-            tok = req.last_tok
-            if not self._accept(req, tok):
-                self._retire(g, i)
-                continue
-            req.last_tok = PipelineCoordinator._sample(
-                logits[i], req.temperature, req.rng
-            )
-
-    async def _step(self) -> None:
-        """One decode step: all microbatch groups advance concurrently —
-        group g+1's stage-0 hop overlaps group g's stage-1 compute."""
+    async def _step_group(self, g: _Group) -> None:
+        """One decode step over group g's active rows (one chain)."""
+        active = g.active()
         self.stats["steps"] += 1
-        busy = [g for g in range(len(self.groups)) if self._active(g)]
-        rows = sum(len(self._active(g)) for g in busy)
         with get_tracer().span(
-            "pipeline.step", groups=len(busy), rows=rows, relay=self.relay
+            "pipeline.step", group=g.idx, rows=len(active),
+            relay=self.relay, interleave=self.interleave,
         ):
-            await self._step_inner(busy)
+            rows = g.rows
+            B = len(rows)
+            x = np.zeros((B, 1), np.int32)
+            offsets = np.zeros(B, np.int32)
+            mask = np.zeros(B, bool)
+            for i in active:
+                req = rows[i]
+                x[i, 0] = req.last_tok
+                offsets[i] = req.n + len(req.out)
+                mask[i] = True
+            logits = await self._chain(
+                g, x, offsets, mask, np.zeros(B, np.int32)
+            )
+            # re-read the active set: another group's failover may have
+            # evacuated these rows mid-chain (they'll re-prefill) — the
+            # stale chain's result must not book tokens for them
+            for i in g.active():
+                req = rows[i]
+                tok = req.last_tok
+                if not self._accept(g, req, tok):
+                    self._retire(g, i)
+                    continue
+                req.last_tok = PipelineCoordinator._sample(
+                    logits[i], req.temperature, req.rng
+                )
 
-    async def _step_inner(self, busy) -> None:
-        if len(busy) == 1:
-            await self._step_group(busy[0])
-            return
-        results = await asyncio.gather(
-            *(self._step_group(g) for g in busy), return_exceptions=True
-        )
-        for r in results:
-            if isinstance(r, BaseException):
-                raise r
+    # ------------------------------------------------------------ drivers
 
-    async def _loop(self) -> None:
+    def _claim_admission(self, g: _Group) -> _SessionReq | None:
+        """Group g's next admission: its own queue first; with a free
+        row and an empty queue, STEAL a fresh request from the longest
+        other queue. Submit-time assignment is a load hint, not an
+        affinity contract — a request must not sit head-of-line behind
+        another group's long row while this group's slot idles.
+        Failover-requeued rows (accepted tokens) are never stolen: their
+        re-admission is imminent once their group's recovery completes,
+        and stealing them would shift re-prefill accounting onto healthy
+        groups."""
+        while g.queue:
+            req = g.queue.popleft()
+            if not req.future.done():  # else: abandoned while queued
+                return req
+        for other in sorted(
+            (o for o in self.groups if o is not g and o.queue),
+            key=lambda o: -len(o.queue),
+        ):
+            for req in list(other.queue):
+                if req.future.done():
+                    other.queue.remove(req)
+                    continue
+                if not req.out:
+                    other.queue.remove(req)
+                    return req
+        return None
+
+    async def _drain_admissions(self, g: _Group) -> bool:
+        """Admit queued requests into group g's free rows (each
+        admission is one masked-prefill chain). Shared by both drivers
+        so their admission semantics can never diverge. Returns False
+        when an admission chain failed — the failure, with the in-flight
+        request, has already been routed through the group-scoped
+        ladder."""
+        while True:
+            row = g.free_row()
+            if row is None:
+                return True
+            req = self._claim_admission(g)
+            if req is None:
+                return True
+            try:
+                await self._admit(g, row, req)
+            except Exception as e:  # noqa: BLE001 — group-scoped ladder
+                await self._on_group_failure(g, e, req)
+                return False
+
+    async def _group_loop(self, g: _Group) -> None:
+        """The free-running driver of ONE microbatch group: admit queued
+        requests into free rows (each admission is one masked-prefill
+        chain) and chain decode steps back-to-back. No barrier against
+        the other groups — the moment this group's chain leaves stage 0,
+        another group's chain can enter it."""
         while not self._closed:
-            if not self._pending and not self._any_active:
+            if not g.queue and not g.active():
+                g.wake.clear()
+                try:
+                    await asyncio.wait_for(g.wake.wait(), timeout=30.0)
+                except asyncio.TimeoutError:
+                    # a generate() can land during wait_for's cancellation
+                    # window (an await point) — park only when still idle
+                    if g.queue or g.active():
+                        continue
+                    break  # idle: park; the next assignment restarts us
+                continue
+            try:
+                if not await self._drain_admissions(g):
+                    continue  # admission failure already rode the ladder
+                if g.active():
+                    await self._step_group(g)
+                    g.failovers = 0  # a whole step landed: chain healthy
+            except Exception as e:  # noqa: BLE001 — group-scoped ladder
+                await self._on_group_failure(g, e, None)
+
+    async def _lockstep_loop(self) -> None:
+        """The pre-interleave barrier scheduler, kept selectable
+        (``interleave=False``) as the A/B baseline the bench rung
+        measures bubble fraction against: admission prefills park every
+        group's decode, and all busy groups advance behind one per-step
+        gather barrier — a straggler group stalls the rest for exactly
+        the bubble time the free-running scheduler drains."""
+        while not self._closed:
+            if not self._any_pending and not self._any_active:
                 self._wake.clear()
                 try:
                     await asyncio.wait_for(self._wake.wait(), timeout=30.0)
                 except asyncio.TimeoutError:
-                    # a generate() can land during wait_for's cancellation
-                    # window (an await point) — park only when still idle
-                    if self._pending or self._any_active:
+                    if self._any_pending or self._any_active:
                         continue
-                    break  # idle: park; the next generate() restarts us
+                    break
                 continue
-            admitting: _SessionReq | None = None
-            try:
-                while self._pending:
-                    slot = self._free_slot()
-                    if slot is None:
-                        break
-                    admitting = self._pending.pop(0)
-                    await self._admit(slot[0], slot[1], admitting)
-                    admitting = None
-                if self._any_active:
-                    await self._step()
-                    self._failovers = 0  # a whole step landed: chain healthy
-            except Exception as e:  # noqa: BLE001 — failover or fail rows
-                await self._on_step_failure(e, admitting)
+            for g in self.groups:
+                await self._drain_admissions(g)
+            busy = [g for g in self.groups if g.active()]
+            if not busy:
+                continue
+            results = await asyncio.gather(
+                *(self._step_group(g) for g in busy), return_exceptions=True
+            )
+            for g, r in zip(busy, results):
+                if isinstance(r, BaseException):
+                    await self._on_group_failure(g, r, None)
+                else:
+                    g.failovers = 0
 
-    async def _on_step_failure(self, e: Exception,
-                               admitting: "_SessionReq | None") -> None:
-        """A chain call failed. Migration-preferring ladder: while the
-        chain is ALIVE (typed timeout/error, not StageDead, no rebuild
-        happened elsewhere), resume IN PLACE — rows keep their stage
-        caches and the loop simply retries the step (a re-chained
-        position rewrites identical K/V; see _generate_attempt's resume
-        note). Otherwise pull every in-flight row out of the groups,
-        rotate the session id, and either FAIL OVER (recover the chain
-        and requeue the rows — admission re-prefills prompt +
-        accepted-so-far) or fail the rows with the typed error."""
+    # ------------------------------------------------------------ failover
+
+    async def _evacuate(self, g: _Group) -> list[_SessionReq]:
+        """Pull group g's in-flight rows, release its stage caches, and
+        rotate its rid (the next admission starts from fresh caches).
+        Returns the pulled rows — callers requeue or fail them."""
+        rows: list[_SessionReq] = []
+        for i, req in enumerate(g.rows):
+            if req is not None:
+                g.rows[i] = None
+                rows.append(req)
+        old_rid = g.rid
+        fresh = new_id("ppsess")
+        if len(self.groups) == 1:
+            # legacy contract: a single-group session's id IS its cache
+            # identity, and callers observe it rotate on failover
+            self.sid = fresh
+            g.rid = fresh
+        else:
+            # multi-group: rotate only THIS group's rid — the session id
+            # keeps naming the session, and sibling groups' rids (still
+            # derived from it) stay live
+            g.rid = f"{fresh}:m{g.idx}"
+        await self._release_rid(old_rid)
+        return rows
+
+    async def _on_group_failure(self, g: _Group, e: Exception,
+                                admitting: "_SessionReq | None") -> None:
+        """A chain of group g failed. Group-scoped ladder (ISSUE 10):
+        only THIS group's rows ride it — healthy groups' chains keep
+        running through it. Rungs:
+
+        1. epoch adoption: a concurrent recover() bumped the stage epoch
+           WITHOUT re-placing any stage — surviving stages kept this
+           group's caches, so adopt the epoch and retry in place (the
+           error was bookkeeping, not a fault; no failover charged).
+        2. resume in place: an ALIVE chain (typed error/timeout, epoch
+           unchanged) keeps every stage's K/V — retry the step on the
+           live caches, one try per failure burst.
+        3. group failover: release + rotate THIS group's rid, recover()
+           the chain (single-flight across groups via observed_epoch),
+           requeue this group's rows for re-prefill (prompt + accepted).
+           Only when recover() actually RE-PLACED a stage are the other
+           groups evacuated too — the replaced process took every
+           group's caches with it.
+        4. typed failure of this group's rows; other groups untouched.
+        """
+        if (
+            not self._closed
+            and self.coordinator is not None
+            and isinstance(e, StageError)
+            and not isinstance(e, StageDead)
+            and self.coordinator.epoch != self.epoch
+            and list(self.coordinator.stage_peers) == list(self.stage_peers)
+        ):
+            self.epoch = self.coordinator.epoch
+            self.relay = (self.coordinator.relay_ok
+                          and len(self.stage_peers) > 1)
+            if admitting is not None:
+                g.queue.appendleft(admitting)
+            logger.info(
+                "group %d adopting rebuilt chain epoch %d (same stages — "
+                "caches intact, no re-prefill)", g.idx, self.epoch,
+            )
+            return
         if (
             not self._closed
             and isinstance(e, StageError)
             and not isinstance(e, StageDead)
-            and self._failovers == 0
+            and g.failovers == 0
             and self.max_failovers > 0
             and (self.coordinator is None
                  or self.coordinator.epoch == self.epoch)
         ):
-            # one in-place try per failure burst (_failovers resets on a
+            # one in-place try per failure burst (failovers resets on a
             # whole successful step); a repeat escalates to re-prefill
-            self._failovers += 1
+            g.failovers += 1
             await asyncio.sleep(self.failover_backoff_s)
-            # re-check AFTER the sleep: a coordinator-level failover may
-            # have rebuilt the chain meanwhile, invalidating this sid's
-            # stage caches — fall through to the full requeue path then,
-            # bounded by the already-incremented _failovers
+            # re-check AFTER the sleep: a concurrent failover may have
+            # rebuilt the chain meanwhile, invalidating this group's
+            # stage caches on any replaced peer — fall through to the
+            # requeue path then, bounded by the incremented count
             if (self.coordinator is None
                     or self.coordinator.epoch == self.epoch):
                 if admitting is not None:
                     # the popped request never finished admission: its
-                    # masked prefill re-runs against the same sid
+                    # masked prefill re-runs against the same rid
                     # (idempotent row writes), resumed rows are untouched
-                    self._pending.insert(0, admitting)
+                    g.queue.appendleft(admitting)
                 self.stats["resumes_in_place"] = (
                     self.stats.get("resumes_in_place", 0) + 1
                 )
                 _C_RESUMES_IN_PLACE.inc()
                 logger.warning(
-                    "session step failed (%s: %s); resuming in place on "
-                    "live stage caches", type(e).__name__, e,
+                    "group %d step failed (%s: %s); resuming in place on "
+                    "live stage caches", g.idx, type(e).__name__, e,
                 )
                 return
             logger.warning(
-                "session step failed (%s: %s); chain rebuilt during "
+                "group %d step failed (%s: %s); chain rebuilt during "
                 "backoff — requeueing rows instead of resuming in place",
-                type(e).__name__, e,
+                g.idx, type(e).__name__, e,
             )
-        # the popped-but-not-yet-admitted request is in neither _pending
-        # nor a group — collect it with the rest so it can't hang
-        inflight: list[_SessionReq] = [admitting] if admitting is not None else []
-        for rows in self.groups:
-            for i, req in enumerate(rows):
-                if req is not None:
-                    rows[i] = None
-                    inflight.append(req)
-        await self._release()  # survivors drop the old sid's caches
-        self.sid = new_id("ppsess")
+        # the popped-but-not-yet-admitted request is in neither the queue
+        # nor a row — collect it with the rest so it can't hang
+        inflight: list[_SessionReq] = (
+            [admitting] if admitting is not None else []
+        )
+        inflight.extend(await self._evacuate(g))
         if (not self._closed and self.coordinator is not None
                 and isinstance(e, StageError)
-                and self._failovers < self.max_failovers):
-            self._failovers += 1
+                and g.failovers < self.max_failovers):
+            g.failovers += 1
             _C_SESSION_FAILOVERS.inc()
             try:
                 await asyncio.sleep(min(
-                    self.failover_backoff_s * 2 ** (self._failovers - 1), 5.0
+                    self.failover_backoff_s * 2 ** (g.failovers - 1), 5.0
                 ))
-                # observed_epoch: if another generation already rebuilt
-                # the chain, this returns immediately and we just adopt
-                await self.coordinator.recover(
+                # observed_epoch: if another group/generation already
+                # rebuilt the chain, this returns [] and we just adopt
+                replaced = await self.coordinator.recover(
                     timeout=self.failover_load_timeout,
                     observed_epoch=self.epoch,
                 )
             except Exception as rec_err:  # noqa: BLE001 — typed fail below
-                logger.warning("session failover failed: %s", rec_err)
+                logger.warning("group %d failover failed: %s", g.idx, rec_err)
                 if isinstance(rec_err, StageError):
                     e = rec_err
             else:
-                # rebuilt chain: adopt the new topology/epoch and requeue
-                # the rows at the FRONT (resume before fresh admissions)
+                topology_changed = (
+                    list(self.coordinator.stage_peers)
+                    != list(self.stage_peers)
+                )
                 self.stage_peers = list(self.coordinator.stage_peers)
                 self.relay = (self.coordinator.relay_ok
                               and len(self.stage_peers) > 1)
                 self.epoch = self.coordinator.epoch
+                if replaced or topology_changed:
+                    # a RE-PLACED stage lost every group's caches with
+                    # its process: evacuate the healthy groups too (their
+                    # rows requeue into their own groups and re-prefill)
+                    for other in self.groups:
+                        if other is g:
+                            continue
+                        other_rows = await self._evacuate(other)
+                        live = [r for r in other_rows
+                                if not r.future.done()]
+                        other.queue.extendleft(reversed(live))
+                        other.wake.set()
                 live = [r for r in inflight if not r.future.done()]
-                self._pending[0:0] = live
+                g.queue.extendleft(reversed(live))
+                g.wake.set()
+                self._wake.set()
                 logger.info(
-                    "session failover %d/%d: resuming %d rows (epoch %d)",
-                    self._failovers, self.max_failovers, len(live), self.epoch,
+                    "group %d failover %d/%d: requeued %d rows (epoch "
+                    "%d%s)", g.idx, g.failovers, self.max_failovers,
+                    len(live), self.epoch,
+                    ", all groups evacuated"
+                    if replaced or topology_changed else "",
                 )
                 return
         logger.warning(
-            "session step failed (%s: %s); failing %d in-flight rows",
-            type(e).__name__, e, len(inflight),
+            "group %d step failed (%s: %s); failing %d in-flight rows",
+            g.idx, type(e).__name__, e, len(inflight),
         )
         err = e if isinstance(e, StageError) else RuntimeError(
             f"pipeline session step failed: {e}"

@@ -194,6 +194,12 @@ CONFIGS: dict[str, ModelConfig] = {
         name="tiny-llama", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
         n_kv_heads=2, d_ff=128, max_seq_len=256,
     ),
+    "tiny-llama-4l": ModelConfig(  # 4 layers: pipeline splits deeper than
+        # 2 stages (layer_ranges caps n_stages at n_layers) — the
+        # pipeline_interleave bench/test topology at 4 stages
+        name="tiny-llama-4l", vocab_size=512, d_model=64, n_layers=4,
+        n_heads=4, n_kv_heads=2, d_ff=128, max_seq_len=256,
+    ),
     "tiny-mixtral": ModelConfig(
         name="tiny-mixtral", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
         n_kv_heads=2, d_ff=128, max_seq_len=256, n_experts=4, n_experts_per_tok=2,

@@ -45,9 +45,10 @@ class PipelineService(BaseService):
         price_per_token: float = 0.0,
         max_new_tokens: int = 2048,
         max_batch: int = 8,
-        # >1: stages overlap microbatch groups; "auto" picks 2 when the
-        # stages run on distinct hosts (parallel compute to unlock), 1 on
-        # a shared host (meshnet.pipeline.resolve_microbatches)
+        # >1: that many free-running microbatch groups interleave their
+        # chains across stages; "auto" resolves a depth from gossiped
+        # stage timings vs hop RTTs on distinct hosts, 1 on a shared
+        # host (meshnet.pipeline.resolve_microbatches)
         n_microbatches: int | str = "auto",
         # lets `--model auto` resolve the tokenizer/vocab + advertised
         # name from the checkpoint's own config

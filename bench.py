@@ -680,6 +680,182 @@ def bench_migration(duration_tokens: int = 96, n_streams: int = 3) -> dict:
     return out
 
 
+def bench_pipeline_interleave(
+    stage_counts=(1, 2, 4), n_requests: int = 16, hop_ms: float = 5.0
+) -> dict:
+    """MPMD interleaved pipeline rung (ISSUE 10 acceptance): a loopback
+    mesh of 1/2/4 stage workers + coordinator serving MIXED traffic —
+    staggered open-loop arrivals with varied prompt/budget lengths, so
+    admission prefills keep landing mid-decode — through the lockstep
+    barrier session vs the free-running interleaved session (2 microbatch
+    groups both ways). Reports aggregate decode tok/s, coordinator sends,
+    and the bubble fraction measured from the stage.task spans inside the
+    timed window (health.bubble_from_spans — the stitched-trace
+    derivation; the loopback mesh shares one tracer, so no stitch hop).
+
+    ``hop_ms`` of per-task latency is injected at every worker (the chaos
+    delay harness) to emulate DISTINCT-host stage links: in-process
+    loopback stages share cores, so raw compute overlap is zero-sum there
+    (docs/PERF.md round 5 measured exactly that), and what the
+    interleaved scheduler actually buys — admission prefills and
+    stragglers no longer parking every other group — only shows once a
+    chain's latency isn't pure shared-core compute. The 2-stage rung is
+    the acceptance signal; the 4-stage in-process rung runs 5 nodes of
+    websocket+XLA on the bench host's cores and its readings are
+    correspondingly noisier (judge per the platform stamp, best-of-2
+    each way). tiny-llama-4l (4 layers splits 4 ways) with random-init
+    weights runs anywhere. Standalone: ``python bench.py
+    pipeline_interleave``."""
+    import asyncio
+    import time as _time
+
+    import jax
+
+    MODEL = "tiny-llama-4l"
+    SEED = 0
+    MICROBATCHES = 2
+
+    async def one(n_stages: int, interleave: bool) -> dict:
+        from bee2bee_tpu.engine.stage_runner import StageRunner
+        from bee2bee_tpu.health import bubble_from_spans
+        from bee2bee_tpu.meshnet.chaos import ChaosStage
+        from bee2bee_tpu.meshnet.node import P2PNode
+        from bee2bee_tpu.meshnet.pipeline import PipelineCoordinator
+        from bee2bee_tpu.tracing import get_tracer
+
+        workers = [
+            P2PNode(host="127.0.0.1", port=0) for _ in range(n_stages)
+        ]
+        coord = P2PNode(host="127.0.0.1", port=0)
+        nodes = [*workers, coord]
+        for n in nodes:
+            await n.start()
+        sess = None
+        chaoses = []
+        try:
+            loop = asyncio.get_running_loop()
+            for i, w in enumerate(workers):
+                runner = await loop.run_in_executor(
+                    None,
+                    lambda i=i: StageRunner(
+                        MODEL, n_stages=n_stages, stage=i, max_seq_len=256,
+                        dtype="float32", rng_seed=SEED,
+                    ),
+                )
+                w.add_stage_runner(runner)
+            for w in workers:
+                await coord.connect_bootstrap(w.addr)
+            for _ in range(200):
+                if len(coord.peers) >= n_stages:
+                    break
+                await asyncio.sleep(0.05)
+            coordinator = PipelineCoordinator(
+                coord, MODEL, stage_peers=[w.peer_id for w in workers],
+                max_seq_len=256, dtype="float32", rng_seed=SEED,
+            )
+            await coordinator.load(timeout=300.0)
+            sess = coordinator.session(
+                max_batch=4, n_microbatches=MICROBATCHES,
+                interleave=interleave,
+            )
+            prompts = [
+                [1 + (i * 13 + j) % 300 for j in range(8 + 8 * (i % 3))]
+                for i in range(n_requests)
+            ]
+            budgets = [8 + 4 * (i % 3) for i in range(n_requests)]
+            # warm EVERY prefill bucket (16 and 32) into every group's
+            # compile cache: a mid-window XLA compile lands on whichever
+            # mode ran first and drowns the scheduling effect under test
+            for _ in range(MICROBATCHES):
+                await asyncio.gather(*(
+                    sess.generate([1] * ln, max_new_tokens=2,
+                                  temperature=0.0)
+                    for ln in (9, 24)
+                ))
+            # emulate distinct-host stage links: per-task wire latency
+            chaoses = [
+                ChaosStage(w, action="delay", at_step=1,
+                           delay_s=hop_ms / 1000.0)
+                for w in workers
+            ]
+
+            async def submit(i: int):
+                await asyncio.sleep(0.03 * i)  # open-loop arrivals
+                return await sess.generate(
+                    prompts[i], max_new_tokens=budgets[i], temperature=0.0
+                )
+
+            best = None
+            for _rep in range(2):
+                base_sends = sess.stats["tasks_sent"]
+                w0 = _time.time() * 1000.0
+                t0 = _time.perf_counter()
+                outs = await asyncio.gather(
+                    *(submit(i) for i in range(n_requests))
+                )
+                wall = _time.perf_counter() - t0
+                w1 = _time.time() * 1000.0
+                tokens = sum(len(o) for o in outs)
+                bubble = bubble_from_spans(
+                    get_tracer().recent(limit=4096, name="stage.task"),
+                    w0, w1,
+                )
+                entry = {
+                    "tok_per_s": (
+                        round(tokens / wall, 2) if wall > 0 else 0.0
+                    ),
+                    "tokens": tokens,
+                    "wall_s": round(wall, 4),
+                    "coordinator_sends": (
+                        sess.stats["tasks_sent"] - base_sends
+                    ),
+                    "bubble_fraction": (
+                        bubble.get("bubble_fraction") if bubble else None
+                    ),
+                }
+                if best is None or entry["tok_per_s"] > best["tok_per_s"]:
+                    best = entry
+            return best
+        finally:
+            for ch in chaoses:
+                ch.restore()
+            if sess is not None:
+                await sess.close()
+            for n in nodes:
+                try:
+                    await n.stop()
+                except Exception:  # noqa: BLE001
+                    pass
+
+    out: dict = {
+        "platform": jax.devices()[0].platform,
+        "platform_fallback": os.environ.get(
+            "_BEE2BEE_BENCH_CPU_FALLBACK") == "1",
+        "requests": n_requests,
+        "microbatches": MICROBATCHES,
+        "hop_ms": hop_ms,
+        "stages": {},
+    }
+    for s in stage_counts:
+        lockstep = asyncio.run(one(s, interleave=False))
+        interleaved = asyncio.run(one(s, interleave=True))
+        off, on = lockstep["tok_per_s"], interleaved["tok_per_s"]
+        entry = {
+            "lockstep": lockstep,
+            "interleaved": interleaved,
+            "speedup": round(on / off, 3) if off > 0 else 0.0,
+        }
+        out["stages"][str(s)] = entry
+        log(
+            f"pipeline_interleave [{out['platform']}] {s} stage(s): "
+            f"{on} tok/s interleaved vs {off} lockstep "
+            f"(x{entry['speedup']}; bubble "
+            f"{interleaved['bubble_fraction']} vs "
+            f"{lockstep['bubble_fraction']})"
+        )
+    return out
+
+
 def bench_reference_path() -> float:
     """The reference's hot loop: HF transformers greedy generate on torch CPU
     (reference hf.py:35-44 minus tokenization — token ids in, ids out)."""
@@ -783,6 +959,15 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — the rung must not kill the bench
         log(f"migration rung failed: {e}")
         extras["migration"] = {"error": str(e)}
+
+    # interleaved-pipeline rung (ISSUE 10 acceptance: interleaved >=
+    # lockstep decode tok/s at 2+ stages on loopback, bubble fraction
+    # before/after from the stage.task spans). tiny-model, any platform
+    try:
+        extras["pipeline_interleave"] = bench_pipeline_interleave()
+    except Exception as e:  # noqa: BLE001 — the rung must not kill the bench
+        log(f"pipeline_interleave rung failed: {e}")
+        extras["pipeline_interleave"] = {"error": str(e)}
 
     if platform == "tpu":
         def rung(key: str, **kw) -> None:
@@ -897,5 +1082,10 @@ if __name__ == "__main__":
     # (tiny random-init model — runs on whatever backend jax resolves)
     if len(sys.argv) > 1 and sys.argv[1] == "migration":
         print(json.dumps(bench_migration()), flush=True)
+        sys.exit(0)
+    # `python bench.py pipeline_interleave`: the MPMD interleave rung
+    # standalone (tiny random-init model, loopback mesh, any platform)
+    if len(sys.argv) > 1 and sys.argv[1] == "pipeline_interleave":
+        print(json.dumps(bench_pipeline_interleave()), flush=True)
         sys.exit(0)
     main()

@@ -24,8 +24,15 @@ exercises the health plane (ISSUE 6):
   takes the SCORED path (not the static fallback) and picks the live
   serving peer.
 
-No model loads, no accelerator touched — this must stay cheap enough to
-run before every boot. Exit 0 on success, 1 with a reason on failure.
+Finally boots a 2-STAGE pipeline split (ISSUE 10): a tiny random-init
+model across two loopback stage workers decodes through the interleaved
+session and the bubble-fraction surface lights up — stage.task timings in
+the gossiped digest (the microbatch auto-depth input) and
+``bee2bee_pipeline_bubble_fraction`` on ``/metrics``.
+
+The first legs load no model; the pipeline leg compiles a 2-layer
+random-init toy (seconds, not minutes) — still cheap enough to run
+before every boot. Exit 0 on success, 1 with a reason on failure.
 """
 
 from __future__ import annotations
@@ -273,11 +280,88 @@ async def run_drain_smoke() -> None:
         await a.stop()
 
 
+async def run_pipeline_smoke() -> None:
+    """2-stage pipeline leg (ISSUE 10): decode through the interleaved
+    session, then assert the bubble observability surface — worker-side
+    stage-task timings ride the digest (feeding the microbatch
+    auto-depth heuristic) and the derived idleness gauge serves on
+    ``/metrics``. Loopback nodes share one process registry/tracer, so
+    the coordinator's surfaces carry the whole split's readings."""
+    import asyncio as aio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from bee2bee_tpu.api import build_app
+    from bee2bee_tpu.engine.stage_runner import StageRunner
+    from bee2bee_tpu.meshnet.node import P2PNode
+    from bee2bee_tpu.meshnet.pipeline import PipelineCoordinator
+
+    MODEL = "tiny-llama"
+    workers = [P2PNode(host="127.0.0.1", port=0) for _ in range(2)]
+    coord = P2PNode(host="127.0.0.1", port=0)
+    nodes = [*workers, coord]
+    client = None
+    sess = None
+    for n in nodes:
+        await n.start()
+    try:
+        loop = aio.get_running_loop()
+        for i, w in enumerate(workers):
+            runner = await loop.run_in_executor(
+                None,
+                lambda i=i: StageRunner(
+                    MODEL, n_stages=2, stage=i, max_seq_len=64,
+                    dtype="float32", rng_seed=0,
+                ),
+            )
+            w.add_stage_runner(runner)
+        for w in workers:
+            assert await coord.connect_bootstrap(w.addr), "stage dial failed"
+        for _ in range(100):
+            if len(coord.peers) >= 2:
+                break
+            await aio.sleep(0.05)
+        coordinator = PipelineCoordinator(
+            coord, MODEL, stage_peers=[w.peer_id for w in workers],
+            max_seq_len=64, dtype="float32", rng_seed=0,
+        )
+        await coordinator.load(timeout=120.0)
+        sess = coordinator.session(max_batch=2)
+        assert sess.interleave, "session must default to interleaved"
+        out = await sess.generate([5, 6, 7], max_new_tokens=4,
+                                  temperature=0.0)
+        assert len(out) == 4, f"pipeline decode produced {len(out)} tokens"
+
+        digest = coord.telemetry_digest()
+        assert "pipeline.stage_task_ms" in (digest.get("hist") or {}), (
+            "stage task timing missing from the telemetry digest"
+        )
+        assert "pipeline_bubble" in digest, (
+            "pipeline_bubble breakdown missing from the telemetry digest"
+        )
+        client = TestClient(TestServer(build_app(coord)))
+        await client.start_server()
+        r = await client.get("/metrics")
+        assert r.status == 200, f"/metrics returned {r.status}"
+        series = parse_prometheus(await r.text())
+        assert "bee2bee_pipeline_bubble_fraction" in series, (
+            "bubble-fraction gauge missing from /metrics"
+        )
+    finally:
+        if client is not None:
+            await client.close()
+        if sess is not None:
+            await sess.close()
+        for n in nodes:
+            await n.stop()
+
+
 def main() -> int:
     try:
         asyncio.run(run_smoke())
         asyncio.run(run_mesh_health_smoke())
         asyncio.run(run_drain_smoke())
+        asyncio.run(run_pipeline_smoke())
     except AssertionError as e:
         print(f"[telemetry-smoke] FAIL: {e}", file=sys.stderr)
         return 1

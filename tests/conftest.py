@@ -47,7 +47,7 @@ except Exception:
 # stages): an introduced hang here must fail THAT test, not eat the whole
 # tier-1 wall-clock budget. The cap is ini-configurable (chaos_test_timeout)
 # and per-test overridable via @pytest.mark.async_timeout(seconds).
-_CHAOS_FILES = ("test_chaos", "test_failover")
+_CHAOS_FILES = ("test_chaos", "test_failover", "test_pipeline_interleave")
 
 
 def pytest_addoption(parser):

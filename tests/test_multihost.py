@@ -13,6 +13,8 @@ import sys
 import textwrap
 from pathlib import Path
 
+import pytest
+
 REPO = Path(__file__).resolve().parent.parent
 
 WORKER = textwrap.dedent(
@@ -64,7 +66,71 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+# The minimal cross-process collective the train step's device_put path
+# hits first (multihost_utils.broadcast_one_to_all). Some jaxlib CPU
+# builds accept jax.distributed.initialize but then refuse the actual
+# computation with "Multiprocess computations aren't implemented on the
+# CPU backend" — a box-capability gap, not a product bug, so the full
+# test SKIPS typed instead of burning a tier-1 F on it.
+_PROBE = textwrap.dedent(
+    """
+    import sys
+    import jax
+    jax.distributed.initialize(sys.argv[1], num_processes=2,
+                               process_id=int(sys.argv[2]))
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+    multihost_utils.broadcast_one_to_all(jnp.zeros((), jnp.float32))
+    print("multihost-ok")
+    """
+)
+
+_PROBE_VERDICT: list = []  # memoized [reason-or-None]
+
+
+def _multihost_gap() -> str | None:
+    """None when two-process collectives work here; else the typed reason
+    to skip (probed once per session, ~seconds either way)."""
+    if _PROBE_VERDICT:
+        return _PROBE_VERDICT[0]
+    port = _free_port()
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+    }
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _PROBE, f"127.0.0.1:{port}", str(i)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    reason = None
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=90)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            reason = "multihost probe timed out (coordination never settled)"
+            break
+        if p.returncode != 0 and reason is None:
+            tail = [ln for ln in err.strip().splitlines() if ln.strip()]
+            reason = (
+                "two-process collectives unavailable on this box: "
+                + (tail[-1][-200:] if tail else f"probe rc={p.returncode}")
+            )
+    _PROBE_VERDICT.append(reason)
+    return reason
+
+
 def test_two_process_global_mesh_train_step(tmp_path):
+    gap = _multihost_gap()
+    if gap:
+        pytest.skip(gap)
     port = _free_port()
     script = tmp_path / "worker.py"
     script.write_text(WORKER)

@@ -1,0 +1,479 @@
+"""MPMD interleaved pipeline serving (ISSUE 10): the free-running
+per-group scheduler must (a) keep emitting tokens in healthy groups while
+a straggler group crawls, (b) produce token-for-token greedy parity with
+the lockstep barrier path under mixed admission/decode traffic, and
+(c) ride a GROUP-SCOPED failover ladder — one group's typed stage
+failure re-prefills only that group's rows while the other groups finish
+with zero re-prefills. Everything pins on per-group progress counters
+(_Group.tokens/prefills), never wall-clock thresholds.
+
+Plus units for the telemetry-fed microbatch depth heuristic
+(resolve_microbatches), the bubble-fraction derivation
+(health.bubble_from_spans / local_stage_idleness), and the stage-side
+concurrency cap (StageRunner.max_concurrent_forwards).
+"""
+
+import asyncio
+import contextlib
+import threading
+import time
+from contextlib import asynccontextmanager
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bee2bee_tpu.engine.stage_runner import StageRunner
+from bee2bee_tpu.engine.tokenizer import ByteTokenizer
+from bee2bee_tpu.meshnet.chaos import ChaosStage
+from bee2bee_tpu.meshnet.node import P2PNode
+from bee2bee_tpu.meshnet.pipeline import (
+    PipelineCoordinator,
+    resolve_microbatches,
+)
+from bee2bee_tpu.models import core, get_config
+
+MODEL = "tiny-llama"
+SEED = 0
+
+
+def _tok() -> ByteTokenizer:
+    return ByteTokenizer(get_config(MODEL).vocab_size)
+
+
+async def _settle(cond, timeout=8.0):
+    for _ in range(int(timeout / 0.05)):
+        if cond():
+            return True
+        await asyncio.sleep(0.05)
+    return False
+
+
+def _expected_text(prompt: str, n: int) -> str:
+    """Greedy single-process rollout of the same random-init params —
+    the parity oracle."""
+    cfg = get_config(MODEL)
+    tok = _tok()
+    params = core.init_params(cfg, jax.random.key(SEED), dtype=jnp.float32)
+    ids = tok.encode(prompt)
+    out = []
+    for _ in range(n):
+        logits, _ = core.forward(
+            params, cfg, jnp.asarray([ids + out], jnp.int32), None,
+            jnp.int32(0),
+        )
+        t = int(np.argmax(np.asarray(logits[0, -1])))
+        if t == tok.eos_token_id:
+            break
+        out.append(t)
+    return tok.decode(out)
+
+
+@asynccontextmanager
+async def interleave_mesh(n_stages=2, n_spares=0):
+    """n_stages preconnected stage workers + coordinator (stages loaded,
+    relay links dialed), ready for session tests."""
+    workers = [
+        P2PNode(host="127.0.0.1", port=0, node_id=f"istage{i}")
+        for i in range(n_stages)
+    ]
+    spares = [
+        P2PNode(host="127.0.0.1", port=0, node_id=f"ispare{i}")
+        for i in range(n_spares)
+    ]
+    coord = P2PNode(host="127.0.0.1", port=0, node_id="icoord")
+    nodes = [*workers, *spares, coord]
+    for n in nodes:
+        await n.start()
+        n.reconnect_enabled = False
+    try:
+        for peer in [*workers, *spares]:
+            await coord.connect_bootstrap(peer.addr)
+        await _settle(lambda: len(coord.peers) >= len(nodes) - 1)
+        coordinator = PipelineCoordinator(
+            coord, MODEL, stage_peers=[w.peer_id for w in workers],
+            max_seq_len=128, dtype="float32", rng_seed=SEED,
+            failover_backoff_s=0.05,
+        )
+        await coordinator.load(timeout=120.0)
+        yield workers, spares, coord, coordinator
+    finally:
+        for n in nodes:
+            with contextlib.suppress(Exception):
+                await n.stop()
+
+
+# ---------------------------------------------------------- depth heuristic
+
+
+def test_resolve_microbatches_depth_heuristic():
+    """Distinct hosts without telemetry keep the legacy binary guess of
+    2; with gossiped stage timings + RTTs the answer becomes a depth:
+    compute-bound ≈ stage count, hop-dominated pushes toward the cap,
+    and a shared host stays 1 no matter what the telemetry says."""
+    two_hosts = ["ws://10.0.0.1:1", "ws://10.0.0.2:1"]
+    assert resolve_microbatches(two_hosts) == 2
+    # timings without RTTs (or vice versa) degrade to the binary guess
+    assert resolve_microbatches(two_hosts, stage_task_ms=[20.0]) == 2
+    assert resolve_microbatches(two_hosts, hop_rtt_ms=[2.0]) == 2
+    # compute-bound (hop << compute): depth ~= stage count
+    assert resolve_microbatches(
+        two_hosts, stage_task_ms=[20.0, 20.0], hop_rtt_ms=[2.0, 2.0]
+    ) == 2
+    # hop ~ compute: one extra in-flight chain per stage
+    assert resolve_microbatches(
+        two_hosts, stage_task_ms=[10.0, 10.0], hop_rtt_ms=[20.0, 20.0]
+    ) == 4
+    # hop-dominated clamps at max_depth
+    assert resolve_microbatches(
+        two_hosts, stage_task_ms=[1.0, 1.0], hop_rtt_ms=[100.0, 100.0]
+    ) == 4
+    assert resolve_microbatches(
+        two_hosts, stage_task_ms=[1.0], hop_rtt_ms=[100.0], max_depth=8
+    ) == 8
+    # shared host: overlap still buys nothing, telemetry or not
+    assert resolve_microbatches(
+        ["ws://127.0.0.1:1", "ws://127.0.0.1:2"],
+        stage_task_ms=[10.0], hop_rtt_ms=[20.0],
+    ) == 1
+
+
+# --------------------------------------------------------- bubble fraction
+
+
+def test_bubble_from_spans_merges_and_attributes():
+    from bee2bee_tpu.health import bubble_from_spans
+
+    spans = [
+        # stage 0: two overlapping tasks covering [0, 750) — overlap must
+        # merge, not double-count
+        {"name": "stage.task", "start_ms": 0.0, "duration_ms": 500.0,
+         "attrs": {"stage": 0}},
+        {"name": "stage.task", "start_ms": 250.0, "duration_ms": 500.0,
+         "attrs": {"stage": 0}},
+        # a remote node's stage 1 (stitched timeline): busy wall-to-wall
+        {"name": "stage.task", "start_ms": 0.0, "duration_ms": 1000.0,
+         "attrs": {"stage": 1}, "node": "w1"},
+        # non-stage spans are ignored
+        {"name": "pipeline.step", "start_ms": 0.0, "duration_ms": 900.0},
+        # a failover reload is STALL time, not serving compute: counting
+        # it busy would report ~zero bubble during the incident
+        {"name": "stage.task", "start_ms": 0.0, "duration_ms": 1000.0,
+         "attrs": {"stage": 0, "kind": "part_load"}},
+    ]
+    info = bubble_from_spans(spans, 0.0, 1000.0)
+    assert info["stages"]["0"]["busy_fraction"] == pytest.approx(0.75)
+    assert info["stages"]["w1/1"]["busy_fraction"] == pytest.approx(1.0)
+    assert info["bubble_fraction"] == pytest.approx(0.125)
+    assert info["stages"]["0"]["tasks"] == 2
+    # no window overlap / no stage spans → None, not a fabricated zero
+    assert bubble_from_spans(spans, 5000.0, 6000.0) is None
+    assert bubble_from_spans([], None, None) is None
+    # open spans (duration -1) carry no busy interval
+    assert bubble_from_spans(
+        [{"name": "stage.task", "start_ms": 0.0, "duration_ms": -1.0}],
+        0.0, 100.0,
+    ) is None
+
+
+def test_local_stage_idleness_sets_and_clears_gauge():
+    from bee2bee_tpu.health import local_stage_idleness
+    from bee2bee_tpu.metrics import get_registry
+    from bee2bee_tpu.tracing import Span, Tracer
+
+    tr = Tracer()
+    now_ms = time.time() * 1000.0
+    tr._spans.append(Span(
+        name="stage.task", start_ms=now_ms - 1000.0, duration_ms=500.0,
+        attrs={"stage": 0},
+    ))
+    info = local_stage_idleness(window_s=10.0, tracer=tr)
+    assert info is not None
+    assert info["stages"]["0"]["busy_fraction"] == pytest.approx(0.05)
+    g = get_registry().get("pipeline.bubble_fraction")
+    assert g.value() == pytest.approx(info["bubble_fraction"])
+    busy = get_registry().get("pipeline.stage_busy_fraction")
+    assert busy.value(stage="0") == pytest.approx(0.05)
+
+    # an idle window CLEARS the gauges (drop-out, not stale readings)
+    assert local_stage_idleness(window_s=10.0, tracer=Tracer()) is None
+    assert g.series() == []
+    assert busy.series() == []
+
+
+# ------------------------------------------------------ straggler isolation
+
+
+async def test_slow_group_does_not_stall_other_groups():
+    """A deliberately-slowed group (per-task delay chaos scoped to ITS
+    rid) must not stall the other group's token emission: the fast
+    group's request completes while the slow group is still mid-flight —
+    pinned on per-group progress counters. Under the lockstep barrier
+    this exact scenario serializes both groups onto the straggler's
+    cadence."""
+    async with interleave_mesh() as (workers, spares, coord, coordinator):
+        tok = _tok()
+        sess = coordinator.session(max_batch=2, n_microbatches=2)
+        try:
+            assert len(sess.groups) == 2
+            g0, g1 = sess.groups
+            chaos = ChaosStage(
+                workers[0], action="delay", at_step=1, delay_s=0.25,
+                match=lambda d: d.get("request_id") == g0.rid,
+            )
+            budget = 16
+            # tasks run their pre-await bodies in creation order, so the
+            # first generate claims group 0, the second group 1
+            slow = asyncio.create_task(sess.generate(
+                tok.encode("slow group"), max_new_tokens=budget,
+                temperature=0.0,
+            ))
+            fast = asyncio.create_task(sess.generate(
+                tok.encode("fast group"), max_new_tokens=budget,
+                temperature=0.0,
+            ))
+            out_fast = await fast
+            # the fast group finished its whole budget while the slow
+            # group (>=250 ms per chain) was still decoding
+            assert not slow.done(), "fast group waited on the straggler"
+            assert g1.tokens >= len(out_fast)
+            assert g0.tokens < budget
+            chaos.restore()
+            out_slow = await slow
+            assert tok.decode(out_fast) == _expected_text("fast group", budget)
+            assert tok.decode(out_slow) == _expected_text("slow group", budget)
+        finally:
+            await sess.close()
+
+
+async def test_free_row_steals_queued_request_from_busy_group():
+    """Submit-time group assignment is a load hint, not an affinity
+    contract: a request queued behind one group's long row is stolen by
+    another group's free slot instead of idling behind the straggler."""
+    async with interleave_mesh() as (workers, spares, coord, coordinator):
+        tok = _tok()
+        sess = coordinator.session(max_batch=2, n_microbatches=2)
+        try:
+            # creation order: long→g0, short→g1; late pins to g0 (tie)
+            long_task = asyncio.create_task(sess.generate(
+                tok.encode("long row"), max_new_tokens=40, temperature=0.0,
+            ))
+            short = await asyncio.create_task(sess.generate(
+                tok.encode("short row"), max_new_tokens=3, temperature=0.0,
+            ))
+            assert tok.decode(short) == _expected_text("short row", 3)
+            late = await sess.generate(
+                tok.encode("late row"), max_new_tokens=3, temperature=0.0,
+            )
+            # the late request finished on g1's freed row while g0's
+            # long row was still decoding — no head-of-line wait
+            assert not long_task.done(), "late request waited on g0's row"
+            assert tok.decode(late) == _expected_text("late row", 3)
+            out_long = await long_task
+            assert tok.decode(out_long) == _expected_text("long row", 40)
+        finally:
+            await sess.close()
+
+
+# ----------------------------------------------- parity with lockstep path
+
+
+async def test_interleaved_parity_with_lockstep_mixed_traffic():
+    """Greedy token-for-token parity between the interleaved scheduler
+    and the lockstep barrier path under MIXED traffic: more requests than
+    rows, staggered arrivals, varied prompt lengths and budgets — so
+    admissions land mid-decode and rows retire at different steps."""
+    async with interleave_mesh() as (workers, spares, coord, coordinator):
+        tok = _tok()
+        prompts = [f"mixed {i} " * (1 + i % 3) for i in range(6)]
+        budgets = [4 + 3 * (i % 3) for i in range(6)]
+
+        async def run_mode(interleave: bool) -> list[list[int]]:
+            sess = coordinator.session(
+                max_batch=4, n_microbatches=2, interleave=interleave
+            )
+            try:
+                async def submit(i: int):
+                    await asyncio.sleep(0.02 * i)
+                    return await sess.generate(
+                        tok.encode(prompts[i]), max_new_tokens=budgets[i],
+                        temperature=0.0,
+                    )
+
+                return await asyncio.gather(*(submit(i) for i in range(6)))
+            finally:
+                await sess.close()
+
+        outs_interleaved = await run_mode(True)
+        outs_lockstep = await run_mode(False)
+        assert outs_interleaved == outs_lockstep
+        for p, n, out in zip(prompts, budgets, outs_interleaved):
+            assert tok.decode(out) == _expected_text(p, n), p
+
+
+# ------------------------------------------------- group-scoped failover
+
+
+async def test_group_scoped_failover_leaves_healthy_groups_alone():
+    """Persistent typed errors scoped to ONE group's rid: that group
+    rides the ladder (in-place resume → rid rotation + recover + requeue
+    re-prefill) while the OTHER group's rows finish with greedy parity
+    and ZERO re-prefills — and the failed group's rows still finish with
+    parity after the requeue."""
+    async with interleave_mesh() as (workers, spares, coord, coordinator):
+        tok = _tok()
+        sess = coordinator.session(max_batch=4, n_microbatches=2)
+        try:
+            g0, g1 = sess.groups
+            doomed_rid = g0.rid
+            # at_step=4: let group 0's two admissions land and ONE decode
+            # chain succeed (its accept books a token per row), then fail
+            # the next decode — the requeued rows resume with accepted
+            # tokens, i.e. real re-prefills, scoped to this group
+            chaos = ChaosStage(
+                workers[0], action="error", at_step=4,
+                match=lambda d: d.get("request_id") == doomed_rid,
+            )
+            prompts = ["doomed a", "healthy b", "doomed c", "healthy d"]
+            budgets = [8, 8, 6, 6]
+            # creation order pins assignment: 0→g0, 1→g1, 2→g0, 3→g1
+            outs = await asyncio.gather(*(
+                sess.generate(tok.encode(p), max_new_tokens=n,
+                              temperature=0.0)
+                for p, n in zip(prompts, budgets)
+            ))
+            assert chaos.triggered.is_set(), "fault never fired"
+            for p, n, out in zip(prompts, budgets, outs):
+                assert tok.decode(out) == _expected_text(p, n), (
+                    f"row {p!r} lost parity"
+                )
+            # the failed group rode the typed ladder: in-place resume
+            # first, then rid rotation + requeue — its rows resumed by
+            # re-prefilling prompt + accepted tokens
+            assert g0.rid != doomed_rid
+            assert sess.stats["resumes_in_place"] >= 1
+            assert g0.reprefills >= 1, sess.group_progress()
+            # the HEALTHY group NEVER re-prefilled a row that held
+            # accepted tokens — failover stayed group-scoped
+            assert g1.reprefills == 0, (
+                f"healthy group re-prefilled: {sess.group_progress()}"
+            )
+            # the chain rebuild was adopted session-wide
+            assert sess.epoch == coordinator.epoch >= 1
+            chaos.restore()
+        finally:
+            await sess.close()
+
+
+async def test_dead_stage_evacuates_all_groups_and_resumes():
+    """StageDead with a spare: the replacement stage lost EVERY group's
+    caches with the dead process, so both groups requeue (re-prefill)
+    and all rows finish with parity on the rebuilt chain — the
+    group-scoped ladder escalating to whole-session evacuation exactly
+    when the topology actually changed."""
+    async with interleave_mesh(n_spares=1) as (workers, spares, coord,
+                                               coordinator):
+        tok = _tok()
+        sess = coordinator.session(max_batch=4, n_microbatches=2)
+        try:
+            chaos = ChaosStage(workers[1], action="kill", at_step=6)
+            prompts = ["evac a", "evac b", "evac c", "evac d"]
+            outs = await asyncio.gather(*(
+                sess.generate(tok.encode(p), max_new_tokens=10,
+                              temperature=0.0)
+                for p in prompts
+            ))
+            assert chaos.triggered.is_set(), "fault never fired"
+            for p, out in zip(prompts, outs):
+                assert tok.decode(out) == _expected_text(p, 10), p
+            assert spares[0].peer_id in coordinator.stage_peers
+            assert sess.epoch == coordinator.epoch >= 1
+            # both groups re-prefilled: the dead stage held their caches
+            assert sess.stats["prefills"] > len(prompts)
+        finally:
+            await sess.close()
+
+
+@pytest.mark.slow
+async def test_repeated_group_churn_keeps_parity():
+    """Churn variant: round after round of persistent typed errors
+    scoped to group 0's CURRENT rid (re-armed after each recovery).
+    Every round the failed group requeues under a fresh rid and the
+    healthy group keeps its zero-re-prefill record."""
+    async with interleave_mesh() as (workers, spares, coord, coordinator):
+        tok = _tok()
+        sess = coordinator.session(max_batch=4, n_microbatches=2)
+        try:
+            g0, g1 = sess.groups
+            for rnd in range(2):
+                doomed_rid = g0.rid
+                chaos = ChaosStage(
+                    workers[0], action="error", at_step=2,
+                    match=lambda d, r=doomed_rid: d.get("request_id") == r,
+                )
+                prompts = [f"churn{rnd} g0", f"churn{rnd} g1"]
+                outs = await asyncio.gather(*(
+                    sess.generate(tok.encode(p), max_new_tokens=8,
+                                  temperature=0.0)
+                    for p in prompts
+                ))
+                assert chaos.triggered.is_set(), f"round {rnd} never fired"
+                for p, out in zip(prompts, outs):
+                    assert tok.decode(out) == _expected_text(p, 8), p
+                assert g0.rid != doomed_rid
+                chaos.restore()
+            assert g1.reprefills == 0, sess.group_progress()
+            assert sess.epoch == coordinator.epoch >= 2
+        finally:
+            await sess.close()
+
+
+# ------------------------------------------------ stage-side concurrency
+
+
+def test_stage_runner_concurrent_forward_cap():
+    """max_concurrent_forwards bounds how many jit dispatches run at
+    once: with cap 1, four threads' forwards never overlap; with the
+    default cap they genuinely do."""
+
+    def run_threads(runner) -> int:
+        state = {"cur": 0, "peak": 0}
+        lock = threading.Lock()
+        orig = runner._fwd
+
+        def tracked(*a):
+            with lock:
+                state["cur"] += 1
+                state["peak"] = max(state["peak"], state["cur"])
+            try:
+                time.sleep(0.05)
+                return orig(*a)
+            finally:
+                with lock:
+                    state["cur"] -= 1
+
+        runner._fwd = tracked
+        x = np.zeros((1, 16), np.int32)
+        threads = [
+            threading.Thread(target=runner.forward, args=(f"r{i}", x, 0))
+            for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return state["peak"]
+
+    capped = StageRunner(
+        MODEL, n_stages=1, stage=0, max_seq_len=64, dtype="float32",
+        rng_seed=SEED, max_concurrent_forwards=1,
+    )
+    assert capped.info["max_concurrent_forwards"] == 1
+    assert run_threads(capped) == 1
+
+    open_runner = StageRunner(
+        MODEL, n_stages=1, stage=0, max_seq_len=64, dtype="float32",
+        rng_seed=SEED,
+    )
+    assert run_threads(open_runner) >= 2
