@@ -33,6 +33,7 @@ _ENV_MAP = {
     "BEE2BEE_PAGED": "paged",
     "BEE2BEE_KV_BLOCK_SIZE": "kv_block_size",
     "BEE2BEE_KV_POOL_BLOCKS": "kv_pool_blocks",
+    "BEE2BEE_KV_QUANT": "kv_quant",
     "BEE2BEE_SPEC": "spec_tokens",
     "BEE2BEE_QUANTIZE": "quantize",
     "BEE2BEE_AUTO_NAT": "auto_nat",
@@ -45,7 +46,7 @@ _INT_FIELDS = {
     "dht_port", "prefill_chunk", "prefix_cache_entries", "kv_block_size",
     "kv_pool_blocks", "spec_tokens",
 }
-_BOOL_FIELDS = {"auto_nat", "paged"}
+_BOOL_FIELDS = {"auto_nat", "paged", "kv_quant"}
 
 
 @dataclass
@@ -84,6 +85,10 @@ class NodeConfig:
     # the paged block pool is now the engine's only cache layout
     paged: bool = False
     kv_block_size: int = 16  # tokens per pool block (EngineConfig knob)
+    # int8 KV pool: pages stored int8 with per-page-per-head scales,
+    # dequantized inside the attention kernels — ~2x resident sessions
+    # at fixed HBM (BEE2BEE_KV_QUANT / --kv-quant; bf16 pool default)
+    kv_quant: bool = False
     # self-speculative decoding: draft up to this many tokens per step
     # by n-gram lookup over the request's own prompt+output and verify
     # them in one batched forward (BEE2BEE_SPEC / --spec; 0 = off —
@@ -120,6 +125,7 @@ class NodeConfig:
             prefill_chunk=self.prefill_chunk or None,
             prefix_cache_entries=self.prefix_cache_entries,
             quantize=self.quantize,
+            cache_dtype="int8" if self.kv_quant else "bfloat16",
             paged=self.paged,
             kv_block_size=self.kv_block_size,
             kv_pool_blocks=self.kv_pool_blocks or None,

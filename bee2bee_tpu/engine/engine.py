@@ -563,11 +563,37 @@ class InferenceEngine:
             + self.engine_cfg.prefix_cache_entries * pin
         )
 
+    @property
+    def kv_info(self) -> dict:
+        """KV-pool identity (ISSUE 12 drive-by): which cache layout this
+        engine runs and its effective capacity — rides engine.info AND
+        the telemetry digest, so /mesh/health and the router see which
+        peers serve the doubled int8 pool, not just a raw block count
+        whose bytes-per-block they can't know. Pure config arithmetic —
+        never allocates the pool or the scheduler."""
+        return {
+            "cache_dtype": str(jnp.dtype(self.engine_cfg.cache_dtype)),
+            "block_size": int(self.engine_cfg.kv_block_size),
+            "pool_blocks": int(self.pool_blocks),
+            # usable tokens (block 0 is the reserved null block)
+            "capacity_tokens": int(
+                (self.pool_blocks - 1) * self.engine_cfg.kv_block_size
+            ),
+        }
+
+    @property
+    def kv_quantized(self) -> bool:
+        """True when the pool stores int8 pages + per-page-per-head
+        scales (EngineConfig.cache_dtype='int8' / --kv-quant)."""
+        return jnp.dtype(self.engine_cfg.cache_dtype) == jnp.int8
+
     def new_pool(self):
         """The paged KV block pool, placed with the kv-head `model` spec
         (partition.paged_cache_spec) so TP serving gathers stay local;
         under attention='sp' the slot dim additionally shards over `seq`
-        (per-device pool memory 1/seq — the long-context scaling)."""
+        (per-device pool memory 1/seq — the long-context scaling). An
+        int8 pool (cache_dtype='int8') carries k_scale/v_scale arrays,
+        sharded like the pool's kv-head dim (partition.paged_scale_spec)."""
         pool = core.init_paged_pool(
             self.model_cfg, self.pool_blocks, self.engine_cfg.kv_block_size,
             jnp.dtype(self.engine_cfg.cache_dtype),
@@ -576,8 +602,15 @@ class InferenceEngine:
             self.model_cfg, self.mesh,
             seq_sharded=self.engine_cfg.attention == "sp",
         )
-        fitted = self._fit_spec(spec, pool["k"].shape)
-        return jax.device_put(pool, NamedSharding(self.mesh, fitted))
+        sspec = partition.paged_scale_spec(self.model_cfg, self.mesh)
+        shardings = {
+            name: NamedSharding(
+                self.mesh,
+                self._fit_spec(spec if arr.ndim == 5 else sspec, arr.shape),
+            )
+            for name, arr in pool.items()
+        }
+        return jax.device_put(pool, shardings)
 
     def _next_key(self):
         with self._mutex:
@@ -871,30 +904,43 @@ class InferenceEngine:
             # the block arrays must match the pool geometry EXACTLY —
             # a malformed/mismatched export must reject typed here, not
             # raise on the scheduler thread (whose catch-all would fail
-            # every in-flight request on this node)
+            # every in-flight request on this node). An int8 pool demands
+            # the scale tensors too (and ONLY then): dequantizing shipped
+            # pages with absent/mismatched scales is silent corruption.
             from .paged import ceil_div
 
             cfg = self.model_cfg
-            want = (
-                cfg.n_layers, cfg.n_kv_heads,
-                ceil_div(offset, self.engine_cfg.kv_block_size),
+            nb = ceil_div(offset, self.engine_cfg.kv_block_size)
+            cache_dt = jnp.dtype(self.engine_cfg.cache_dtype)
+            pool_shape = (
+                cfg.n_layers, cfg.n_kv_heads, nb,
                 self.engine_cfg.kv_block_size, cfg.head_dim,
             )
-            cache_dt = jnp.dtype(self.engine_cfg.cache_dtype)
-            for name in ("k", "v"):
-                arr = kv.get(name) if isinstance(kv, dict) else None
+            want = {"k": (pool_shape, cache_dt), "v": (pool_shape, cache_dt)}
+            if self.kv_quantized:
+                sshape = (cfg.n_layers, cfg.n_kv_heads, nb)
+                want["k_scale"] = (sshape, jnp.dtype(jnp.float32))
+                want["v_scale"] = (sshape, jnp.dtype(jnp.float32))
+            got_names = set(kv) if isinstance(kv, dict) else set()
+            if got_names != set(want):
+                raise ValueError(
+                    f"import: kv tensors {sorted(got_names)} != pool "
+                    f"layout {sorted(want)} (cache_dtype {cache_dt})"
+                )
+            for name, (wshape, wdt) in want.items():
+                arr = kv.get(name)
                 shape = tuple(getattr(arr, "shape", ()))
-                if shape != want:
+                if shape != wshape:
                     raise ValueError(
                         f"import: kv[{name!r}] shape {shape} != pool "
-                        f"geometry {want}"
+                        f"geometry {wshape}"
                     )
-                if jnp.dtype(getattr(arr, "dtype", None)) != cache_dt:
+                if jnp.dtype(getattr(arr, "dtype", None)) != wdt:
                     # wrong-dtype bytes pass the sha256 (it hashes what
                     # was sent) but would scatter garbage bit patterns
                     raise ValueError(
                         f"import: kv[{name!r}] dtype {arr.dtype} != pool "
-                        f"cache dtype {cache_dt}"
+                        f"dtype {wdt}"
                     )
             req.import_state = {
                 "offset": offset, "cur": int(snap["cur"]), "kv": kv,
@@ -935,6 +981,7 @@ class InferenceEngine:
             "max_seq_len": self.max_seq_len,
             "platform": jax.devices()[0].platform,
         }
+        out["kv"] = self.kv_info
         # speculative-decode observability (dashboards read acceptance to
         # judge whether the workload repeats enough to keep K up). Read
         # _scheduler directly — info() must not allocate the batch cache.

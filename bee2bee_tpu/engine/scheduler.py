@@ -382,24 +382,41 @@ class BatchScheduler:
         self._sample_first = jax.jit(sample_batched)
         self._copy_block = jax.jit(copy_block, donate_argnums=(0,))
 
-        # migration block transfer (pool block dim = axis 2): gather reads
-        # a row's blocks out for host export (no donation — the pool keeps
+        # migration block transfer (pool block dim = axis 2 of EVERY pool
+        # leaf — the int8 pool's [L, Hkv, NB] scale arrays line up with
+        # the [L, Hkv, NB, BS, hd] pages, so one generic gather/scatter
+        # moves pages and their scales together): gather reads a row's
+        # blocks out for host export (no donation — the pool keeps
         # serving), scatter writes imported blocks into freshly allocated
         # slots. Index arrays pad to pow2 widths (null block 0 / zero
         # data) so compile variants stay O(log) like the table widths;
         # pad writes land in the null block, which dead-row decode
         # scribbles on by design anyway.
         def gather_blocks(cache, idx):
-            return {"k": cache["k"][:, :, idx], "v": cache["v"][:, :, idx]}
+            return {name: arr[:, :, idx] for name, arr in cache.items()}
 
-        def scatter_blocks(cache, kk, vv, idx):
+        def scatter_blocks(cache, new, idx):
             return {
-                "k": cache["k"].at[:, :, idx].set(kk),
-                "v": cache["v"].at[:, :, idx].set(vv),
+                name: arr.at[:, :, idx].set(new[name])
+                for name, arr in cache.items()
             }
 
         self._gather_blocks = jax.jit(gather_blocks)
         self._scatter_blocks = jax.jit(scatter_blocks, donate_argnums=(0,))
+        # int8 pool: a recycled block's scale entry must drop to zero
+        # before its next tenant writes — the quantize-on-write running
+        # max would otherwise inherit the PREVIOUS tenant's amax and
+        # serve the new row at an inflated quantization step forever
+        self._quantized = e.kv_quantized
+        if self._quantized:
+            def reset_scales(cache, idx):
+                return dict(
+                    cache,
+                    k_scale=cache["k_scale"].at[:, :, idx].set(0.0),
+                    v_scale=cache["v_scale"].at[:, :, idx].set(0.0),
+                )
+
+            self._reset_scales = jax.jit(reset_scales, donate_argnums=(0,))
         if e.engine_cfg.prefix_cache_entries > 0:
             from .paged import PagedPrefixCache
 
@@ -661,7 +678,12 @@ class BatchScheduler:
 
     def _alloc_or_evict(self, n: int) -> list[int]:
         """n fresh blocks, reclaiming LRU prefix pins under pressure;
-        raises _PoolExhausted when even that can't cover it."""
+        raises _PoolExhausted when even that can't cover it. On an int8
+        pool the fresh blocks' scale entries reset to zero here — every
+        allocation path (admission prefill, decode growth, CoW copy
+        targets, KV imports) funnels through this method, so a new
+        tenant always quantizes from a clean slate (the CoW copy and
+        the import scatter then overwrite with the real scales)."""
         fresh = self._alloc.alloc(n)
         if fresh is None and self._prefix_cache is not None:
             if self._prefix_cache.evict_for_pressure(n):
@@ -671,6 +693,14 @@ class BatchScheduler:
                 f"paged KV pool exhausted: need {n} blocks, "
                 f"{self._alloc.free_count} free of {self._alloc.num_blocks}"
             )
+        if self._quantized and fresh:
+            from .paged import pow2_at_least
+
+            # pow2-padded index (null block 0 pad) bounds compile variants
+            width = pow2_at_least(len(fresh))
+            idx = np.zeros((width,), np.int32)
+            idx[:len(fresh)] = fresh
+            self._cache = self._reset_scales(self._cache, idx)
         self.stats.paged_blocks_in_use = self._alloc.used_count
         self.stats.paged_blocks_hwm = self._alloc.hwm
         return fresh
@@ -779,9 +809,10 @@ class BatchScheduler:
             idx = np.zeros((width,), np.int32)
             idx[:nb] = self._row_blocks[b][:nb]
             got = jax.device_get(self._gather_blocks(self._cache, idx))
+            # int8 pool: the per-page scales ride under their own keys
+            # (k_scale/v_scale), halving the exported bytes with them
             snap["_kv"] = {
-                "k": np.asarray(got["k"][:, :, :nb]),
-                "v": np.asarray(got["v"][:, :, :nb]),
+                name: np.asarray(arr[:, :, :nb]) for name, arr in got.items()
             }
         return snap
 
@@ -811,14 +842,19 @@ class BatchScheduler:
                 width = min(pow2_at_least(need), e.blocks_per_row)
                 idx = np.zeros((width,), np.int32)
                 idx[:need] = fresh
-                kk = np.zeros(
-                    kv["k"].shape[:2] + (width,) + kv["k"].shape[3:],
-                    kv["k"].dtype,
-                )
-                vv = np.zeros_like(kk)
-                kk[:, :, :need] = kv["k"]
-                vv[:, :, :need] = kv["v"]
-                self._cache = self._scatter_blocks(self._cache, kk, vv, idx)
+                # pad every pool leaf (pages AND int8 scales — the key
+                # sets match: import_generation validated them against
+                # the pool layout) to the pow2 width; pad columns target
+                # the null block
+                new = {}
+                for name in self._cache:
+                    arr = np.asarray(kv[name])
+                    buf = np.zeros(
+                        arr.shape[:2] + (width,) + arr.shape[3:], arr.dtype
+                    )
+                    buf[:, :, :need] = arr
+                    new[name] = buf
+                self._cache = self._scatter_blocks(self._cache, new, idx)
                 self._offsets[b] = offset
                 self._cur[b] = int(st["cur"])
                 # prefix pins travel WITH the generation: the imported

@@ -26,7 +26,12 @@ the generation snapshot (scheduler ``_snapshot_meta``), the engine's
 pool-compat signature and the chunk count; ``KV_BLOCKS`` frames carry
 the pool blocks as binary tensor frames with per-buffer sha256 (the
 pieces.py discipline — a corrupt block is refused before it touches the
-target pool); ``KV_IMPORT_ACK`` is the target's typed verdict. The
+target pool; an int8 pool ships its k_scale/v_scale tensors alongside
+the pages at half the page bytes, hashed and verified the same way);
+``KV_IMPORT_ACK`` is the target's typed verdict. The signature's
+``cache_dtype`` gates layout compatibility: a bf16-pool node refuses an
+int8 exporter's pages typed ``incompatible``, and the ladder then takes
+the layout-free re-prefill rung — on the SAME peer if need be. The
 resumed stream rides the existing GEN_CHUNK / GEN_SUCCESS / GEN_ERROR
 plumbing under the migration rid, and the source BRIDGES it into the
 original Request's event queue — the consumer (HTTP stream, p2p
@@ -339,10 +344,15 @@ class MigrationManager:
                     return "ok"
                 except MigrationError as err:
                     self._incident(err, snap, reason)
-                    # hash_mismatch indicts the PIECES (source/transit),
-                    # not the target — it stays eligible for the
-                    # re-prefill rung, which ships no tensors at all
-                    if err.target and err.code != "hash_mismatch":
+                    # hash_mismatch indicts the PIECES (source/transit)
+                    # and incompatible indicts the LAYOUT PAIRING (e.g. a
+                    # bf16-pool peer refusing int8 pages, or a different
+                    # kv_block_size) — neither indicts the target itself,
+                    # so both stay eligible for the re-prefill rung,
+                    # which ships token ids only and is layout-free
+                    if err.target and err.code not in (
+                        "hash_mismatch", "incompatible"
+                    ):
                         excluded.add(err.target)
                 except Exception as err:  # noqa: BLE001 — a rung bug must
                     # fall down the ladder, not escape the drain gather
@@ -507,16 +517,22 @@ class MigrationManager:
 
     def _encode_chunks(self, rid: str, kv: dict) -> list[bytes]:
         """Pool blocks → binary tensor frames, <= MAX_CHUNK_BYTES each,
-        with per-buffer sha256 in the header (the pieces.py discipline)."""
-        k, v = np.asarray(kv["k"]), np.asarray(kv["v"])
-        nb = k.shape[2]
-        per_block = max(1, k[:, :, :1].nbytes + v[:, :, :1].nbytes)
+        with per-buffer sha256 in the header (the pieces.py discipline).
+        Generic over the pool's leaves: an int8 pool ships k/v pages AND
+        their k_scale/v_scale tensors (block dim = axis 2 on every leaf),
+        each hashed separately — a corrupt SCALE is as fatal to the
+        import as a corrupt page and takes the same typed refusal."""
+        arrs = {name: np.asarray(a) for name, a in kv.items()}
+        nb = arrs["k"].shape[2]
+        per_block = max(1, sum(a[:, :, :1].nbytes for a in arrs.values()))
         per = max(1, MAX_CHUNK_BYTES // per_block)
         frames = []
         starts = list(range(0, nb, per))
         for ci, s in enumerate(starts):
-            kk = np.ascontiguousarray(k[:, :, s:s + per])
-            vv = np.ascontiguousarray(v[:, :, s:s + per])
+            part = {
+                name: np.ascontiguousarray(a[:, :, s:s + per])
+                for name, a in arrs.items()
+            }
             frames.append(protocol.encode_binary(
                 protocol.msg(
                     protocol.KV_BLOCKS,
@@ -524,11 +540,11 @@ class MigrationManager:
                     seq=ci,
                     done=(ci == len(starts) - 1),
                     hashes={
-                        "k": sha256_hex(kk.tobytes()),
-                        "v": sha256_hex(vv.tobytes()),
+                        name: sha256_hex(p.tobytes())
+                        for name, p in part.items()
                     },
                 ),
-                {"k": kk, "v": vv},
+                part,
             ))
         return frames
 
@@ -690,14 +706,28 @@ class MigrationManager:
             return
         tensors = data.get("_tensors") or {}
         hashes = data.get("hashes") or {}
-        for name in ("k", "v"):
+        names = sorted(hashes)
+        if not {"k", "v"} <= set(names) or set(tensors) != set(names):
+            # every shipped tensor must be hashed and every hash must
+            # cover a shipped tensor — an unhashed scale (or a hashed
+            # phantom) is a malformed export, not a verification pass
+            self._imports.pop(rid, None)
+            await self._ack(
+                ws, rid, ok=False,
+                error=f"chunk {seq}: tensor set {sorted(tensors)} != "
+                      f"hash set {names}",
+                error_kind="import_rejected",
+            )
+            return
+        for name in names:
             arr = tensors.get(name)
             digest = hashes.get(name)
             if arr is None or digest is None or sha256_hex(
                 np.ascontiguousarray(arr).tobytes()
             ) != digest:
-                # a corrupt piece never touches the pool: typed reject,
-                # the exporter's ladder re-prefills elsewhere
+                # a corrupt piece — page OR quantization scale — never
+                # touches the pool: typed reject, the exporter's ladder
+                # re-prefills elsewhere
                 self._imports.pop(rid, None)
                 _C_MIGRATIONS.inc(role="in", outcome="hash_mismatch")
                 get_recorder().incident(
@@ -712,7 +742,7 @@ class MigrationManager:
                     error_kind="hash_mismatch",
                 )
                 return
-        imp.chunks.append((seq, {"k": tensors["k"], "v": tensors["v"]}))
+        imp.chunks.append((seq, {name: tensors[name] for name in names}))
         if not data.get("done"):
             return
         self._imports.pop(rid, None)
@@ -725,9 +755,17 @@ class MigrationManager:
             )
             return
         imp.chunks.sort(key=lambda c: c[0])
+        first_names = set(imp.chunks[0][1])
+        if any(set(c[1]) != first_names for c in imp.chunks):
+            await self._ack(
+                ws, rid, ok=False,
+                error="chunks disagree on tensor set",
+                error_kind="import_rejected",
+            )
+            return
         kv = {
-            "k": np.concatenate([c[1]["k"] for c in imp.chunks], axis=2),
-            "v": np.concatenate([c[1]["v"] for c in imp.chunks], axis=2),
+            name: np.concatenate([c[1][name] for c in imp.chunks], axis=2)
+            for name in sorted(first_names)
         }
         self._spawn_finish(imp, kv)
 

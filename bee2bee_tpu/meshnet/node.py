@@ -695,6 +695,23 @@ class P2PNode(StageTaskMixin):
         prefixes = self.prefixes.advertised()
         if prefixes:
             digest["prefix_hashes"] = prefixes
+        # KV-pool identity (ISSUE 12 drive-by): cache dtype + effective
+        # capacity ride the digest, KEYED BY SERVICE (a node may host a
+        # bf16-pool and an int8-pool engine side by side), so
+        # /mesh/health and the router can see WHICH peers run the
+        # doubled int8 pool — the raw block-count gauges alone can't say
+        # what a block's bytes buy
+        kv_info = {}
+        for name, svc in list(self.local_services.items()):
+            eng = getattr(svc, "engine", None)
+            if eng is not None:
+                try:
+                    kv_info[str(name)] = eng.kv_info
+                except Exception:  # noqa: BLE001 — telemetry must not
+                    # fail the gossip loop on an engine mid-teardown
+                    pass
+        if kv_info:
+            digest["kv"] = kv_info
         # drain state rides the digest so RouterPolicy excludes draining
         # peers on the same gossip the rest of the scoring reads; the
         # disagg role is how prefill nodes find decode-designated targets

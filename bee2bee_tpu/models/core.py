@@ -312,6 +312,73 @@ def _attention(q, k, v, mask, cfg: ModelConfig):
     return out.reshape(B, T, H * hd)
 
 
+def _quantized_page_write(pool, scale, blk, slot, wslot, xT):
+    """Quantize-on-write scatter for ONE layer of an int8 paged pool —
+    the models/quant.py symmetric amax recipe at (page, kv-head)
+    granularity.
+
+    ``pool`` [Hkv, NB, BS, hd] int8; ``scale`` [Hkv, NB] f32;
+    ``blk``/``slot`` [B, T] the position→(page, slot) map WITH the
+    write-floor/ceil null redirects already applied (so CoW donor pages
+    are never touched — redirected positions land in the null block 0);
+    ``wslot`` [B, T] each position's index into the chunk's page window
+    (positions // BS - offset // BS); ``xT`` [Hkv, B, T, hd] the chunk's
+    freshly projected K or V, head-major like the pool.
+
+    A page's scale is a RUNNING MAX over its tenancy: a write that
+    raises the page's amax requantizes the page's existing int8 content
+    under the grown scale (bounded re-rounding noise — at most one
+    re-round per scale growth; scales never shrink until the allocator
+    recycles the block and the scheduler zeroes its scale entry, so a
+    recycled block's previous tenant can never inflate the new one).
+    Touched pages are deduplicated through the chunk's page window
+    before the gather/rescatter, so per-step requantization traffic is
+    O(pages written) — one page per row on decode — not O(T) full-page
+    copies. Returns (new_pool, new_scale)."""
+    Hkv, NB, BS, hd = pool.shape
+    B, T = blk.shape
+    # a T-position chunk at an arbitrary slot offset straddles at most
+    # this many pages — the window the touched-page dedup scatters into
+    # (wslot values are < P by construction: (off+T-1)//BS - off//BS)
+    P = (T + BS - 2) // BS + 1
+    xf = xT.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1) * (1.0 / 127.0)  # [Hkv, B, T]
+    # scatter-max per (head, page): redirected positions only ever grow
+    # the null block's scale (garbage page by design)
+    cand = jnp.zeros((Hkv, NB), jnp.float32).at[:, blk].max(amax)
+    new_scale = jnp.maximum(scale, cand)
+    safe = jnp.where(new_scale > 0.0, new_scale, 1.0)
+    # dedup touched pages: window slot w holds ONE page id (rows own
+    # disjoint blocks; fully redirected slots keep the null block 0),
+    # so the page gather/rescatter below moves each page once
+    pg_blk = jnp.zeros((B, P), jnp.int32).at[
+        jnp.arange(B, dtype=jnp.int32)[:, None], wslot
+    ].max(blk)
+    # requantize existing content under the (possibly) grown scale —
+    # but ONLY when some page actually grew (lax.cond, a real branch):
+    # steady-state decode (token amax under the page's running max, the
+    # common case once a page warms up) skips the page read-modify-write
+    # entirely and pays just the slot scatter, like the bf16 path.
+    # Inside the taken branch, ratio == 1 where unchanged (rint(int *
+    # 1.0) is exact), < 1 where grown, 0 for a freshly reset page
+    # (scale 0 → stale bytes zeroed before the new tenant's first read)
+    def _requant(p):
+        ratio = scale / safe  # [Hkv, NB]
+        pages = p[:, pg_blk].astype(jnp.float32)  # [Hkv, B, P, BS, hd]
+        rq = jnp.clip(
+            jnp.rint(pages * ratio[:, pg_blk][..., None, None]), -127, 127
+        ).astype(jnp.int8)
+        return p.at[:, pg_blk].set(rq)
+
+    out = lax.cond(jnp.any(cand > scale), _requant, lambda p: p, pool)
+    # quantize the chunk's values under the new page scales and scatter
+    # into their slots (distinct (page, slot) pairs except the null block)
+    q = jnp.clip(
+        jnp.rint(xf / safe[:, blk][..., None]), -127, 127
+    ).astype(jnp.int8)
+    return out.at[:, blk, slot].set(q), new_scale
+
+
 def matmul(x, w):
     """x @ w where w may be an int8 weight-only quantized subtree
     {"q": int8 [..., in, out], "s": f32 [..., out]} (models/quant.py).
@@ -680,6 +747,19 @@ def forward(
     tail, so a short prompt never needs pool blocks past
     ceil(prompt_len / block_size) — pad positions are causally masked and
     decode overwrites its own positions before reading them.
+
+    **Quantized pool** (EngineConfig.cache_dtype="int8"): the pool dict
+    additionally carries ``k_scale``/``v_scale`` [L, Hkv, NB] f32
+    per-page-per-head scales (init_paged_pool). The paged scatter becomes
+    quantize-on-write (_quantized_page_write: amax per (page, head) →
+    int8 + running-max scale, requantizing a page whose scale grew), the
+    ragged attn_fn receives (pool_slice, scale_slice) tuples and
+    dequantizes INSIDE its page loop, and the dense/sp fallback
+    dequantizes the gathered view — K/V never materialize wider than one
+    block (kernel) or the existing gathered view (fallback) anywhere.
+    The write-floor CoW argument carries over unchanged: redirected
+    positions touch only the null block, so shared donor pages keep both
+    their bytes AND their scales.
     """
     B, T = input_ids.shape
 
@@ -704,6 +784,21 @@ def forward(
     else:
         bt = None
         S = cache["k"].shape[2] if cache is not None else None
+    # int8 cache: scales must ride along or writes would silently
+    # astype-truncate K/V into garbage bit patterns — and only the PAGED
+    # pool implements quantize-on-write, so an int8 rectangular cache is
+    # rejected outright (static trace-time check, not a traced branch)
+    quantized = bt is not None and cache is not None and "k_scale" in cache
+    if (
+        cache is not None
+        and cache["k"].dtype == jnp.int8
+        and not quantized
+    ):
+        raise ValueError(
+            "int8 KV cache requires the paged pool with its "
+            "k_scale/v_scale scale arrays (init_paged_pool dtype=int8 "
+            "+ block_tables); the rectangular cache has no quantized path"
+        )
     # pool-direct attention: the ragged kernel gathers blocks itself, so
     # it needs the tables; kv_hook then skips the gathered-view build and
     # the per-layer "mask" becomes the compact window selector — nothing
@@ -721,22 +816,21 @@ def forward(
         return is_sliding_layer(cfg, layer_idx)
 
     def layer(carry, xs):
-        x, cache_k, cache_v = carry
+        x, lcache = carry
         lp, layer_idx = xs
 
-        if cache_k is None:  # training/scoring path: plain block
+        if lcache is None:  # training/scoring path: plain block
             return (
                 transformer_block(lp, cfg, x, positions,
                                   layer_mask(layer_idx), attn_fn=attn_fn,
                                   rope_local=rope_flag(layer_idx)),
-                None,
                 None,
             ), None
 
         def kv_hook(k, v):
             # write this chunk's K/V at [offset, offset+T) per batch row,
             # then attend over the whole cache row
-            nonlocal cache_k, cache_v
+            nonlocal lcache
 
             if bt is not None:
                 # paged: scatter each position into its mapped (block, slot)
@@ -760,14 +854,56 @@ def forward(
                 # pool layer [Hkv, NB, BS, hd]: the leading slice before
                 # the (blk, slot) index arrays keeps the head dim in
                 # place, so the update operand is k as [Hkv, B, T, hd]
-                ck = cache_k[layer_idx].at[:, blk, slot].set(
-                    jnp.transpose(k, (2, 0, 1, 3)).astype(cache_k.dtype)
+                kT = jnp.transpose(k, (2, 0, 1, 3))
+                vT = jnp.transpose(v, (2, 0, 1, 3))
+                if quantized:
+                    # chunk-position → page-window slot for the touched-
+                    # page dedup (positions[:, 0] == off_b)
+                    wslot = positions // BS - (off_b // BS)[:, None]
+                    ck, ks = _quantized_page_write(
+                        lcache["k"][layer_idx],
+                        lcache["k_scale"][layer_idx], blk, slot, wslot, kT,
+                    )
+                    cv, vs = _quantized_page_write(
+                        lcache["v"][layer_idx],
+                        lcache["v_scale"][layer_idx], blk, slot, wslot, vT,
+                    )
+                    lcache = dict(
+                        lcache,
+                        k=lcache["k"].at[layer_idx].set(ck),
+                        v=lcache["v"].at[layer_idx].set(cv),
+                        k_scale=lcache["k_scale"].at[layer_idx].set(ks),
+                        v_scale=lcache["v_scale"].at[layer_idx].set(vs),
+                    )
+                    if ragged:
+                        # (pool slice, scale slice): the kernel dequants
+                        # inside its page loop — int8 is all that crosses
+                        # HBM, one block's dequant lives in VMEM
+                        return (ck, ks), (cv, vs)
+                    # dense/sp fallback: dequantize the gathered view —
+                    # the same [B, S, Hkv, hd] width the bf16 path builds
+                    k_eff = jnp.transpose(
+                        ck[:, bt].astype(jnp.float32)
+                        * ks[:, bt][..., None, None],
+                        (1, 2, 3, 0, 4),
+                    ).reshape(B, S, Hkv, hd).astype(k.dtype)
+                    v_eff = jnp.transpose(
+                        cv[:, bt].astype(jnp.float32)
+                        * vs[:, bt][..., None, None],
+                        (1, 2, 3, 0, 4),
+                    ).reshape(B, S, Hkv, hd).astype(v.dtype)
+                    return k_eff, v_eff
+                ck = lcache["k"][layer_idx].at[:, blk, slot].set(
+                    kT.astype(lcache["k"].dtype)
                 )
-                cv = cache_v[layer_idx].at[:, blk, slot].set(
-                    jnp.transpose(v, (2, 0, 1, 3)).astype(cache_v.dtype)
+                cv = lcache["v"][layer_idx].at[:, blk, slot].set(
+                    vT.astype(lcache["v"].dtype)
                 )
-                cache_k = cache_k.at[layer_idx].set(ck)
-                cache_v = cache_v.at[layer_idx].set(cv)
+                lcache = dict(
+                    lcache,
+                    k=lcache["k"].at[layer_idx].set(ck),
+                    v=lcache["v"].at[layer_idx].set(cv),
+                )
                 if ragged:
                     # the kernel gathers straight from the pool — no
                     # [B, S, Hkv, hd] view, no [T, S] scores
@@ -785,10 +921,13 @@ def forward(
                     cache_row, new_row.astype(cache_row.dtype), (start, 0, 0)
                 )
 
-            ck = jax.vmap(write)(cache_k[layer_idx], k, off_b)
-            cv = jax.vmap(write)(cache_v[layer_idx], v, off_b)
-            cache_k = cache_k.at[layer_idx].set(ck)
-            cache_v = cache_v.at[layer_idx].set(cv)
+            ck = jax.vmap(write)(lcache["k"][layer_idx], k, off_b)
+            cv = jax.vmap(write)(lcache["v"][layer_idx], v, off_b)
+            lcache = dict(
+                lcache,
+                k=lcache["k"].at[layer_idx].set(ck),
+                v=lcache["v"].at[layer_idx].set(cv),
+            )
             return ck, cv
 
         x = transformer_block(
@@ -796,7 +935,7 @@ def forward(
             kv_hook=kv_hook, attn_fn=attn_fn,
             rope_local=rope_flag(layer_idx)
         )
-        return (x, cache_k, cache_v), None
+        return (x, lcache), None
 
     layer_params = params["layers"]
     n_layers = cfg.n_layers
@@ -814,25 +953,16 @@ def forward(
         # and GEMM packing works. TPU keeps the stacked scan below
         # (compile-time scales O(1) in depth; Mosaic handles layouts).
         # models.unstack_layers converts; engine does it when backend=cpu.
-        carry = (x, cache["k"], cache["v"]) if cache is not None else (x, None, None)
+        carry = (x, cache)
         for i, lp in enumerate(layer_params):
             carry, _ = layer_body(carry, (lp, i))
-        x, ck, cv = carry
-        new_cache = {"k": ck, "v": cv} if cache is not None else None
-    elif cache is not None:
-        (x, ck, cv), _ = lax.scan(
-            layer_body,
-            (x, cache["k"], cache["v"]),
-            (layer_params, jnp.arange(n_layers)),
-        )
-        new_cache = {"k": ck, "v": cv}
+        x, new_cache = carry
     else:
-        (x, _, _), _ = lax.scan(
+        (x, new_cache), _ = lax.scan(
             layer_body,
-            (x, None, None),
+            (x, cache),
             (layer_params, jnp.arange(n_layers)),
         )
-        new_cache = None
 
     return final_logits(params, cfg, x), new_cache
 
@@ -900,6 +1030,20 @@ def init_paged_pool(
     (kv_head, block) tile per grid step, and Mosaic needs the trailing
     two dims of that tile to be (block_size, hd) — a head axis blocked
     at 1 in trailing position fails to lower, the same constraint that
-    shaped ops/flash.py's head-major transpose."""
+    shaped ops/flash.py's head-major transpose.
+
+    With ``dtype=int8`` (EngineConfig.cache_dtype="int8") the pool pages
+    store quantized K/V and the dict grows ``k_scale``/``v_scale``
+    [L, Hkv, num_blocks] f32 per-page-per-head symmetric scales —
+    initialized to ZERO (= "page holds nothing"; forward's running-max
+    quantize-on-write takes it from there, and the scheduler re-zeroes a
+    block's entry when the allocator recycles it). Pool HBM halves vs
+    bf16 at a 4 / (block_size * head_dim) scale overhead (~0.4% at the
+    16x64 default)."""
     shape = (cfg.n_layers, cfg.n_kv_heads, num_blocks, block_size, cfg.head_dim)
-    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    pool = {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    if jnp.dtype(dtype) == jnp.int8:
+        sshape = (cfg.n_layers, cfg.n_kv_heads, num_blocks)
+        pool["k_scale"] = jnp.zeros(sshape, jnp.float32)
+        pool["v_scale"] = jnp.zeros(sshape, jnp.float32)
+    return pool

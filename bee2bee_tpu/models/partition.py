@@ -203,6 +203,19 @@ def paged_cache_spec(
     return P(None, "model", None, seq, None)
 
 
+def paged_scale_spec(cfg: ModelConfig | None = None, mesh: Mesh | None = None) -> P:
+    """Int8-pool quantization scales [L, Hkv, num_blocks] f32: the
+    kv-head dim shards exactly like the pool's (MQA replication
+    included), the block dim never shards (same any-row-any-block
+    argument as paged_cache_spec), and there is no slot dim — under
+    attention='sp' the scales stay whole per shard and the gathered-view
+    dequant broadcasts each page's scale across its (seq-sharded) slots
+    locally."""
+    if cfg is not None and mesh is not None and kv_replicated(cfg, mesh):
+        return P(None, None, None)
+    return P(None, "model", None)
+
+
 def flat_partition_specs(
     params,
     mesh_axes: dict[str, int] | None = None,

@@ -46,6 +46,22 @@ the pool:
   ops/flash.py's numerics, so greedy parity with the dense path holds
   token-for-token.
 
+- **Int8 pool dequant in the page loop**: with ``k_scale``/``v_scale``
+  [Hkv, NB] f32 (the per-layer slice of core.init_paged_pool's
+  per-page-per-head quantization scales), the pool blocks arrive int8
+  and each grid step dequantizes ITS one block in VMEM — K before the
+  QK^T dot, V before the PV dot — so the precision change rides the
+  existing gather: HBM cache traffic halves and nothing wider than one
+  block ever materializes. The scales ride the SAME scalar-prefetch
+  channel as the block tables — pre-gathered through the tables to
+  ``[Hkv, B, MB]`` outside the kernel, so the kernel reads one f32 per
+  grid step at ``[h, b, j]`` from SMEM (a (1, 1)-blocked VMEM operand
+  would violate the trailing-dims tiling rule above) and the SMEM
+  footprint is table-sized — 2 * Hkv/shard * B * MB * 4 bytes, bounded
+  by the pow2-bucketed LIVE width like every per-step operand, never by
+  pool capacity. The f32 m/l/acc scratch already isolates accumulation
+  from storage precision, so the quantized path changes no softmax math.
+
 Off-TPU the kernel runs in pallas interpret mode (the `_on_tpu()` /
 `interpret` pattern from ops/flash.py), so the CPU test suite exercises
 the exact kernel code path.
@@ -69,21 +85,33 @@ def _ragged_kernel(
     tables_ref,  # SMEM [B, MB] int32 (scalar-prefetch): per-row block tables
     off_ref,  # SMEM [B] int32 (scalar-prefetch): position of q[:, 0]
     win_ref,  # SMEM [1] int32 (scalar-prefetch): sliding window (0 = none)
-    q_ref,  # [1, 1, BQ, hd]   q rows: GQA group g major, chunk pos t minor
-    k_ref,  # [1, 1, BS, hd]   one pool block, gathered via index_map
-    v_ref,  # [1, 1, BS, hd]
-    o_ref,  # [1, 1, BQ, hd]
-    m_ref,  # VMEM [BQ, 128] f32 running max
-    l_ref,  # VMEM [BQ, 128] f32 running sum
-    acc_ref,  # VMEM [BQ, hd] f32
-    *,
+    *refs,
+    # quantized=True prepends two more scalar-prefetch refs:
+    #   kscale_ref, vscale_ref  SMEM [Hkv, B, MB] f32 scales, pre-gathered
+    #                           through the block tables per row
+    # then the tensor operands either way:
+    #   q_ref    [1, 1, BQ, hd]  q rows: GQA group g major, chunk pos t minor
+    #   k_ref    [1, 1, BS, hd]  one pool block, gathered via index_map
+    #   v_ref    [1, 1, BS, hd]
+    #   o_ref    [1, 1, BQ, hd]
+    #   m_ref    VMEM [BQ, 128] f32 running max
+    #   l_ref    VMEM [BQ, 128] f32 running sum
+    #   acc_ref  VMEM [BQ, hd] f32
     sm_scale: float,
     softcap: float,
     block_size: int,
     block_q: int,
     chunk: int,  # T: query positions per row (row r is chunk position r % T)
+    quantized: bool = False,
 ):
+    if quantized:
+        (kscale_ref, vscale_ref, q_ref, k_ref, v_ref,
+         o_ref, m_ref, l_ref, acc_ref) = refs
+    else:
+        kscale_ref = vscale_ref = None
+        q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref = refs
     b = pl.program_id(0)
+    h = pl.program_id(1)
     i = pl.program_id(2)
     j = pl.program_id(3)
 
@@ -106,6 +134,13 @@ def _ragged_kernel(
     def _attend():
         q = q_ref[0, 0]
         k = k_ref[0, 0]
+        if quantized:
+            # every key/value row of this block shares ONE scale per kv
+            # head: the wrapper pre-gathered the per-page scales through
+            # the block tables to [Hkv, B, MB], so the grid coordinates
+            # index them directly and the dequant touches only the one
+            # block already resident in VMEM
+            k = (k.astype(jnp.float32) * kscale_ref[h, b, j]).astype(q.dtype)
         s = (
             jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -134,6 +169,8 @@ def _ragged_kernel(
         l_new = l_prev * alpha + jnp.sum(p, axis=-1)
 
         v = v_ref[0, 0]
+        if quantized:
+            v = (v.astype(jnp.float32) * vscale_ref[h, b, j]).astype(q.dtype)
         pv = jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -165,17 +202,25 @@ def ragged_paged_attention(
     logit_softcap: float = 0.0,
     block_q: int = 256,
     interpret: bool | None = None,
+    k_scale=None,  # [Hkv, NB] f32: int8-pool per-page-per-head scales;
+    v_scale=None,  # both present = quantized pool, dequant in-kernel
 ):
     """Causal attention for a [B, T] chunk over the paged pool; returns
     [B, T, H*hd] (core._attention ABI). T=1 is decode, T=K+1 spec verify,
     T=bucket a ragged prefill chunk — one compiled program per (T, table
-    width) pair, both already bucketed by the engine."""
+    width) pair, both already bucketed by the engine. With
+    ``k_scale``/``v_scale`` the pool is int8 (core.init_paged_pool's
+    quantized layout) and each gathered block dequantizes in VMEM before
+    its dot — same grid, same softmax math, half the pool HBM traffic."""
     B, T, H, hd = q.shape
     Hkv, NB, BS, _ = k_pool.shape
     MB = block_tables.shape[1]
     G = H // Hkv
     sm_scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(hd)
     interpret = (not _on_tpu()) if interpret is None else interpret
+    quantized = k_scale is not None
+    if quantized and v_scale is None:
+        raise ValueError("quantized pool needs BOTH k_scale and v_scale")
 
     nq = G * T
     bq = min(block_q, max(nq, 8))
@@ -201,25 +246,28 @@ def ragged_paged_attention(
         block_size=BS,
         block_q=bq,
         chunk=T,
+        quantized=quantized,
     )
-    # index maps take the scalar-prefetch refs as trailing args; the K/V
-    # maps ARE the gather — page j of row b reads pool block tables[b, j]
+    # index maps take the scalar-prefetch refs as trailing args (3 of
+    # them, or 5 with the quantization scales — the variadic tail keeps
+    # one lambda serving both); the K/V maps ARE the gather — page j of
+    # row b reads pool block tables[b, j]
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=5 if quantized else 3,
         grid=grid,
         in_specs=[
             pl.BlockSpec(
-                (1, 1, bq, hd), lambda b, h, i, j, tb, off, w: (b, h, i, 0)
+                (1, 1, bq, hd), lambda b, h, i, j, tb, *_: (b, h, i, 0)
             ),
             pl.BlockSpec(
-                (1, 1, BS, hd), lambda b, h, i, j, tb, off, w: (h, tb[b, j], 0, 0)
+                (1, 1, BS, hd), lambda b, h, i, j, tb, *_: (h, tb[b, j], 0, 0)
             ),
             pl.BlockSpec(
-                (1, 1, BS, hd), lambda b, h, i, j, tb, off, w: (h, tb[b, j], 0, 0)
+                (1, 1, BS, hd), lambda b, h, i, j, tb, *_: (h, tb[b, j], 0, 0)
             ),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, bq, hd), lambda b, h, i, j, tb, off, w: (b, h, i, 0)
+            (1, 1, bq, hd), lambda b, h, i, j, tb, *_: (b, h, i, 0)
         ),
         scratch_shapes=[
             pltpu.VMEM((bq, _LANES), jnp.float32),
@@ -227,12 +275,27 @@ def ragged_paged_attention(
             pltpu.VMEM((bq, hd), jnp.float32),
         ],
     )
+    # pre-gather the per-page scales through the block tables OUTSIDE the
+    # kernel: the SMEM operand is then [Hkv, B, MB] — bounded by the
+    # pow2-bucketed LIVE table width like every other per-step operand —
+    # instead of the pool-sized [Hkv, NB], which scales with total
+    # capacity and would overflow SMEM on production-sized pools. The
+    # gather itself is B*MB*Hkv f32 per call — noise next to one block's
+    # page traffic — and the kernel then indexes (h, b, j) directly.
+    scales = (
+        (
+            jnp.asarray(k_scale, jnp.float32)[:, tables],
+            jnp.asarray(v_scale, jnp.float32)[:, tables],
+        )
+        if quantized
+        else ()
+    )
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hkv, nqp, hd), q.dtype),
         interpret=interpret,
-    )(tables, off, win, qT, k_pool, v_pool)
+    )(tables, off, win, *scales, qT, k_pool, v_pool)
     # [B, Hkv, nqp, hd] -> [B, T, H*hd]
     out = out[:, :, :nq].reshape(B, Hkv, G, T, hd).transpose(0, 3, 1, 2, 4)
     return out.reshape(B, T, H * hd)
@@ -248,7 +311,9 @@ def make_ragged_attn_fn(mesh=None, interpret: bool | None = None):
     (k, v), forward partials in the block tables, and the per-layer mask
     argument becomes the compact [1] int32 window selector
     (core.make_layer_window) instead of a bool mask — nothing S-wide is
-    ever built.
+    ever built. On an int8 pool the hook hands (pool slice, [Hkv, NB]
+    scale slice) TUPLES through and the kernel dequantizes per gathered
+    block.
 
     Under a non-trivial mesh the kernel runs per-shard via shard_map
     (pallas_call has no SPMD partitioning rule): q heads and the pool's
@@ -271,6 +336,12 @@ def make_ragged_attn_fn(mesh=None, interpret: bool | None = None):
             from ..models.core import _attention
 
             return _attention(q, k, v, mask, cfg)
+        # int8 pool: the kv_hook hands (pool slice, scale slice) pairs
+        # through — unpack them here so the kernel dequants in-loop
+        k_scale = v_scale = None
+        if isinstance(k, tuple):
+            k, k_scale = k
+            v, v_scale = v
         window = mask  # the ragged path's per-layer [1] int32 selector
         offset = positions[:, 0] if positions is not None else None
         sm_scale = 1.0 / math.sqrt(cfg.attn_scale or cfg.head_dim)
@@ -279,6 +350,7 @@ def make_ragged_attn_fn(mesh=None, interpret: bool | None = None):
             return ragged_paged_attention(
                 q, k, v, block_tables, offset, window,
                 sm_scale=sm_scale, logit_softcap=softcap, interpret=interpret,
+                k_scale=k_scale, v_scale=v_scale,
             )
         B = q.shape[0]
         Hkv = k.shape[0]
@@ -294,11 +366,22 @@ def make_ragged_attn_fn(mesh=None, interpret: bool | None = None):
         win = jnp.asarray(
             window if window is not None else 0, jnp.int32
         ).reshape(-1)[:1]
-        mapped = shard_map(
-            lambda q_, k_, v_, t_, o_, w_: ragged_paged_attention(
+        # ONE shard_map for both pool precisions: the int8 scales shard
+        # exactly like the pool's kv-head dim (their block dim, like the
+        # pool's, never shards) and simply extend the operand tuple
+        quant = k_scale is not None
+        scale_args = (k_scale, v_scale) if quant else ()
+
+        def body(q_, k_, v_, t_, o_, w_, *sc):
+            return ragged_paged_attention(
                 q_, k_, v_, t_, o_, w_,
                 sm_scale=sm_scale, logit_softcap=softcap, interpret=interpret,
-            ),
+                k_scale=sc[0] if sc else None,
+                v_scale=sc[1] if sc else None,
+            )
+
+        mapped = shard_map(
+            body,
             mesh=mesh,
             in_specs=(
                 P(batch_ax, None, head_ax, None),
@@ -307,11 +390,14 @@ def make_ragged_attn_fn(mesh=None, interpret: bool | None = None):
                 P(batch_ax),
                 P(batch_ax),
                 P(),
-            ),
+            ) + (P(kv_ax),) * len(scale_args),
             out_specs=P(batch_ax, None, head_ax),
             check_vma=False,
         )
-        return mapped(q, k, v, jnp.asarray(block_tables, jnp.int32), off, win)
+        return mapped(
+            q, k, v, jnp.asarray(block_tables, jnp.int32), off, win,
+            *scale_args,
+        )
 
     attn.ragged = True
     return attn

@@ -856,6 +856,147 @@ def bench_pipeline_interleave(
     return out
 
 
+def _kv_sessions_at_capacity(eng, prompt_len: int, hold: int,
+                             max_sessions: int = 63,
+                             wall_budget_s: float = 120.0) -> int:
+    """Submit a burst of streamed sessions and count how many were
+    resident when the pool first backpressured (stats.paged_alloc_waits
+    flips — the scheduler's typed pool_exhausted requeue). The burst
+    admits in one scheduler pass between decode windows, so the count
+    reflects the pool's admission capacity through the REAL admission
+    path — not an arithmetic projection — with minimal skew from holder
+    rows growing mid-measurement. ``max_sessions`` must exceed any
+    plausible capacity (and stay under max_batch) or the pool never
+    backpressures and the measurement is void."""
+    import queue as _q
+
+    sch = eng.scheduler
+    reqs: list = []
+    t0 = time.perf_counter()
+    try:
+        for i in range(max_sessions):
+            prompt = [1 + (i * 13 + j) % 500 for j in range(prompt_len)]
+            req = eng._make_request(prompt, hold, 0.0, 0, 1.0, None, stream=True)
+            sch.submit(req)
+            reqs.append(req)
+        # wait for the backpressure event, then let the burst's first
+        # tokens land (they come back in one sync after the admit pass)
+        while (
+            sch.stats.paged_alloc_waits == 0
+            and time.perf_counter() - t0 < wall_budget_s
+        ):
+            time.sleep(0.01)
+        time.sleep(0.5)
+        return sum(1 for r in reqs if r.out_ids and r.finish is None)
+    finally:
+        for r in reqs:
+            r.cancelled = True
+        deadline = time.perf_counter() + 60
+        for r in reqs:
+            while r.finish is None and time.perf_counter() < deadline:
+                try:
+                    ev = r.events.get(timeout=5)
+                except _q.Empty:
+                    continue
+                if ev.get("done"):
+                    break
+
+
+def bench_kv_quant(msl: int = 256) -> dict:
+    """Quantized-KV-pool rung (ISSUE 12): bf16 vs int8 pool at the SAME
+    pool HBM byte budget — sessions-at-capacity (rows admitted before the
+    first pool_exhausted backpressure), decode tok/s at concurrency 4,
+    and the bytes one mid-decode row exports for migration (the
+    drain-pause payload, which the int8 pool roughly halves). Per-rung
+    platform stamp per PR 6 bench hygiene: on CPU these are PROXY numbers
+    for the ~2x-sessions-per-chip claim until a TPU lease lands — the
+    capacity ratio is geometry (block counts at equal bytes), so it
+    transfers; the tok/s deltas do not."""
+    import jax
+
+    from bee2bee_tpu.engine import EngineConfig, InferenceEngine
+    from bee2bee_tpu.models.config import get_config
+
+    name = "distilgpt2"
+    BS = 16
+    PROMPT = 48
+    cfg = get_config(name)
+    # bytes per pool block: K + V pages, plus the int8 layout's
+    # per-page-per-head f32 scales (~0.4% at BS=16, hd=64)
+    elems = cfg.n_layers * cfg.n_kv_heads * BS * cfg.head_dim
+    block_bytes = {
+        "bfloat16": 2 * elems * 2,
+        "int8": 2 * elems * 1 + 2 * cfg.n_layers * cfg.n_kv_heads * 4,
+    }
+    budget = 56 * block_bytes["bfloat16"]  # a deliberately tight pool
+    out: dict = {
+        "platform": jax.devices()[0].platform,
+        "pool_hbm_budget_bytes": int(budget),
+        "block_size": BS,
+        "prompt_tokens": PROMPT,
+    }
+    for mode in ("bfloat16", "int8"):
+        blocks = max(4, budget // block_bytes[mode])
+        eng = InferenceEngine(
+            name,
+            engine_config=EngineConfig(
+                max_seq_len=msl, max_batch=64, kv_pool_blocks=int(blocks),
+                kv_block_size=BS, cache_dtype=mode, decode_chunk=4,
+                prefill_buckets=(64,),
+            ),
+        )
+        try:
+            prompt = [1 + j % 500 for j in range(PROMPT)]
+            eng.generate(prompt, max_new_tokens=4, temperature=0.0)  # compile
+            admitted = _kv_sessions_at_capacity(
+                eng, PROMPT, hold=msl - PROMPT - 8
+            )
+            prompts = [
+                [1 + (i * 37 + j) % 500 for j in range(PROMPT)] for i in range(4)
+            ]
+            thr = _bench_concurrency(eng, prompts, 32)
+            # one mid-decode row's export payload = the drain-pause bytes
+            gen = eng.generate_stream(prompt, max_new_tokens=64, temperature=0.0)
+            for ev in gen:
+                if ev.get("done") or len(ev.get("tokens") or []) >= 1:
+                    break
+            mig_bytes = 0
+            live = eng.scheduler.live_requests()
+            if live:
+                snap = eng.scheduler.checkpoint(live[0])
+                if snap:
+                    mig_bytes = sum(
+                        a.nbytes for a in (snap.pop("_kv", None) or {}).values()
+                    )
+            gen.close()
+            out[mode] = {
+                "pool_blocks": int(blocks),
+                "sessions_at_capacity": admitted,
+                "decode_tok_per_s_c4": thr["tok_per_s"],
+                "migration_bytes_per_row": int(mig_bytes),
+            }
+        finally:
+            eng.close()
+    bf, q8 = out["bfloat16"], out["int8"]
+    if bf["sessions_at_capacity"]:
+        out["capacity_ratio"] = round(
+            q8["sessions_at_capacity"] / bf["sessions_at_capacity"], 3
+        )
+    if q8["migration_bytes_per_row"]:
+        out["migration_bytes_ratio"] = round(
+            bf["migration_bytes_per_row"] / q8["migration_bytes_per_row"], 3
+        )
+    log(
+        f"kv_quant rung [{out['platform']}]: sessions-at-capacity "
+        f"{bf['sessions_at_capacity']} (bf16, {bf['pool_blocks']} blocks) vs "
+        f"{q8['sessions_at_capacity']} (int8, {q8['pool_blocks']} blocks) at "
+        f"equal HBM; decode c4 {bf['decode_tok_per_s_c4']} vs "
+        f"{q8['decode_tok_per_s_c4']} tok/s; migration bytes/row "
+        f"{bf['migration_bytes_per_row']} vs {q8['migration_bytes_per_row']}"
+    )
+    return out
+
+
 def bench_reference_path() -> float:
     """The reference's hot loop: HF transformers greedy generate on torch CPU
     (reference hf.py:35-44 minus tokenization — token ids in, ids out)."""
@@ -940,6 +1081,15 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — the rung must not kill the bench
         log(f"ragged rung failed: {e}")
         extras["ragged_distilgpt2"] = {"error": str(e)}
+
+    # quantized-KV-pool rung (ISSUE 12 acceptance: >=1.8x sessions-at-
+    # capacity at equal pool HBM, migration bytes per row ~halved —
+    # CPU-proxy capacity geometry until a TPU lease lands)
+    try:
+        extras["kv_quant_distilgpt2"] = bench_kv_quant()
+    except Exception as e:  # noqa: BLE001 — the rung must not kill the bench
+        log(f"kv_quant rung failed: {e}")
+        extras["kv_quant_distilgpt2"] = {"error": str(e)}
 
     # per-tenant fairness rung (ISSUE 7 acceptance: ~4:1 completed-token
     # ratio at 4:1 weights under saturation) — model-free and platform-
@@ -1087,5 +1237,11 @@ if __name__ == "__main__":
     # standalone (tiny random-init model, loopback mesh, any platform)
     if len(sys.argv) > 1 and sys.argv[1] == "pipeline_interleave":
         print(json.dumps(bench_pipeline_interleave()), flush=True)
+        sys.exit(0)
+    # `python bench.py kv_quant`: the quantized-KV capacity rung standalone
+    # (distilgpt2, bf16-vs-int8 pool at equal HBM budget, any platform)
+    if len(sys.argv) > 1 and sys.argv[1] == "kv_quant":
+        ensure_live_backend()
+        print(json.dumps(bench_kv_quant()), flush=True)
         sys.exit(0)
     main()

@@ -299,6 +299,54 @@ def wrapper(q, tables):
     assert analyze_paths([ragged_py, flash_py]) == []
 
 
+def test_jax_pass_catches_host_sync_in_quantize_on_write_root():
+    """ISSUE 12: the int8 KV pool's quantize-on-write runs inside the
+    engine's jit roots (prefill/decode/spec-verify) and its scan-carried
+    layer body — a host-side ``.item()`` / numpy cast there would put a
+    device→host sync on EVERY cache write. Pin that the pass catches
+    exactly that wiring on a known-bad fixture (jit-root method + scan
+    body, mirroring engine._prefill_fn → core.forward's layer scan), and
+    that the REAL modules owning the quantized pool lint clean so the
+    ratchet baseline stays EMPTY."""
+    src = '''
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Engine:
+    def __init__(self):
+        self._prefill = jax.jit(self._prefill_fn, donate_argnums=(2,))
+
+    def _prefill_fn(self, params, tokens, cache, blk, slot):
+        # quantize-on-write gone wrong: host amax + scalar cast per write
+        amax = np.asarray(tokens).max()
+        n = int(slot)
+        scale = cache["k_scale"].item()
+        return cache, tokens
+
+
+def forward(pool, scale, xT):
+    def layer(carry, xs):
+        pool, scale = carry
+        if jnp.any(scale > 0):
+            pool = pool
+        q = np.asarray(xT)
+        return (pool, scale), None
+    return jax.lax.scan(layer, (pool, scale), xT)
+'''
+    rules = _rules(analyze_source(src, "engine/engine.py"))
+    assert "ML-J001" in rules and "ML-J002" in rules
+    from bee2bee_tpu.analysis.jaxhygiene import JaxHygienePass
+
+    assert JaxHygienePass().applies("models/core.py")
+    core_py = PACKAGE_ROOT / "models" / "core.py"
+    ragged_py = PACKAGE_ROOT / "ops" / "ragged.py"
+    scheduler_py = PACKAGE_ROOT / "engine" / "scheduler.py"
+    assert "_quantized_page_write" in core_py.read_text()  # the root exists
+    assert analyze_paths([core_py, ragged_py, scheduler_py]) == []
+
+
 def test_jax_pass_sees_decorators_and_scan_bodies():
     src = '''
 import jax

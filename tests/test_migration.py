@@ -226,6 +226,69 @@ def test_cow_shared_prefix_refcounts_across_migration():
         b.close()
 
 
+def test_int8_kv_import_roundtrip_greedy_parity():
+    """ISSUE 12: quantized pages migrate — an int8-pool checkpoint ships
+    pages AND their per-page-per-head scales (at roughly half the bf16
+    page bytes), the target scatters both, and decode resumes
+    token-for-token with ZERO re-prefill forwards. Pages share scales
+    with their bytes, so the imported rollout is bit-identical to the
+    unmigrated one."""
+    a, b = _engine(cache_dtype="int8"), _engine(cache_dtype="int8")
+    try:
+        base = a.generate(PROMPT, max_new_tokens=24)
+        snap, kv, _req = _checkpoint_mid_decode(a)
+        assert sorted(kv) == ["k", "k_scale", "v", "v_scale"]
+        assert kv["k"].dtype == np.int8 and kv["k_scale"].dtype == np.float32
+        # scales are per (layer, head, page) — tiny next to the pages
+        assert kv["k_scale"].shape == kv["k"].shape[:3]
+        page_bytes = kv["k"].nbytes + kv["v"].nbytes
+        scale_bytes = kv["k_scale"].nbytes + kv["v_scale"].nbytes
+        assert scale_bytes < page_bytes / 16
+        json.dumps(snap)  # the wire half stays pure JSON
+
+        req2 = b.import_generation(snap, kv)
+        out, result = _drain_events(req2, snap["out"])
+        assert out == base.token_ids
+        assert result.finish_reason == base.finish_reason
+        assert b.scheduler.stats.migrated_in == 1
+        assert b.scheduler.stats.import_reprefills == 0
+    finally:
+        a.close()
+        b.close()
+
+
+def test_int8_import_validation_and_signature_gate():
+    """Layout discipline for quantized pages: an int8 engine refuses a
+    scale-less kv typed; a full-precision engine refuses int8 pages
+    (dtype mismatch) typed; and the migration signatures differ — the
+    mesh-level KV gate that bounces an int8 exporter off a bf16 importer
+    BEFORE any tensor bytes scatter."""
+    a = _engine(cache_dtype="int8")
+    b = _engine()  # the full-precision pool (float32 on the CPU suite)
+    try:
+        snap, kv, _req = _checkpoint_mid_decode(a)
+        no_scales = {name: kv[name] for name in ("k", "v")}
+        with pytest.raises(ValueError, match="kv tensors"):
+            a.import_generation(dict(snap), no_scales)
+        with pytest.raises(ValueError, match="kv tensors"):
+            b.import_generation(dict(snap), kv)  # scale keys ≠ f32 layout
+        assert a.migration_signature() != b.migration_signature()
+        assert a.migration_signature()["cache_dtype"] == "int8"
+        # and the layout-free rung still works across the dtype split: kv
+        # withheld → b re-prefills prompt+accepted at ITS precision and
+        # decodes on (the continuation may legitimately differ from a's
+        # int8-pool rollout — the accepted prefix is what must survive)
+        snap2, _kv2, _ = _checkpoint_mid_decode(a)
+        req2 = b.import_generation(dict(snap2))
+        out, _result = _drain_events(req2, snap2["out"])
+        assert out[:len(snap2["out"])] == snap2["out"]
+        assert len(out) >= len(snap2["out"])
+        assert b.scheduler.stats.import_reprefills == 1
+    finally:
+        a.close()
+        b.close()
+
+
 def test_import_pool_exhausted_is_typed_and_immediate():
     """A target whose pool cannot host the blocks fails the import with a
     typed pool_exhausted event — never a requeue-hang."""
@@ -416,6 +479,86 @@ async def test_chaos_corrupt_piece_falls_back_to_reprefill():
         recorder.flush()
         kinds = {e["kind"] for e in recorder.list_incidents()}
         assert "migration:hash_mismatch" in kinds
+
+
+@pytest.mark.async_timeout(240)
+async def test_corrupt_scale_tensor_falls_back_to_reprefill():
+    """ISSUE 12: the int8 export's SCALE tensors are verified exactly
+    like the pages — a corrupted k_scale fails its sha256 at the target
+    (typed hash_mismatch, the bytes never touch the pool) and the ladder
+    re-prefills; the generation still completes with the accepted prefix
+    intact."""
+    import numpy as np  # noqa: F811 — local alias for clarity
+
+    from bee2bee_tpu import protocol
+    from bee2bee_tpu.health import get_recorder
+
+    recorder = get_recorder()
+    recorder.clear()
+    over = [{"cache_dtype": "int8"}, {"cache_dtype": "int8"}]
+    async with _mesh_with_engines(2, engine_over=over) as (nodes, svcs):
+        a, b = nodes
+        orig = a.migration._send_chunk
+        tampered = asyncio.Event()
+
+        async def tamper(ws, frame: bytes, seq: int):
+            if seq == 0 and not tampered.is_set():
+                tampered.set()
+                msg, tensors = protocol.decode_binary(frame)
+                assert "k_scale" in tensors, sorted(tensors)
+                arr = np.array(tensors["k_scale"])  # writable copy
+                arr.view(np.uint8).reshape(-1)[0] ^= 0xFF
+                # re-encode with the ORIGINAL hashes header: only the
+                # scale payload bytes lie
+                frame = protocol.encode_binary(msg, dict(tensors, k_scale=arr))
+            await orig(ws, frame, seq)
+
+        a.migration._send_chunk = tamper
+        task, _chunks = await _start_streamed(a, svcs[0])
+        summary = await a.begin_drain()
+        a.migration._send_chunk = orig
+        assert tampered.is_set()
+        assert summary["reprefilled"] == 1 and summary["failed"] == 0, summary
+        result = await task
+        assert result.get("tokens")
+        assert svcs[1].engine.scheduler.stats.import_reprefills == 1
+        recorder.flush()
+        kinds = {e["kind"] for e in recorder.list_incidents()}
+        assert "migration:hash_mismatch" in kinds
+
+
+@pytest.mark.async_timeout(240)
+async def test_int8_exporter_refused_by_fullprec_importer_then_reprefills():
+    """ISSUE 12: an int8-pool node draining toward a full-precision-pool
+    peer is refused TYPED at the signature gate (cache_dtype mismatch —
+    no tensor bytes ever scatter), and because `incompatible` indicts the
+    layout pairing rather than the peer, the ladder's layout-free
+    re-prefill rung lands on the SAME peer and the generation completes."""
+    from bee2bee_tpu.health import get_recorder
+
+    recorder = get_recorder()
+    recorder.clear()
+    over = [{"cache_dtype": "int8"}, {}]  # a quantized, b full precision
+    async with _mesh_with_engines(2, engine_over=over) as (nodes, svcs):
+        a, b = nodes
+        # drive-by pin: the telemetry digest advertises WHICH pool layout
+        # each peer runs (cache_dtype + effective capacity, keyed by
+        # service — a node may host mixed-precision pools), so the
+        # router/fleet view can tell a doubled int8 pool from a bf16 one
+        (ka,) = a.telemetry_digest()["kv"].values()
+        (kb,) = b.telemetry_digest()["kv"].values()
+        assert ka["cache_dtype"] == "int8"
+        assert kb["cache_dtype"] == "float32"
+        assert ka["capacity_tokens"] == kb["capacity_tokens"] > 0
+        task, _chunks = await _start_streamed(a, svcs[0])
+        summary = await a.begin_drain()
+        assert summary["reprefilled"] == 1 and summary["failed"] == 0, summary
+        result = await task
+        assert result.get("tokens")
+        assert svcs[1].engine.scheduler.stats.import_reprefills == 1
+        recorder.flush()
+        kinds = {e["kind"] for e in recorder.list_incidents()}
+        assert "migration:incompatible" in kinds
 
 
 @pytest.mark.async_timeout(240)

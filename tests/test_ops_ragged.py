@@ -175,6 +175,53 @@ def test_ragged_under_jit():
     _assert_close(f(q, kp, vp, tb, off), _dense_ref(q, kg, vg, mask))
 
 
+def _quantize_pool(kp, vp):
+    """f32 pool → (int8 pool, [Hkv, NB] scales), the per-page-per-head
+    symmetric amax recipe core._quantized_page_write applies on write."""
+    def one(p):
+        s = np.max(np.abs(np.asarray(p, np.float32)), axis=(2, 3)) / 127.0
+        safe = np.where(s > 0, s, 1.0)
+        q = np.clip(
+            np.rint(np.asarray(p, np.float32) / safe[:, :, None, None]),
+            -127, 127,
+        ).astype(np.int8)
+        return jnp.asarray(q), jnp.asarray(s.astype(np.float32))
+
+    kq, ks = one(kp)
+    vq, vs = one(vp)
+    return kq, ks, vq, vs
+
+
+def test_ragged_int8_pool_dequant_matches_dense_on_dequantized_view():
+    """ISSUE 12 kernel contract: with an int8 pool + [Hkv, NB] scales the
+    kernel dequantizes K before QK^T and V before PV per gathered block —
+    it must match the dense reference attending over the HOST-dequantized
+    gathered view exactly (same values enter both softmaxes, so the only
+    tolerance is the usual online-softmax reordering). Covers ragged
+    decode lengths, null-block tails, and the [B, K+1] verify shape."""
+    for offs, T, extra in ([0, 7, 8, 21], 1, 0), ([3, 12], 1, 3), ([2, 15, 24], 6, 0):
+        q, kp, vp, tb, off, mask, _kg, _vg = _pool_case(
+            offs=offs, T=T, H=4, Hkv=2, hd=16, extra_tables=extra, seed=11
+        )
+        kq, ks, vq, vs = _quantize_pool(kp, vp)
+        out = ragged_paged_attention(q, kq, vq, tb, off, k_scale=ks, v_scale=vs)
+        # dense view over the DEQUANTIZED pool (what the engine's int8
+        # dense fallback builds), gathered exactly like _pool_case does
+        kdq = jnp.asarray(kq, jnp.float32) * ks[:, :, None, None]
+        vdq = jnp.asarray(vq, jnp.float32) * vs[:, :, None, None]
+        B, S = tb.shape[0], tb.shape[1] * kp.shape[2]
+        kg = jnp.transpose(kdq[:, tb], (1, 2, 3, 0, 4)).reshape(B, S, 2, 16)
+        vg = jnp.transpose(vdq[:, tb], (1, 2, 3, 0, 4)).reshape(B, S, 2, 16)
+        _assert_close(out, _dense_ref(q, kg, vg, mask))
+
+
+def test_ragged_int8_requires_both_scales():
+    q, kp, vp, tb, off, *_ = _pool_case(offs=[4], T=1, H=4, Hkv=2, hd=16)
+    kq, ks, _vq, _vs = _quantize_pool(kp, vp)
+    with pytest.raises(ValueError, match="k_scale and v_scale"):
+        ragged_paged_attention(q, kq, vp, tb, off, k_scale=ks)
+
+
 def test_ragged_bf16_storage_f32_accumulation():
     q, kp, vp, tb, off, mask, kg, vg = _pool_case(
         offs=[10], T=1, H=4, Hkv=2, hd=16, seed=8, dtype=jnp.bfloat16
@@ -255,6 +302,76 @@ def test_single_batch_mixes_prefill_decode_and_spec_verify():
         # row refs all released; only the prefix cache's pins remain (the
         # three distinct prompts pin disjoint block sets, and the repeat
         # de-duplicates on its exact key instead of re-pinning)
+        pinned = sum(
+            len(blocks)
+            for blocks in eng.scheduler._prefix_cache._entries.values()
+        )
+        assert st.paged_blocks_in_use == pinned
+    finally:
+        eng.close()
+
+
+def test_int8_batch_mixes_prefill_decode_and_spec_verify():
+    """ISSUE 12 engine-level acceptance: one int8-pool engine with
+    attention='flash' and --spec on serves a chunk-prefilled prompt, a
+    plain decoding prompt, and a spec-verifying repetitive prompt
+    concurrently — all three chunk shapes riding the QUANTIZED kernel —
+    with token-for-token parity vs the int8 DENSE engine under the same
+    spec setting (identical write sequences → identical pages and
+    scales, so the two READ paths see the same quantized bytes and any
+    divergence is a kernel-dequant bug), and speculation must actually
+    have engaged. Quantization tolerance vs full precision is pinned by
+    the test_paged_cache family sweep."""
+    from bee2bee_tpu.engine import EngineConfig, InferenceEngine
+
+    kw = dict(
+        max_seq_len=128, dtype="float32", cache_dtype="int8",
+        decode_chunk=4, prefill_buckets=(16, 32, 64), max_batch=4,
+        prefill_chunk=16, prefix_cache_entries=4,
+    )
+    rng = np.random.default_rng(9)
+    long_prompt = list(rng.integers(3, 500, size=50))  # chunked prefill
+    plain_prompt = list(rng.integers(3, 500, size=12))
+    rep_prompt = [5, 6, 7, 8, 9] * 3 + [5, 6, 7]  # drafts from step one
+
+    jobs = [(long_prompt, 10), (plain_prompt, 12), (rep_prompt, 24)]
+
+    ref = InferenceEngine(
+        "tiny-llama", engine_config=EngineConfig(spec_tokens=6, **kw)
+    )
+    want = [
+        ref.generate(p, max_new_tokens=n, temperature=0.0).token_ids
+        for p, n in jobs
+    ]
+    ref.close()
+
+    eng = InferenceEngine(
+        "tiny-llama",
+        engine_config=EngineConfig(attention="flash", spec_tokens=6, **kw),
+    )
+    try:
+        results: list = [None] * len(jobs)
+
+        def run(i):
+            p, n = jobs[i]
+            results[i] = eng.generate(p, max_new_tokens=n, temperature=0.0)
+
+        threads = [
+            threading.Thread(target=run, args=(i,)) for i in range(len(jobs))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i in range(len(jobs)):
+            assert results[i].token_ids == want[i], f"row {i} diverged"
+        st = eng.scheduler.stats
+        assert st.peak_active >= 2, "rows never actually batched"
+        assert st.spec_steps > 0 and st.spec_drafted > 0, (
+            "speculation never engaged through the quantized kernel"
+        )
+        assert st.paged_blocks_in_use >= 0  # released below
+        # every row retired: only prefix pins (scales included) remain
         pinned = sum(
             len(blocks)
             for blocks in eng.scheduler._prefix_cache._entries.values()

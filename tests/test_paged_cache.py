@@ -114,6 +114,82 @@ def test_paged_dense_vs_ragged_flash_greedy(name):
     assert got == want
 
 
+# ------------------------------------------------------ int8 pool parity
+
+
+INT8_FAMILIES = [
+    "tiny-llama",   # GQA (2 kv heads / 4 q heads)
+    "tiny-gemma",   # MQA single kv head
+    "tiny-gemma3",  # alternating local/global masks + dual-theta rope
+    pytest.param("tiny-qwen", marks=pytest.mark.slow),     # qkv bias
+    pytest.param("tiny-mistral", marks=pytest.mark.slow),  # window only
+]
+
+
+@pytest.mark.parametrize("name", INT8_FAMILIES)
+def test_paged_int8_pool_greedy_parity(name):
+    """ISSUE 12 family sweep: the int8 pool (quantize-on-write + in-read
+    dequant) serves greedy decode within tolerance of the full-precision
+    pool, and its TWO read paths — dense attention over the dequantized
+    gathered view vs the ragged kernel dequantizing per gathered block —
+    agree token-for-token EXACTLY (they read the same quantized bytes
+    under the same scales, so any divergence is a dequant bug, not
+    quantization noise)."""
+    prompt = _prompt(0, n=21)  # crosses a block boundary (block_size 16)
+    ref = InferenceEngine(name, engine_config=EngineConfig(**KW))
+    want = ref.generate(prompt, max_new_tokens=10, temperature=0.0).token_ids
+    ref.close()
+
+    kw8 = dict(KW, cache_dtype="int8")
+    dense = InferenceEngine(name, engine_config=EngineConfig(**kw8))
+    got_dense = dense.generate(
+        prompt, max_new_tokens=10, temperature=0.0
+    ).token_ids
+    dense.close()
+    flash = InferenceEngine(
+        name, engine_config=EngineConfig(attention="flash", **kw8)
+    )
+    got_flash = flash.generate(
+        prompt, max_new_tokens=10, temperature=0.0
+    ).token_ids
+    flash.close()
+    assert got_dense == got_flash, "int8 dense vs ragged-kernel dequant split"
+    # bf16-vs-int8 tolerance: int8 KV noise (~0.8% of a page's amax) may
+    # legitimately flip a near-tied greedy argmax late in the rollout —
+    # but not more than a couple of tokens of ten on these fixed seeds
+    mismatches = sum(a != b for a, b in zip(want, got_dense))
+    assert len(got_dense) == len(want) and mismatches <= 2, (
+        f"int8 pool drifted {mismatches}/10 tokens vs full precision: "
+        f"{got_dense} vs {want}"
+    )
+
+
+def test_paged_int8_prefix_cow_and_block_recycling_stay_exact():
+    """The int8 pool's bookkeeping invariants: CoW prefix sharing copies
+    a page's SCALE with its bytes (repeat prompts decode identically),
+    and a recycled block's zeroed scale entry means pool churn cannot
+    bleed one tenant's amax into the next (repeat of the first prompt
+    still matches after unrelated traffic reused its freed blocks)."""
+    kw8 = dict(KW, cache_dtype="int8")
+    prompt = _prompt(2, n=24)
+    eng = InferenceEngine(
+        "tiny-llama",
+        engine_config=EngineConfig(paged=True, prefix_cache_entries=4, **kw8),
+    )
+    try:
+        st = eng.scheduler.stats
+        a = eng.generate(prompt, max_new_tokens=10, temperature=0.0).token_ids
+        # churn the pool so freed blocks are recycled under new scales
+        eng.generate(_prompt(9, n=30), max_new_tokens=10, temperature=0.0)
+        b = eng.generate(prompt, max_new_tokens=10, temperature=0.0).token_ids
+        c = eng.generate(prompt, max_new_tokens=10, temperature=0.0).token_ids
+        assert a == b == c
+        assert st.prefix_hits >= 2
+        assert st.paged_blocks_copied >= 1  # the CoW partial-block copy ran
+    finally:
+        eng.close()
+
+
 @pytest.mark.slow
 def test_paged_matches_rectangular_sampled_and_penalized():
     """Same rng seed => same token stream: the sampled path reads the same
@@ -517,6 +593,33 @@ def test_paged_parity_on_tp_mesh():
                            temperature=0.0).token_ids
         eng.close()
         assert got == want, name
+
+
+@pytest.mark.slow
+def test_paged_int8_parity_on_tp_mesh():
+    """The int8 pool's sharded read paths agree on a TP mesh: the
+    quantized ragged kernel runs per-shard via shard_map with the scale
+    operands sharded like the pool's kv-head dim (MQA replication
+    included) — greedy parity vs the int8 dense gathered-view engine on
+    the same mesh."""
+    import jax
+
+    from bee2bee_tpu.parallel import MeshSpec, build_mesh
+
+    kw = dict(max_seq_len=64, dtype="float32", cache_dtype="int8",
+              decode_chunk=4, max_batch=2, prefill_buckets=(16,))
+    mesh = build_mesh(MeshSpec(model=4), devices=jax.devices()[:4])
+    ref = InferenceEngine("tiny-gemma", mesh=mesh,
+                          engine_config=EngineConfig(**kw))
+    want = ref.generate([5, 17, 99, 42], max_new_tokens=6,
+                        temperature=0.0).token_ids
+    ref.close()
+    eng = InferenceEngine("tiny-gemma", mesh=mesh,
+                          engine_config=EngineConfig(attention="flash", **kw))
+    got = eng.generate([5, 17, 99, 42], max_new_tokens=6,
+                       temperature=0.0).token_ids
+    eng.close()
+    assert got == want
 
 
 def test_paged_composes_with_flash_and_auto():
