@@ -15,8 +15,8 @@ build time by checking, against the schema registry (analysis/schema.py):
   (protocol.SAMPLING_KEYS): the exact "knob dropped at one hop" bug class
   protocol.py warns about
 
-Scope: meshnet/, web/, services/, api.py — everywhere frames are built or
-consumed.
+Scope: meshnet/, web/, services/, fleet/, api.py — everywhere frames are
+built or consumed.
 """
 
 from __future__ import annotations
@@ -32,7 +32,7 @@ from .schema import FRAME_SCHEMAS, TASK_SCHEMAS, declared_key_universe
 _HANDLER_PREFIXES = ("_handle_", "_task_", "_on_", "_run_stage", "_ring_")
 _MESSAGE_PARAM_NAMES = ("data", "msg", "message", "frame")
 
-_SCOPES = ("meshnet/", "web/", "services/")
+_SCOPES = ("meshnet/", "web/", "services/", "fleet/")
 
 
 class _ProtocolNames:

@@ -195,6 +195,32 @@ FRAME_SCHEMAS: dict[str, FrameSchema] = {
             required=frozenset({"rid"}),
             optional=frozenset({"ok"}) | ADMISSION_KEYS | frozenset({"error"}),
         ),
+        # elastic fleet control (fleet/). FLEET_LEASE is the gossiped
+        # controller lease: `holder` + monotonic `epoch` order claims
+        # deterministically (higher epoch wins, ties break to the
+        # lexicographically smaller holder id), `ttl_s` is relative so
+        # receivers stamp arrival time instead of comparing clocks;
+        # `action` is the leader's in-flight replica action (one opaque
+        # descriptor — a successor adopts or rolls it back), `released`
+        # zeroes the TTL on clean stepdown/shutdown.
+        _fs(
+            P.FLEET_LEASE,
+            required=frozenset({"holder", "epoch", "ttl_s"}),
+            optional=frozenset({"scope", "action", "released"}),
+        ),
+        # a replica-lifecycle command from the lease holder; `epoch` +
+        # `holder` are checked against the target's own lease view (a
+        # stale or split-brain-losing controller cannot drain nodes)
+        _fs(
+            P.FLEET_ACTION,
+            required=frozenset({"rid", "action", "epoch", "holder"}),
+            optional=frozenset({"state", "model", "reason"}),
+        ),
+        _fs(
+            P.FLEET_ACK,
+            required=frozenset({"rid"}),
+            optional=frozenset({"ok", "error", "info"}),
+        ),
         # task protocol: per-kind field contracts live in TASK_SCHEMAS —
         # the TASK envelope itself only promises kind + correlation id
         _fs(P.TASK, required=frozenset({"kind", "task_id"}), allow_extra=True),

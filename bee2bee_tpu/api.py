@@ -430,7 +430,13 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
         ``?format=prom`` (or ``Accept: text/plain``) renders Prometheus
         text with one series per fresh peer under a ``peer`` label —
         stale peers' series drop out rather than serving forever."""
-        view = fleet_view(node.peer_id, node.telemetry_digest(), node.health)
+        view = fleet_view(
+            node.peer_id, node.telemetry_digest(), node.health,
+            # scope the fleet aggregate block to the controller's actual
+            # replica universe — the endpoint must show the same numbers
+            # a scale decision reads, not count every gossiping node
+            serving=node.fleet.serving_peers(),
+        )
         fmt = request.query.get("format")
         accept = request.headers.get("Accept", "")
         if fmt == "prom" or (fmt is None and "text/plain" in accept):
@@ -499,6 +505,40 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
             "draining": node.draining,
             "migration": dict(node.migration.stats),
         })
+
+    async def fleet_status(request):
+        """Elastic fleet control surface (fleet/controller.py): lease
+        view, leader role, latest controller aggregates, the bounded
+        decision journal (noops included — the operator sees WHY nothing
+        happened), in-flight action and config."""
+        return web.json_response(node.fleet.status())
+
+    async def fleet_override(request):
+        """Manual override (docs/ROBUSTNESS.md "Elastic fleet control"):
+        body ``{"action": "scale_out"|"scale_in"|"pause"|"resume",
+        "target": <peer_id, optional>}``. Scale actions bypass the
+        hysteresis but NOT the probe gate or the one-in-flight rule, and
+        only the lease holder runs them (409 points at the leader).
+        ADMIN surface, same rule as /admin/drain: tenant keys do not
+        open it."""
+        if not _auth_ok(request, api_key, None):
+            return web.json_response(
+                {"detail": "fleet override requires the node API key"},
+                status=403, headers=cors,
+            )
+        body = await _json_body(request)
+        action = body.get("action")
+        if not action:
+            return web.json_response({"detail": "action required"}, status=400)
+        out = await node.fleet.override(
+            str(action), target=body.get("target")
+        )
+        if out.get("ok"):
+            return web.json_response(out)
+        status = 409 if out.get("error") in (
+            "not_leader", "action_in_flight"
+        ) else 400
+        return web.json_response(out, status=status)
 
     async def debug_incidents(request):
         """Flight-recorder surface: ``?id=<incident id>`` fetches one full
@@ -649,6 +689,8 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
     app.router.add_get("/debug/incidents", debug_incidents)
     app.router.add_post("/admin/drain", admin_drain)
     app.router.add_get("/admin/drain", admin_drain_status)
+    app.router.add_get("/fleet", fleet_status)
+    app.router.add_post("/fleet/override", fleet_override)
     app.router.add_post("/connect", connect)
     app.router.add_post("/chat", chat)
     app.router.add_post("/generate", chat)  # alias (reference api.py:190-191)

@@ -374,10 +374,129 @@ class HealthStore:
             )
 
 
-def fleet_view(local_peer_id: str, local_digest: dict, store: HealthStore) -> dict:
+def digest_slo_burn(digest: dict | None) -> tuple[float, bool]:
+    """(max fast-window burn rate, is_burning) from a digest's SLO brief.
+    ``is_burning`` uses the same rule the router's exclusion does: any
+    objective reporting burning/tripped status."""
+    if not isinstance(digest, dict):
+        return 0.0, False
+    brief = digest.get("slo")
+    if not isinstance(brief, dict):
+        return 0.0, False
+    burn = 0.0
+    burning = False
+    for e in brief.values():
+        if not isinstance(e, dict):
+            continue
+        try:
+            burn = max(burn, float(e.get("burn_fast") or 0.0))
+        except (TypeError, ValueError):
+            pass
+        if e.get("status") in ("burning", "tripped"):
+            burning = True
+    return burn, burning
+
+
+def controller_aggregates(
+    digests: dict[str, dict], serving: set | None = None
+) -> dict:
+    """Controller-grade fleet aggregates (fleet/controller.py's input,
+    also served under ``/mesh/health``'s ``aggregate.fleet``).
+
+    Callers pass FRESH digests only (``HealthStore.fresh()`` + the local
+    live digest) — a stale digest must drop out of these numbers before
+    it can trigger a scale action, and freshness is the store's job, not
+    re-derived here.
+
+    Bucketing rules, which ARE the capacity semantics:
+
+    - ``draining`` peers are leaving: excluded from the eligible count
+      and from every headroom signal (their emptying batch would read as
+      fake headroom exactly while the fleet is losing a replica);
+    - ``standby`` / ``warming`` peers receive no routed traffic yet, so
+      their (idle) signals say nothing about serving capacity — counted
+      in their own buckets only;
+    - with ``serving`` given, a peer must be in it to count as eligible
+      (a client-only node gossips a digest too, but it is not a
+      replica).
+
+    Headroom/burn signals over the ELIGIBLE set only: ``burning`` /
+    ``burn_fast_max`` from the SLO briefs, ``fill_mean`` (absent
+    batch-fill gauges count as 0 — no engine, no pressure),
+    ``queue_p95_max``, ``pool_free_min``, ``active_rows_total``."""
+    eligible: dict[str, dict] = {}
+    draining: list[str] = []
+    standby: list[str] = []
+    warming: list[str] = []
+    other: list[str] = []
+    for pid, d in digests.items():
+        if not isinstance(d, dict):
+            continue
+        if d.get("draining"):
+            draining.append(pid)
+            continue
+        state = d.get("fleet_state")
+        if state == "standby":
+            standby.append(pid)
+            continue
+        if state == "warming":
+            warming.append(pid)
+            continue
+        if serving is not None and pid not in serving:
+            other.append(pid)
+            continue
+        eligible[pid] = d
+    burning_ids: list[str] = []
+    burn_max = 0.0
+    fills: list[float] = []
+    q95s: list[float] = []
+    pool_fracs: list[float] = []
+    rows = 0.0
+    for pid, d in eligible.items():
+        burn, is_burning = digest_slo_burn(d)
+        burn_max = max(burn_max, burn)
+        if is_burning:
+            burning_ids.append(pid)
+        gauge = d.get("gauge") or {}
+        fills.append(
+            min(max(float(gauge.get("engine.batch_fill") or 0.0), 0.0), 1.0)
+        )
+        qw = (d.get("hist") or {}).get("engine.queue_wait_ms") or {}
+        q95s.append(float(qw.get("p95") or 0.0))
+        total = float(gauge.get("engine.paged_blocks_total") or 0.0)
+        if total > 0:
+            free = float(gauge.get("engine.paged_blocks_free") or 0.0)
+            pool_fracs.append(min(max(free / total, 0.0), 1.0))
+        rows += float(gauge.get("engine.active_rows") or 0.0)
+    n = len(eligible)
+    return {
+        "nodes": len(digests),
+        "eligible": n,
+        "eligible_ids": sorted(eligible),
+        "draining": sorted(draining),
+        "standby": sorted(standby),
+        "warming": sorted(warming),
+        "other": sorted(other),
+        "burning": len(burning_ids),
+        "burning_ids": sorted(burning_ids),
+        "burning_frac": round(len(burning_ids) / n, 4) if n else 0.0,
+        "burn_fast_max": round(burn_max, 4),
+        "fill_mean": round(sum(fills) / n, 4) if n else 0.0,
+        "queue_p95_max": round(max(q95s), 3) if q95s else 0.0,
+        "pool_free_min": round(min(pool_fracs), 4) if pool_fracs else None,
+        "active_rows_total": rows,
+    }
+
+
+def fleet_view(local_peer_id: str, local_digest: dict, store: HealthStore,
+               serving: set | None = None) -> dict:
     """The merged ``/mesh/health`` payload: the local node's digest plus
     every FRESH peer digest, with fleet-level aggregates. Stale peers are
-    listed by id but contribute nothing to the aggregates."""
+    listed by id but contribute nothing to the aggregates. ``serving``
+    (the controller's replica universe — api.py passes
+    ``node.fleet.serving_peers()``) scopes the ``fleet`` aggregate block
+    to actual replicas, so the endpoint shows the exact numbers a scale
+    decision reads; without it every gossiping node counts as eligible."""
     peers: dict[str, dict] = {local_peer_id: {**local_digest, "age_s": 0.0}}
     for pid, digest in store.fresh().items():
         age = store.age_s(pid)
@@ -412,6 +531,10 @@ def fleet_view(local_peer_id: str, local_digest: dict, store: HealthStore) -> di
     agg["tokens_generated_total"] = tokens
     agg["paged_blocks_in_use_total"] = blocks
     agg["active_rows_total"] = rows
+    # the controller-grade breakdown (fleet/controller.py consumes the
+    # same function over the same fresh digests): /mesh/health shows the
+    # exact numbers a scale decision would read
+    agg["fleet"] = controller_aggregates(peers, serving=serving)
     return {
         "node": local_peer_id,
         "ttl_s": store.ttl_s,

@@ -140,6 +140,131 @@ class ChaosMigration:
         self._restores.clear()
 
 
+class ChaosController:
+    """Deterministic fault injection for the elastic fleet control loop
+    (fleet/controller.py). The tentpole contract (tests/test_fleet.py):
+    a controller death or network split never strands a draining node,
+    a half-provisioned replica never receives traffic, and no in-flight
+    generation is dropped.
+
+    Faults:
+      - ``await kill_leader()``: hard_kill the node currently holding
+        the lease (mid-drain if the test timed it so) — the successor's
+        orphan scan must adopt or roll back whatever it left behind.
+      - ``partition(a, b, ops=None)``: drop frames between nodes a and b
+        in BOTH directions (default: only the fleet ops — lease gossip
+        and actions — the nastier case where telemetry still flows but
+        leadership is invisible). ``heal()`` restores delivery.
+      - ``await usurp(node, epoch=None)``: force ``node`` to claim the
+        lease NOW (default: at the current highest epoch — a true
+        split-brain tie). Both leaders broadcast; the deterministic
+        ordering (higher epoch, then smaller peer id) must leave exactly
+        one standing.
+      - ``fail_probe(node, fails=1)``: the next ``fails`` warm-up probes
+        on that controller report failure — the provision-probe chaos
+        rung (replica must be rolled back to standby, never eligible).
+
+    ``restore()`` undoes partitions and probe wraps (kills stay dead).
+    """
+
+    def __init__(self, nodes=()):
+        self.nodes = list(nodes)
+        self._restores: list = []
+
+    # ------------------------------------------------------------- leaders
+
+    def leader(self):
+        """The node currently believing it holds the lease (None if no
+        node does; tests settle on exactly one)."""
+        leaders = [n for n in self.nodes if n.fleet.is_leader and not n._stopped]
+        return leaders[0] if leaders else None
+
+    def leaders(self):
+        return [n for n in self.nodes if n.fleet.is_leader and not n._stopped]
+
+    async def kill_leader(self):
+        """Process-death semantics for the current leader; returns the
+        killed node (its in-flight action dies with it)."""
+        node = self.leader()
+        if node is None:
+            raise AssertionError("no leader to kill")
+        await hard_kill(node)
+        return node
+
+    # ---------------------------------------------------------- partitions
+
+    def partition(self, a, b, ops: tuple[str, ...] | None = None) -> None:
+        """Drop `ops` frames (default: the fleet control plane) between
+        nodes a and b, both directions, at the RECEIVER — the sender
+        still believes it spoke, exactly like a one-way-lossy network."""
+        drop_ops = ops or (
+            protocol.FLEET_LEASE, protocol.FLEET_ACTION, protocol.FLEET_ACK
+        )
+        for me, other in ((a, b), (b, a)):
+            orig = me._on_message
+            other_id = other.peer_id
+
+            async def filtered(ws, data, _me=me, _orig=orig,
+                               _other=other_id):
+                if data.get("type") in drop_ops:
+                    pid = await _me._peer_for(ws)
+                    if pid == _other:
+                        return  # dropped on the virtual wire
+                await _orig(ws, data)
+
+            me._on_message = filtered
+            self._restores.append(
+                lambda _me=me, _orig=orig: setattr(_me, "_on_message", _orig)
+            )
+
+    def heal(self) -> None:
+        """Restore every partition/probe wrap installed so far."""
+        self.restore()
+
+    # ----------------------------------------------------------- usurpation
+
+    async def usurp(self, node, epoch: int | None = None):
+        """Force `node`'s controller to claim leadership immediately —
+        bypassing the lapse wait — and broadcast the claim. With the
+        default epoch (the highest seen) this manufactures a genuine
+        double-leader split-brain whose resolution must be deterministic."""
+        ctrl = node.fleet
+        ctrl.epoch = int(epoch) if epoch is not None else max(
+            1, ctrl.lease.highest_epoch
+        )
+        ctrl.is_leader = True
+        await ctrl._broadcast_lease()
+        return ctrl
+
+    # --------------------------------------------------------------- probes
+
+    def fail_probe(self, node, fails: int = 1) -> None:
+        """Make the next `fails` warm-up probes on this controller fail
+        (the replica must end back in standby, never eligible)."""
+        prov = node.fleet.provisioner
+        orig = prov.probe
+        state = {"left": int(fails)}
+
+        async def failing(target, _orig=orig, _state=state):
+            if _state["left"] > 0:
+                _state["left"] -= 1
+                return False, "chaos: probe failure injected"
+            return await _orig(target)
+
+        prov.probe = failing
+        self._restores.append(
+            lambda _prov=prov, _orig=orig: setattr(_prov, "probe", _orig)
+        )
+
+    def restore(self) -> None:
+        # reversed: stacked wraps on one node (two partitions, repeated
+        # fail_probe) must unwind inner-first, or an outer restore would
+        # re-install the inner wrapper it captured as "original"
+        for undo in reversed(self._restores):
+            undo()
+        self._restores.clear()
+
+
 class ChaosStage:
     """Wrap one stage worker node's task handler with a scheduled fault.
 

@@ -270,6 +270,10 @@ class MigrationManager:
             d = fresh.get(pid)
             if not isinstance(d, dict) or d.get("draining"):
                 continue
+            if d.get("fleet_state") in ("standby", "warming"):
+                # an unprobed elastic-fleet replica must not receive
+                # live state either — migrations are traffic
+                continue
             if decode_only and d.get("disagg_role") != "decode":
                 continue
             for meta in list(svcs.values()):

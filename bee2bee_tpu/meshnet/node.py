@@ -38,6 +38,7 @@ except ImportError:  # gate the missing dep: loopback shim (wscompat.py)
     from .. import wscompat as websockets
 
 from .. import protocol
+from ..fleet import FleetController
 from ..health import HealthStore, SloTracker, build_digest, get_recorder, load_slo_config
 from ..joinlink import generate_join_link, parse_join_link
 from ..metrics import get_registry
@@ -141,6 +142,13 @@ class P2PNode(StageTaskMixin):
         # disaggregated serving role (BEE2BEE_DISAGG): a prefill node
         # hands freshly prefilled generations to decode-designated peers
         # via KV migration; a decode node advertises itself as the target
+        fleet_state: str | None = None,  # "standby" | None (eligible) —
+        # elastic fleet role (BEE2BEE_FLEET_STATE): a standby replica is
+        # connected and gossiping but router-excluded until the fleet
+        # controller activates + probes it (fleet/provision.py)
+        fleet_controller: bool | None = None,  # compete for the fleet
+        # controller lease (BEE2BEE_FLEET=controller); every node still
+        # keeps a lease view and obeys epoch-gated fleet actions
     ):
         self.host = host
         self.accept_stages = accept_stages
@@ -184,8 +192,13 @@ class P2PNode(StageTaskMixin):
         # live generation migration (meshnet/migrate.py): graceful drain,
         # disaggregated prefill→decode handoff, migration-based failover.
         # `draining` gates admission (typed 503) and rides the telemetry
-        # digest so RouterPolicy stops routing here.
+        # digest so RouterPolicy stops routing here. `drain_source`
+        # ("operator" | "fleet") rides alongside it: the fleet
+        # controller's orphan scan reconciles only drains ITS OWN kind
+        # started — an operator's deliberate /admin/drain is never
+        # undrained or converted to standby out from under them.
         self.draining = False
+        self.drain_source: str | None = None
         role = (
             disagg_role
             if disagg_role is not None
@@ -197,13 +210,42 @@ class P2PNode(StageTaskMixin):
             )
         self.disagg_role = role
         self.migration = MigrationManager(self)
+        # elastic fleet control (fleet/): lease bookkeeping + the
+        # epoch-gated action handler live on EVERY node; only enabled
+        # controllers compete for the lease and run the decision loop
+        fstate = (
+            fleet_state
+            if fleet_state is not None
+            else (os.environ.get("BEE2BEE_FLEET_STATE") or "").strip().lower()
+        ) or None
+        if fstate in ("active", "eligible"):
+            fstate = None
+        if fstate not in (None, "standby", "warming"):
+            raise ValueError(
+                f"fleet_state must be 'standby', 'warming' or unset, got {fstate!r}"
+            )
+        self.fleet_state = fstate
+        self.fleet_provision_cb = None  # async (model) -> None: boots the
+        # local service on activate (weights publish→DHT→fetch in real
+        # deployments — meshnet.weights.serve_model_from_mesh)
+        self.fleet = FleetController(self, enabled=fleet_controller)
         self.admission = AdmissionController(
             config=load_admission_config(),
             weights=self.tenants.weights(),
             budgets=self.tenants.budgets(),
             # this node's OWN burn state (not the process-global registry):
-            # the monitor loop refreshes it on the ping cadence
-            slo_burn=lambda: self.slo.max_fast_burn(),
+            # the monitor loop refreshes it on the ping cadence. A WARMING
+            # fleet replica reports no burn: the router excludes it from
+            # all routed traffic, so the only request it legitimately
+            # sees is the controller's warm-up probe — and shedding the
+            # probe that would relieve a fleet-wide burn (cold-start TTFT
+            # spikes trip the SLO exactly then) would deadlock scale-out.
+            # Queue/pool bounds still apply, same carve-out shape as
+            # migration imports.
+            slo_burn=lambda: (
+                0.0 if self.fleet_state == "warming"
+                else self.slo.max_fast_burn()
+            ),
             pool_free_fraction=paged_pool_free_fraction,
             draining=lambda: self.draining,
         )
@@ -303,6 +345,10 @@ class P2PNode(StageTaskMixin):
 
     async def stop(self):
         self._stopped = True
+        # a stopping leader releases its lease (zero TTL) so a follower
+        # takes over immediately instead of waiting out the lapse
+        with contextlib.suppress(Exception):
+            await self.fleet.release()
         # fail outstanding migrations typed before sockets go away
         self.migration.close()
         # say goodbye and close sockets FIRST — cancelling reader tasks
@@ -552,6 +598,9 @@ class P2PNode(StageTaskMixin):
             protocol.KV_EXPORT: self._handle_kv_export,
             protocol.KV_BLOCKS: self._handle_kv_blocks,
             protocol.KV_IMPORT_ACK: self._handle_kv_import_ack,
+            protocol.FLEET_LEASE: self._handle_fleet_lease,
+            protocol.FLEET_ACTION: self._handle_fleet_action,
+            protocol.FLEET_ACK: self._handle_fleet_ack,
             protocol.TASK: self._handle_task,
             protocol.RESULT: self._handle_result,
             protocol.TASK_ERROR: self._handle_result,
@@ -569,7 +618,12 @@ class P2PNode(StageTaskMixin):
         # frames and TCP backpressure paces a flooding peer instead of
         # unbounded tasks/threads. Everything else stays inline:
         # gen_chunk/result ordering is part of the streaming contract.
-        if data.get("type") in (protocol.GEN_REQUEST, protocol.TASK):
+        # FLEET_ACTION joins the spawned set: an `activate` runs the
+        # node's provision hook (weight fetch — slow), and the reader
+        # must keep pumping pings/telemetry meanwhile
+        if data.get("type") in (
+            protocol.GEN_REQUEST, protocol.TASK, protocol.FLEET_ACTION
+        ):
             if self._serving.get(ws, 0) >= MAX_CONCURRENT_SERVES_PER_CONN:
                 await handler(ws, data)
                 return
@@ -717,8 +771,18 @@ class P2PNode(StageTaskMixin):
         # disagg role is how prefill nodes find decode-designated targets
         if self.draining:
             digest["draining"] = True
+            if self.drain_source:
+                digest["drain_source"] = self.drain_source
         if self.disagg_role:
             digest["disagg_role"] = self.disagg_role
+        # elastic fleet (fleet/): a standby/warming replica advertises
+        # its state so routers and the migration plane exclude it, and
+        # controller-eligible nodes advertise themselves so takeover
+        # ranks are computed over the LIVE controller set
+        if self.fleet_state:
+            digest["fleet_state"] = self.fleet_state
+        if self.fleet.enabled:
+            digest["fleet_controller"] = True
         return digest
 
     async def gossip_telemetry(self) -> int:
@@ -1266,9 +1330,32 @@ class P2PNode(StageTaskMixin):
     async def _handle_kv_import_ack(self, ws, data):
         self.migration.handle_ack(data)
 
-    async def begin_drain(self, stop: bool = False, wait: bool = True) -> dict:
-        """Graceful drain (POST /admin/drain): see MigrationManager.drain."""
+    async def begin_drain(self, stop: bool = False, wait: bool = True,
+                          source: str = "operator") -> dict:
+        """Graceful drain (POST /admin/drain): see MigrationManager.drain.
+        ``source`` stamps WHO started it ("operator" | "fleet") into the
+        gossiped digest — the fleet controller reconciles only its own."""
+        self.drain_source = source
         return await self.migration.drain(stop=stop, wait=wait)
+
+    def end_drain(self) -> None:
+        """Cancel the draining state (fleet rollback / operator undo):
+        admission re-opens and the next gossip drops the digest flag.
+        Migrations already launched complete harmlessly — their rows
+        left; new work lands here again."""
+        self.draining = False
+        self.drain_source = None
+
+    # ------------------------------------------------------- elastic fleet
+
+    async def _handle_fleet_lease(self, ws, data):
+        await self.fleet.on_lease(ws, data)
+
+    async def _handle_fleet_action(self, ws, data):
+        await self.fleet.on_action(ws, data)
+
+    async def _handle_fleet_ack(self, ws, data):
+        self.fleet.on_ack(data)
 
     # ------------------------------------------------------------ pieces
 
@@ -1382,6 +1469,9 @@ class P2PNode(StageTaskMixin):
                 self.slo.evaluate()
                 await self.gossip_telemetry()
                 self._record_metric_deltas(last_counts)
+                # elastic fleet control loop, same cadence: lease renew/
+                # claim + (leaders only) one hysteresis-guarded decision
+                await self.fleet.tick()
             except asyncio.CancelledError:
                 raise
             except Exception:

@@ -49,6 +49,17 @@ TELEMETRY = "telemetry"
 KV_EXPORT = "kv_export"
 KV_BLOCKS = "kv_blocks"
 KV_IMPORT_ACK = "kv_import_ack"
+# elastic fleet control loop (fleet/): a TTL'd controller lease gossiped
+# mesh-wide (FLEET_LEASE — holder, monotonic epoch, ttl; receivers stamp
+# ARRIVAL time, so no cross-node clock is compared), replica lifecycle
+# commands from the lease holder (FLEET_ACTION — drain / undrain /
+# activate / set_state / to_standby, epoch-gated so a split-brain loser
+# or a stale controller cannot drain nodes), and the target's typed
+# verdict (FLEET_ACK). Not in the reference message set — old peers
+# ignore the frames, they just never participate in elasticity.
+FLEET_LEASE = "fleet_lease"
+FLEET_ACTION = "fleet_action"
+FLEET_ACK = "fleet_ack"
 
 # ---- coordinator/worker task protocol (reference protocol.py:25-53, node.py:89+)
 REGISTER = "register"
@@ -101,6 +112,9 @@ MESSAGE_TYPES = frozenset(
         KV_EXPORT,
         KV_BLOCKS,
         KV_IMPORT_ACK,
+        FLEET_LEASE,
+        FLEET_ACTION,
+        FLEET_ACK,
         REGISTER,
         INFO,
         TASK,

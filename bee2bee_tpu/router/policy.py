@@ -35,6 +35,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, fields
 
+from ..health import digest_slo_burn
 from ..metrics import get_registry
 from ..utils import load_json_source
 from .prefixmap import match_depth, prompt_prefix_hashes
@@ -52,6 +53,10 @@ _C_SLO_EXCLUDED = get_registry().counter(
 )
 _C_DRAIN_EXCLUDED = get_registry().counter(
     "router.drain_excluded", "candidates excluded for draining"
+)
+_C_WARMUP_EXCLUDED = get_registry().counter(
+    "router.warmup_excluded",
+    "candidates excluded as standby/warming fleet replicas",
 )
 
 MODE_SCORED = "scored"
@@ -106,16 +111,11 @@ def _soft(value: float, ref: float) -> float:
 
 def _slo_burning(digest: dict | None) -> bool:
     """True when the peer's own SLO brief reports any objective burning or
-    tripped — the shed-before-melt contract seen from the outside."""
-    if not digest:
-        return False
-    brief = digest.get("slo")
-    if not isinstance(brief, dict):
-        return False
-    return any(
-        isinstance(e, dict) and e.get("status") in ("burning", "tripped")
-        for e in brief.values()
-    )
+    tripped — the shed-before-melt contract seen from the outside. ONE
+    rule shared with the fleet controller's aggregates
+    (health.digest_slo_burn): the controller must scale on exactly the
+    definition of "burning" the router excludes on."""
+    return digest_slo_burn(digest)[1]
 
 
 class RouterPolicy:
@@ -204,6 +204,15 @@ class RouterPolicy:
                 # just converts one hop into a guaranteed typed shed)
                 _C_DRAIN_EXCLUDED.inc()
                 continue
+            if digest is not None and digest.get("fleet_state") in (
+                "standby", "warming"
+            ):
+                # an elastic-fleet standby/warming replica (fleet/) has
+                # NOT passed its warm-up probe: it must never receive
+                # routed traffic — no waiver, same as draining (the
+                # controller's probe is the only thing allowed to hit it)
+                _C_WARMUP_EXCLUDED.inc()
+                continue
             if _slo_burning(digest):
                 excluded += 1
                 _C_SLO_EXCLUDED.inc()
@@ -222,7 +231,10 @@ class RouterPolicy:
                     local_digest if cand.get("local")
                     else fresh_digests.get(cand.get("provider_id"))
                 )
-                if digest is not None and digest.get("draining"):
+                if digest is not None and (
+                    digest.get("draining")
+                    or digest.get("fleet_state") in ("standby", "warming")
+                ):
                     continue
                 s, breakdown = self.score(
                     cand, digest, cand.get("_latency"), max_price, ph

@@ -523,6 +523,255 @@ def bench_router_fairness(duration_s: float = 6.0) -> dict:
     return out
 
 
+def bench_fleet_elastic(duration_s: float = 24.0, tail_s: float = 12.0,
+                        base_rate: float = 8.0, swing: float = 10.0) -> dict:
+    """Elastic-fleet rung (ISSUE 13 acceptance): a diurnal ramp with a
+    ``swing``x traffic swing drives a loopback fleet — one controller
+    front door + one active replica + two warm standbys — and the rung
+    records whether NODE COUNT FOLLOWS LOAD (scale-out on sustained
+    fleet-wide fast-burn, scale-in back to standby over the idle tail)
+    while SLO fast-burn stays bounded instead of running away.
+
+    Model-free (FakeService behind a contention lock, so service time
+    grows with per-replica concurrency exactly like a serialized
+    accelerator) and platform-independent; the client-side dispatcher
+    spreads arrivals over the CURRENT router-eligible set — in-process
+    loopback shares one metrics registry, so the router's digest-scored
+    spreading cannot differentiate replicas here and the spread is the
+    load balancer's job, while the CONTROLLER (lease, burn decisions,
+    probe gate, drain) is the thing under test. Standalone:
+    ``python bench.py fleet_elastic``."""
+    import asyncio
+    import contextlib
+    import threading
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent))
+    from scripts.loadgen import (
+        TenantLoad,
+        TenantStats,
+        _fire,
+        _window_report,
+        profile_multiplier,
+    )
+
+    async def run() -> dict:
+        import random
+
+        import aiohttp
+        from aiohttp.test_utils import TestServer
+
+        from bee2bee_tpu.api import build_app
+        from bee2bee_tpu.fleet import FleetConfig
+        from bee2bee_tpu.health import SloTracker, parse_slo_config
+        from bee2bee_tpu.meshnet.node import P2PNode
+        from bee2bee_tpu.router import AdmissionConfig
+        from bee2bee_tpu.services.fake import FakeService
+
+        class ContendedFake(FakeService):
+            """Service time = lock wait + hold: per-replica concurrency
+            shows up in service.execute_ms the way a serialized decode
+            loop would — the latency signal the SLO burns against. The
+            clock starts BEFORE the lock (result_dict's t0), so queueing
+            behind the replica's serial resource is what the histogram
+            measures."""
+
+            def __init__(self, *a, hold_s=0.02, **kw):
+                super().__init__(*a, **kw)
+                self._hold_s = hold_s
+                self._serial = threading.Lock()
+
+            def execute(self, params):
+                t0 = time.time()
+                self.calls.append(dict(params))
+                with self._serial:
+                    time.sleep(self._hold_s)
+                text = self._reply_for(params)
+                n = len(text.split())
+                out = self.result_dict(text, n, t0, self.price_per_token)
+                out["timing"] = self._timing(t0, n)
+                return out
+
+        MODEL = "fleet-bench"
+        cfg = FleetConfig(
+            model=MODEL, min_replicas=1, max_replicas=3,
+            out_sustain_ticks=2, in_sustain_ticks=8,
+            scale_out_cooldown_s=2.0, scale_in_cooldown_s=2.0,
+            ack_timeout_s=5.0, settle_timeout_s=5.0, probe_timeout_s=10.0,
+            action_timeout_s=20.0, lease_ttl_s=0.3, claim_stagger_s=0.1,
+        )
+        slo_cfg = parse_slo_config([{
+            "name": "exec_p95", "kind": "latency",
+            "metric": "service.execute_ms", "threshold_ms": 96.0,
+            "target": 0.95,
+        }])
+        # controller = non-serving front door; 1 active + 2 warm standbys
+        ctrl = P2PNode(host="127.0.0.1", port=0, fleet_controller=True)
+        replicas = [
+            P2PNode(host="127.0.0.1", port=0,
+                    fleet_state=None if i == 0 else "standby")
+            for i in range(3)
+        ]
+        nodes = [ctrl] + replicas
+        servers: dict[str, TestServer] = {}
+        try:
+            for node in nodes:
+                node.ping_interval_s = 0.1
+                node.health.ttl_s = 1.5
+                node.fleet.config = cfg
+                node.fleet.lease.ttl_s = cfg.lease_ttl_s
+                node.slo = SloTracker(
+                    objectives=list(slo_cfg),
+                    fast_window_s=3.0, slow_window_s=15.0,
+                )
+                # slo_shed OFF for this rung: every loopback node reads
+                # the ONE process registry, so a burning histogram would
+                # shed traffic on freshly-added replicas that are in
+                # fact idle — shed-before-melt is pinned by the router
+                # tests; this rung measures the SCALE loop
+                node.admission.config = AdmissionConfig(
+                    max_concurrent=32, max_queue=512, tenant_queue=400,
+                    queue_timeout_s=30.0, shed_burn_rate=1e9,
+                )
+                await node.start()
+            for node in replicas:
+                node.add_service(ContendedFake(MODEL, reply="tok " * 16))
+            for node in nodes[1:]:
+                assert await ctrl.connect_bootstrap(node.addr)
+            for _ in range(100):
+                if all(len(n.peers) == len(nodes) - 1 for n in nodes):
+                    break
+                await asyncio.sleep(0.05)
+            for node in replicas:
+                await node.announce_service(node.local_services["fake"])
+                server = TestServer(build_app(node))
+                await server.start_server()
+                servers[node.peer_id] = server
+            for node in nodes:
+                await node.gossip_telemetry()
+            for _ in range(100):
+                if ctrl.fleet.is_leader:
+                    break
+                await asyncio.sleep(0.05)
+            assert ctrl.fleet.is_leader, "controller never claimed the lease"
+
+            mult = profile_multiplier("ramp", swing)
+            tenant = TenantLoad("fleet", rate_per_s=base_rate,
+                                prompt="fleet bench", max_new_tokens=16)
+            stats = TenantStats()
+            timeline: list[dict] = []
+            t0 = time.perf_counter()
+            total_s = duration_s + tail_s
+            inflight: set = set()
+
+            def eligible_urls() -> list[str]:
+                agg = ctrl.fleet.status()["aggregates"] or {}
+                ids = [p for p in (agg.get("eligible_ids") or [])
+                       if p in servers]
+                if not ids:
+                    ids = [replicas[0].peer_id]
+                return [f"http://127.0.0.1:{servers[p].port}" for p in ids]
+
+            async def sampler():
+                while time.perf_counter() - t0 < total_s:
+                    agg = ctrl.fleet.status()["aggregates"] or {}
+                    timeline.append({
+                        "t_s": round(time.perf_counter() - t0, 2),
+                        "eligible": agg.get("eligible"),
+                        "standby": len(agg.get("standby") or []),
+                        "warming": len(agg.get("warming") or []),
+                        "draining": len(agg.get("draining") or []),
+                        "burning": agg.get("burning"),
+                        "burn_fast_max": agg.get("burn_fast_max"),
+                    })
+                    await asyncio.sleep(0.5)
+
+            async def driver(session):
+                rr = 0
+                while True:
+                    now = time.perf_counter()
+                    if now - t0 >= duration_s:
+                        return  # the idle tail drives nothing
+                    urls = eligible_urls()
+                    url = urls[rr % len(urls)]
+                    rr += 1
+                    stats.sent += 1
+                    stats.sent_ts.append(now)
+                    task = asyncio.ensure_future(
+                        _fire(session, url, tenant, stats)
+                    )
+                    inflight.add(task)
+                    task.add_done_callback(inflight.discard)
+                    rate = base_rate * mult((now - t0) / duration_s)
+                    await asyncio.sleep(random.expovariate(max(rate, 1e-6)))
+
+            async with aiohttp.ClientSession() as session:
+                sample_task = asyncio.create_task(sampler())
+                await driver(session)
+                # idle tail: headroom sustains, the fleet breathes back in
+                await asyncio.sleep(tail_s)
+                await sample_task
+                if inflight:
+                    await asyncio.wait(set(inflight), timeout=30.0)
+
+            windows = _window_report(
+                [stats], t0, duration_s, duration_s / 12.0, mult
+            )
+            counts = [e["eligible"] for e in timeline
+                      if e["eligible"] is not None]
+            burns = [e["burn_fast_max"] for e in timeline
+                     if e["burn_fast_max"] is not None]
+            tail_entries = [e for e in timeline if e["t_s"] > duration_s]
+            return {
+                "model_free": True,
+                "profile": {"name": "ramp", "swing": swing,
+                            "base_rate_per_s": base_rate,
+                            "duration_s": duration_s, "tail_s": tail_s},
+                "windows": windows,
+                "timeline": timeline,
+                "replicas_min": min(counts) if counts else None,
+                "replicas_max": max(counts) if counts else None,
+                "replicas_final": counts[-1] if counts else None,
+                "burn_fast_peak": max(burns) if burns else None,
+                "burn_fast_final": burns[-1] if burns else None,
+                "tail_burning_samples": sum(
+                    1 for e in tail_entries if (e["burning"] or 0) > 0
+                ),
+                "completed": stats.completed,
+                "shed": dict(stats.rejected),
+                "errors": stats.errors,
+                "controller": {
+                    "stats": dict(ctrl.fleet.stats),
+                    "decisions_tail": list(ctrl.fleet.decisions)[-10:],
+                },
+            }
+        finally:
+            for server in servers.values():
+                with contextlib.suppress(Exception):
+                    await server.close()
+            for node in nodes:
+                with contextlib.suppress(Exception):
+                    await node.stop()
+
+    out = asyncio.run(run())
+    # the PR 6 platform stamp — model-free, but the artifact still says
+    # what machine produced the numbers
+    try:
+        import jax
+
+        out["platform"] = jax.devices()[0].platform
+    except Exception:  # noqa: BLE001 — standalone runs skip the probe
+        out["platform"] = "unknown"
+    log(
+        f"fleet_elastic rung: replicas {out['replicas_min']}→"
+        f"{out['replicas_max']}→{out['replicas_final']} across a "
+        f"{out['profile']['swing']}x ramp, burn_fast peak "
+        f"{out['burn_fast_peak']} final {out['burn_fast_final']}, "
+        f"completed {out['completed']}, shed {out['shed']}"
+    )
+    return out
+
+
 def bench_migration(duration_tokens: int = 96, n_streams: int = 3) -> dict:
     """Live-migration rung (ISSUE 9 acceptance): a 3-node loopback mesh
     under concurrent streaming load; node A drains mid-decode and the
@@ -1100,6 +1349,15 @@ def main() -> None:
         log(f"router_fairness rung failed: {e}")
         extras["router_fairness"] = {"error": str(e)}
 
+    # elastic-fleet rung (ISSUE 13 acceptance: node count follows a 10x
+    # diurnal traffic swing with SLO fast-burn bounded; probe-gated
+    # scale-out, drain-to-standby scale-in) — model-free loopback fleet
+    try:
+        extras["fleet_elastic"] = bench_fleet_elastic()
+    except Exception as e:  # noqa: BLE001 — the rung must not kill the bench
+        log(f"fleet_elastic rung failed: {e}")
+        extras["fleet_elastic"] = {"error": str(e)}
+
     # live-migration rung (ISSUE 9 acceptance: drain pause for KV resume
     # vs re-prefill failover on a 3-node loopback mesh under load; the
     # happy path must show zero re-prefills). tiny-model, any platform —
@@ -1227,6 +1485,11 @@ if __name__ == "__main__":
     # JSON alone so CI can gate on the token ratio directly
     if len(sys.argv) > 1 and sys.argv[1] == "router_fairness":
         print(json.dumps(bench_router_fairness()), flush=True)
+        sys.exit(0)
+    # `python bench.py fleet_elastic`: the elastic-fleet diurnal-ramp rung
+    # standalone (model-free loopback fleet — no accelerator probe)
+    if len(sys.argv) > 1 and sys.argv[1] == "fleet_elastic":
+        print(json.dumps(bench_fleet_elastic()), flush=True)
         sys.exit(0)
     # `python bench.py migration`: the live-migration drain rung standalone
     # (tiny random-init model — runs on whatever backend jax resolves)

@@ -25,6 +25,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import math
 import random
 import sys
 import time
@@ -51,6 +52,10 @@ class TenantStats:
     errors: int = 0
     latencies_s: list = field(default_factory=list)
     finishes: list = field(default_factory=list)  # (t_done, tokens)
+    # timestamped twins for the per-window (diurnal profile) accounting
+    sent_ts: list = field(default_factory=list)       # t_sent
+    reject_events: list = field(default_factory=list)  # (t, error_kind)
+    error_ts: list = field(default_factory=list)       # t
 
     def summary(self, window_end: float | None = None) -> dict:
         lats = sorted(self.latencies_s)
@@ -104,13 +109,16 @@ async def _fire(session, base_url: str, t: TenantLoad, stats: TenantStats):
                     err = {}
                 kind = err.get("error_kind") or f"http_{r.status}"
                 stats.rejected[kind] = stats.rejected.get(kind, 0) + 1
+                stats.reject_events.append((time.perf_counter(), kind))
                 return
             if r.status != 200:
                 stats.errors += 1
+                stats.error_ts.append(time.perf_counter())
                 return
             result = await r.json()
     except Exception:  # noqa: BLE001 — a dropped socket is a data point
         stats.errors += 1
+        stats.error_ts.append(time.perf_counter())
         return
     t_done = time.perf_counter()
     stats.completed += 1
@@ -119,34 +127,130 @@ async def _fire(session, base_url: str, t: TenantLoad, stats: TenantStats):
     stats.finishes.append((t_done, float(result.get("tokens") or 0)))
 
 
+def profile_multiplier(profile: str, swing: float):
+    """Arrival-rate multiplier m(x) over normalized run time x∈[0,1]:
+    1.0 at both edges, `swing` at the peak — a compressed diurnal day.
+
+    - ``ramp``: linear climb to the peak at mid-run, linear fall back —
+      the classic morning-ramp/evening-decay shape, sharp at the peak;
+    - ``sine``: half-cosine day, smooth everywhere — no discontinuous
+      rate derivative for the controller to alias on.
+
+    The load shape the elastic fleet controller is validated against
+    (``bench.py fleet_elastic``): node count should FOLLOW m(x) with the
+    controller's hysteresis lag, and SLO fast-burn stay bounded across
+    the whole swing."""
+    if swing < 1.0:
+        raise ValueError(f"swing must be >= 1, got {swing}")
+    if profile == "ramp":
+        def m(x: float) -> float:
+            x = min(max(x, 0.0), 1.0)
+            up = x / 0.5 if x <= 0.5 else (1.0 - x) / 0.5
+            return 1.0 + (swing - 1.0) * up
+        return m
+    if profile == "sine":
+        def m(x: float) -> float:
+            x = min(max(x, 0.0), 1.0)
+            return 1.0 + (swing - 1.0) * 0.5 * (1.0 - math.cos(2 * math.pi * x))
+        return m
+    raise ValueError(f"unknown profile {profile!r} (ramp|sine)")
+
+
 async def _tenant_loop(session, base_url: str, t: TenantLoad,
-                       stats: TenantStats, until: float, tasks: set):
-    """Open loop: fire-and-track on an exponential arrival clock."""
+                       stats: TenantStats, until: float, tasks: set,
+                       rate_of=None):
+    """Open loop: fire-and-track on an exponential arrival clock.
+    ``rate_of(now) -> per-second rate`` modulates the clock (diurnal
+    profiles); None keeps the tenant's flat configured rate."""
     while time.perf_counter() < until:
+        now = time.perf_counter()
+        rate = rate_of(now) if rate_of is not None else t.rate_per_s
         stats.sent += 1
+        stats.sent_ts.append(now)
         task = asyncio.ensure_future(_fire(session, base_url, t, stats))
         tasks.add(task)
         task.add_done_callback(tasks.discard)
         # exponential inter-arrival around 1/rate — Poisson-ish traffic,
         # so bursts and gaps both happen (fixed spacing flatters WDRR)
-        await asyncio.sleep(random.expovariate(t.rate_per_s))
+        await asyncio.sleep(random.expovariate(max(rate, 1e-6)))
+
+
+def _window_report(all_stats: list[TenantStats], t_start: float,
+                   duration_s: float, window_s: float, rate_mult) -> list[dict]:
+    """Per-window accounting across every tenant: arrivals, IN-WINDOW
+    completions (by completion time — the drain after arrivals stop
+    must not flatter a saturated window), typed sheds by kind, errors.
+    The window grid is the controller-validation view: completion rate
+    tracking the offered curve with sheds bounded is the pass signal."""
+    n_windows = max(1, math.ceil(duration_s / window_s - 1e-9))
+    windows = []
+    for i in range(n_windows):
+        a = t_start + i * window_s
+        b = min(a + window_s, t_start + duration_s)
+        arrivals = completed = errors = 0
+        tokens = 0.0
+        shed: dict[str, int] = {}
+        lats: list[float] = []
+        for s in all_stats:
+            arrivals += sum(1 for ts in s.sent_ts if a <= ts < b)
+            for ts, n in s.finishes:
+                if a <= ts < b:
+                    completed += 1
+                    tokens += n
+            for ts, kind in s.reject_events:
+                if a <= ts < b:
+                    shed[kind] = shed.get(kind, 0) + 1
+            errors += sum(1 for ts in s.error_ts if a <= ts < b)
+        # offered multiplier at the window midpoint (exact enough for a
+        # window well under the profile period)
+        mid_x = ((a + b) / 2.0 - t_start) / duration_s
+        windows.append({
+            "window": i,
+            "t0_s": round(a - t_start, 3),
+            "t1_s": round(b - t_start, 3),
+            "offered_multiplier": round(rate_mult(mid_x), 3),
+            "arrivals": arrivals,
+            "completed_in_window": completed,
+            "completed_tokens_in_window": tokens,
+            "shed": shed,
+            "errors": errors,
+        })
+    return windows
 
 
 async def run_loadgen(base_url: str, tenants: list[TenantLoad],
                       duration_s: float = 10.0,
-                      drain_s: float = 30.0) -> dict:
+                      drain_s: float = 30.0,
+                      profile: str | None = None,
+                      swing: float = 10.0,
+                      window_s: float | None = None) -> dict:
     """Drive every tenant concurrently for duration_s, then wait (bounded)
-    for in-flight requests to drain; returns {tenant: summary}."""
+    for in-flight requests to drain; returns {tenant: summary}.
+
+    With ``profile`` ("ramp" | "sine") every tenant's arrival rate is
+    modulated by ``profile_multiplier`` — a compressed diurnal day
+    swinging 1x→``swing``x→1x over the run — and the report grows a
+    ``windows`` list with per-window arrival / in-window-completion /
+    typed-shed accounting (window width ``window_s``, default a 20th of
+    the run)."""
     import aiohttp
 
     base_url = base_url.rstrip("/")
     stats = {t.name: TenantStats() for t in tenants}
     inflight: set = set()
-    until = time.perf_counter() + duration_s
     t_start = time.perf_counter()
+    until = t_start + duration_s
+    mult = profile_multiplier(profile, swing) if profile else None
+
+    def rate_fn(t: TenantLoad):
+        if mult is None:
+            return None
+        return lambda now: t.rate_per_s * mult((now - t_start) / duration_s)
+
     async with aiohttp.ClientSession() as session:
         await asyncio.gather(*(
-            _tenant_loop(session, base_url, t, stats[t.name], until, inflight)
+            _tenant_loop(session, base_url, t, stats[t.name], until,
+                         inflight, rate_of=rate_fn(t))
             for t in tenants
         ))
         if inflight:
@@ -162,7 +266,14 @@ async def run_loadgen(base_url: str, tenants: list[TenantLoad],
             round(stats[t.name].completed_tokens / wall, 2) if wall > 0 else 0.0
         )
         out[t.name] = s
-    return {"wall_s": round(wall, 3), "window_s": duration_s, "tenants": out}
+    report = {"wall_s": round(wall, 3), "window_s": duration_s, "tenants": out}
+    if mult is not None:
+        report["profile"] = {"name": profile, "swing": swing}
+        report["windows"] = _window_report(
+            list(stats.values()), t_start, duration_s,
+            window_s or duration_s / 20.0, mult,
+        )
+    return report
 
 
 def _parse_tenant(spec: str) -> TenantLoad:
@@ -184,12 +295,22 @@ def main() -> int:
     ap.add_argument("--duration", type=float, default=10.0)
     ap.add_argument("--max-new-tokens", type=int, default=32)
     ap.add_argument("--prompt", default="loadgen: say hi")
+    ap.add_argument("--profile", choices=("ramp", "sine"), default=None,
+                    help="diurnal arrival shape: rates swing 1x→SWINGx→1x "
+                         "over the run, report gains per-window accounting")
+    ap.add_argument("--swing", type=float, default=10.0,
+                    help="peak/base arrival-rate ratio for --profile")
+    ap.add_argument("--window", type=float, default=None,
+                    help="accounting window seconds (default duration/20)")
     args = ap.parse_args()
     tenants = [_parse_tenant(s) for s in args.tenant] or [TenantLoad("default")]
     for t in tenants:
         t.max_new_tokens = args.max_new_tokens
         t.prompt = args.prompt
-    report = asyncio.run(run_loadgen(args.base_url, tenants, args.duration))
+    report = asyncio.run(run_loadgen(
+        args.base_url, tenants, args.duration,
+        profile=args.profile, swing=args.swing, window_s=args.window,
+    ))
     print(json.dumps(report, indent=2))
     return 0
 

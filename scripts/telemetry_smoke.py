@@ -280,6 +280,69 @@ async def run_drain_smoke() -> None:
         await a.stop()
 
 
+async def run_fleet_smoke() -> None:
+    """Elastic fleet controller leg (ISSUE 13): boot a 2-node loopback
+    fleet with one controller-enabled node, and assert the control loop
+    actually runs — the lease is claimed and visible on ``GET /fleet``
+    of BOTH nodes (holder agreement), and the controller journaled at
+    least one decision (a no-op on an idle fleet: the journal must show
+    WHY nothing happened, not sit empty)."""
+    import asyncio as aio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from bee2bee_tpu.api import build_app
+    from bee2bee_tpu.meshnet.node import P2PNode
+    from bee2bee_tpu.services.fake import FakeService
+
+    a = P2PNode(host="127.0.0.1", port=0, fleet_controller=True)
+    b = P2PNode(host="127.0.0.1", port=0)
+    clients: list = []
+    for n in (a, b):
+        n.ping_interval_s = 0.1
+        n.fleet.lease.ttl_s = 0.3
+    await a.start()
+    await b.start()
+    try:
+        a.add_service(FakeService("smoke-model", reply="fleet smoke ok"))
+        b.add_service(FakeService("smoke-model", reply="fleet smoke ok"))
+        assert await b.connect_bootstrap(a.addr), "bootstrap connect failed"
+        for _ in range(100):
+            if a.peers and b.peers:
+                break
+            await aio.sleep(0.05)
+        # the monitor loop (0.1 s cadence) claims the lease and journals
+        for _ in range(100):
+            if a.fleet.is_leader and any(
+                d["decision"] == "noop" for d in a.fleet.decisions
+            ):
+                break
+            await aio.sleep(0.05)
+        assert a.fleet.is_leader, "controller never claimed the lease"
+
+        for node in (a, b):
+            client = TestClient(TestServer(build_app(node)))
+            clients.append(client)
+            await client.start_server()
+            r = await client.get("/fleet")
+            assert r.status == 200, f"/fleet returned {r.status}"
+            st = await r.json()
+            assert st["lease"] and st["lease"]["holder"] == a.peer_id, (
+                f"{node.peer_id}'s /fleet lease view is {st['lease']!r}, "
+                f"expected holder {a.peer_id}"
+            )
+        st = await (await clients[0].get("/fleet")).json()
+        assert st["is_leader"] is True
+        noops = [d for d in st["decisions"] if d["decision"] == "noop"]
+        assert noops, f"no journaled no-op decision: {st['decisions']!r}"
+        assert noops[-1]["reason"], "a decision without a reason is noise"
+    finally:
+        for client in clients:
+            await client.close()
+        await b.stop()
+        await a.stop()
+
+
 async def run_pipeline_smoke() -> None:
     """2-stage pipeline leg (ISSUE 10): decode through the interleaved
     session, then assert the bubble observability surface — worker-side
@@ -361,6 +424,7 @@ def main() -> int:
         asyncio.run(run_smoke())
         asyncio.run(run_mesh_health_smoke())
         asyncio.run(run_drain_smoke())
+        asyncio.run(run_fleet_smoke())
         asyncio.run(run_pipeline_smoke())
     except AssertionError as e:
         print(f"[telemetry-smoke] FAIL: {e}", file=sys.stderr)

@@ -29,25 +29,85 @@ try:  # pragma: no cover - environment-dependent
 
     import jax
 
-    _cache_dir = os.environ.get("BEE2BEE_JAX_CACHE")
-    if not _cache_dir:
-        _cache_dir = tempfile.mkdtemp(prefix="bee2bee_jax_cache_")
-        atexit.register(shutil.rmtree, _cache_dir, ignore_errors=True)
-    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    _cache_base = os.environ.get("BEE2BEE_JAX_CACHE")
+    _CACHE_PINNED = bool(_cache_base)
+    if not _cache_base:
+        _cache_base = tempfile.mkdtemp(prefix="bee2bee_jax_cache_")
+        atexit.register(shutil.rmtree, _cache_base, ignore_errors=True)
+    jax.config.update("jax_compilation_cache_dir", _cache_base)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
     try:
         jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
     except Exception:
         pass  # older jax: flag absent, executables still cached
+
+    # Quarantine of the pre-existing XLA segfault (CHANGES.md PR 12
+    # note): ~545 tests into a tier-1 run this container died at rc=139
+    # inside backend.deserialize_executable. Rotating only the DISK
+    # cache moved the crash into backend_compile at the same aged-
+    # process point — so deserialization was a symptom; the trigger is
+    # XLA work in a process aged into hundreds of live executables
+    # (the crashing file passes standalone either way). Guards:
+    # - default: per test module, the persistent cache dir ROTATES (an
+    #   entry is only ever read by the file that wrote it) AND the
+    #   in-process jit/executable caches are CLEARED (fixture below) —
+    #   the process never ages past one file's worth of XLA state,
+    #   while within-file engine reuse (a file's engines share one
+    #   config — the dominant win) survives. Measured: 574 dots, zero
+    #   F, no crash at the 870s cap vs 543-then-rc=139 before.
+    # - BEE2BEE_JAX_CACHE_NO_DESERIALIZE=1 additionally disables cache
+    #   READS outright (writes continue, so pinned BEE2BEE_JAX_CACHE
+    #   dirs still warm up) — the belt-and-suspenders escape hatch.
+    # jax._src.compilation_cache is PRIVATE API — its own try, so a jax
+    # upgrade that moves it degrades only the quarantine (no rotation,
+    # no read-disable), never the public persistent-cache setup above
+    try:
+        from jax._src import compilation_cache as _jax_cc
+
+        if os.environ.get("BEE2BEE_JAX_CACHE_NO_DESERIALIZE"):
+            _jax_cc.get_executable_and_time = (
+                lambda *a, **kw: (None, None)
+            )
+    except Exception:
+        _jax_cc = None
 except Exception:
-    pass
+    _jax_cc = None
+    _CACHE_PINNED = True  # unknown cache state: never rotate blindly
 
 
 # files whose tests deliberately break things (killed peers, black-holed
 # stages): an introduced hang here must fail THAT test, not eat the whole
 # tier-1 wall-clock budget. The cap is ini-configurable (chaos_test_timeout)
 # and per-test overridable via @pytest.mark.async_timeout(seconds).
-_CHAOS_FILES = ("test_chaos", "test_failover", "test_pipeline_interleave")
+_CHAOS_FILES = (
+    "test_chaos", "test_failover", "test_pipeline_interleave", "test_fleet",
+)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _fresh_jax_cache_per_module():
+    """Per-FILE jax state rotation (see the quarantine note above):
+    the persistent cache dir rotates so no entry outlives its writer's
+    module, and the IN-PROCESS jit/executable caches are cleared so the
+    process never ages into the hundreds-of-live-executables state the
+    segfault needs — within-file reuse (a file's engines share one
+    config) survives both. A pinned BEE2BEE_JAX_CACHE opts out of the
+    dir rotation — the operator asked for cross-run sharing."""
+    if _jax_cc is None or _CACHE_PINNED:
+        yield
+        return
+    import gc
+    import tempfile as _tf
+
+    d = _tf.mkdtemp(prefix="mod_", dir=_cache_base)
+    try:
+        gc.collect()  # release dead engines' executables first
+        jax.clear_caches()
+        _jax_cc.set_cache_dir(d)
+        _jax_cc.reset_cache()
+    except Exception:
+        pass
+    yield
 
 
 def pytest_addoption(parser):

@@ -16,6 +16,8 @@ from bee2bee_tpu.health import (
     HealthStore,
     SloTracker,
     build_digest,
+    controller_aggregates,
+    digest_slo_burn,
     fleet_view,
     load_slo_config,
     parse_slo_config,
@@ -107,6 +109,67 @@ def test_health_store_staleness_excludes_from_fresh_and_aggregates():
     # aggregates exclude the stale peer's 90 tokens
     assert view["aggregate"]["tokens_generated_total"] == 16.0
     assert view["aggregate"]["nodes"] == 2
+
+
+_BURNING_DIGEST = {
+    "slo": {"ttft_p95": {"status": "burning", "burn_fast": 9.0,
+                         "burn_slow": 2.0}},
+    "gauge": {"engine.batch_fill": 0.9},
+}
+
+
+def test_controller_aggregates_stale_digest_cannot_trigger_scale():
+    """The controller's input contract (fleet/controller.py reads
+    ``{local} + store.fresh()``): a peer that stopped gossiping drops
+    out of the aggregates BEFORE its last (burning) reading can sustain
+    a scale decision — a dead node is not demand."""
+    store = HealthStore(ttl_s=0.05)
+    store.update("peer-live", dict(_BURNING_DIGEST))
+    store.update("peer-gone", dict(_BURNING_DIGEST))
+    agg = controller_aggregates({"me": {}, **store.fresh()})
+    assert agg["eligible"] == 3 and agg["burning"] == 2
+
+    time.sleep(0.06)
+    store.update("peer-live", dict(_BURNING_DIGEST))
+    agg = controller_aggregates({"me": {}, **store.fresh()})
+    assert agg["nodes"] == 2  # the stale peer is GONE, not bucketed
+    assert agg["eligible"] == 2
+    assert agg["burning"] == 1 and agg["burning_ids"] == ["peer-live"]
+    # and the /mesh/health twin shows the same fleet block
+    view = fleet_view("me", {}, store)
+    assert view["aggregate"]["fleet"]["burning"] == 1
+
+
+def test_controller_aggregates_draining_excluded_from_headroom():
+    """A draining peer's emptying batch reads as fake headroom exactly
+    while the fleet is losing that replica — it must contribute to NO
+    headroom signal (and a burning draining peer must not count toward
+    the scale-out quorum either: its burn leaves with it)."""
+    digests = {
+        "live-a": {"gauge": {"engine.batch_fill": 0.8},
+                   "hist": {"engine.queue_wait_ms": {"p95": 120.0}}},
+        "live-b": {"gauge": {"engine.batch_fill": 0.6}},
+        "leaving": {"draining": True, **_BURNING_DIGEST},
+    }
+    agg = controller_aggregates(digests)
+    assert agg["eligible"] == 2 and agg["draining"] == ["leaving"]
+    # fill_mean over the ELIGIBLE two only — the drainer's 0.9 (or an
+    # emptied 0.0) never enters
+    assert agg["fill_mean"] == pytest.approx(0.7)
+    assert agg["queue_p95_max"] == 120.0
+    assert agg["burning"] == 0  # the drainer's burn left with it
+    assert agg["burning_frac"] == 0.0
+
+
+def test_digest_slo_burn_parses_briefs_defensively():
+    assert digest_slo_burn(None) == (0.0, False)
+    assert digest_slo_burn({"slo": "junk"}) == (0.0, False)
+    burn, burning = digest_slo_burn({
+        "slo": {"a": {"status": "ok", "burn_fast": 0.5},
+                "b": {"status": "tripped", "burn_fast": "12.5"},
+                "c": "garbage"},
+    })
+    assert burn == 12.5 and burning is True
 
 
 def test_stale_peer_series_drop_out_of_prom_exposition():

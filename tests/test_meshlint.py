@@ -132,6 +132,47 @@ def test_frames_pass_out_of_scope_paths_unchecked():
     assert analyze_source(src, "engine/fixture.py") == []
 
 
+def test_frames_pass_fleet_frames_declared_and_checked():
+    """ISSUE 13 CI satellite: the fleet control frames are registry-
+    declared, the fleet/ package is in the frames-pass scope, and the
+    known-bad fixture proves each bug class is caught there."""
+    for op in (protocol.FLEET_LEASE, protocol.FLEET_ACTION, protocol.FLEET_ACK):
+        assert op in FRAME_SCHEMAS, f"{op} missing from the schema registry"
+    assert "holder" in FRAME_SCHEMAS[protocol.FLEET_LEASE].required
+    assert "epoch" in FRAME_SCHEMAS[protocol.FLEET_ACTION].required
+    src = '''
+from .. import protocol
+
+async def announce(node, ws, rid):
+    await ws.send(protocol.encode(protocol.msg(
+        protocol.FLEET_LEASE, holder=node.peer_id, epoch=1, ttl=30.0)))
+    await ws.send(protocol.encode(protocol.msg(
+        protocol.FLEET_ACTION, rid=rid, action="drain", epoch=2)))
+
+async def _handle_fleet_ack(ws, data):
+    return data.get("okk")
+'''
+    rules = _rules(analyze_source(src, "fleet/fixture.py"))
+    assert "ML-F001" in rules  # `ttl` is not a declared lease key (ttl_s is)
+    assert "ML-F002" in rules  # lease missing ttl_s / action missing holder
+    assert "ML-F003" in rules  # read of undeclared "okk"
+    # the same constructions built right are clean
+    good = '''
+from .. import protocol
+
+async def announce(node, ws, rid):
+    await ws.send(protocol.encode(protocol.msg(
+        protocol.FLEET_LEASE, holder=node.peer_id, epoch=1, ttl_s=30.0)))
+    await ws.send(protocol.encode(protocol.msg(
+        protocol.FLEET_ACTION, rid=rid, action="drain", epoch=2,
+        holder=node.peer_id)))
+
+async def _handle_fleet_ack(ws, data):
+    return data.get("ok")
+'''
+    assert analyze_source(good, "fleet/fixture.py") == []
+
+
 # -------------------------------------------------------- async pass fixtures
 
 
