@@ -175,7 +175,9 @@ class FleetController:
         }
         self._action: dict | None = None
         self._action_task: asyncio.Task | None = None
-        self._acks: dict[str, asyncio.Future] = {}
+        # rid → (target peer, ws the action went out on, future): the
+        # ack is only accepted from the addressed peer (see on_ack)
+        self._acks: dict[str, tuple[str, object, asyncio.Future]] = {}
         self._burn_streak = 0
         self._headroom_streak = 0
         self._last_out = float("-inf")
@@ -184,12 +186,42 @@ class FleetController:
 
     # ------------------------------------------------------- frame handlers
 
+    @staticmethod
+    def _advertises_controller(digest) -> bool:
+        """THE controller-eligibility predicate — shared by the frame
+        authorization gate (_controller_sender) and the takeover
+        ranking (_claim_rank): the set a target obeys must be exactly
+        the set that competes for the lease."""
+        return bool(isinstance(digest, dict) and digest.get("fleet_controller"))
+
+    def _controller_sender(self, pid: str) -> bool:
+        """May this peer speak for the control plane at all? Leadership
+        is restricted to controller-ELIGIBLE nodes (they advertise
+        ``fleet_controller`` in their gossiped digest — the same set
+        _claim_rank ranks), so a plain serving peer cannot claim a
+        reign or command replicas no matter what epoch it invents. The
+        mesh has no cryptographic identities — a peer that falsely
+        advertises eligibility can still compete (Byzantine peers are
+        out of scope) — but the bar matches the takeover protocol's own."""
+        return self._advertises_controller(self.node.health.fresh().get(pid))
+
     async def on_lease(self, ws, data: dict) -> None:
         """FLEET_LEASE from a peer. Identity comes from the CONNECTION
         (like telemetry gossip): a peer can only claim the lease for
-        itself, never forge another node's reign."""
+        itself, never forge another node's reign — and only a
+        controller-eligible peer's claim counts at all."""
         pid = await self.node._peer_for(ws)
         if pid is None or data.get("holder") != pid:
+            return
+        if not self._controller_sender(pid):
+            # benign on first contact (the lease broadcast can beat the
+            # sender's first telemetry frame by one gossip round), but
+            # an operator chasing "why does this node ignore the
+            # leader" needs the drop to be visible
+            logger.debug(
+                "lease claim from %s dropped: no fresh controller-"
+                "eligible digest for the sender yet", pid,
+            )
             return
         view = self.lease.observe(data)
         if (
@@ -209,6 +241,22 @@ class FleetController:
         node = self.node
         rid = data.get("rid")
         act = data.get("action")
+        # identity comes from the CONNECTION, exactly like on_lease: the
+        # leader always issues its own actions over its own link, so a
+        # frame whose claimed holder is not the sending peer is a forgery.
+        # Drop it before lease.observe — a forged (holder, epoch) would
+        # otherwise both command this node and poison its epoch floor.
+        pid = await self.node._peer_for(ws)
+        if pid is None or data.get("holder") != pid:
+            return
+        # and only a controller-ELIGIBLE peer may command at all: a
+        # serving peer self-claiming an invented high epoch under its
+        # own (connection-verified) identity must not drain the fleet
+        # either. Typed nack — the refusal should be debuggable at the
+        # sender, unlike the silent forgery drop above.
+        if not self._controller_sender(pid):
+            await self._ack(ws, rid, ok=False, error="not_controller")
+            return
         if not self.lease.authorizes(data.get("holder"), data.get("epoch")):
             await self._ack(ws, rid, ok=False, error="stale_epoch")
             return
@@ -256,9 +304,23 @@ class FleetController:
             logger.exception("fleet action %s failed", act)
             await self._ack(ws, rid, ok=False, error=str(e))
 
-    def on_ack(self, data: dict) -> None:
-        fut = self._acks.get(data.get("rid"))
-        if fut is not None and not fut.done():
+    async def on_ack(self, ws, data: dict) -> None:
+        entry = self._acks.get(data.get("rid"))
+        if entry is None:
+            return
+        target, sent_ws, fut = entry
+        # the ack must come from the peer the action was addressed to —
+        # a peer that learns (or guesses) a rid cannot forge another
+        # node's completion. The EXACT connection the action went out on
+        # also counts: a mid-action hello rebind (dual-dial convergence)
+        # re-registers the target onto a new ws while its genuine ack
+        # rides the old link, and a completed drain booked as refused
+        # would be worse than the (already-flagged) rebind itself.
+        if ws is not sent_ws:
+            pid = await self.node._peer_for(ws)
+            if pid != target:
+                return
+        if not fut.done():
             fut.set_result({k: v for k, v in data.items() if k != "type"})
 
     async def _ack(self, ws, rid, ok: bool, error: str | None = None,
@@ -281,7 +343,7 @@ class FleetController:
             return {"ok": False, "error": f"peer {target} unknown"}
         rid = new_id("fla")
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._acks[rid] = fut
+        self._acks[rid] = (target, info["ws"], fut)
         try:
             await self.node._send(info["ws"], protocol.msg(
                 protocol.FLEET_ACTION,
@@ -383,7 +445,7 @@ class FleetController:
         id — the deterministic takeover order."""
         pids = {self.node.peer_id}
         for pid, d in self.node.health.fresh().items():
-            if isinstance(d, dict) and d.get("fleet_controller"):
+            if self._advertises_controller(d):
                 pids.add(pid)
         return sorted(pids).index(self.node.peer_id)
 

@@ -27,7 +27,10 @@ nodes (they advertise ``fleet_controller`` in their telemetry digest)
 rank themselves by peer id, and rank *i* waits ``i * stagger`` past the
 lapse before claiming — so the deterministic first claimant is the
 smallest live peer id, and collisions (rank-0 died too) resolve by the
-ordering above anyway.
+ordering above anyway. A keeper that has never observed ANY lease
+additionally waits one full TTL of boot grace before the void counts
+as a lapse, so a freshly joined node cannot usurp a live incumbent it
+simply hasn't heard yet.
 """
 
 from __future__ import annotations
@@ -93,8 +96,36 @@ class LeaseKeeper:
         self._view: LeaseView | None = None
         self.highest_epoch = 0
         # when the CURRENT view lapsed (or the keeper booted with none):
-        # the takeover stagger counts from here
+        # the takeover stagger counts from here. While NO lease has ever
+        # been observed, lapsed_for adds one full TTL of boot grace on
+        # top (see there) so a fresh node cannot claim before the
+        # incumbent's gossip has had a chance to arrive.
         self._lapse_started: float = time.time()
+        # first-election deferral bound: set by the first
+        # reset_boot_grace (node start) — see there
+        self._grace_cap: float | None = None
+
+    def reset_boot_grace(self, now: float | None = None) -> None:
+        """Re-anchor the boot grace at the moment the node actually
+        joins the mesh — called from P2PNode.start AND from every first
+        contact with a new peer: construction→start can take longer
+        than a TTL (first jit compile), and a bootstrap dial that
+        stalls past one TTL after start() would otherwise silently
+        consume the grace too — either way re-opening the
+        fresh-joiner-usurps-live-incumbent window. No-op once any lease
+        has been observed. The total deferral is CAPPED at three TTLs
+        past the first anchor (node start): a rolling bootstrap — or a
+        crash-looping peer minting a fresh random id per restart —
+        keeps re-anchoring, and an unbounded grace would leave the
+        fleet leaderless forever."""
+        if self._view is not None:
+            return
+        now = time.time() if now is None else now
+        if self._grace_cap is None:
+            # grace END = _lapse_started + ttl, so capping the anchor
+            # at start + 2*ttl bounds the first claim to start + 3*ttl
+            self._grace_cap = now + 2.0 * self.ttl_s
+        self._lapse_started = min(now, self._grace_cap)
 
     # ------------------------------------------------------------ observe
 
@@ -154,11 +185,19 @@ class LeaseKeeper:
         return None
 
     def lapsed_for(self, now: float | None = None) -> float | None:
-        """Seconds since the lease lapsed; None while one is fresh."""
+        """Seconds since the lease lapsed; None while one is fresh —
+        or while the BOOT GRACE runs: a keeper that has never observed
+        any lease waits out one full TTL of silence before the void
+        counts as a lapse. Without it a freshly booted claimant ranks
+        itself on an empty view and can usurp a live incumbent (same
+        epoch, smaller peer id) whose gossip simply hasn't arrived yet."""
         now = time.time() if now is None else now
         if self.current(now) is not None:
             return None
-        return max(0.0, now - self._lapse_started)
+        start = self._lapse_started
+        if self._view is None:
+            start += self.ttl_s
+        return now - start if now >= start else None
 
     def authorizes(self, holder: str, epoch: int, now: float | None = None) -> bool:
         """May (holder, epoch) command this node right now?

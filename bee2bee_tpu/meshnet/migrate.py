@@ -907,8 +907,15 @@ class MigrationManager:
                 **({"error_kind": error_kind} if error_kind else {}),
             ))
 
-    def handle_ack(self, data: dict) -> None:
-        fut = self._acks.get(data.get("rid"))
+    def handle_ack(self, ws, data: dict) -> None:
+        rid = data.get("rid")
+        # the verdict must ride the connection the export went out on
+        # (the target acks over the link the KV_EXPORT arrived from) —
+        # a peer that learns or guesses a rid can neither fail a healthy
+        # import nor fake one that never landed (fleet on_ack discipline)
+        if ws is not self._rid_ws.get(rid):
+            return
+        fut = self._acks.get(rid)
         if fut is not None and not fut.done():
             fut.set_result({k: v for k, v in data.items() if k != "type"})
 

@@ -210,6 +210,9 @@ class P2PNode(StageTaskMixin):
             )
         self.disagg_role = role
         self.migration = MigrationManager(self)
+        # peer ids EVER greeted (never pruned — only their first hello
+        # re-anchors the lease boot grace, see _handle_hello)
+        self._greeted: set[str] = set()
         # elastic fleet control (fleet/): lease bookkeeping + the
         # epoch-gated action handler live on EVERY node; only enabled
         # controllers compete for the lease and run the decision loop
@@ -339,6 +342,9 @@ class P2PNode(StageTaskMixin):
         if self.port == 0:  # resolve ephemeral port
             self.port = next(iter(self._server.sockets)).getsockname()[1]
         self.started_at = time.time()
+        # the lease boot grace counts from JOINING the mesh, not from
+        # construction — a slow build (first jit compile) must not eat it
+        self.fleet.lease.reset_boot_grace(self.started_at)
         self._tasks.append(asyncio.create_task(self._monitor_loop()))
         logger.info("node %s listening on %s", self.peer_id, self.addr)
         return self
@@ -646,7 +652,20 @@ class P2PNode(StageTaskMixin):
             return
         known = False
         async with self._lock:
-            known = pid in self.peers
+            prev = self.peers.get(pid)
+            known = prev is not None
+            # identity is HELLO-claimed, not cryptographic: a hello that
+            # rebinds a peer id away from a LIVE connection is either a
+            # simultaneous dual-dial converging or an impersonation
+            # attempt (docs/ROBUSTNESS.md scopes the fleet control
+            # plane's guarantees to this identity model) — allow it for
+            # the former, but never silently
+            live_rebind = (
+                prev is not None
+                and prev.get("ws") is not ws
+                and time.time() - prev.get("last_seen", 0.0)
+                <= 3 * self.ping_interval_s
+            )
             self.peers[pid] = {
                 "ws": ws,
                 "addr": data.get("addr"),
@@ -659,13 +678,34 @@ class P2PNode(StageTaskMixin):
                 "accepts_stages": bool(data.get("accepts_stages")),
                 "health": "online",
                 "last_seen": time.time(),
-                "rtt_ms": self.peers.get(pid, {}).get("rtt_ms"),
+                "rtt_ms": prev.get("rtt_ms") if prev else None,
             }
             services = data.get("services") or {}
             if services:
                 self.providers.setdefault(pid, {}).update(services)
             peer_addrs = [p["addr"] for p in self.peers.values() if p.get("addr")]
+        if live_rebind:
+            logger.warning(
+                "hello rebinds %s away from a live connection", pid
+            )
+            self.recorder.incident(
+                "mesh:identity_rebind",
+                detail=f"hello re-registered {pid} over a new connection "
+                       "while its previous link was live",
+                node=self.peer_id,
+            )
         if not known:
+            if pid not in self._greeted:
+                # first contact with a NEVER-seen peer re-anchors the
+                # lease boot grace (while no lease has ever been
+                # observed): a node whose bootstrap dial stalled past
+                # one TTL after start() must not claim the instant it
+                # finally joins — it owes the incumbent's gossip one
+                # full TTL of listening first. The ever-greeted set
+                # keeps a flapping link (drop + re-hello faster than
+                # one TTL) from deferring the first election forever.
+                self._greeted.add(pid)
+                self.fleet.lease.reset_boot_grace()
             await self._send(ws, self._hello_msg())
             await self._send(ws, protocol.msg(protocol.PEER_LIST, peers=peer_addrs))
 
@@ -1328,7 +1368,7 @@ class P2PNode(StageTaskMixin):
         await self.migration.handle_blocks(ws, data)
 
     async def _handle_kv_import_ack(self, ws, data):
-        self.migration.handle_ack(data)
+        self.migration.handle_ack(ws, data)
 
     async def begin_drain(self, stop: bool = False, wait: bool = True,
                           source: str = "operator") -> dict:
@@ -1355,7 +1395,7 @@ class P2PNode(StageTaskMixin):
         await self.fleet.on_action(ws, data)
 
     async def _handle_fleet_ack(self, ws, data):
-        self.fleet.on_ack(data)
+        await self.fleet.on_ack(ws, data)
 
     # ------------------------------------------------------------ pieces
 

@@ -25,6 +25,7 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import json
+import time
 
 import pytest
 
@@ -186,6 +187,25 @@ class _CaptureWs:
 
     async def send(self, raw):
         self.sent.append(json.loads(raw))
+
+
+def _fake_peer(node, pid: str, controller: bool = False) -> _CaptureWs:
+    """Register a capture ws as a live peer connection — fleet frame
+    handlers resolve identity via node._peer_for(ws), so action/ack
+    tests must speak from a REGISTERED connection. With ``controller``
+    the peer also advertises fleet_controller in a fresh digest (the
+    eligibility gate lease/action frames are vetted against)."""
+    ws = _CaptureWs()
+    node.peers[pid] = {"ws": ws, "addr": None, "last_seen": time.time()}
+    if controller:
+        node.health.update(pid, {"fleet_controller": True})
+    return ws
+
+
+def _acks(ws: _CaptureWs) -> list[dict]:
+    """The fleet_ack frames the node wrote at ws (the monitor loop also
+    pings registered peers — filter those out)."""
+    return [f for f in ws.sent if f.get("type") == "fleet_ack"]
 
 
 # ------------------------------------------------------------- lease units
@@ -522,21 +542,155 @@ async def test_stale_epoch_action_is_refused():
         b.fleet.lease.observe(
             {"holder": "node-000leader", "epoch": 5, "ttl_s": 30.0}
         )
-        ws = _CaptureWs()
-        await b.fleet.on_action(ws, {
+        stale_ws = _fake_peer(b, "node-zzz-stale", controller=True)
+        leader_ws = _fake_peer(b, "node-000leader", controller=True)
+        await b.fleet.on_action(stale_ws, {
             "rid": "r1", "action": "drain", "epoch": 4,
             "holder": "node-zzz-stale",
         })
-        assert ws.sent and ws.sent[0]["type"] == "fleet_ack"
-        assert ws.sent[0]["ok"] is False
-        assert ws.sent[0]["error"] == "stale_epoch"
+        acks = _acks(stale_ws)
+        assert acks and acks[0]["ok"] is False
+        assert acks[0]["error"] == "stale_epoch"
         assert b.draining is False  # the stale command changed nothing
         # the rightful holder's command lands
-        await b.fleet.on_action(ws, {
+        await b.fleet.on_action(leader_ws, {
             "rid": "r2", "action": "drain", "epoch": 5,
             "holder": "node-000leader",
         })
-        assert ws.sent[-1]["ok"] is True and b.draining is True
+        assert _acks(leader_ws)[-1]["ok"] is True and b.draining is True
+
+
+@pytest.mark.async_timeout(120)
+async def test_forged_holder_action_is_dropped():
+    """A connected peer that copies the gossiped leader identity (with
+    an arbitrarily high epoch) must neither command the node nor poison
+    its lease view / epoch floor: on_action binds the claimed holder to
+    the sending connection, exactly like on_lease."""
+    async with _fleet(controllers=0, actives=1) as (nodes, _c, acts, _s):
+        b = acts[0]
+        b.fleet.lease.observe(
+            {"holder": "node-000leader", "epoch": 5, "ttl_s": 30.0}
+        )
+        # evil IS controller-eligible here, so this pins the holder
+        # binding specifically (eligibility alone would not save us)
+        evil_ws = _fake_peer(b, "node-evil", controller=True)
+        await b.fleet.on_action(evil_ws, {
+            "rid": "rf", "action": "drain", "epoch": 10_000,
+            "holder": "node-000leader",
+        })
+        # dropped silently: no ack, no drain, no epoch-floor bump —
+        # the rightful leader's reign stays intact
+        assert not _acks(evil_ws)
+        assert b.draining is False
+        assert b.fleet.lease.highest_epoch == 5
+        cur = b.fleet.lease.current()
+        assert cur is not None and cur.holder == "node-000leader"
+        # a connection that is not a known peer at all is dropped too
+        await b.fleet.on_action(_CaptureWs(), {
+            "rid": "rg", "action": "drain", "epoch": 5,
+            "holder": "node-000leader",
+        })
+        assert b.draining is False
+
+
+@pytest.mark.async_timeout(120)
+async def test_non_controller_self_claim_is_refused():
+    """Connection binding alone is not enough: a plain serving peer
+    self-claiming an invented high epoch under its OWN identity must
+    not command the node either — lease and action frames only count
+    from peers whose fresh digest advertises fleet_controller."""
+    async with _fleet(controllers=0, actives=1) as (nodes, _c, acts, _s):
+        b = acts[0]
+        b.fleet.lease.observe(
+            {"holder": "node-000leader", "epoch": 5, "ttl_s": 30.0}
+        )
+        rogue_ws = _fake_peer(b, "node-rogue")  # NOT controller-eligible
+        await b.fleet.on_action(rogue_ws, {
+            "rid": "rr", "action": "drain", "epoch": 10_000,
+            "holder": "node-rogue",
+        })
+        acks = _acks(rogue_ws)
+        assert acks and acks[0]["ok"] is False
+        assert acks[0]["error"] == "not_controller"
+        assert b.draining is False
+        assert b.fleet.lease.highest_epoch == 5  # floor unpoisoned
+        # its lease claims are dropped too — the recognized reign and
+        # the epoch floor both stay with the rightful leader
+        await b.fleet.on_lease(rogue_ws, {
+            "holder": "node-rogue", "epoch": 10_000, "ttl_s": 30.0,
+        })
+        cur = b.fleet.lease.current()
+        assert cur is not None and cur.holder == "node-000leader"
+        assert b.fleet.lease.highest_epoch == 5
+
+
+@pytest.mark.async_timeout(120)
+async def test_forged_ack_is_ignored():
+    """A FLEET_ACK only completes an action when it arrives over the
+    connection the action went out on — another peer replaying the rid
+    cannot fake a drain/activate completion."""
+    async with _fleet(controllers=0, actives=1) as (nodes, _c, acts, _s):
+        b = acts[0]
+        target_ws = _fake_peer(b, "node-target")
+        evil_ws = _fake_peer(b, "node-evil")
+        task = asyncio.create_task(
+            b.fleet.send_action("node-target", "undrain", timeout=5.0)
+        )
+        assert await _settle(lambda: bool(b.fleet._acks), timeout=2)
+        rid = next(iter(b.fleet._acks))
+        await b.fleet.on_ack(evil_ws, {"rid": rid, "ok": True})
+        _, _, fut = b.fleet._acks[rid]
+        assert not fut.done()  # the forged ack changed nothing
+        await b.fleet.on_ack(target_ws, {"rid": rid, "ok": True})
+        ack = await task
+        assert ack["ok"] is True
+
+
+def test_lease_keeper_boot_grace_before_first_claim():
+    k = LeaseKeeper(ttl_s=10.0)
+    k._lapse_started = 100.0  # the boot instant, on the fake clock
+    # nothing ever observed: one full TTL of silence must pass before
+    # the void counts as a lapse, so a freshly booted node cannot claim
+    # (and usurp a live incumbent) before the incumbent's gossip arrives
+    assert k.lapsed_for(now=100.0) is None
+    assert k.lapsed_for(now=109.9) is None
+    assert k.lapsed_for(now=112.0) == pytest.approx(2.0)
+    # once a lease HAS been observed the grace never applies again:
+    # lapse counts straight from the TTL expiry
+    k.observe({"holder": "node-a", "epoch": 1, "ttl_s": 10.0}, now=112.0)
+    assert k.lapsed_for(now=121.0) is None
+    assert k.lapsed_for(now=124.0) == pytest.approx(2.0)
+
+
+def test_lease_boot_grace_re_anchors_at_mesh_join():
+    # construction→start can take longer than a TTL (first jit compile,
+    # retried bootstrap): node.start() re-anchors the grace so it is
+    # not silently consumed before the node has even joined the mesh
+    k = LeaseKeeper(ttl_s=10.0)
+    k._lapse_started = 50.0  # constructed long ago on the fake clock
+    assert k.lapsed_for(now=100.0) == pytest.approx(40.0)  # grace eaten
+    k.reset_boot_grace(now=100.0)  # the node actually starts here
+    assert k.lapsed_for(now=105.0) is None
+    assert k.lapsed_for(now=112.0) == pytest.approx(2.0)
+    # once a lease is held, re-anchoring is a no-op (restarting the
+    # monitor loop must not erase a known reign's lapse bookkeeping)
+    k.observe({"holder": "node-a", "epoch": 1, "ttl_s": 10.0}, now=112.0)
+    k.reset_boot_grace(now=500.0)
+    assert k.current(now=113.0) is not None
+
+
+def test_lease_boot_grace_deferral_is_capped():
+    # a rolling bootstrap — or a crash-looping peer minting a fresh
+    # random id per restart — keeps re-anchoring the grace on every
+    # first contact; the first claim must still be bounded (three TTLs
+    # past the first anchor), or the fleet stays leaderless forever
+    k = LeaseKeeper(ttl_s=10.0)
+    k.reset_boot_grace(now=100.0)  # node start: anchor cap = 120
+    for t in (109.0, 118.0, 127.0, 136.0):  # endless fresh peer ids
+        k.reset_boot_grace(now=t)
+    # the anchor clamps at 120 → the grace ends at 130, not at 146
+    assert k.lapsed_for(now=129.0) is None
+    assert k.lapsed_for(now=132.0) == pytest.approx(2.0)
 
 
 @pytest.mark.async_timeout(180)
