@@ -85,6 +85,10 @@ def _apply_common_cfg(cfg, kw):
         cfg.paged = True
     if kw.get("spec_tokens") is not None:
         cfg.spec_tokens = kw["spec_tokens"]
+    if kw.get("adapters"):
+        cfg.adapters = kw["adapters"]
+    if kw.get("max_adapters") is not None:
+        cfg.max_adapters = kw["max_adapters"]
     return cfg
 
 
@@ -178,6 +182,16 @@ def cli():
                    "step by n-gram lookup over the request's own "
                    "prompt+output and verify them in one batched forward "
                    "(greedy rows; BEE2BEE_SPEC; 0 = off)")
+@click.option("--adapters", default=None,
+              help="batched multi-LoRA serving: comma-separated "
+                   "name=path.npz adapters preloaded into the hot-swap "
+                   "pool and published on the DHT — clients select one "
+                   "via model='<base>:<name>' on /v1 (BEE2BEE_ADAPTERS; "
+                   "composes with on-demand mesh paging)")
+@click.option("--max-adapters", "max_adapters", type=int, default=None,
+              help="adapter pool slots (BEE2BEE_MAX_ADAPTERS; --adapters "
+                   "implies 8). Non-resident adapters page in from mesh "
+                   "peers, LRU-evicting cold ones — no restart")
 @click.option("--publish-weights", is_flag=True,
               help="announce this node's params as DHT pieces for joiners")
 @click.option("--from-mesh", is_flag=True,
@@ -185,12 +199,13 @@ def cli():
                    "(zero local checkpoint)")
 @_common_opts
 def serve_tpu(model, checkpoint, lora, mesh_shape, attention, quantize,
-              kv_quant, paged, spec_tokens, publish_weights, from_mesh, **kw):
+              kv_quant, paged, spec_tokens, adapters, max_adapters,
+              publish_weights, from_mesh, **kw):
     """Serve a model on TPU via the jit engine (the flagship entrypoint)."""
     _serve(
         "tpu", model, checkpoint=checkpoint, lora=lora, mesh_shape=mesh_shape,
         attention=attention, quantize=quantize, kv_quant=kv_quant, paged=paged,
-        spec_tokens=spec_tokens,
+        spec_tokens=spec_tokens, adapters=adapters, max_adapters=max_adapters,
         publish_weights=publish_weights, from_mesh=from_mesh, **kw
     )
 

@@ -1,0 +1,348 @@
+"""AdapterPool: N hot-swappable LoRA adapters resident over ONE base model.
+
+The multi-tenant serving core (ROADMAP item 1): instead of merging one
+adapter into the base weights at load (`serve-tpu --lora`, which bakes a
+single tenant into the engine), the pool keeps every adapter's low-rank
+A/B factors stacked on device —
+
+    {target: {"a": [L, N+1, din, r], "b": [L, N+1, r, dout]}}  (f32)
+
+— and the jitted step gathers each batch ROW's slot (models/core.
+lora_matmul), so a mixed batch serves N tenants in one forward. Slot 0 is
+the reserved NULL adapter (all-zero factors, scaling 0): adapter-less
+rows in a mixed batch gather zeros and stay bit-exact, and a batch with
+no adapter rows skips the lora arguments entirely (the scheduler's
+batch-level gate — same per-row discipline spec decode established).
+
+Geometry is fixed by the FIRST adapter loaded (or pinned explicitly):
+layer layout from the model config, rank = that adapter's rank, targets =
+its target set. Later adapters may use a smaller rank (factors zero-pad
+to the pool rank — the delta is unchanged) and any subset of the pool's
+targets (missing targets stay zero); a larger rank or a new target is a
+typed AdapterLoadError, never a shape crash inside jit.
+
+Slots recycle LRU among adapters with no in-flight rows: the scheduler
+acquire()s a slot at admission and release()s it at retirement, so a
+hot-swap (fetch over the DHT, evict a cold adapter) can never yank the
+factors out from under a live generation. Pool arrays are never donated —
+an in-flight decode keeps reading the buffers it was dispatched with,
+and a load() swaps in fresh arrays for the NEXT step.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..metrics import get_registry
+from ..train.lora import (
+    ATTN_TARGETS,
+    MLP_TARGETS,
+    AdapterLoadError,
+    LoraConfig,
+    adapter_target_io,
+    validate_adapter_shapes,
+)
+
+# pool observability (the satellite's /metrics surface): residency gauge,
+# load/evict counters, and per-adapter request counts. The `adapter`
+# label is bounded by what the pool ever admitted — the scheduler only
+# counts RESOLVED slots, so a hostile wire string can't mint series.
+_G_RESIDENT = get_registry().gauge(
+    "adapter.pool_resident", "LoRA adapters resident in the pool"
+)
+_C_LOADS = get_registry().counter(
+    "adapter.pool_loads", "adapters loaded (fresh or refreshed) into the pool"
+)
+_C_EVICTED = get_registry().counter(
+    "adapter.pool_evicted", "adapters evicted from the pool"
+)
+_C_REQUESTS = get_registry().counter(
+    "adapter.requests", "generations admitted per adapter"
+)
+
+
+from . import AdapterPoolBusy, UnknownAdapter  # noqa: F401 — canonical
+# definitions live in the import-light package root so api/meshnet can
+# catch them without pulling jax; re-exported here for pool-side callers
+
+
+class AdapterPool:
+    """See module docstring. Thread-safety: the host maps and the device
+    array references swap under one lock; device arrays themselves are
+    immutable, so a scheduler thread that snapshotted ``device_args()``
+    keeps a consistent (factors, scales) pair for its whole step."""
+
+    def __init__(self, model_cfg, slots: int):
+        if slots < 1:
+            raise ValueError(f"adapter pool needs >= 1 slot, got {slots}")
+        self.model_cfg = model_cfg
+        self.slots = int(slots)
+        self._lock = threading.Lock()
+        # serializes WRITERS (load) across their whole host-prep +
+        # device-build; the fast _lock above is what the scheduler's
+        # device_args()/acquire() take and is only ever held for
+        # bookkeeping and reference swaps. Order: _io_lock → _lock.
+        self._io_lock = threading.Lock()
+        # geometry (rank/targets) binds on the first load
+        self.rank: int | None = None
+        self.targets: tuple | None = None
+        self._device: dict | None = None  # {t: {"a","b"}} stacked on device
+        self._scales = None  # [slots+1] f32 device array (slot 0 -> 0.0)
+        self._by_name: dict[str, int] = {}  # name -> slot (1-based)
+        self._by_slot: dict[int, str] = {}
+        self._refs: dict[int, int] = {}  # slot -> in-flight rows
+        self._tick = 0  # LRU clock
+        self._last_used: dict[int, int] = {}
+        self.loads = 0
+        self.evictions = 0
+        # one jitted slot write per (a|b, target shape): slot rides as a
+        # traced scalar so swapping different slots never recompiles
+        self._set_slot = jax.jit(
+            lambda arr, new, slot: jax.lax.dynamic_update_slice(
+                arr, new[:, None], (0, slot, 0, 0)
+            )
+        )
+
+    # ------------------------------------------------------------ geometry
+
+    def _ensure_geometry(self, lcfg: LoraConfig):
+        if self.rank is not None:
+            return
+        for t in lcfg.targets:
+            if t not in ATTN_TARGETS + MLP_TARGETS:
+                raise AdapterLoadError(f"unknown adapter target {t!r}")
+        io = adapter_target_io(self.model_cfg)
+        L = self.model_cfg.n_layers
+        self.rank = int(lcfg.rank)
+        self.targets = tuple(lcfg.targets)
+        N = self.slots + 1  # + the null slot 0
+        self._device = {
+            t: {
+                "a": jnp.zeros((L, N, io[t][0], self.rank), jnp.float32),
+                "b": jnp.zeros((L, N, self.rank, io[t][1]), jnp.float32),
+            }
+            for t in self.targets
+        }
+        self._scales = jnp.zeros((N,), jnp.float32)
+
+    # ------------------------------------------------------------ load/evict
+
+    def _pick_slot(self) -> int:
+        free = [
+            s for s in range(1, self.slots + 1) if s not in self._by_slot
+        ]
+        if free:
+            return free[0]
+        idle = [
+            s for s in range(1, self.slots + 1) if self._refs.get(s, 0) == 0
+        ]
+        if not idle:
+            raise AdapterPoolBusy(
+                f"all {self.slots} adapter slots have in-flight rows"
+            )
+        victim = min(idle, key=lambda s: self._last_used.get(s, 0))
+        name = self._by_slot.pop(victim)
+        self._by_name.pop(name, None)
+        self.evictions += 1
+        _C_EVICTED.inc()
+        return victim
+
+    def _write_slot(self, snapshot: dict, host: dict, slot: int,
+                    targets: tuple) -> dict:
+        """New device dict with `slot`'s factors replaced from the host-
+        prepped `host` map (None entry = zero the target). Reads only the
+        passed snapshot — callers guarantee no concurrent writer via
+        _io_lock."""
+        device = dict(snapshot)
+        for t in targets:
+            pair = host.get(t)
+            sa = snapshot[t]["a"].shape  # [L, N, din, R]
+            sb = snapshot[t]["b"].shape
+            if pair is None:  # target absent from this adapter: zeros
+                a = np.zeros((sa[0], sa[2], sa[3]), np.float32)
+                b = np.zeros((sb[0], sb[2], sb[3]), np.float32)
+            else:
+                a, b = pair
+            device[t] = {
+                "a": self._set_slot(snapshot[t]["a"], a, slot),
+                "b": self._set_slot(snapshot[t]["b"], b, slot),
+            }
+        return device
+
+    def _publish_locked(self, name: str, slot: int, device: dict,
+                        lcfg: LoraConfig) -> int:
+        """Swap the built device arrays + bookkeeping in. Caller holds
+        _lock — this is the ONLY part of a load the scheduler can ever
+        wait on."""
+        self._device = device
+        self._scales = self._scales.at[slot].set(float(lcfg.scaling))
+        self._by_name[name] = slot
+        self._by_slot[slot] = name
+        self._tick += 1
+        self._last_used[slot] = self._tick
+        self.loads += 1
+        _C_LOADS.inc()
+        _G_RESIDENT.set(len(self._by_name))
+        return slot
+
+    def load(self, name: str, adapters: dict, lcfg: LoraConfig) -> int:
+        """Pin `name`'s factors into a slot (fresh, refreshed in place, or
+        LRU-evicting a cold adapter). Validates shapes against the pool
+        geometry FIRST — a rank/target mismatch is a typed
+        AdapterLoadError with the pool untouched. Returns the slot.
+
+        Locking: _io_lock serializes writers over the whole build; the
+        scheduler-facing _lock is held only for bookkeeping and the
+        final reference swap, so device_args()/acquire() never stall
+        behind the MB-scale host copies, H2D transfers, or a first-use
+        jit compile — live decode continues through a hot-swap."""
+        if not name or not isinstance(name, str):
+            raise AdapterLoadError(f"adapter name must be a string, got {name!r}")
+        with self._io_lock:
+            with self._lock:
+                rank, targets = self.rank, self.targets
+            # validate BEFORE the geometry binds: a corrupt first adapter
+            # must leave the pool untouched, not fix rank/targets to its
+            # bad declaration until restart
+            validate_adapter_shapes(
+                self.model_cfg, adapters, lcfg, max_rank=rank
+            )
+            if targets is not None:
+                extra = set(lcfg.targets) - set(targets)
+                if extra:
+                    raise AdapterLoadError(
+                        f"adapter {name!r} targets {sorted(extra)} not in pool "
+                        f"targets {sorted(targets)} (fixed by the first "
+                        "adapter loaded)"
+                    )
+            # host-side prep (device_get + rank padding) with no lock a
+            # reader ever takes
+            pool_rank = rank if rank is not None else int(lcfg.rank)
+            pool_targets = (
+                targets if targets is not None else tuple(lcfg.targets)
+            )
+            host: dict = {}
+            for t in pool_targets:
+                ab = adapters.get(t)
+                if ab is None:
+                    host[t] = None
+                    continue
+                a = np.asarray(jax.device_get(ab["a"]), np.float32)
+                b = np.asarray(jax.device_get(ab["b"]), np.float32)
+                if lcfg.rank < pool_rank:
+                    # zero-pad the rank dim: delta unchanged, one
+                    # stacked shape for the whole pool
+                    a = np.pad(a, ((0, 0), (0, 0), (0, pool_rank - lcfg.rank)))
+                    b = np.pad(b, ((0, 0), (0, pool_rank - lcfg.rank), (0, 0)))
+                host[t] = (a, b)
+            with self._lock:
+                self._ensure_geometry(lcfg)
+                slot = self._by_name.get(name)
+                if slot is not None:
+                    if self._refs.get(slot, 0) > 0:
+                        # an in-place refresh would hand a LIVE generation
+                        # new factors at its next decode window — mixed-
+                        # weights output. Same typed backpressure as
+                        # eviction.
+                        raise AdapterPoolBusy(
+                            f"adapter {name!r} has in-flight rows; "
+                            "cannot refresh"
+                        )
+                    # refresh stays atomic under _lock: an unlocked build
+                    # window would let acquire() admit a row against the
+                    # OLD factors that then decodes on the NEW ones
+                    device = self._write_slot(
+                        self._device, host, slot, pool_targets
+                    )
+                    return self._publish_locked(name, slot, device, lcfg)
+                slot = self._pick_slot()
+                snapshot = self._device
+            # FRESH slot: no name maps to it until _publish_locked below,
+            # so no acquire() can race this build — the H2D dispatches
+            # run without stalling the decode loop
+            device = self._write_slot(snapshot, host, slot, pool_targets)
+            with self._lock:
+                return self._publish_locked(name, slot, device, lcfg)
+
+    def evict(self, name: str) -> bool:
+        """Explicitly drop a resident adapter (refetch tests, operator
+        surface). Refuses — AdapterPoolBusy — while rows are in flight."""
+        with self._lock:
+            slot = self._by_name.get(name)
+            if slot is None:
+                return False
+            if self._refs.get(slot, 0) > 0:
+                raise AdapterPoolBusy(
+                    f"adapter {name!r} has in-flight rows; cannot evict"
+                )
+            self._by_name.pop(name)
+            self._by_slot.pop(slot, None)
+            # zero the scaling so a stale id (never handed out past this
+            # point, but defense in depth) gathers a zero delta
+            self._scales = self._scales.at[slot].set(0.0)
+            self.evictions += 1
+            _C_EVICTED.inc()
+            _G_RESIDENT.set(len(self._by_name))
+            return True
+
+    # ------------------------------------------------------------ row leases
+
+    def acquire(self, name: str) -> int:
+        """Slot for `name`, with its in-flight refcount bumped (the
+        scheduler calls this at admission; release() at retirement). The
+        refcount is what makes hot-swap safe mid-traffic: a referenced
+        slot is never an eviction victim."""
+        with self._lock:
+            slot = self._by_name.get(name)
+            if slot is None:
+                raise UnknownAdapter(f"adapter {name!r} is not resident")
+            self._refs[slot] = self._refs.get(slot, 0) + 1
+            self._tick += 1
+            self._last_used[slot] = self._tick
+            _C_REQUESTS.inc(adapter=name)
+            return slot
+
+    def release(self, slot: int) -> None:
+        with self._lock:
+            left = self._refs.get(slot, 0) - 1
+            if left <= 0:
+                self._refs.pop(slot, None)
+            else:
+                self._refs[slot] = left
+
+    # ------------------------------------------------------------ queries
+
+    def has(self, name: str) -> bool:
+        with self._lock:
+            return name in self._by_name
+
+    def resident(self) -> list[str]:
+        with self._lock:
+            return sorted(self._by_name)
+
+    def slot_of(self, name: str) -> int | None:
+        with self._lock:
+            return self._by_name.get(name)
+
+    def device_args(self):
+        """(stacked factors pytree, [N+1] scales) for the jitted step, or
+        (None, None) before the first load. One lock-held read gives the
+        scheduler a consistent snapshot for a whole decode window."""
+        with self._lock:
+            return self._device, self._scales
+
+    @property
+    def info(self) -> dict:
+        with self._lock:
+            return {
+                "slots": self.slots,
+                "rank": self.rank,
+                "targets": list(self.targets or ()),
+                "resident": sorted(self._by_name),
+                "loads": self.loads,
+                "evictions": self.evictions,
+            }

@@ -35,6 +35,11 @@ TRACE_KEYS = frozenset({P.TRACE_CTX})
 # so admission fairness bills the same tenant at every hop
 TENANT_KEYS = frozenset({P.TENANT})
 
+# multi-adapter serving (adapters/): the LoRA adapter a generation runs
+# under rides GEN_REQUEST (clamped at the receiving node — unknown claims
+# answer a typed unknown_adapter GEN_ERROR, never mint state)
+ADAPTER_REQ_KEYS = frozenset({P.ADAPTER})
+
 # typed admission rejections (router/admission.py): every 429/503 shed —
 # HTTP response AND p2p GEN_ERROR frame alike — carries the rejection kind
 # and the Retry-After hint, so callers can back off instead of hammering
@@ -126,7 +131,8 @@ FRAME_SCHEMAS: dict[str, FrameSchema] = {
                 {"model", "svc", "max_new_tokens", "max_tokens", "temperature", "stream"}
             )
             | TRACE_KEYS
-            | TENANT_KEYS,
+            | TENANT_KEYS
+            | ADAPTER_REQ_KEYS,
             allow_sampling=True,
         ),
         # `tokens`: migration resume streams (meshnet/migrate.py) carry the
@@ -220,6 +226,15 @@ FRAME_SCHEMAS: dict[str, FrameSchema] = {
             P.FLEET_ACK,
             required=frozenset({"rid"}),
             optional=frozenset({"ok", "error", "info"}),
+        ),
+        # multi-adapter residency update (adapters/): `service` names the
+        # local service whose pool changed, `adapters` the now-resident
+        # names, `models` the full per-adapter model-name list
+        # ("<base>:<name>") receivers install into their provider tables
+        _fs(
+            P.ADAPTER_ANNOUNCE,
+            required=frozenset({"peer_id", "service", "adapters"}),
+            optional=frozenset({"models"}),
         ),
         # task protocol: per-kind field contracts live in TASK_SCHEMAS —
         # the TASK envelope itself only promises kind + correlation id

@@ -24,6 +24,12 @@ import math
 
 from . import __version__
 from . import health
+from .adapters import (
+    AdapterPoolBusy,
+    UnknownAdapter,
+    clamp_adapter_name,
+    split_model_adapter,
+)
 from .health import fleet_view, render_fleet_prom
 from .meshnet.node import P2PNode
 from .metrics import PROMETHEUS_CONTENT_TYPE, get_registry
@@ -173,6 +179,29 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
             return _admission_response(
                 rej, cors, v1=request.path.startswith("/v1")
             )
+        except UnknownAdapter as e:
+            # the eviction-races-admission window (the pre-admission
+            # ensure_adapter check covers the common path): still a typed
+            # 404, never a 500
+            if request.path.startswith("/v1"):
+                body = {"error": {"message": str(e),
+                                  "type": "invalid_request_error",
+                                  "error_kind": "unknown_adapter"}}
+            else:
+                body = {"detail": str(e), "error_kind": "unknown_adapter"}
+            return web.json_response(body, status=404, headers=cors)
+        except AdapterPoolBusy as e:
+            # a VALID adapter hitting a slot-saturated pool is
+            # backpressure, not absence: the pool_exhausted 503 +
+            # Retry-After shed (clients retry; a 404 they would not)
+            return _admission_response(
+                AdmissionReject(
+                    "pool_exhausted",
+                    node.admission.config.shed_retry_after_s,
+                    f"adapter pool busy: {e}",
+                ),
+                cors, v1=request.path.startswith("/v1"),
+            )
         except Exception as e:
             if request.transport is None:
                 raise  # response already started and connection is gone
@@ -252,6 +281,51 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
         ):
             return await _chat_inner(request, body, prompt, model)
 
+    def _resolve_model(model, tenant):
+        """(svc, base model, adapter, affinity) for one request. The
+        "<base>:<adapter>" grammar applies ONLY where a colon can mean
+        an adapter — the base resolves to an adapter-pooled engine
+        service: a backend whose OWN ids contain colons (ollama
+        "llama3:8b") advertised verbatim keeps serving them whole.
+        Within the grammar, the explicit model form wins, else the
+        tenant's configured default adapter (router/tenants.py) — the
+        one-base-many-tenants mapping every surface shares. A malformed
+        adapter half raises UnknownAdapter (the middleware's typed 404)
+        — never a silent fall-through to the plain base. `adapter` is
+        what this node COMMITS to (params + ensure_adapter); `affinity`
+        only scores the provider pick when nothing local resolves and
+        the serving node must re-derive from the forwarded model id."""
+        base_model, raw = split_model_adapter(model)
+        if raw is None:
+            svc = node.local_service_for(base_model)
+            adapter = node.tenants.default_adapter(tenant)
+            if adapter and svc is not None and not P2PNode.adapter_capable(svc):
+                adapter = None  # a default can't apply to this backend
+            return svc, base_model, adapter, adapter
+        svc = node.local_service_for(base_model)
+        if svc is not None and P2PNode.adapter_capable(svc):
+            adapter = clamp_adapter_name(raw)
+            if adapter is None:
+                raise UnknownAdapter(
+                    f"malformed adapter name in model {model!r}"
+                )
+            return svc, base_model, adapter, adapter
+        verbatim = node.service_advertising(model)
+        if verbatim is not None:
+            # the colon belongs to the backend's own tag grammar
+            return verbatim, model, None, None
+        if svc is not None:
+            # the base resolves locally but cannot serve adapters: the
+            # typed 404 (a pool-less engine must never silently serve
+            # the plain base under an adapter-qualified id)
+            raise UnknownAdapter(
+                f"service for {base_model!r} cannot serve adapter "
+                f"models ({model!r})"
+            )
+        # nothing local either way: forward the ORIGINAL id whole; the
+        # split half only biases the provider pick toward residents
+        return None, model, None, clamp_adapter_name(raw)
+
     async def _chat_inner(request, body, prompt, model):
         params = {
             "prompt": prompt,
@@ -262,19 +336,32 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
         # silently dropping a requested penalty would be wrong output, not
         # a degraded default
         copy_sampling(body, params)
-        svc = node.local_service_for(model)
         stream = bool(body.get("stream"))
         tenant = _tenant_of(request, node.tenants)
         params["tenant"] = tenant
+        svc, base_model, adapter, affinity = _resolve_model(model, tenant)
+        if adapter:
+            params["adapter"] = adapter
 
         if svc is not None:
+            if adapter and not await node.ensure_adapter(svc, adapter):
+                # typed 404: the adapter neither is resident nor could be
+                # paged in from the mesh — a wrong name must not serve
+                # the plain base model silently
+                return web.json_response(
+                    {"detail": f"unknown adapter {adapter!r} for model "
+                               f"{base_model!r}",
+                     "error_kind": "unknown_adapter"}, status=404
+                )
             out = await _admit_and_serve_local(request, svc, params, stream)
             if isinstance(out, web.StreamResponse):
                 return out
             return web.json_response(out)
 
         # P2P fallback (reference api.py:247-264): prefix-aware scored pick
-        provider = node.pick_provider(model, prompt=prompt)
+        provider = node.pick_provider(
+            model, prompt=prompt, adapter=adapter or affinity
+        )
         if provider is None or provider["local"]:
             return web.json_response(
                 {"detail": f"no provider for model {model!r}"}, status=404
@@ -621,18 +708,33 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
     async def _v1_generate(request, body, prompt, chat: bool):
         model = body.get("model")
         params = _openai_params(body, prompt)
-        svc = node.local_service_for(model)
         sse = ("chat" if chat else "text", model or "")
         tenant = _tenant_of(request, node.tenants)
         params["tenant"] = tenant
+        # model="<base>:<adapter>" (multi-adapter serving, adapters/):
+        # standard OpenAI SDKs select a tenant adapter purely through the
+        # model id; a tenant's configured default applies otherwise
+        svc, base_model, adapter, affinity = _resolve_model(model, tenant)
+        if adapter:
+            params["adapter"] = adapter
         if svc is not None:
+            if adapter and not await node.ensure_adapter(svc, adapter):
+                return web.json_response(
+                    {"error": {
+                        "message": f"model {model!r} not found "
+                                   f"(unknown adapter {adapter!r})",
+                        "type": "invalid_request_error",
+                        "error_kind": "unknown_adapter",
+                    }}, status=404)
             result = await _admit_and_serve_local(
                 request, svc, params, bool(body.get("stream")), sse=sse
             )
             if isinstance(result, web.StreamResponse):
                 return result
         else:
-            provider = node.pick_provider(model, prompt=prompt)
+            provider = node.pick_provider(
+                model, prompt=prompt, adapter=adapter or affinity
+            )
             if provider is None or provider["local"]:
                 return web.json_response(
                     {"error": {"message": f"model {model!r} not found",
@@ -702,7 +804,12 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
 
 
 def _sampling_extra(params: dict) -> dict:
-    return copy_sampling(params, {})
+    extra = copy_sampling(params, {})
+    if params.get("adapter"):
+        # the adapter selection must survive the P2P hop like any
+        # sampling knob — the serving node resolves it against its pool
+        extra["adapter"] = params["adapter"]
+    return extra
 
 
 async def _json_body(request: web.Request) -> dict[str, Any]:

@@ -35,6 +35,8 @@ _ENV_MAP = {
     "BEE2BEE_KV_POOL_BLOCKS": "kv_pool_blocks",
     "BEE2BEE_KV_QUANT": "kv_quant",
     "BEE2BEE_SPEC": "spec_tokens",
+    "BEE2BEE_ADAPTERS": "adapters",
+    "BEE2BEE_MAX_ADAPTERS": "max_adapters",
     "BEE2BEE_QUANTIZE": "quantize",
     "BEE2BEE_AUTO_NAT": "auto_nat",
     "BEE2BEE_DHT_PORT": "dht_port",
@@ -44,7 +46,7 @@ _ENV_MAP = {
 _INT_FIELDS = {
     "port", "api_port", "announce_port", "max_batch_size", "max_seq_len",
     "dht_port", "prefill_chunk", "prefix_cache_entries", "kv_block_size",
-    "kv_pool_blocks", "spec_tokens",
+    "kv_pool_blocks", "spec_tokens", "max_adapters",
 }
 _BOOL_FIELDS = {"auto_nat", "paged", "kv_quant"}
 
@@ -94,6 +96,14 @@ class NodeConfig:
     # them in one batched forward (BEE2BEE_SPEC / --spec; 0 = off —
     # EngineConfig.spec_tokens)
     spec_tokens: int = 0
+    # batched multi-LoRA serving (adapters/): comma-separated
+    # name=path.npz adapters preloaded into the engine's hot-swap pool
+    # AND published as pieces manifests on the DHT (BEE2BEE_ADAPTERS /
+    # serve-tpu --adapters); empty = none preloaded
+    adapters: str = ""
+    # adapter pool slots (BEE2BEE_MAX_ADAPTERS): 0 = multi-adapter
+    # serving off unless --adapters is given, which implies 8
+    max_adapters: int = 0
     # total pool blocks; 0 = default sizing (exhaustion impossible). An
     # explicit smaller value trades HBM for admission backpressure
     # (EngineConfig.kv_pool_blocks)
@@ -130,6 +140,9 @@ class NodeConfig:
             kv_block_size=self.kv_block_size,
             kv_pool_blocks=self.kv_pool_blocks or None,
             spec_tokens=self.spec_tokens,
+            # --adapters implies a pool even when no slot count was set:
+            # the operator clearly wants multi-adapter serving
+            max_adapters=self.max_adapters or (8 if self.adapters else 0),
         )
 
 

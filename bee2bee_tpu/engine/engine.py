@@ -177,6 +177,13 @@ class EngineConfig:
     # non-repetitive content).
     spec_min_accept: float = 0.25
     spec_probe_tokens: int = 64
+    # batched multi-LoRA serving (adapters/pool.py): slots for hot-
+    # swappable adapters over the one resident base model — per-row
+    # adapter selection inside the SAME decode step (a mixed batch
+    # serves N tenants in one forward; adapter-less batches skip the
+    # lora arguments entirely). 0 = off. Adapters page in/out at runtime
+    # (engine.load_adapter / the mesh's DHT fetch) without a restart.
+    max_adapters: int = 0
 
     def __post_init__(self):
         # <= 0 means "disabled" (NodeConfig uses 0 as its sentinel); a raw
@@ -188,6 +195,8 @@ class EngineConfig:
             raise ValueError(f"kv_block_size must be >= 1, got {self.kv_block_size}")
         if self.spec_tokens < 0:  # NodeConfig's 0-means-disabled sentinel
             self.spec_tokens = 0
+        if self.max_adapters < 0:
+            self.max_adapters = 0
         if self.spec_tokens and not (
             1 <= self.spec_min_match <= self.spec_max_match
         ):
@@ -307,6 +316,16 @@ class InferenceEngine:
         self._scheduler = None  # created on first generate (allocates the
         # shared [max_batch] cache — engines built only for score()/info
         # never pay for it)
+        # batched multi-LoRA serving: the hot-swap pool (adapters/pool.py).
+        # Construction is cheap — device factors allocate at the first
+        # load_adapter, whose rank/targets fix the pool geometry.
+        self.adapter_pool = None
+        if self.engine_cfg.max_adapters > 0:
+            from ..adapters.pool import AdapterPool
+
+            self.adapter_pool = AdapterPool(
+                self.model_cfg, self.engine_cfg.max_adapters
+            )
 
     # ------------------------------------------------------------ compiled fns
 
@@ -452,7 +471,8 @@ class InferenceEngine:
             validate_sp_mesh(self.model_cfg, self.engine_cfg, self.mesh)
 
     def _prefill_fn(self, params, tokens, cache, true_len, offset,
-                    block_tables=None, write_floor=None, write_ceil=None):
+                    block_tables=None, write_floor=None, write_ceil=None,
+                    adapters=None, aids=None, ascales=None):
         """tokens [B, Tb] padded; returns (cache, last_logits [B, V]).
         `offset` is the global cache position of tokens[:, 0] — 0 for a
         whole-prompt prefill, the running position for chunked prefill.
@@ -461,18 +481,24 @@ class InferenceEngine:
         into the row's mapped blocks (core.forward's paged path);
         `write_floor` keeps re-fed positions below a CoW share point from
         rewriting shared donor blocks, `write_ceil` drops the padded tail
-        so short prompts only claim blocks covering their real length."""
+        so short prompts only claim blocks covering their real length.
+        `adapters`/`aids`/`ascales` (adapters/pool.py): the row's LoRA
+        factors apply to the PROMPT too — an adapted wk/wv writes
+        adapter-specific K/V, which is exactly why adapter rows never
+        share the base model's prefix cache (scheduler guard)."""
         logits, cache = core.forward(
             params, self.model_cfg, tokens, cache, offset,
             attn_fn=self._attn_fn(), block_tables=block_tables,
             paged_write_floor=write_floor, paged_write_ceil=write_ceil,
+            adapters=adapters, adapter_ids=aids, adapter_scales=ascales,
         )
         idx = (true_len - 1).reshape(-1, 1, 1)  # [B,1,1]
         last = jnp.take_along_axis(logits, jnp.broadcast_to(idx, (logits.shape[0], 1, logits.shape[2])), axis=1)
         return cache, last[:, 0, :]
 
     def _spec_verify_fn(self, params, cur, drafts, draft_lens, cache, offsets,
-                        temps, topks, topps, minps, key, tables=None):
+                        temps, topks, topps, minps, key, tables=None,
+                        adapters=None, aids=None, ascales=None):
         """Speculative-decode verify: one [B, K+1] forward checks a whole
         draft. Returns (next_tok [B], cache, accepted [B]).
 
@@ -500,6 +526,7 @@ class InferenceEngine:
         logits, cache = core.forward(
             params, self.model_cfg, tokens, cache, offsets,
             attn_fn=self._attn_fn(), block_tables=tables,
+            adapters=adapters, adapter_ids=aids, adapter_scales=ascales,
         )
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K+1]
         pos = jnp.arange(K, dtype=jnp.int32)[None, :]
@@ -617,6 +644,42 @@ class InferenceEngine:
             self._rng, sub = self._split_key(self._rng)
             return sub
 
+    # ---------------------------------------------- multi-adapter serving
+
+    def load_adapter(self, name: str, adapters: dict | None = None,
+                     lcfg=None, path: str | None = None) -> int:
+        """Pin one LoRA adapter into the hot-swap pool (fresh load,
+        in-place refresh, or LRU-evicting a cold adapter) WITHOUT
+        restarting the engine — in-flight generations keep the factors
+        they were dispatched with. Pass (adapters, lcfg) directly (the
+        DHT fetch path) or ``path`` to an adapter .npz, whose versioned
+        sha256 manifest is verified on read. Typed AdapterLoadError on a
+        corrupt/mismatched adapter; returns the pool slot."""
+        if self.adapter_pool is None:
+            raise RuntimeError(
+                "multi-adapter serving is off (EngineConfig.max_adapters=0)"
+            )
+        if path is not None:
+            from ..train.lora import load_adapters
+
+            adapters, lcfg = load_adapters(path, model_cfg=self.model_cfg)
+        if adapters is None or lcfg is None:
+            raise ValueError("load_adapter needs (adapters, lcfg) or path")
+        return self.adapter_pool.load(name, adapters, lcfg)
+
+    def unload_adapter(self, name: str) -> bool:
+        """Evict a resident adapter; AdapterPoolBusy while rows are in
+        flight on it (the refcount hot-swap guard)."""
+        if self.adapter_pool is None:
+            return False
+        return self.adapter_pool.evict(name)
+
+    def has_adapter(self, name: str) -> bool:
+        return self.adapter_pool is not None and self.adapter_pool.has(name)
+
+    def resident_adapters(self) -> list[str]:
+        return self.adapter_pool.resident() if self.adapter_pool else []
+
     # ------------------------------------------------------------ public API
 
     @property
@@ -643,6 +706,18 @@ class InferenceEngine:
         if sch is not None:
             sch.shutdown()
 
+    @staticmethod
+    def _event_error(ev: dict) -> Exception:
+        """Typed exception for a failed-generation event: an admission-
+        race unknown_adapter keeps its type across the event queue (the
+        serving surfaces map it to 404 / a typed gen_error) — everything
+        else stays the generic RuntimeError."""
+        if ev.get("error_kind") == "unknown_adapter":
+            from ..adapters.pool import UnknownAdapter
+
+            return UnknownAdapter(ev.get("error", "unknown adapter"))
+        return RuntimeError(ev.get("error", "generation failed"))
+
     def _stop_set(self, stop_tokens):
         stop = set(int(t) for t in (stop_tokens or []))
         eos = self.tokenizer.eos_token_id
@@ -655,6 +730,7 @@ class InferenceEngine:
         stream: bool = False, repetition_penalty: float = 1.0,
         presence_penalty: float = 0.0, frequency_penalty: float = 0.0,
         min_p: float = 0.0, tenant: str = "default",
+        adapter: str | None = None,
     ):
         from .scheduler import Request
 
@@ -683,6 +759,21 @@ class InferenceEngine:
             # min_p > 1 would mask EVERY token (floor above the max prob)
             # and degenerate to token 0 — reject, don't silently garble
             raise ValueError(f"min_p must be in [0, 1], got {min_p}")
+        if adapter:
+            # typed BEFORE submission (UnknownAdapter → /v1 404, p2p
+            # unknown_adapter): serving is off, or the adapter is not
+            # resident and nothing upstream (node.ensure_adapter) paged
+            # it in. The admission-time acquire still re-checks — an
+            # eviction can race a queued request.
+            from ..adapters.pool import UnknownAdapter
+
+            if self.adapter_pool is None:
+                raise UnknownAdapter(
+                    f"adapter {adapter!r}: multi-adapter serving is off "
+                    "(EngineConfig.max_adapters=0)"
+                )
+            if not self.adapter_pool.has(adapter):
+                raise UnknownAdapter(f"adapter {adapter!r} is not resident")
         stop, eos = self._stop_set(stop_tokens)
         return Request(
             ids, max_new_tokens, temperature, top_k, top_p, stop, eos,
@@ -692,6 +783,7 @@ class InferenceEngine:
             frequency_penalty=frequency_penalty,
             min_p=min_p,
             tenant=tenant,
+            adapter=adapter,
         )
 
     def _build_result(self, req) -> GenerationResult:
@@ -762,12 +854,14 @@ class InferenceEngine:
         frequency_penalty: float = 0.0,
         min_p: float = 0.0,
         tenant: str = "default",
+        adapter: str | None = None,
     ) -> Iterator[dict]:
         """Yield {"token": last_id, "tokens": ids, "text": piece} per decode
         chunk, then {"done": True, "result": GenerationResult}. Streaming
         granularity is engine_cfg.decode_chunk tokens. Requests from
         concurrent callers share the scheduler's batch — submission order
-        is admission order; rows decode together."""
+        is admission order; rows decode together (including rows on
+        DIFFERENT adapters: per-row selection inside one decode step)."""
         req = self._make_request(
             prompt, max_new_tokens, temperature, top_k, top_p, stop_tokens,
             stream=True, repetition_penalty=repetition_penalty,
@@ -775,6 +869,7 @@ class InferenceEngine:
             frequency_penalty=frequency_penalty,
             min_p=min_p,
             tenant=tenant,
+            adapter=adapter,
         )
         if req.max_new_tokens <= 0:
             req.timing.t_first = req.timing.t_done = time.perf_counter()
@@ -785,7 +880,7 @@ class InferenceEngine:
             while True:
                 ev = req.events.get()
                 if ev.get("done") and ev.get("result") is None:
-                    raise RuntimeError(ev.get("error", "generation failed"))
+                    raise self._event_error(ev)
                 yield ev
                 if ev.get("done"):
                     return
@@ -812,6 +907,7 @@ class InferenceEngine:
             frequency_penalty=kw.get("frequency_penalty", 0.0),
             min_p=kw.get("min_p", 0.0),
             tenant=kw.get("tenant", "default"),
+            adapter=kw.get("adapter"),
         )
         if req.max_new_tokens <= 0:
             req.timing.t_first = req.timing.t_done = time.perf_counter()
@@ -821,7 +917,7 @@ class InferenceEngine:
             ev = req.events.get()
             if ev.get("done"):
                 if ev.get("result") is None:
-                    raise RuntimeError(ev.get("error", "generation failed"))
+                    raise self._event_error(ev)
                 return ev["result"]
 
     # ---------------------------------------------------- live migration
@@ -861,6 +957,16 @@ class InferenceEngine:
                 f"import: snapshot is for model {snap['model']!r}, "
                 f"this engine serves {self.model_cfg.name!r}"
             )
+        adapter = snap.get("adapter") or None
+        if adapter and not self.has_adapter(adapter):
+            # the row's KV was computed (and its decode continues) under
+            # THIS adapter's wk/wv deltas — resuming without it would be
+            # silent corruption, and the re-prefill rung would recompute
+            # the wrong K/V too. Typed refusal; the exporter's ladder
+            # tries another target (migrate.py types this 'incompatible').
+            raise ValueError(
+                f"import: adapter {adapter!r} is not resident on this engine"
+            )
         req = Request(
             ids,
             int(snap.get("max_new_tokens") or 0),
@@ -876,6 +982,7 @@ class InferenceEngine:
             frequency_penalty=float(snap.get("frequency_penalty") or 0.0),
             min_p=float(snap.get("min_p") or 0.0),
             tenant=str(snap.get("tenant") or "default"),
+            adapter=adapter,
         )
         req.out_ids = out
         # the already-streamed text was emitted at the SOURCE; the local
@@ -996,4 +1103,9 @@ class InferenceEngine:
                 round(st.spec_accepted / drafted, 4) if drafted else 0.0
             ),
         }
+        # multi-adapter serving: residency + pool churn (dashboards, the
+        # mesh hello's service metadata, and the router's placement input
+        # all read this through TPUService.get_metadata)
+        if self.adapter_pool is not None:
+            out["adapters"] = self.adapter_pool.info
         return out

@@ -122,12 +122,21 @@ class Request:
         frequency_penalty: float = 0.0,
         min_p: float = 0.0,
         tenant: str = "default",
+        adapter: str | None = None,
     ):
         self.stream = stream
         # fairness identity (router/tenants.py): keys the scheduler's WDRR
         # submit queue, so one tenant's burst can't starve another past
         # its configured weight even below the admission layer
         self.tenant = str(tenant or "default")
+        # multi-adapter serving (adapters/pool.py): which LoRA adapter
+        # this row decodes under (None = the plain base model). The slot
+        # resolves at ADMISSION — an adapter may page in/out while the
+        # request is queued — and the acquired flag makes the pool
+        # refcount release idempotent across the several retirement paths
+        self.adapter = adapter or None
+        self.adapter_slot = 0
+        self._adapter_acquired = False
         # set by an abandoning consumer (generate_stream closed early);
         # plain bool write cross-thread — the scheduler thread reads it at
         # chunk boundaries and retires the row
@@ -320,6 +329,12 @@ class BatchScheduler:
         # token readback it needed anyway
         self._cur = np.zeros((self._bsz,), np.int32)
         self._offsets = np.zeros((self._bsz,), np.int32)
+        # per-row adapter slots (adapters/pool.py; 0 = base model). A host
+        # mirror like _cur/_offsets: rides into the jitted step as a [B]
+        # argument only when some row actually holds an adapter — the
+        # all-base batch keeps the adapter-free trace (per-row gating
+        # discipline, same as the penalized-counts split)
+        self._aids = np.zeros((self._bsz,), np.int32)
         self._rows: list[Request | None] = [None] * self._bsz
         self._row_params_dirty = True
         self._temps = self._topps = self._topks = self._minps = None
@@ -530,10 +545,13 @@ class BatchScheduler:
     # ------------------------------------------------------------ device fns
 
     def _decode_fn(self, params, cur, cache, offsets, temps, topks, topps,
-                   minps, key, tables=None):
+                   minps, key, tables=None, adapters=None, aids=None,
+                   ascales=None):
         """One chunk: decode K tokens for ALL rows. Returns
         (cur', cache', offsets', toks [B, K]). `tables` [B, MBb] selects
-        the paged-pool path: attention gathers only the mapped blocks."""
+        the paged-pool path: attention gathers only the mapped blocks.
+        `adapters`/`aids`/`ascales` (adapters/pool.py) select per-row
+        LoRA deltas inside the same step; None keeps the base trace."""
         from ..models import core
         from .sampling import sample_batched
 
@@ -544,6 +562,7 @@ class BatchScheduler:
             logits, cache = core.forward(
                 params, e.model_cfg, cur[:, None], cache, off,
                 attn_fn=e._attn_fn(), block_tables=tables,
+                adapters=adapters, adapter_ids=aids, adapter_scales=ascales,
             )
             nxt = sample_batched(
                 logits[:, -1, :], key_t, temps, topks, topps, minps
@@ -557,6 +576,7 @@ class BatchScheduler:
     def _decode_pen_fn(
         self, params, cur, cache, offsets, counts,
         temps, topks, topps, minps, reps, press, freqs, key, tables=None,
+        adapters=None, aids=None, ascales=None,
     ):
         """Penalty-carrying decode chunk: counts ride the scan carry and
         every sampled token scatters into its row. Compiled only when a
@@ -573,6 +593,7 @@ class BatchScheduler:
             logits, cache = core.forward(
                 params, e.model_cfg, cur[:, None], cache, off,
                 attn_fn=e._attn_fn(), block_tables=tables,
+                adapters=adapters, adapter_ids=aids, adapter_scales=ascales,
             )
             nxt = sample_batched(
                 logits[:, -1, :], key_t, temps, topks, topps, minps,
@@ -627,6 +648,7 @@ class BatchScheduler:
         blocked on their event queues and must always get a done event).
         Caller must hold self._cond — submit() appends under it."""
         for req in list(self._queue) + [r for r in self._rows if r is not None]:
+            self._release_adapter(req)
             req.finish = "error"
             req.events.put({"done": True, "result": None, "error": reason})
         self._queue.clear()
@@ -660,6 +682,7 @@ class BatchScheduler:
         self.stats.paged_blocks_in_use = 0
         self._cur = np.zeros((1,), np.int32)
         self._offsets = np.zeros((1,), np.int32)
+        self._aids = np.zeros((1,), np.int32)
         self._rows = [None]
         self._counts = None  # lazily reallocated by the next penalized admit
         self._row_params_dirty = True
@@ -674,7 +697,16 @@ class BatchScheduler:
             self._alloc.deref(self._row_blocks[b])
             self._row_blocks[b] = []
         self._tables[b, :] = 0
+        self._aids[b] = 0  # dead rows gather the null adapter (zeros)
         self.stats.paged_blocks_in_use = self._alloc.used_count
+
+    def _release_adapter(self, req: Request):
+        """Return req's adapter-pool refcount (idempotent — retirement,
+        migration-out and fail_all paths may all reach a request). A zero
+        refcount is what lets the LRU hot-swap recycle the slot."""
+        if getattr(req, "_adapter_acquired", False):
+            req._adapter_acquired = False
+            self.engine.adapter_pool.release(req.adapter_slot)
 
     def _alloc_or_evict(self, n: int) -> list[int]:
         """n fresh blocks, reclaiming LRU prefix pins under pressure;
@@ -751,6 +783,7 @@ class BatchScheduler:
             snap = self._snapshot_row(b, req)
             self._rows[b] = None
             self._release_row(b)
+            self._release_adapter(req)  # the target re-acquires its own pin
             self._row_params_dirty = True
             self.stats.migrated_out += 1
             self._compact_and_shrink()
@@ -784,6 +817,10 @@ class BatchScheduler:
             "stop": sorted(int(t) for t in req.stop),
             "eos": None if req.eos is None else int(req.eos),
             "tenant": req.tenant,
+            # multi-adapter serving: the target must hold (or fetch) this
+            # adapter before it can resume the row — KV AND future decode
+            # both depend on the adapted projections
+            "adapter": req.adapter,
             "block_size": self._block_size,
             "offset": 0,
             "cur": None,
@@ -862,13 +899,15 @@ class BatchScheduler:
                 # pinned, so repeat prompts hit CoW on the target too
                 n = len(req.ids)
                 if (self._prefix_cache is not None and offset >= n
+                        and not req.adapter
                         and not self._prefix_cache.has(req.ids)):
                     self._prefix_cache.put(req.ids, fresh[:ceil_div(n, BS)])
             else:
                 seq = [int(t) for t in st["seq"]]
                 start, cached = (
                     self._prefix_cache.match(seq)
-                    if self._prefix_cache is not None else (0, None)
+                    if self._prefix_cache is not None and not req.adapter
+                    else (0, None)
                 )
                 C = e.engine_cfg.prefill_chunk
                 remaining = len(seq) - (start if cached is not None else 0)
@@ -925,11 +964,14 @@ class BatchScheduler:
                 self._counts = self._counts_shrink(self._counts, new_bsz)
         cur = np.zeros((new_bsz,), np.int32)
         offs = np.zeros((new_bsz,), np.int32)
+        aids = np.zeros((new_bsz,), np.int32)
         keep = min(old, new_bsz)
         cur[:keep] = self._cur[:keep]
         offs[:keep] = self._offsets[:keep]
+        aids[:keep] = self._aids[:keep]
         self._cur = cur
         self._offsets = offs
+        self._aids = aids
         self._rows = self._rows[:keep] + [None] * (new_bsz - keep)
         self._bsz = new_bsz
         self._row_params_dirty = True
@@ -958,6 +1000,8 @@ class BatchScheduler:
                 )
             self._cur[hole] = self._cur[last]
             self._offsets[hole] = self._offsets[last]
+            self._aids[hole] = self._aids[last]
+            self._aids[last] = 0
             self._rows[hole] = self._rows[last]
             self._rows[last] = None
             self._row_params_dirty = True
@@ -1066,8 +1110,14 @@ class BatchScheduler:
                     e.params, tokens, self._cache,
                     np.asarray([len(chunk)], np.int32),
                     np.int32(pos), tbl, np.int32(start), np.int32(n),
+                    **self._lora_args_row(req),
                 )
-            if self._prefix_cache is not None and not self._prefix_cache.has(seq):
+            # adapter rows NEVER enter the prefix cache: an adapted wk/wv
+            # writes adapter-specific K/V, so sharing those blocks with a
+            # base-model (or other-adapter) prompt would serve silently
+            # wrong attention — sharing stays base-model-only
+            if (self._prefix_cache is not None and not req.adapter
+                    and not self._prefix_cache.has(seq)):
                 # pinning is free (refcounts, no snapshot): the entry
                 # claims the blocks covering exactly the prefilled positions
                 self._prefix_cache.put(seq, row[:ceil_div(n, BS)])
@@ -1107,6 +1157,30 @@ class BatchScheduler:
                     )
                 continue
             req.timing.t_admit = time.perf_counter()
+            if req.adapter:
+                # slot resolution happens at ADMISSION, not submit — the
+                # adapter may page out while the request queues. The
+                # acquire bumps the pool refcount, so a hot-swap can
+                # never evict the factors under this row mid-decode.
+                try:
+                    req.adapter_slot = self.engine.adapter_pool.acquire(
+                        req.adapter
+                    )
+                    req._adapter_acquired = True
+                except Exception as err:  # UnknownAdapter / pool races:
+                    # typed retirement — the serving surfaces map the
+                    # kind onto 404 (/v1) and gen_error (p2p)
+                    req.finish = "error"
+                    req.events.put({
+                        "done": True, "result": None,
+                        "error": f"unknown adapter: {err}",
+                        "error_kind": "unknown_adapter",
+                    })
+                    with self._cond:
+                        self._queue.refund(
+                            req.tenant, max(1.0, float(req.max_new_tokens))
+                        )
+                    continue
             if self.active == self._bsz:
                 self._resize(min(self._bsz * 2, self.max_batch))
             b = next(i for i, r in enumerate(self._rows) if r is None)
@@ -1127,6 +1201,7 @@ class BatchScheduler:
                     # typed, immediate: the exporter's fallback ladder
                     # (re-prefill elsewhere) beats parking the import on
                     # backpressure that may never clear
+                    self._release_adapter(req)
                     req.finish = "error"
                     req.events.put({
                         "done": True, "result": None,
@@ -1142,6 +1217,11 @@ class BatchScheduler:
                         )
                     continue
                 except Exception as err:
+                    # this request is in neither _queue nor _rows, so the
+                    # _fail_all sweep upstream can never release its slot
+                    # lease — drop it here or the refcount pins the slot
+                    # (and eventually the whole pool) until restart
+                    self._release_adapter(req)
                     req.finish = "error"
                     req.events.put({
                         "done": True, "result": None,
@@ -1149,6 +1229,7 @@ class BatchScheduler:
                     })
                     raise
                 self._rows[b] = req
+                self._aids[b] = req.adapter_slot
                 req.timing.t_first = time.perf_counter()
                 self.stats.admitted += 1
                 self._row_params_dirty = True
@@ -1159,10 +1240,12 @@ class BatchScheduler:
 
             n = len(req.ids)
             # longest cached prompt prefix: admit from there and prefill
-            # only the remainder (chat transcripts grow by appending)
+            # only the remainder (chat transcripts grow by appending).
+            # Adapter rows skip the cache both ways — their K/V diverges
+            # from the base model's under the adapted projections
             start, cached = (
                 self._prefix_cache.match(req.ids)
-                if self._prefix_cache is not None
+                if self._prefix_cache is not None and not req.adapter
                 else (0, None)
             )
             C = e.engine_cfg.prefill_chunk
@@ -1227,6 +1310,9 @@ class BatchScheduler:
                 # front and admit again after the next window. With
                 # nothing in flight and nothing left to evict, this
                 # request can never fit the configured pool: fail it.
+                # either way this admission attempt is over: return the
+                # adapter refcount (a requeued retry re-acquires)
+                self._release_adapter(req)
                 if self.active > 0 or placed:
                     with self._cond:
                         # front requeue refunds the WDRR cost charged at
@@ -1257,6 +1343,7 @@ class BatchScheduler:
                 # the popped request is in neither _queue nor _rows: fail it
                 # here or its caller hangs; then let _loop's handler recover
                 # (which errors the rest of this burst — they sit in _rows)
+                self._release_adapter(req)
                 req.finish = "error"
                 req.events.put(
                     {"done": True, "result": None, "error": f"admission failed: {err!r}"}
@@ -1265,6 +1352,7 @@ class BatchScheduler:
             # reserve the row now (cur gets the real token after readback)
             self._rows[b] = req
             self._offsets[b] = n
+            self._aids[b] = req.adapter_slot
             placed.append((req, b, len(firsts)))
             firsts.append(first)
 
@@ -1324,6 +1412,7 @@ class BatchScheduler:
                 if accepted:
                     self._rows[b] = None
                     self._release_row(b)
+                    self._release_adapter(req)
                     self._row_params_dirty = True
                     self.stats.migrated_out += 1
                     self.stats.prefill_handoffs += 1
@@ -1355,6 +1444,30 @@ class BatchScheduler:
             )
             self._row_params_dirty = False
         return self._temps, self._topks, self._topps
+
+    def _lora_args(self) -> dict:
+        """Adapter kwargs for the batch-wide jitted calls (decode window /
+        spec verify): EMPTY when no active row holds an adapter, so the
+        all-base batch runs the unchanged adapter-free trace — the same
+        batch-level gate the penalized-counts split uses. Otherwise the
+        pool's stacked factors + the [bsz] per-row slot ids (null slot 0
+        for base rows in the mixed batch)."""
+        pool = self.engine.adapter_pool
+        if pool is None or not self._aids.any():
+            return {}
+        adapters, scales = pool.device_args()
+        return {"adapters": adapters, "aids": self._aids, "ascales": scales}
+
+    def _lora_args_row(self, req: Request) -> dict:
+        """Adapter kwargs for ONE row's prefill calls."""
+        if not getattr(req, "_adapter_acquired", False):
+            return {}
+        adapters, scales = self.engine.adapter_pool.device_args()
+        return {
+            "adapters": adapters,
+            "aids": np.asarray([req.adapter_slot], np.int32),
+            "ascales": scales,
+        }
 
     def _window_size(self) -> int:
         """Chunks to dispatch before the next host sync (see
@@ -1426,6 +1539,8 @@ class BatchScheduler:
                 self._row_params_dirty = True
                 if not migrated:
                     self._retire_error(req, str(err))
+                else:
+                    self._release_adapter(req)
         live = [
             len(self._row_blocks[b])
             for b, r in enumerate(self._rows) if r is not None
@@ -1557,7 +1672,7 @@ class BatchScheduler:
             nxt_d, self._cache, acc_d = e._spec_verify(
                 e.params, self._cur, drafts, lens, self._cache,
                 self._offsets, temps, topks, topps, minps,
-                e._next_key(), tables,
+                e._next_key(), tables, **self._lora_args(),
             )
             nxt, acc = (np.asarray(x) for x in jax.device_get((nxt_d, acc_d)))
         _H_STEP.observe((time.perf_counter() - t_step) * 1000.0)
@@ -1654,6 +1769,7 @@ class BatchScheduler:
             # from the same readback the tokens needed anyway — the whole
             # window runs with zero eager device ops
             cur_d, off_d = self._cur, self._offsets
+            lora = self._lora_args()
             toks_parts = []
             for _ in range(W):
                 if pen:
@@ -1662,13 +1778,14 @@ class BatchScheduler:
                             e.params, cur_d, self._cache, off_d, self._counts,
                             temps, topks, topps, minps,
                             self._reps, self._press, self._freqs,
-                            e._next_key(), tables,
+                            e._next_key(), tables, **lora,
                         )
                     )
                 else:
                     cur_d, self._cache, off_d, toks = self._decode(
                         e.params, cur_d, self._cache, off_d,
                         temps, topks, topps, minps, e._next_key(), tables,
+                        **lora,
                     )
                 toks_parts.append(toks)
             parts_host = [np.asarray(x) for x in jax.device_get(toks_parts)]
@@ -1690,6 +1807,7 @@ class BatchScheduler:
             self._compact_and_shrink()
 
     def _retire(self, req: Request):
+        self._release_adapter(req)
         req.timing.t_done = time.perf_counter()
         self.stats.retired += 1
         self.stats.history.append(
@@ -1701,6 +1819,7 @@ class BatchScheduler:
         """Error-terminate an ADMITTED row with full retirement accounting
         (retired/history/t_done) — `admitted - retired` must not drift for
         rows the pool failed mid-decode."""
+        self._release_adapter(req)
         req.finish = "error"
         req.timing.t_done = time.perf_counter()
         self.stats.retired += 1

@@ -38,6 +38,7 @@ except ImportError:  # gate the missing dep: loopback shim (wscompat.py)
     from .. import wscompat as websockets
 
 from .. import protocol
+from ..adapters import AdapterPoolBusy, clamp_adapter_name, split_model_adapter
 from ..fleet import FleetController
 from ..health import HealthStore, SloTracker, build_digest, get_recorder, load_slo_config
 from ..joinlink import generate_join_link, parse_join_link
@@ -257,6 +258,11 @@ class P2PNode(StageTaskMixin):
         self.piece_store: dict[str, bytes] = {}
         self.piece_dir = Path(piece_dir) if piece_dir else None
         self.manifests: dict[str, ShardManifest] = {}
+        # weight/adapter distribution DHT (dht.DHTNode); the runtime (or a
+        # test) attaches it — None means adapter paging falls back to
+        # "resident adapters only" (ensure_adapter can't fetch)
+        self.dht = None
+        self._adapter_fetch_locks: dict[str, asyncio.Lock] = {}
 
         self._server = None
         self._lock = asyncio.Lock()  # guards peers/providers
@@ -607,6 +613,7 @@ class P2PNode(StageTaskMixin):
             protocol.FLEET_LEASE: self._handle_fleet_lease,
             protocol.FLEET_ACTION: self._handle_fleet_action,
             protocol.FLEET_ACK: self._handle_fleet_ack,
+            protocol.ADAPTER_ANNOUNCE: self._handle_adapter_announce,
             protocol.TASK: self._handle_task,
             protocol.RESULT: self._handle_result,
             protocol.TASK_ERROR: self._handle_result,
@@ -806,6 +813,22 @@ class P2PNode(StageTaskMixin):
                     pass
         if kv_info:
             digest["kv"] = kv_info
+        # adapter residency (adapters/): the router's placement input —
+        # a peer already holding the requested adapter skips the fetch +
+        # pool churn, so RouterPolicy credits it (never past an outright
+        # loaded node, same tolerance discipline as the prefix bonus)
+        adapter_info = {}
+        for name, svc in list(self.local_services.items()):
+            eng = getattr(svc, "engine", None)
+            if eng is not None:
+                try:
+                    resident = eng.resident_adapters()
+                except Exception:  # noqa: BLE001 — telemetry never throws
+                    resident = []
+                if resident:
+                    adapter_info[str(name)] = resident
+        if adapter_info:
+            digest["adapters"] = adapter_info
         # drain state rides the digest so RouterPolicy excludes draining
         # peers on the same gossip the rest of the scoring reads; the
         # disagg role is how prefill nodes find decode-designated targets
@@ -904,6 +927,108 @@ class P2PNode(StageTaskMixin):
             protocol.msg(protocol.SERVICE_ANNOUNCE, service=svc.name, meta=svc.get_metadata())
         )
 
+    async def announce_adapters(self, svc) -> int:
+        """Broadcast the service's CURRENT adapter residency (hot-swap
+        fetch/evict) so peers' provider tables track the per-adapter
+        model names without waiting for a re-hello."""
+        meta = svc.get_metadata()
+        return await self.broadcast(protocol.msg(
+            protocol.ADAPTER_ANNOUNCE,
+            peer_id=self.peer_id,
+            service=svc.name,
+            adapters=meta.get("adapters") or [],
+            models=meta.get("models") or [],
+        ))
+
+    async def _handle_adapter_announce(self, ws, data):
+        # like telemetry: identity comes from the CONNECTION, not the
+        # frame's peer_id claim
+        pid = await self._peer_for(ws)
+        svc = data.get("service")
+        names = data.get("adapters")
+        if not pid or not svc or not isinstance(names, list):
+            return
+        async with self._lock:
+            meta = self.providers.setdefault(pid, {}).setdefault(str(svc), {})
+            meta["adapters"] = [str(n) for n in names[:64]]
+            models = data.get("models")
+            if isinstance(models, list) and models:
+                meta["models"] = [str(m) for m in models[:256]]
+
+    async def ensure_adapter(self, svc, name: str) -> bool:
+        """Resolve one adapter for an engine-backed service: already
+        resident → True; otherwise PAGE it in over the mesh (DHT manifest
+        → sha256-verified pieces → AdapterPool, LRU-evicting a cold
+        adapter) without restarting the engine, then re-announce
+        residency. False = unknown adapter (the caller answers the typed
+        404 / unknown_adapter). AdapterPoolBusy propagates — every slot
+        pinned by in-flight rows is BACKPRESSURE on a valid adapter, and
+        collapsing it to False would tell the client a published adapter
+        does not exist (a 404 an SDK will never retry). Concurrent
+        requests for the same adapter share one fetch via a per-name
+        lock."""
+        engine = getattr(svc, "engine", None)
+        if engine is None or getattr(engine, "adapter_pool", None) is None:
+            return False
+        if engine.has_adapter(name):
+            return True
+        if self.dht is None:
+            return False
+        lock = self._adapter_fetch_locks.setdefault(name, asyncio.Lock())
+        try:
+            async with lock:
+                if engine.has_adapter(name):
+                    return True
+                base = engine.model_cfg.name
+                from ..adapters.distrib import (
+                    UnknownAdapterManifest,
+                    fetch_adapter,
+                )
+
+                try:
+                    with get_tracer().span(
+                        "adapter.fetch", adapter=name, model=base
+                    ):
+                        adapters, lcfg = await fetch_adapter(
+                            self, self.dht, base, name,
+                            model_cfg=engine.model_cfg,
+                        )
+                        # load on an executor: the device write +
+                        # validation must not park the mesh reader loop
+                        await asyncio.get_running_loop().run_in_executor(
+                            None,
+                            lambda: engine.load_adapter(name, adapters, lcfg),
+                        )
+                except UnknownAdapterManifest:
+                    # nobody published this name: the typed-404 case, not
+                    # an infrastructure failure — no incident
+                    logger.info("adapter %r: no manifest on the DHT", name)
+                    return False
+                except AdapterPoolBusy:
+                    # transient: every slot has in-flight rows. Not a
+                    # fetch failure (no incident) and NOT unknown — the
+                    # caller maps it onto the pool_exhausted shed
+                    raise
+                except Exception as e:  # noqa: BLE001 — fetch/verify/pool
+                    self.recorder.incident(
+                        "adapter:fetch_failed",
+                        detail=str(e),
+                        node=self.peer_id,
+                        extra={"adapter": name, "model": base},
+                    )
+                    logger.warning("adapter %r fetch failed: %s", name, e)
+                    return False
+                self._spawn(self.announce_adapters(svc))
+                return True
+        finally:
+            # never let wire-chosen names accumulate state: the lock only
+            # matters while a fetch is in flight. Waiters still hold their
+            # reference to this lock object; a post-pop arrival creating a
+            # fresh lock can at worst duplicate a fetch (benign — the
+            # in-lock has_adapter re-check absorbs it).
+            if not lock.locked():
+                self._adapter_fetch_locks.pop(name, None)
+
     def list_providers(self, model: str | None = None) -> list[dict]:
         """Flatten local + remote providers (reference p2p_runtime.py:687-721)."""
         out = []
@@ -936,6 +1061,7 @@ class P2PNode(StageTaskMixin):
         prompt: str | None = None,
         exclude=(),
         remote_only: bool = False,
+        adapter: str | None = None,
     ) -> dict | None:
         """Telemetry-scored provider pick (router/policy.py): queue-wait,
         batch-fill headroom, paged-pool pressure, SLO burn state, RTT and
@@ -963,7 +1089,8 @@ class P2PNode(StageTaskMixin):
             if any(p["local"] for p in cands) else None
         )
         winner, _decision = self.router.pick(
-            cands, fresh, local_digest=local_digest, prompt=prompt
+            cands, fresh, local_digest=local_digest, prompt=prompt,
+            adapter=adapter,
         )
         return winner
 
@@ -1069,6 +1196,34 @@ class P2PNode(StageTaskMixin):
         for svc in self.local_services.values():
             models = svc.get_metadata().get("models", [])
             if any(model.lower() in m.lower() or m.lower() in model.lower() for m in models):
+                return svc
+        return None
+
+    @staticmethod
+    def adapter_capable(svc) -> bool:
+        """Can this service serve `<base>:<adapter>` model ids? Only an
+        engine-backed service with an AdapterPool can — the gate that
+        scopes the colon grammar: backends whose OWN model ids contain
+        colons (ollama tags like "llama3:8b") must keep serving them
+        verbatim."""
+        engine = getattr(svc, "engine", None)
+        return engine is not None and getattr(engine, "adapter_pool", None) is not None
+
+    def service_advertising(self, model) -> object | None:
+        """The local service whose metadata lists `model` VERBATIM (case-
+        insensitive), or None. Deliberately stricter than the fuzzy
+        local_service_for: deciding that a colon-containing id is the
+        backend's own tag (not our adapter grammar) must not fuzzy-match
+        "tiny-llama:acme" onto a pool-less "tiny-llama" service and
+        silently serve the plain base."""
+        if not isinstance(model, str):
+            return None
+        for svc in self.local_services.values():
+            models = svc.get_metadata().get("models", [])
+            if any(
+                isinstance(m, str) and m.lower() == model.lower()
+                for m in models
+            ):
                 return svc
         return None
 
@@ -1186,7 +1341,50 @@ class P2PNode(StageTaskMixin):
     async def _serve_gen_request(self, ws, data):
         rid = data.get("rid") or data.get("task_id")
         model = data.get("model")
-        svc = self.local_services.get(data.get("svc", "")) or self.local_service_for(model)
+        # multi-adapter serving: the adapter rides either the explicit
+        # `adapter` key or the "<base>:<name>" model form — one parser
+        # (adapters.split_model_adapter) for every surface. The wire
+        # claim is CLAMPED: an oversized/exotic string — via EITHER
+        # carrier — answers the typed unknown_adapter below; it must
+        # never mint metric series or DHT keys, and never silently
+        # degrade to serving the plain base model.
+        base_model, model_adapter = split_model_adapter(model)
+        svc = self.local_services.get(data.get("svc", "")) or self.local_service_for(base_model)
+        if (
+            data.get("adapter") is None and model_adapter is not None
+            and not self.adapter_capable(svc)
+        ):
+            # the colon can only mean OUR adapter grammar on a pooled
+            # engine; a backend advertising the full id verbatim (ollama
+            # "llama3:8b") keeps serving it whole. No verbatim match
+            # keeps the split, so a pool-less engine still answers the
+            # typed unknown_adapter below instead of silently serving
+            # the plain base.
+            verbatim = self.service_advertising(model)
+            if verbatim is not None:
+                svc, base_model, model_adapter = verbatim, model, None
+        raw_adapter = (
+            data.get("adapter")
+            if data.get("adapter") is not None else model_adapter
+        )
+        adapter = None
+        if raw_adapter is not None:
+            adapter = clamp_adapter_name(raw_adapter)
+            if adapter is None:
+                if data.get("adapter") is None and svc is None:
+                    # model-derived half on a pure relay hop: not ours
+                    # to judge — forward the original id whole below and
+                    # let the serving node parse it (a backend's own
+                    # tags may use chars our adapter names forbid)
+                    pass
+                else:
+                    with contextlib.suppress(Exception):
+                        await self._send(ws, protocol.msg(
+                            protocol.GEN_ERROR, rid=rid,
+                            error="unknown_adapter: malformed adapter name",
+                            error_kind="unknown_adapter",
+                        ))
+                    return
         mnt = data.get("max_new_tokens")
         if mnt is None:  # explicit 0 must stay 0 ("or" would turn it into 2048)
             mnt = data.get("max_tokens")
@@ -1196,6 +1394,33 @@ class P2PNode(StageTaskMixin):
             "temperature": data.get("temperature", 0.7),
         }
         protocol.copy_sampling(data, params)
+        if svc is not None and adapter:
+            # resolve (or PAGE IN over the DHT) before admission: a slot
+            # must not sit occupied through a multi-second piece fetch
+            try:
+                resolved = await self.ensure_adapter(svc, adapter)
+            except AdapterPoolBusy as busy:
+                # valid adapter, saturated pool: the pool_exhausted shed
+                # (retryable 503 twin), NEVER unknown_adapter — a 404
+                # would tell the client a published adapter is gone
+                with contextlib.suppress(Exception):
+                    await self._send(ws, protocol.msg(
+                        protocol.GEN_ERROR, rid=rid,
+                        error=f"adapter_pool_busy: {busy}",
+                        error_kind="pool_exhausted",
+                        retry_after_s=self.admission.config.shed_retry_after_s,
+                    ))
+                return
+            if not resolved:
+                with contextlib.suppress(Exception):
+                    await self._send(ws, protocol.msg(
+                        protocol.GEN_ERROR, rid=rid,
+                        error=f"unknown_adapter: {adapter!r} is not resident "
+                              "and could not be fetched",
+                        error_kind="unknown_adapter",
+                    ))
+                return
+            params["adapter"] = adapter
         if svc is not None:
             # p2p ingress admission (router/admission.py): the frame's
             # tenant claim is clamped to a CONFIGURED name — an arbitrary
@@ -1266,6 +1491,7 @@ class P2PNode(StageTaskMixin):
             prompt=params["prompt"],
             exclude={requester} if requester else (),
             remote_only=True,
+            adapter=adapter,
         )
         if cand is None:
             await self._send(
@@ -1276,6 +1502,14 @@ class P2PNode(StageTaskMixin):
             )
             return
         _C_RELAY_HOPS.inc()
+        relay_extra = protocol.copy_sampling(params, {})
+        if adapter and data.get("adapter") is not None:
+            # an EXPLICIT adapter claim survives the relay hop explicitly
+            # — the serving node clamps/resolves it against ITS OWN pool.
+            # A model-string-derived half stays inside the forwarded
+            # model id instead: this relay can't know whether the far
+            # node reads "llama3:8b" as its own tag or as our grammar.
+            relay_extra["adapter"] = adapter
         try:
             if data.get("stream"):
                 # relay the STREAM too: chunks from the far provider are
@@ -1292,7 +1526,7 @@ class P2PNode(StageTaskMixin):
                         temperature=params["temperature"],
                         stream=True,
                         on_chunk=relay_q.put_nowait,
-                        extra=protocol.copy_sampling(params, {}),
+                        extra=relay_extra,
                         # the ORIGINAL claim, unclamped: the serving node
                         # clamps against its own tenant config
                         tenant=data.get("tenant"),
@@ -1312,7 +1546,7 @@ class P2PNode(StageTaskMixin):
                     model=model,
                     max_new_tokens=params["max_new_tokens"],
                     temperature=params["temperature"],
-                    extra=protocol.copy_sampling(params, {}),
+                    extra=relay_extra,
                     tenant=data.get("tenant"),
                 )
             # the inner result carries its own rid — replace it with ours

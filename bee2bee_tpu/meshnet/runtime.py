@@ -57,6 +57,53 @@ def build_service(backend: str, model: str, cfg: NodeConfig, **kw):
     raise ValueError(f"unknown backend {backend!r} (tpu | ollama | hf_remote | fake)")
 
 
+def parse_adapter_spec(spec: str) -> list[tuple[str, str]]:
+    """Parse ``BEE2BEE_ADAPTERS`` / ``--adapters``: a comma-separated
+    list of ``name=path.npz`` entries → [(name, path)]. Loud on junk —
+    a silently-dropped adapter would serve the wrong tenant the base."""
+    out: list[tuple[str, str]] = []
+    for entry in (spec or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, sep, path = entry.partition("=")
+        if not sep or not name.strip() or not path.strip():
+            raise ValueError(
+                f"bad adapter entry {entry!r}: expected name=path.npz"
+            )
+        out.append((name.strip(), path.strip()))
+    return out
+
+
+async def _preload_adapters(node, dht, svc, spec: str):
+    """Load the configured adapters into the engine's pool, publish each
+    as a pieces manifest on the DHT (peers page them in on demand), and
+    announce residency. Failures are LOUD — the operator configured
+    these adapters by name; serving without them is wrong output."""
+    engine = getattr(svc, "engine", None)
+    if engine is None or engine.adapter_pool is None:
+        raise ValueError(
+            "--adapters requires the tpu backend with max_adapters > 0"
+        )
+    from ..adapters.distrib import publish_adapter
+    from ..train.lora import load_adapters
+
+    loop = asyncio.get_running_loop()
+    for name, path in parse_adapter_spec(spec):
+        adapters, lcfg = await loop.run_in_executor(
+            None, lambda p=path: load_adapters(p, model_cfg=engine.model_cfg)
+        )
+        await loop.run_in_executor(
+            None, lambda n=name, a=adapters, c=lcfg: engine.load_adapter(n, a, c)
+        )
+        if dht is not None:
+            await publish_adapter(
+                node, dht, engine.model_cfg.name, name, adapters, lcfg
+            )
+        logger.info("adapter %s loaded from %s", name, path)
+    await node.announce_adapters(svc)
+
+
 def _parse_dht_bootstrap(spec: str) -> list[tuple[str, int]]:
     """"host:port,[v6::addr]:port,barehost" → [(host, port), ...].
 
@@ -175,11 +222,19 @@ async def run_p2p_node(
                 stage_runner.spec.stage + 1, stage_runner.spec.n_stages,
                 model, stage_runner.info["layers"], node.join_link(),
             )
-        if (publish_weights or from_mesh) and dht is None:
+        # adapter paging (adapters/) rides the same DHT leg as weight
+        # distribution: a node with an adapter pool needs one to fetch
+        # non-resident adapters on demand, and one to publish its own
+        wants_adapters = backend == "tpu" and (
+            cfg.adapters or cfg.max_adapters > 0
+        )
+        if (publish_weights or from_mesh or wants_adapters) and dht is None:
             from ..dht import DHTNode
 
             dht = DHTNode(port=cfg.dht_port)
             await dht.start(_parse_dht_bootstrap(cfg.dht_bootstrap) or None)
+        if dht is not None:
+            node.dht = dht  # ensure_adapter's fetch path reads this
 
         if backend == "tpu" and from_mesh:
             if lora_path:
@@ -223,6 +278,12 @@ async def run_p2p_node(
                 "stage worker awaiting part_load for %s; join link: %s",
                 model, node.join_link(),
             )
+
+        if backend == "tpu" and cfg.adapters:
+            # preload + publish the configured adapters (BEE2BEE_ADAPTERS
+            # / serve-tpu --adapters): this node serves them immediately
+            # and seeds the mesh so peers can page them in
+            await _preload_adapters(node, dht, svc, cfg.adapters)
 
         if publish_weights and backend == "tpu":
             # publishes after a --from-mesh join too: a joined peer reseeds

@@ -390,6 +390,39 @@ def matmul(x, w):
     return x @ w
 
 
+def _lora_rows(ab, ids, scale):
+    """Gather one layer's per-ROW adapter factors: ``ab`` is the pool's
+    stacked {"a": [N, din, r], "b": [N, r, dout]} slice for this layer,
+    ``ids`` [B] each row's pool slot (0 = the reserved null adapter,
+    all-zero factors), ``scale`` [N] each slot's alpha/rank scaling.
+    Returns (a [B, din, r], b [B, r, dout], s [B])."""
+    return ab["a"][ids], ab["b"][ids], scale[ids]
+
+
+def lora_matmul(x, w, name, lora):
+    """The multi-adapter serving hook around ``matmul``: base projection
+    plus each row's low-rank delta ``s * (x @ A) @ B`` (adapters/pool.py
+    holds the stacked factors; train/lora.py defines the merge math this
+    must agree with). ``lora`` is None (plain matmul — the trace is
+    byte-identical to the pre-adapter graph) or {"ab": per-layer target
+    dict, "ids": [B], "scale": [N]}; a target absent from the pool passes
+    through untouched. Rows mapped to slot 0 gather the null adapter's
+    zero factors, so adapter-less rows in a mixed batch stay exact (the
+    batch-level skip for ALL-baseline batches lives in the scheduler,
+    same per-row gating discipline as spec decode). The rank-r einsums
+    run in f32 like merge_lora's delta, then cast back — x is [B, T, din]
+    everywhere this is called (the batch dim is the row identity)."""
+    out = matmul(x, w)
+    ab = None if lora is None else lora["ab"].get(name)
+    if ab is None:
+        return out
+    a, b, s = _lora_rows(ab, lora["ids"], lora["scale"])
+    xf = x.astype(jnp.float32)
+    h = jnp.einsum("btd,bdr->btr", xf, a.astype(jnp.float32))
+    delta = jnp.einsum("btr,bro->bto", h, b.astype(jnp.float32))
+    return out + (delta * s[:, None, None]).astype(out.dtype)
+
+
 def expert_einsum(spec, x, w, s_expand):
     """Expert-weight einsum with optional int8 quantization.
 
@@ -405,13 +438,13 @@ def expert_einsum(spec, x, w, s_expand):
     return jnp.einsum(spec, x, w)
 
 
-def _mlp(x, p, cfg: ModelConfig):
-    up = matmul(x, p["w_up"])
+def _mlp(x, p, cfg: ModelConfig, lora=None):
+    up = lora_matmul(x, p["w_up"], "w_up", lora)
     if "b_up" in p:
         up = up + p["b_up"]
-    gate = matmul(x, p["w_gate"]) if "w_gate" in p else None
+    gate = lora_matmul(x, p["w_gate"], "w_gate", lora) if "w_gate" in p else None
     h = _activate(up, gate, cfg)
-    out = matmul(h, p["w_down"])
+    out = lora_matmul(h, p["w_down"], "w_down", lora)
     if "b_down" in p:
         out = out + p["b_down"]
     return out
@@ -531,7 +564,7 @@ def embed_tokens(params: Params, cfg: ModelConfig, input_ids, positions):
 
 def transformer_block(
     lp: Params, cfg: ModelConfig, x, positions, mask, kv_hook=None,
-    attn_fn=None, rope_local=None,
+    attn_fn=None, rope_local=None, lora=None,
 ):
     """One block. lp: a single layer's params (no leading L dim). x [B,T,D].
 
@@ -544,14 +577,19 @@ def transformer_block(
     the dense softmax attention — the sequence-parallel path passes ring
     attention here, the engine's flash path passes the pallas kernel
     (which derives per-batch cache offsets from `positions`).
+
+    ``lora`` (multi-adapter serving, adapters/pool.py): one layer's
+    stacked per-target A/B factors plus the batch's per-row slot ids —
+    every projection goes through lora_matmul, which adds each row's
+    low-rank delta after the (possibly quantized) base matmul.
     """
     B, T, _ = x.shape
     H, Hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
     h = x if cfg.no_pre_norms else _norm(x, lp["ln1"], cfg)
-    q = matmul(h, lp["attn"]["wq"])
-    k = matmul(h, lp["attn"]["wk"])
-    v = matmul(h, lp["attn"]["wv"])
+    q = lora_matmul(h, lp["attn"]["wq"], "wq", lora)
+    k = lora_matmul(h, lp["attn"]["wk"], "wk", lora)
+    v = lora_matmul(h, lp["attn"]["wv"], "wv", lora)
     if "bq" in lp["attn"]:
         q = q + lp["attn"]["bq"]
         k = k + lp["attn"]["bk"]
@@ -591,7 +629,7 @@ def transformer_block(
         attn_out = _attention(q, k, v, mask, cfg)
     else:
         attn_out = attn_fn(q, k, v, mask, cfg, positions=positions)
-    attn_out = matmul(attn_out, lp["attn"]["wo"])
+    attn_out = lora_matmul(attn_out, lp["attn"]["wo"], "wo", lora)
     if "bo" in lp["attn"]:
         attn_out = attn_out + lp["attn"]["bo"]
     if cfg.parallel_block:
@@ -599,13 +637,17 @@ def transformer_block(
         # (parallel_norms=1) feeds both from ln1's output; gpt-neox
         # (parallel_norms=2) norms the mlp branch separately with ln2
         h_mlp = h if cfg.parallel_norms == 1 else _norm(x, lp["ln2"], cfg)
-        return x + attn_out + _mlp(h_mlp, lp["mlp"], cfg)
+        return x + attn_out + _mlp(h_mlp, lp["mlp"], cfg, lora)
     if cfg.post_norms:  # gemma-2/olmo2: norm the attn OUTPUT
         attn_out = _norm(attn_out, lp["ln1_post"], cfg)
     x = x + attn_out
 
     h2 = x if cfg.no_pre_norms else _norm(x, lp["ln2"], cfg)
-    mlp_out = _moe(h2, lp["moe"], cfg) if cfg.is_moe else _mlp(h2, lp["mlp"], cfg)
+    # MoE keeps base experts (lora MLP targets are rejected per-model by
+    # train/lora.validate_targets — expert weights carry an [L, E, ...] dim)
+    mlp_out = (
+        _moe(h2, lp["moe"], cfg) if cfg.is_moe else _mlp(h2, lp["mlp"], cfg, lora)
+    )
     if cfg.post_norms:
         mlp_out = _norm(mlp_out, lp["ln2_post"], cfg)
     return x + mlp_out
@@ -709,6 +751,10 @@ def forward(
     block_tables=None,  # [B, MB] int32: paged cache — see below
     paged_write_floor=None,  # [] int32: drop paged WRITES below this position
     paged_write_ceil=None,  # [] int32: drop paged WRITES at/after this position
+    adapters=None,  # multi-LoRA serving (adapters/pool.py): stacked pool
+    # factors {target: {"a": [L, N, din, r], "b": [L, N, r, dout]}}
+    adapter_ids=None,  # [B] int32: each row's pool slot (0 = no adapter)
+    adapter_scales=None,  # [N] f32: per-slot alpha/rank scaling
 ):
     """Run a [B, T] token chunk. Returns (logits [B, T, V], new_cache).
 
@@ -810,6 +856,19 @@ def forward(
     else:
         layer_mask = make_layer_mask(cfg, positions, T, S)
 
+    # multi-adapter serving: the per-row slot ids and scales are batch-
+    # constant across layers; the stacked factors ride the layer loop
+    # (scan xs / per-layer index) so one layer's [N, din, r] slice — not
+    # the whole [L, ...] stack — enters each block's gather
+    if adapters is not None:
+        aids = jnp.asarray(adapter_ids, jnp.int32)
+        ascale = jnp.asarray(adapter_scales, jnp.float32)
+
+        def lora_for(lad):
+            return {"ab": lad, "ids": aids, "scale": ascale}
+    else:
+        lora_for = None
+
     def rope_flag(layer_idx):
         if cfg.local_rope_theta is None:
             return None
@@ -817,13 +876,14 @@ def forward(
 
     def layer(carry, xs):
         x, lcache = carry
-        lp, layer_idx = xs
+        lp, layer_idx = xs[0], xs[1]
+        lora = lora_for(xs[2]) if len(xs) > 2 else None
 
         if lcache is None:  # training/scoring path: plain block
             return (
                 transformer_block(lp, cfg, x, positions,
                                   layer_mask(layer_idx), attn_fn=attn_fn,
-                                  rope_local=rope_flag(layer_idx)),
+                                  rope_local=rope_flag(layer_idx), lora=lora),
                 None,
             ), None
 
@@ -933,7 +993,7 @@ def forward(
         x = transformer_block(
             lp, cfg, x, positions, layer_mask(layer_idx),
             kv_hook=kv_hook, attn_fn=attn_fn,
-            rope_local=rope_flag(layer_idx)
+            rope_local=rope_flag(layer_idx), lora=lora,
         )
         return (x, lcache), None
 
@@ -955,14 +1015,20 @@ def forward(
         # models.unstack_layers converts; engine does it when backend=cpu.
         carry = (x, cache)
         for i, lp in enumerate(layer_params):
-            carry, _ = layer_body(carry, (lp, i))
+            if adapters is not None:
+                lad = jax.tree.map(lambda a: a[i], adapters)
+                carry, _ = layer_body(carry, (lp, i, lad))
+            else:
+                carry, _ = layer_body(carry, (lp, i))
         x, new_cache = carry
     else:
-        (x, new_cache), _ = lax.scan(
-            layer_body,
-            (x, cache),
-            (layer_params, jnp.arange(n_layers)),
-        )
+        xs = (layer_params, jnp.arange(n_layers))
+        if adapters is not None:
+            # the [L, N, ...] factor stacks join the scan xs, so each
+            # layer body sees only its own [N, ...] slice; adapters=None
+            # keeps the 2-tuple — the pre-adapter trace is unchanged
+            xs = xs + (adapters,)
+        (x, new_cache), _ = lax.scan(layer_body, (x, cache), xs)
 
     return final_logits(params, cfg, x), new_cache
 

@@ -60,6 +60,14 @@ KV_IMPORT_ACK = "kv_import_ack"
 FLEET_LEASE = "fleet_lease"
 FLEET_ACTION = "fleet_action"
 FLEET_ACK = "fleet_ack"
+# batched multi-LoRA serving (adapters/): a node whose adapter pool
+# residency CHANGED (hot-swap fetch / eviction) broadcasts the new set so
+# peers' provider tables track per-adapter model names ("<base>:<name>")
+# without waiting for a re-hello — hello itself already carries the
+# residency inside the service metadata. Not in the reference message
+# set; old peers ignore the frame and simply route adapter traffic by
+# the fuzzy model match alone.
+ADAPTER_ANNOUNCE = "adapter_announce"
 
 # ---- coordinator/worker task protocol (reference protocol.py:25-53, node.py:89+)
 REGISTER = "register"
@@ -115,6 +123,7 @@ MESSAGE_TYPES = frozenset(
         FLEET_LEASE,
         FLEET_ACTION,
         FLEET_ACK,
+        ADAPTER_ANNOUNCE,
         REGISTER,
         INFO,
         TASK,
@@ -213,6 +222,14 @@ def decode_binary(raw: bytes) -> tuple[dict, dict]:
         raise ValueError("not a protocol message")
     return header, tensors
 
+
+# multi-adapter serving (adapters/): which LoRA adapter a generation runs
+# under, riding GEN_REQUEST as an optional key (the "<base>:<adapter>"
+# model form parses to the same thing — adapters.split_model_adapter is
+# the one rule). Receivers CLAMP the claim (adapters.clamp_adapter_name)
+# and answer a typed unknown_adapter GEN_ERROR when nothing resolves —
+# a wire string must never mint metric series or DHT keys.
+ADAPTER = "adapter"
 
 # per-tenant serving identity (router/): resolved from the API key at the
 # gateway, riding GEN_REQUEST (and relay hops) as an optional key so the

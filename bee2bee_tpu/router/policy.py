@@ -58,6 +58,10 @@ _C_WARMUP_EXCLUDED = get_registry().counter(
     "router.warmup_excluded",
     "candidates excluded as standby/warming fleet replicas",
 )
+_C_ADAPTER_PREFERRED = get_registry().counter(
+    "router.adapter_preferred",
+    "scored picks whose winner already held the requested adapter",
+)
 
 MODE_SCORED = "scored"
 MODE_STATIC = "static_fallback"
@@ -76,6 +80,12 @@ class RouterWeights:
     prefix_max_blocks: int = 2  # cap on credited blocks ("within tolerance":
     # a prefix match may beat at most ~prefix_bonus*max/fill of batch-fill
     # difference, never a peer that is outright loaded)
+    adapter_bonus: float = 0.12  # score credit for a peer whose digest
+    # advertises the requested LoRA adapter resident (adapters/): routing
+    # there skips a whole DHT piece fetch + pool churn. Like the prefix
+    # bonus it is affinity-within-tolerance — burning/draining peers are
+    # excluded BEFORE scoring, and the bonus stays below fill+pool
+    # weights so residency never beats an outright-loaded node
     queue_ref_ms: float = 500.0  # soft knee: p95 at the knee scores 0.5
     rtt_ref_ms: float = 100.0
     unknown: float = 0.5       # the explicit unknown tier for digest-less peers
@@ -128,11 +138,22 @@ class RouterPolicy:
     # ------------------------------------------------------------- scoring
 
     def score(self, cand: dict, digest: dict | None, rtt_ms: float | None,
-              max_price: float, prompt_hashes: list[str]) -> tuple[float, dict]:
+              max_price: float, prompt_hashes: list[str],
+              adapter: str | None = None) -> tuple[float, dict]:
         """(penalty score, breakdown) for one candidate. ``digest`` is the
         peer's fresh telemetry digest (the node's own live digest for the
-        local candidate); None selects the unknown tier."""
+        local candidate); None selects the unknown tier. ``adapter``
+        credits peers whose digest advertises that LoRA adapter resident."""
         w = self.weights
+        adapter_resident = bool(
+            adapter
+            and digest is not None
+            and any(
+                adapter in names
+                for names in (digest.get("adapters") or {}).values()
+                if isinstance(names, (list, tuple))
+            )
+        )
         if digest is None:
             queue = fill = pool = w.unknown
             matched = 0
@@ -164,11 +185,13 @@ class RouterPolicy:
             w.queue * queue + w.fill * fill + w.pool * pool
             + w.rtt * rtt + w.price * pnorm
             - w.prefix_bonus * matched
+            - (w.adapter_bonus if adapter_resident else 0.0)
         )
         return score, {
             "queue": round(queue, 4), "fill": round(fill, 4),
             "pool": round(pool, 4), "rtt": round(rtt, 4),
             "price": round(pnorm, 4), "prefix_blocks": matched,
+            "adapter_resident": adapter_resident,
             "unknown": digest is None, "score": round(score, 4),
         }
 
@@ -180,6 +203,7 @@ class RouterPolicy:
         fresh_digests: dict[str, dict],
         local_digest: dict | None = None,
         prompt: str | None = None,
+        adapter: str | None = None,
     ) -> tuple[dict | None, dict]:
         """Pick from candidates using fresh digests; returns
         ``(winner | None, decision)``. The caller handles the no-fresh-
@@ -218,7 +242,8 @@ class RouterPolicy:
                 _C_SLO_EXCLUDED.inc()
                 continue
             s, breakdown = self.score(
-                cand, digest, cand.get("_latency"), max_price, ph
+                cand, digest, cand.get("_latency"), max_price, ph,
+                adapter=adapter,
             )
             # deterministic tie-break: local first, then provider id
             scored.append((s, i, cand, breakdown))
@@ -237,7 +262,8 @@ class RouterPolicy:
                 ):
                     continue
                 s, breakdown = self.score(
-                    cand, digest, cand.get("_latency"), max_price, ph
+                    cand, digest, cand.get("_latency"), max_price, ph,
+                    adapter=adapter,
                 )
                 breakdown["slo_override"] = True
                 scored.append((s, i, cand, breakdown))
@@ -250,6 +276,8 @@ class RouterPolicy:
         _C_DECISIONS.inc(mode=MODE_SCORED)
         if breakdown.get("prefix_blocks"):
             _C_PREFIX_PREFERRED.inc()
+        if breakdown.get("adapter_resident"):
+            _C_ADAPTER_PREFERRED.inc()
         return winner, {
             "mode": MODE_SCORED,
             "candidates": len(candidates),

@@ -43,6 +43,10 @@ class TenantSpec:
     # (0 = one minute of sustained rate)
     rate_tokens_per_min: float = 0.0
     burst_tokens: float = 0.0
+    # default LoRA adapter (adapters/): a request from this tenant that
+    # names no adapter (plain base model id, no `adapter` field) serves
+    # under this one — the one-base-many-tenants mapping. None = base.
+    adapter: str | None = None
 
     @property
     def rate_tokens_per_s(self) -> float:
@@ -54,7 +58,7 @@ class TenantSpec:
 
 
 _ALLOWED_KEYS = frozenset(
-    {"api_key", "weight", "rate_tokens_per_min", "burst_tokens"}
+    {"api_key", "weight", "rate_tokens_per_min", "burst_tokens", "adapter"}
 )
 
 
@@ -85,9 +89,21 @@ def parse_tenant_config(obj) -> dict[str, TenantSpec]:
                 # match would silently absorb the second tenant's traffic
                 raise ValueError(f"tenant {name!r}: api_key reused by another tenant")
             seen_keys.add(key)
+        adapter = spec.get("adapter")
+        if adapter is not None:
+            from ..adapters import clamp_adapter_name
+
+            if clamp_adapter_name(str(adapter)) is None:
+                # same clamp as the wire: a malformed default would turn
+                # every request from this tenant into a typed 404
+                raise ValueError(
+                    f"tenant {name!r}: invalid adapter name {adapter!r}"
+                )
+            adapter = str(adapter)
         out[str(name)] = TenantSpec(
             name=str(name), api_key=key, weight=weight,
             rate_tokens_per_min=rate, burst_tokens=burst,
+            adapter=adapter,
         )
     return out
 
@@ -131,6 +147,13 @@ class TenantRegistry:
     def weight(self, name: str) -> float:
         spec = self.specs.get(name)
         return spec.weight if spec else 1.0
+
+    def default_adapter(self, name: str) -> str | None:
+        """The tenant's configured default LoRA adapter (adapters/), or
+        None for the base model. Applied only when the request itself
+        names no adapter — an explicit model="<base>:<name>" wins."""
+        spec = self.specs.get(name)
+        return spec.adapter if spec else None
 
     def weights(self) -> dict[str, float]:
         return {name: s.weight for name, s in self.specs.items()}

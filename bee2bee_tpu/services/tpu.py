@@ -72,6 +72,17 @@ class TPUService(BaseService):
         if self.engine is not None:
             meta["engine"] = self.engine.info
             meta["measured"] = self.engine.metrics.snapshot()
+            resident = self.engine.resident_adapters()
+            if resident:
+                # per-adapter model names (adapters/): "<base>:<name>"
+                # rides hello/announce metadata so the mesh can route an
+                # adapter request straight to a node already holding it
+                from ..adapters import adapter_model_name
+
+                meta["adapters"] = resident
+                meta["models"] = [self.model_name] + [
+                    adapter_model_name(self.model_name, a) for a in resident
+                ]
         return meta
 
     def _gen_args(self, params: dict) -> dict:
@@ -97,6 +108,10 @@ class TPUService(BaseService):
             "frequency_penalty": float(params.get("frequency_penalty", 0.0)),
             # fairness identity (router/): keys the scheduler's WDRR queue
             "tenant": str(params.get("tenant") or "default"),
+            # multi-adapter serving (adapters/): which pool adapter this
+            # generation decodes under (None = base model). The engine
+            # raises a typed UnknownAdapter for anything non-resident.
+            "adapter": params.get("adapter") or None,
         }
 
     def execute(self, params: dict[str, Any]) -> dict[str, Any]:

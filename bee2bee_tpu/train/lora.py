@@ -104,14 +104,7 @@ def init_lora(
     [L, D, H*hd], wk/wv [L, D, Hkv*hd], wo [L, H*hd, D], mlp [L, D, F]/
     [L, F, D]. B zero-init makes step 0 exactly the base model."""
     validate_targets(cfg, lcfg)
-    D, H, Hkv, hd, F = (
-        cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.d_ff
-    )
-    io = {
-        "wq": (D, H * hd), "wk": (D, Hkv * hd), "wv": (D, Hkv * hd),
-        "wo": (H * hd, D),
-        "w_gate": (D, F), "w_up": (D, F), "w_down": (F, D),
-    }
+    io = adapter_target_io(cfg)
     adapters = {}
     for t in lcfg.targets:
         din, dout = io[t]
@@ -232,27 +225,147 @@ class LoraTrainer:
         return merge_lora(self.base_params, self.adapters, self.lora_cfg)
 
 
+class AdapterLoadError(ValueError):
+    """Typed adapter load/validation failure: a corrupt file, a tampered
+    tensor, or factors whose shapes don't match the declared LoraConfig.
+    Raised HOST-side (load/validate time), so a bad adapter is a clean
+    error to the one caller — never a shape crash inside a jitted step
+    that would fail every in-flight request on the engine."""
+
+
+# adapter .npz layout version. v2 adds the per-tensor sha256 manifest
+# (__meta_sha256, pieces.py discipline); v1 files (no version key) load
+# without verification for backward compatibility.
+ADAPTER_FORMAT_VERSION = 2
+
+
+def adapter_target_io(cfg: ModelConfig) -> dict:
+    """{target: (din, dout)} against the base layout (core.init_params
+    schema) — THE one copy of the per-target shape map, shared by
+    init_lora, shape validation, and the serving pool's factor stacks
+    (adapters/pool.py); two copies would silently desynchronize pool
+    allocation from load-time validation."""
+    D, H, Hkv, hd, F = (
+        cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.d_ff
+    )
+    return {
+        "wq": (D, H * hd), "wk": (D, Hkv * hd), "wv": (D, Hkv * hd),
+        "wo": (H * hd, D),
+        "w_gate": (D, F), "w_up": (D, F), "w_down": (F, D),
+    }
+
+
+def validate_adapter_shapes(cfg: ModelConfig, adapters, lcfg: LoraConfig,
+                            max_rank: int | None = None) -> None:
+    """Check every A/B factor against the base layout implied by `cfg` and
+    the rank/targets `lcfg` declares. AdapterLoadError on any mismatch —
+    the typed gate every consumer (engine merge, AdapterPool.load, mesh
+    fetch) runs before factors go anywhere near a jit trace."""
+    try:
+        validate_targets(cfg, lcfg)
+    except AdapterLoadError:
+        raise
+    except ValueError as e:
+        # validate_targets raises bare ValueError (the training-time
+        # surface); here a per-model target mismatch is still the typed
+        # load error — a mesh fetch of a MoE-incompatible adapter must
+        # not masquerade as an infrastructure fetch_failed incident
+        raise AdapterLoadError(str(e)) from e
+    io = adapter_target_io(cfg)
+    if set(adapters) != set(lcfg.targets):
+        raise AdapterLoadError(
+            f"adapter targets {sorted(adapters)} != declared "
+            f"{sorted(lcfg.targets)}"
+        )
+    if max_rank is not None and lcfg.rank > max_rank:
+        raise AdapterLoadError(
+            f"adapter rank {lcfg.rank} exceeds pool rank {max_rank}"
+        )
+    for t, ab in adapters.items():
+        din, dout = io[t]
+        a_shape = tuple(getattr(ab.get("a"), "shape", ()))
+        b_shape = tuple(getattr(ab.get("b"), "shape", ()))
+        if a_shape != (cfg.n_layers, din, lcfg.rank):
+            raise AdapterLoadError(
+                f"adapter {t!r}: A shape {a_shape} != "
+                f"{(cfg.n_layers, din, lcfg.rank)} for {cfg.name!r}"
+            )
+        if b_shape != (cfg.n_layers, lcfg.rank, dout):
+            raise AdapterLoadError(
+                f"adapter {t!r}: B shape {b_shape} != "
+                f"{(cfg.n_layers, lcfg.rank, dout)} for {cfg.name!r}"
+            )
+
+
 def save_adapters(path, adapters, lora_cfg: LoraConfig) -> None:
-    """One .npz with the adapter arrays + the LoraConfig needed to merge
-    (rank/alpha/targets ride as metadata — a mismatched merge would be
-    silently wrong scaling)."""
+    """One .npz with the adapter arrays + a versioned manifest: the
+    LoraConfig needed to merge (rank/alpha/targets — a mismatched merge
+    would be silently wrong scaling) and a per-tensor sha256 map (the
+    pieces.py discipline), so load_adapters turns a corrupt or tampered
+    file into a typed AdapterLoadError instead of garbage weights."""
+    import json
+
     from ..models.loader import _flatten
+    from ..utils import sha256_hex
 
     flat = {k: np.asarray(v) for k, v in _flatten(jax.device_get(adapters)).items()}
+    hashes = {
+        k: sha256_hex(np.ascontiguousarray(v).tobytes()) for k, v in flat.items()
+    }
+    flat["__meta_version"] = np.int64(ADAPTER_FORMAT_VERSION)
     flat["__meta_rank"] = np.int64(lora_cfg.rank)
     flat["__meta_alpha"] = np.float64(lora_cfg.alpha)
     flat["__meta_targets"] = np.array(",".join(lora_cfg.targets))
+    flat["__meta_sha256"] = np.array(json.dumps(hashes, separators=(",", ":")))
     np.savez(path, **flat)
 
 
-def load_adapters(path) -> tuple[dict, LoraConfig]:
-    from ..models.loader import _unflatten
+def load_adapters(path, model_cfg: ModelConfig | None = None) -> tuple[dict, LoraConfig]:
+    """Load + verify an adapter .npz. v2 files carry a per-tensor sha256
+    manifest that is checked tensor-by-tensor; with ``model_cfg`` the
+    factor shapes are additionally validated against the base layout.
+    Any mismatch is a typed AdapterLoadError."""
+    import json
 
-    with np.load(path, allow_pickle=False) as z:
-        lcfg = LoraConfig(
-            rank=int(z["__meta_rank"]),
-            alpha=float(z["__meta_alpha"]),
-            targets=tuple(str(z["__meta_targets"]).split(",")),
-        )
-        flat = {k: z[k] for k in z.files if not k.startswith("__meta_")}
-    return _unflatten(flat), lcfg
+    from ..models.loader import _unflatten
+    from ..utils import sha256_hex
+
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            files = set(z.files)
+            missing = {"__meta_rank", "__meta_alpha", "__meta_targets"} - files
+            if missing:
+                raise AdapterLoadError(
+                    f"{path}: not an adapter file (missing {sorted(missing)})"
+                )
+            lcfg = LoraConfig(
+                rank=int(z["__meta_rank"]),
+                alpha=float(z["__meta_alpha"]),
+                targets=tuple(str(z["__meta_targets"]).split(",")),
+            )
+            flat = {k: z[k] for k in z.files if not k.startswith("__meta_")}
+            version = int(z["__meta_version"]) if "__meta_version" in files else 1
+            if version >= 2:
+                hashes = json.loads(str(z["__meta_sha256"]))
+                if set(hashes) != set(flat):
+                    raise AdapterLoadError(
+                        f"{path}: manifest names {sorted(hashes)} != "
+                        f"tensors {sorted(flat)}"
+                    )
+                for k, arr in flat.items():
+                    got = sha256_hex(np.ascontiguousarray(arr).tobytes())
+                    if got != hashes[k]:
+                        raise AdapterLoadError(
+                            f"{path}: tensor {k!r} hash mismatch "
+                            f"({got[:12]} != {hashes[k][:12]})"
+                        )
+    except AdapterLoadError:
+        raise
+    except ValueError as e:  # LoraConfig validation (bad rank/targets)
+        raise AdapterLoadError(f"{path}: {e}") from e
+    except Exception as e:  # zipfile/np.load corruption
+        raise AdapterLoadError(f"{path}: unreadable adapter file: {e}") from e
+    adapters = _unflatten(flat)
+    if model_cfg is not None:
+        validate_adapter_shapes(model_cfg, adapters, lcfg)
+    return adapters, lcfg

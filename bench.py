@@ -1246,6 +1246,127 @@ def bench_kv_quant(msl: int = 256) -> dict:
     return out
 
 
+def bench_lora_multi(msl: int = 256, new_tokens: int = 32,
+                     n_adapters: int = 8) -> dict:
+    """Batched multi-LoRA serving rung (ISSUE 14): N adapters resident
+    over ONE engine, mixed batches with per-row adapter selection in the
+    same decode step.
+
+    Three readings: (1) per-adapter greedy PARITY vs dedicated merged-
+    weights reference engines (f32 — bf16 argmax near-ties would flip on
+    math-order differences, the same reason the flash parity test pins
+    f32); (2) mixed-batch decode tok/s (8 rows, round-robin adapters)
+    vs the SAME engine serving 8 adapter-less rows — the reported
+    overhead of the per-row gather+rank-r einsums; (3) pool residency/
+    churn counters. Platform-stamped per PR 6 bench hygiene."""
+    import jax
+
+    from bee2bee_tpu.engine import EngineConfig, InferenceEngine
+    from bee2bee_tpu.models import core
+    from bee2bee_tpu.train.lora import LoraConfig, init_lora, merge_lora
+
+    lcfg = LoraConfig(rank=8, alpha=16.0)
+    out: dict = {
+        "platform": jax.devices()[0].platform,
+        "n_adapters": n_adapters,
+        "rank": lcfg.rank,
+    }
+
+    # ---- parity leg (f32, small budget): pool row == merged engine
+    fcfg = dict(max_seq_len=128, dtype="float32", cache_dtype="float32")
+    eng = InferenceEngine(
+        "distilgpt2",
+        engine_config=EngineConfig(max_batch=8, max_adapters=n_adapters, **fcfg),
+    )
+    try:
+        base = core.restack_layers(eng.params)
+        names = []
+        adapters_by_name = {}
+        for i in range(n_adapters):
+            name = f"tenant{i}"
+            ad = jax.tree.map(
+                lambda x, i=i: x + 0.01 * (i + 1),
+                init_lora(eng.model_cfg, lcfg, jax.random.key(i + 1)),
+            )
+            eng.load_adapter(name, ad, lcfg)
+            names.append(name)
+            adapters_by_name[name] = ad
+        prompt = [1 + j % 500 for j in range(64)]
+        parity_ok = 0
+        for name in names[:2]:  # 2 merged references bound the rung's cost
+            ref = InferenceEngine(
+                "distilgpt2",
+                params=merge_lora(base, jax.device_get(adapters_by_name[name]),
+                                  lcfg),
+                engine_config=EngineConfig(max_batch=1, **fcfg),
+            )
+            try:
+                got = eng.generate(prompt, max_new_tokens=8, temperature=0.0,
+                                   adapter=name)
+                want = ref.generate(prompt, max_new_tokens=8, temperature=0.0)
+                parity_ok += int(got.token_ids == want.token_ids)
+            finally:
+                ref.close()
+        out["parity_checked"] = 2
+        out["parity_ok"] = parity_ok
+
+        # ---- throughput leg: 8 mixed rows vs 8 base rows, SAME engine
+        prompts = [
+            [1 + (i * 37 + j) % 500 for j in range(64)] for i in range(8)
+        ]
+        eng.generate(prompts[0], max_new_tokens=8, temperature=0.0)  # warm
+
+        def run_batch(rows):
+            results: list = [None] * len(rows)
+            errors: list = []
+
+            def run(i, adapter):
+                try:
+                    results[i] = eng.generate(
+                        prompts[i], max_new_tokens=new_tokens,
+                        temperature=0.0, adapter=adapter,
+                    )
+                except Exception as e:  # noqa: BLE001 — re-raised below
+                    errors.append(e)
+
+            t0 = time.perf_counter()
+            threads = [
+                threading.Thread(target=run, args=(i, a))
+                for i, a in enumerate(rows)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            if errors:
+                raise RuntimeError(
+                    f"{len(errors)}/{len(rows)} rows failed"
+                ) from errors[0]
+            total = sum(r.new_tokens for r in results)
+            return round(total / wall, 2) if wall > 0 else 0.0
+
+        run_batch([None] * 8)  # warm the batch-8 trace too
+        base_tps = run_batch([None] * 8)
+        mixed_rows = [names[i % n_adapters] for i in range(8)]
+        run_batch(mixed_rows)  # warm the adapter trace
+        mixed_tps = run_batch(mixed_rows)
+        out["base_tok_per_s"] = base_tps
+        out["mixed_tok_per_s"] = mixed_tps
+        out["overhead"] = (
+            round(1.0 - mixed_tps / base_tps, 4) if base_tps > 0 else None
+        )
+        out["pool"] = eng.adapter_pool.info
+        log(
+            f"lora_multi rung [{out['platform']}]: {n_adapters} adapters, "
+            f"parity {parity_ok}/2, mixed {mixed_tps} tok/s vs base "
+            f"{base_tps} ({out['overhead']:.1%} overhead)"
+        )
+        return out
+    finally:
+        eng.close()
+
+
 def bench_reference_path() -> float:
     """The reference's hot loop: HF transformers greedy generate on torch CPU
     (reference hf.py:35-44 minus tokenization — token ids in, ids out)."""
@@ -1339,6 +1460,15 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — the rung must not kill the bench
         log(f"kv_quant rung failed: {e}")
         extras["kv_quant_distilgpt2"] = {"error": str(e)}
+
+    # batched multi-LoRA rung (ISSUE 14 acceptance: 8+ adapters served
+    # from one engine in mixed batches, per-adapter greedy parity vs the
+    # merged-weights reference, tok/s overhead vs adapter-less decode)
+    try:
+        extras["lora_multi"] = bench_lora_multi()
+    except Exception as e:  # noqa: BLE001 — the rung must not kill the bench
+        log(f"lora_multi rung failed: {e}")
+        extras["lora_multi"] = {"error": str(e)}
 
     # per-tenant fairness rung (ISSUE 7 acceptance: ~4:1 completed-token
     # ratio at 4:1 weights under saturation) — model-free and platform-
@@ -1506,5 +1636,11 @@ if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "kv_quant":
         ensure_live_backend()
         print(json.dumps(bench_kv_quant()), flush=True)
+        sys.exit(0)
+    # `python bench.py lora_multi`: the batched multi-LoRA rung standalone
+    # (distilgpt2, 8 adapters over one engine, parity + mixed-batch tok/s)
+    if len(sys.argv) > 1 and sys.argv[1] == "lora_multi":
+        ensure_live_backend()
+        print(json.dumps(bench_lora_multi()), flush=True)
         sys.exit(0)
     main()

@@ -419,6 +419,100 @@ async def run_pipeline_smoke() -> None:
             await n.stop()
 
 
+async def run_adapter_smoke() -> None:
+    """Multi-adapter serving leg (ISSUE 14): node A publishes a LoRA
+    adapter as sha256 pieces on the DHT; node B serves the base model
+    with an EMPTY pool, receives one request for ``<base>:<name>`` over
+    the mesh, pages the adapter in, and serves it — then residency shows
+    on B's /metrics (pool gauge + per-adapter request counter) and in
+    its telemetry digest (the router's placement input)."""
+    import asyncio as aio
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from bee2bee_tpu.adapters.distrib import publish_adapter
+    from bee2bee_tpu.api import build_app
+    from bee2bee_tpu.dht import DHTNode
+    from bee2bee_tpu.engine import EngineConfig, InferenceEngine
+    from bee2bee_tpu.meshnet.node import P2PNode
+    from bee2bee_tpu.models import core, get_config
+    from bee2bee_tpu.services.tpu import TPUService
+    from bee2bee_tpu.train.lora import LoraConfig, init_lora
+
+    cfg = get_config("tiny-llama")
+    params = jax.tree.map(
+        np.asarray,
+        jax.device_get(core.init_params(cfg, jax.random.key(0),
+                                        dtype=jnp.float32)),
+    )
+    lcfg = LoraConfig(rank=4, alpha=32.0)
+    adapters = jax.tree.map(
+        lambda x: x + 0.03, init_lora(cfg, lcfg, jax.random.key(1))
+    )
+    a = P2PNode(host="127.0.0.1", port=0)
+    b = P2PNode(host="127.0.0.1", port=0)
+    await a.start()
+    await b.start()
+    dht = DHTNode()
+    await dht.start()
+    a.dht = dht
+    b.dht = dht
+    client = None
+    engine = InferenceEngine(
+        cfg, params=params,
+        engine_config=EngineConfig(
+            max_seq_len=64, prefill_buckets=(16,), dtype="float32",
+            cache_dtype="float32", decode_chunk=4, max_adapters=4,
+        ),
+    )
+    try:
+        await publish_adapter(a, dht, cfg.name, "smoke-tenant",
+                              adapters, lcfg)
+        svc = TPUService(cfg.name, engine=engine)
+        await b.announce_service(svc)
+        assert await a.connect_bootstrap(b.addr), "bootstrap connect failed"
+        for _ in range(100):
+            if a.peers and b.peers:
+                break
+            await aio.sleep(0.05)
+        assert not engine.has_adapter("smoke-tenant")
+        out = await a.request_generation(
+            next(iter(a.peers)), "adapter smoke",
+            model=f"{cfg.name}:smoke-tenant",
+            max_new_tokens=4, temperature=0.0,
+        )
+        assert out.get("tokens") == 4, f"adapter serve returned {out!r}"
+        assert engine.has_adapter("smoke-tenant"), (
+            "adapter was not paged into the pool"
+        )
+        digest = b.telemetry_digest()
+        assert digest.get("adapters") == {"tpu": ["smoke-tenant"]}, (
+            f"digest residency wrong: {digest.get('adapters')!r}"
+        )
+        client = TestClient(TestServer(build_app(b)))
+        await client.start_server()
+        text = await (await client.get("/metrics")).text()
+        series = parse_prometheus(text)
+        assert series.get("bee2bee_adapter_pool_resident", 0) >= 1, (
+            "adapter pool gauge missing from /metrics"
+        )
+        assert (
+            "bee2bee_adapter_requests_total" in series
+            and 'adapter="smoke-tenant"' in text
+        ), "per-adapter request counter missing from /metrics"
+    finally:
+        if client is not None:
+            await client.close()
+        engine.close()
+        await a.stop()
+        await b.stop()
+        await dht.stop()
+
+
 def main() -> int:
     try:
         asyncio.run(run_smoke())
@@ -426,6 +520,7 @@ def main() -> int:
         asyncio.run(run_drain_smoke())
         asyncio.run(run_fleet_smoke())
         asyncio.run(run_pipeline_smoke())
+        asyncio.run(run_adapter_smoke())
     except AssertionError as e:
         print(f"[telemetry-smoke] FAIL: {e}", file=sys.stderr)
         return 1

@@ -173,6 +173,48 @@ async def _handle_fleet_ack(ws, data):
     assert analyze_source(good, "fleet/fixture.py") == []
 
 
+def test_frames_pass_adapter_frames_declared_and_checked():
+    """ISSUE 14 CI satellite: the multi-adapter serving keys are registry-
+    declared — `adapter` on GEN_REQUEST and the ADAPTER_ANNOUNCE frame —
+    and the known-bad fixtures prove each bug class is caught (a typo'd
+    adapter key is a silently-ignored tenant selection on old peers)."""
+    assert protocol.ADAPTER_ANNOUNCE in FRAME_SCHEMAS
+    assert protocol.ADAPTER in FRAME_SCHEMAS[protocol.GEN_REQUEST].optional
+    assert "adapters" in FRAME_SCHEMAS[protocol.ADAPTER_ANNOUNCE].required
+    src = '''
+from .. import protocol
+
+async def announce(node, ws, rid):
+    await ws.send(protocol.encode(protocol.msg(
+        protocol.ADAPTER_ANNOUNCE, peer_id=node.peer_id, service="tpu")))
+    await ws.send(protocol.encode(protocol.msg(
+        protocol.GEN_REQUEST, rid=rid, prompt="x", top_k=2, stop=["a"],
+        adaptr="acme")))
+
+async def _handle_adapter_announce(ws, data):
+    return data.get("adaptrs")
+'''
+    rules = _rules(analyze_source(src, "meshnet/fixture.py"))
+    assert "ML-F001" in rules  # `adaptr` undeclared on gen_request
+    assert "ML-F002" in rules  # announce missing its `adapters` list
+    assert "ML-F003" in rules  # read of undeclared "adaptrs"
+    good = '''
+from .. import protocol
+
+async def announce(node, ws, rid):
+    await ws.send(protocol.encode(protocol.msg(
+        protocol.ADAPTER_ANNOUNCE, peer_id=node.peer_id, service="tpu",
+        adapters=["acme"], models=["m", "m:acme"])))
+    await ws.send(protocol.encode(protocol.msg(
+        protocol.GEN_REQUEST, rid=rid, prompt="x", top_k=2, stop=["a"],
+        adapter="acme")))
+
+async def _handle_adapter_announce(ws, data):
+    return data.get("adapters"), data.get("models")
+'''
+    assert analyze_source(good, "meshnet/fixture.py") == []
+
+
 # -------------------------------------------------------- async pass fixtures
 
 
