@@ -486,6 +486,9 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
         # scrape recomputes it over the trailing window (and clears it
         # when this node served no stage traffic — never-throw inside)
         health.local_stage_idleness()
+        # engine economics (ISSUE 15): MFU/goodput/HBM-ledger gauges are
+        # provider-derived the same way — refresh them at scrape time
+        health.run_digest_providers()
 
     async def metrics(request):
         """The node's metrics registry (metrics.py): Prometheus text
@@ -653,6 +656,76 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
             }
         )
 
+    async def debug_profile(request):
+        """On-demand device profiling (docs/OBSERVABILITY.md "Engine
+        economics"): POST starts a duration-bounded ``jax.profiler``
+        capture (body ``{"duration_s": 2.0}``, clamped to the profiler's
+        max) and blocks until the zipped artifact lands under
+        ``$BEE2BEE_INCIDENT_DIR/profiles``; a concurrent capture is the
+        typed 409 ``profile_in_progress`` (jax.profiler is a process
+        singleton — two captures would corrupt each other). GET lists
+        artifacts newest-first like /debug/incidents; ``?id=`` streams
+        one zip.
+
+        ADMIN surface, same rule as /admin/drain: a device profile leaks
+        whole-node execution detail, so tenant keys do not open it."""
+        from .engine.introspect import ProfileInProgress, get_profiler
+
+        # the admin gate covers the WHOLE surface — the GET listing and
+        # ?id= zip download leak the same whole-node execution detail the
+        # POST produces, so a tenant key must not open them either
+        if not _auth_ok(request, api_key, None):
+            return web.json_response(
+                {"detail": "device profiling requires the node API key"},
+                status=403, headers=cors,
+            )
+        profiler = get_profiler()
+        if request.method == "GET":
+            prof_id = request.query.get("id")
+            if prof_id:
+                path = await asyncio.to_thread(profiler.profile_path, prof_id)
+                if path is None:
+                    return web.json_response(
+                        {"detail": f"unknown profile {prof_id!r}"}, status=404
+                    )
+                # streamed, not buffered: a long TPU capture's zip can be
+                # hundreds of MB — exactly the memory pressure the
+                # operator is profiling
+                return web.FileResponse(
+                    path,
+                    headers={
+                        "Content-Type": "application/zip",
+                        "Content-Disposition":
+                            f'attachment; filename="{prof_id}.zip"',
+                    },
+                )
+            return web.json_response({
+                "node": node.peer_id,
+                "profiles": await asyncio.to_thread(profiler.list_profiles),
+                "active": profiler.active,
+            })
+        body = await _json_body(request) if request.can_read_body else {}
+        if not isinstance(body, dict):
+            return web.json_response(
+                {"detail": "invalid JSON body"}, status=400
+            )
+        try:
+            duration = float(body.get("duration_s", 2.0))
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"detail": "duration_s must be a number"}, status=400
+            )
+        try:
+            # capture blocks ~duration_s: off the event loop, bounded by
+            # the profiler's own MAX_DURATION_S clamp
+            header = await asyncio.to_thread(profiler.capture, duration)
+        except ProfileInProgress as e:
+            return web.json_response(
+                {"detail": str(e), "error_kind": "profile_in_progress"},
+                status=409,
+            )
+        return web.json_response(header)
+
     # ---- OpenAI-compatible surface (/v1): standard SDKs and tools can
     # point at a mesh node unchanged (base_url="http://node:4002/v1").
     # Completions/chat map onto the same local-first + P2P-fallback path
@@ -789,6 +862,8 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
     app.router.add_get("/mesh/health", mesh_health)
     app.router.add_get("/slo", slo)
     app.router.add_get("/debug/incidents", debug_incidents)
+    app.router.add_get("/debug/profile", debug_profile)
+    app.router.add_post("/debug/profile", debug_profile)
     app.router.add_post("/admin/drain", admin_drain)
     app.router.add_get("/admin/drain", admin_drain_status)
     app.router.add_get("/fleet", fleet_status)

@@ -301,11 +301,60 @@ class InferenceEngine:
         self.tokenizer = tokenizer or load_tokenizer(checkpoint_path, self.model_cfg.vocab_size)
 
         self._replicated = NamedSharding(self.mesh, P())
+        # engine economics plane (engine/introspect.py, ISSUE 15): the
+        # retrace sentinel every jit root below registers with, the HBM
+        # ledger, and the MFU/goodput meter the scheduler feeds. Built
+        # BEFORE the jits so their compiles count from call one.
+        from .introspect import EngineIntrospection
+
+        self.introspect = EngineIntrospection(self.model_cfg, self.mesh)
+        self.introspect.ledger.register("weights", lambda: self.params)
+        # the declared compile space — THE warm-up/bucket-growth contract
+        # the sentinel enforces: prefill widths are the configured buckets
+        # (clipped to context) + the chunked-prefill width, batch sizes
+        # the scheduler's pow2 grow ladder. A shape outside these through
+        # a registered root is a steady-state retrace (typed incident).
+        prefill_widths = {
+            b for b in self.engine_cfg.prefill_buckets if b <= self.max_seq_len
+        } | {self.max_seq_len}
+        if self.engine_cfg.prefill_chunk:
+            prefill_widths.add(self.engine_cfg.prefill_chunk)
+        self._declared_prefill_widths = frozenset(prefill_widths)
+        # batch buckets: the CLOSURE of {1} under the scheduler's actual
+        # resize ops — grow min(2b, max_batch), shrink max(1, b//2) — so
+        # a non-pow2 max_batch's shrink ladder (6 -> 3 -> 1) is declared
+        # warm-up, not a false storm
+        mb = self.engine_cfg.max_batch
+        sizes: set[int] = set()
+        frontier = {1, mb}
+        while frontier:
+            b = frontier.pop()
+            if b in sizes:
+                continue
+            sizes.add(b)
+            frontier.add(min(2 * b, mb))
+            frontier.add(max(1, b // 2))
+        self._declared_batch_sizes = frozenset(sizes)
         # one jit object; it specializes per tokens shape (= per bucket)
-        self._prefill = jax.jit(self._prefill_fn, donate_argnums=(2,))
+        self._prefill = self.introspect.sentinel.watch(
+            "prefill",
+            jax.jit(self._prefill_fn, donate_argnums=(2,)),
+            key_fn=self._prefill_key,
+            allowed=lambda key: (
+                key[0] == 1 and key[1] in self._declared_prefill_widths
+            ),
+        )
         # speculative-decode verify step: [B, K+1] forward through the
         # same cache write paths, donated like the decode cache
-        self._spec_verify = jax.jit(self._spec_verify_fn, donate_argnums=(4,))
+        self._spec_verify = self.introspect.sentinel.watch(
+            "spec_verify",
+            jax.jit(self._spec_verify_fn, donate_argnums=(4,)),
+            key_fn=self._spec_verify_key,
+            allowed=lambda key: (
+                key[0] in self._declared_batch_sizes
+                and key[1] == self.engine_cfg.spec_tokens
+            ),
+        )
         self._rng = jax.random.key(self.engine_cfg.rng_seed)
         # jitted split: an eager jax.random.split is a blocking round trip
         # on a tunneled chip, and _next_key runs on every admission/window
@@ -326,8 +375,42 @@ class InferenceEngine:
             self.adapter_pool = AdapterPool(
                 self.model_cfg, self.engine_cfg.max_adapters
             )
+            # HBM ledger: the stacked A/B factors + scales are the
+            # "adapter pool vs KV pool" squeeze the ledger exists to
+            # show ((None, None) before the first load reads as 0)
+            self.introspect.ledger.register(
+                "adapter_pool", lambda: self.adapter_pool.device_args()
+            )
 
     # ------------------------------------------------------------ compiled fns
+
+    @staticmethod
+    def _prefill_key(params, tokens, cache, true_len, offset,
+                     block_tables=None, write_floor=None, write_ceil=None,
+                     adapters=None, aids=None, ascales=None):
+        """Sentinel shape key for the prefill root: the dims that select
+        a compiled variant — batch rows, the padded token width (the
+        bucket), the block-table width bucket, and the None-flags of the
+        optional operands (each flag is a distinct legitimate trace)."""
+        return (
+            int(tokens.shape[0]), int(tokens.shape[1]),
+            None if block_tables is None else int(block_tables.shape[1]),
+            write_floor is not None, write_ceil is not None,
+            adapters is not None,
+        )
+
+    @staticmethod
+    def _spec_verify_key(params, cur, drafts, draft_lens, cache, offsets,
+                         temps, topks, topps, minps=None, key=None,
+                         tables=None, adapters=None, aids=None, ascales=None):
+        """Sentinel shape key for the spec-verify root: batch bucket,
+        draft width K, and the optional-operand flags."""
+        return (
+            int(cur.shape[0]), int(drafts.shape[1]),
+            minps is not None,
+            None if tables is None else int(tables.shape[1]),
+            adapters is not None,
+        )
 
     def _attn_fn(self):
         """attn_fn for core.forward per the engine's attention setting.
@@ -705,6 +788,9 @@ class InferenceEngine:
             sch, self._scheduler = self._scheduler, None
         if sch is not None:
             sch.shutdown()
+        # drop out of the economics digest (a closed engine must not keep
+        # its params pinned through the ledger, nor report stale gauges)
+        self.introspect.close()
 
     @staticmethod
     def _event_error(ev: dict) -> Exception:
@@ -1108,4 +1194,8 @@ class InferenceEngine:
         # all read this through TPUService.get_metadata)
         if self.adapter_pool is not None:
             out["adapters"] = self.adapter_pool.info
+        # engine economics plane (ISSUE 15): per-root compile counts,
+        # MFU/goodput over the trailing window, and the HBM ledger —
+        # refresh() also brings the engine.* economics gauges current
+        out["introspect"] = self.introspect.refresh()
         return out

@@ -390,12 +390,36 @@ class BatchScheduler:
         self._counts_shrink = jax.jit(
             lambda c, n: c[:n], static_argnums=(1,)
         )
-        self._decode = jax.jit(self._decode_fn, donate_argnums=(2,))
-        self._decode_pen = jax.jit(self._decode_pen_fn, donate_argnums=(2, 4))
+        # engine economics plane (engine/introspect.py): the decode roots
+        # register with the engine's retrace sentinel under the declared
+        # compile space — batch sizes on the pow2 grow ladder, block-table
+        # widths on the pow2 width buckets. The CoW copy is scalar-arg'd
+        # (one trace ever): un-predicated, repeats storm.
+        ic = engine.introspect
+        self._meter = ic.meter
+        ic.ledger.register("kv_pool", lambda: self._cache)
+        tw_ok = self._declared_table_width
+        bs_ok = engine._declared_batch_sizes
+        self._decode = ic.sentinel.watch(
+            "decode",
+            jax.jit(self._decode_fn, donate_argnums=(2,)),
+            key_fn=self._decode_key,
+            allowed=lambda key: key[0] in bs_ok and tw_ok(key[1]),
+        )
+        self._decode_pen = ic.sentinel.watch(
+            "decode_penalized",
+            jax.jit(self._decode_pen_fn, donate_argnums=(2, 4)),
+            key_fn=self._decode_pen_key,
+            allowed=lambda key: key[0] in bs_ok and tw_ok(key[1]),
+        )
         # jitted: sample_batched run eagerly is ~15 tiny ops = ~15 round
         # trips through a tunneled chip per admission
         self._sample_first = jax.jit(sample_batched)
-        self._copy_block = jax.jit(copy_block, donate_argnums=(0,))
+        self._copy_block = ic.sentinel.watch(
+            "cow_copy",
+            jax.jit(copy_block, donate_argnums=(0,)),
+            key_fn=lambda cache, src, dst: (),
+        )
 
         # migration block transfer (pool block dim = axis 2 of EVERY pool
         # leaf — the int8 pool's [L, Hkv, NB] scale arrays line up with
@@ -543,6 +567,40 @@ class BatchScheduler:
         return sum(r is not None for r in self._rows)
 
     # ------------------------------------------------------------ device fns
+
+    def _declared_table_width(self, w) -> bool:
+        """Is ``w`` a legitimate block-table width for the sentinel's
+        declared compile space? _table_width emits pow2 widths capped at
+        blocks_per_row — anything else through a decode root is an
+        undeclared shape (None = a rect/table-less call, also legal)."""
+        if w is None:
+            return True
+        limit = self.engine.blocks_per_row
+        return w == limit or (w & (w - 1) == 0 and 0 < w <= limit)
+
+    @staticmethod
+    def _decode_key(params, cur, cache, offsets, temps, topks, topps,
+                    minps, key, tables=None, adapters=None, aids=None,
+                    ascales=None):
+        """Sentinel shape key for the decode root: batch bucket, table
+        width bucket, and the optional-operand None-flags (min_p and the
+        adapter factors each select a distinct legitimate trace)."""
+        return (
+            int(cur.shape[0]),
+            None if tables is None else int(tables.shape[1]),
+            minps is not None, adapters is not None,
+        )
+
+    @staticmethod
+    def _decode_pen_key(params, cur, cache, offsets, counts,
+                        temps, topks, topps, minps, reps, press, freqs,
+                        key, tables=None, adapters=None, aids=None,
+                        ascales=None):
+        return (
+            int(cur.shape[0]),
+            None if tables is None else int(tables.shape[1]),
+            minps is not None, adapters is not None,
+        )
 
     def _decode_fn(self, params, cur, cache, offsets, temps, topks, topps,
                    minps, key, tables=None, adapters=None, aids=None,
@@ -1035,6 +1093,11 @@ class BatchScheduler:
 
         e = self.engine
         BS = self._block_size
+        # goodput accounting: a re-prefill (migration/failover import —
+        # `seq` passed) recomputes K/V the fleet already paid for once;
+        # its positions are scheduled work that produces zero USEFUL
+        # tokens, which is exactly how the meter is told to book it
+        recompute = seq is not None
         if seq is None:
             seq = req.ids
         n = len(seq)
@@ -1112,6 +1175,14 @@ class BatchScheduler:
                     np.int32(pos), tbl, np.int32(start), np.int32(n),
                     **self._lora_args_row(req),
                 )
+                # economics: the bucket's padded width is what the chip
+                # ran; only the real prompt tokens were useful (and none
+                # on the re-prefill rung)
+                self._meter.record_dispatch(
+                    bucket, pos + bucket / 2.0, scheduled=bucket
+                )
+                if not recompute:
+                    self._meter.note_useful(len(chunk))
             # adapter rows NEVER enter the prefix cache: an adapted wk/wv
             # writes adapter-specific K/V, so sharing those blocks with a
             # base-model (or other-adapter) prompt would serve silently
@@ -1369,7 +1440,16 @@ class BatchScheduler:
             _H_QUEUE_WAIT.observe((t.t_admit - t.t_submit) * 1000.0)
             _H_PREFILL.observe((now - t.t_admit) * 1000.0)
             self.stats.admitted += 1
-            if req.accept(tok) and req.stream:
+            accepted = req.accept(tok)
+            if accepted:
+                # the admission-sampled first token is as useful as any
+                # decode-window token — and its slot must be SCHEDULED
+                # too (its FLOPs were booked with the prefill positions;
+                # without the slot, a bucket-exact prompt could push
+                # useful past scheduled and the 0..1 fraction past 1)
+                self._meter.record_dispatch(0.0, 0.0, scheduled=1)
+                self._meter.note_useful(1)
+            if accepted and req.stream:
                 # token events (and their cumulative re-decode) are only
                 # for streaming consumers; generate() reads the done event
                 req.events.put(
@@ -1665,6 +1745,16 @@ class BatchScheduler:
         temps, topks, topps = self._row_sampling_arrays()
         minps = self._minps if self._minps.any() else None
         self._set_fill_gauges()
+        # economics: the hardware runs bsz*(K+1) positions; the batch
+        # SCHEDULED active*(K+1) token slots, of which only accepted
+        # drafts + the bonus token will prove useful (_process_row_tokens).
+        # Mean depth DURING the step includes the in-flight half-window,
+        # same convention as the decode-window dispatch below
+        self._meter.record_dispatch(
+            self._bsz * (e.engine_cfg.spec_tokens + 1),
+            self._mean_active_ctx() + (e.engine_cfg.spec_tokens + 1) / 2.0,
+            scheduled=self.active * (e.engine_cfg.spec_tokens + 1),
+        )
         t_step = time.perf_counter()
         with get_tracer().span(
             "engine.spec_verify", active=self.active, drafted=int(lens.sum())
@@ -1708,6 +1798,21 @@ class BatchScheduler:
         a = self.active
         _G_ACTIVE_ROWS.set(a)
         _G_BATCH_FILL.set(a / self._bsz if self._bsz else 0.0)
+        # pool-growth forecast (engine/introspect.py): sampled on the
+        # dispatch cadence so the pool_exhaust_eta gauge the admission
+        # shed reads tracks the live allocation trend
+        self.engine.introspect.forecast.feed(
+            self._alloc.used_count, self._alloc.free_count
+        )
+
+    def _mean_active_ctx(self) -> float:
+        """Mean cache depth of the active rows — the attention-term input
+        of the FLOPs model (introspect.GoodputMeter)."""
+        depths = [
+            int(self._offsets[b])
+            for b, r in enumerate(self._rows) if r is not None
+        ]
+        return sum(depths) / len(depths) if depths else 0.0
 
     def _process_row_tokens(self, b: int, req: Request, tokens) -> bool:
         """THE per-row token-intake protocol, shared by the decode-window
@@ -1724,6 +1829,10 @@ class BatchScheduler:
             emitted.append(int(t))
             if req.done:  # budget exhausted exactly on this token
                 break
+        # goodput accounting: only tokens ACCEPTED into an output are
+        # useful — post-EOS overshoot, rejected draft positions and
+        # cancelled-row tokens all stay scheduled-only
+        self._meter.note_useful(len(emitted))
         if emitted and req.stream:
             req.events.put({
                 "token": emitted[-1],
@@ -1762,6 +1871,13 @@ class BatchScheduler:
         # diverge from how _row_sampling_arrays builds _minps
         minps = self._minps if self._minps.any() else None
         self._set_fill_gauges()
+        # economics: bsz*W*K positions run (dead rows included — the
+        # hardware computes them); active*W*K token slots are scheduled
+        self._meter.record_dispatch(
+            self._bsz * W * K,
+            self._mean_active_ctx() + W * K / 2.0,
+            scheduled=self.active * W * K,
+        )
         t_step = time.perf_counter()
         with get_tracer().span("engine.decode_window", active=self.active, chunks=W):
             # host mirrors go in as the first call's args; chunks chain on

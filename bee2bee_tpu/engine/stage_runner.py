@@ -141,7 +141,22 @@ class StageRunner:
                 out = out[jnp.arange(out.shape[0]), jnp.asarray(gather, jnp.int32)]
             return out, c
 
-        self._fwd = jax.jit(_wrapped, donate_argnums=(2,))
+        # retrace sentinel (engine/introspect.py, ISSUE 15): the stage
+        # forward is THE pipeline worker's hot jit root — per-instance
+        # sentinel (a fresh runner's compiles are its own warm-up), no
+        # declared predicate (prefill widths come from the coordinator's
+        # bucketing; any FIRST-seen shape is growth, repeats storm).
+        from .introspect import RetraceSentinel
+
+        self._sentinel = RetraceSentinel()
+        self._fwd = self._sentinel.watch(
+            "stage_forward",
+            jax.jit(_wrapped, donate_argnums=(2,)),
+            key_fn=lambda p, x, cache, off, mask, gather: (
+                tuple(int(s) for s in x.shape),
+                mask is not None, gather is not None,
+            ),
+        )
         self._caches: dict[str, dict] = {}  # request_id -> {"cache", "touched"}
         self._lock = threading.Lock()
         self.max_concurrent_forwards = max(1, int(max_concurrent_forwards))

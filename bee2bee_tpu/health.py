@@ -240,6 +240,36 @@ def local_stage_idleness(
         return None
 
 
+# live-digest providers: subsystems that derive their digest entry at
+# build time (refreshing their gauges as a side effect) register here —
+# e.g. the engine economics plane (engine/introspect.py) contributes the
+# `introspect` block. A dict, not a list: re-registration replaces, so
+# module reloads/tests can't stack duplicates.
+_DIGEST_PROVIDERS: dict[str, Callable[[], dict | None]] = {}
+
+
+def register_digest_provider(key: str, fn: Callable[[], dict | None]) -> None:
+    """Register a live-digest provider: ``fn()`` returns the payload for
+    digest[key] (None = omit — the absent-subsystem contract)."""
+    _DIGEST_PROVIDERS[key] = fn
+
+
+def run_digest_providers() -> dict[str, dict]:
+    """Every provider's current payload (never-throw per provider). Also
+    the scrape-time gauge-refresh hook: api.py calls this at /metrics so
+    provider-owned gauges (MFU, HBM ledger, pool forecast) are current."""
+    out: dict[str, dict] = {}
+    for key, fn in list(_DIGEST_PROVIDERS.items()):
+        try:
+            payload = fn()
+        except Exception:  # noqa: BLE001 — telemetry never throws
+            logger.exception("digest provider %r failed", key)
+            continue
+        if payload is not None:
+            out[key] = payload
+    return out
+
+
 def build_digest(registry: MetricsRegistry | None = None) -> dict:
     """Fold the metrics registry into a compact wire-portable summary.
 
@@ -259,6 +289,9 @@ def build_digest(registry: MetricsRegistry | None = None) -> dict:
         bubble = local_stage_idleness()
         if bubble is not None:
             digest["pipeline_bubble"] = bubble
+        # provider-derived entries (engine economics plane etc.): each
+        # refreshes its own gauges and returns its digest block
+        digest.update(run_digest_providers())
     hists: dict[str, dict] = {}
     for name in DIGEST_HISTOGRAMS:
         m = reg.get(name)
@@ -504,7 +537,8 @@ def fleet_view(local_peer_id: str, local_digest: dict, store: HealthStore,
     agg: dict[str, float] = {"nodes": len(peers)}
     p95s, queue_p95s, tokens, blocks, rows = [], [], 0.0, 0.0, 0.0
     bubbles = []
-    for d in peers.values():
+    goodputs, mfus, headrooms, storming = [], [], [], []
+    for pid, d in peers.items():
         hist = d.get("hist") or {}
         ttft = hist.get("engine.ttft_ms")
         if ttft:
@@ -520,6 +554,20 @@ def fleet_view(local_peer_id: str, local_digest: dict, store: HealthStore,
         bubble = (d.get("pipeline_bubble") or {}).get("bubble_fraction")
         if bubble is not None:
             bubbles.append(float(bubble))
+        # engine economics (digest `introspect` block): fleet goodput is
+        # the SUM across engine peers; MFU averages over reporters; HBM
+        # headroom keeps the worst peer — the one a router/controller
+        # must notice — and retrace-storming peers are listed by id
+        intro = d.get("introspect") or {}
+        if intro.get("goodput_tokens_per_s") is not None:
+            goodputs.append(float(intro["goodput_tokens_per_s"]))
+        if intro.get("mfu") is not None:
+            mfus.append(float(intro["mfu"]))
+        hr = (intro.get("hbm") or {}).get("headroom_frac")
+        if hr is not None:
+            headrooms.append((float(hr), pid))
+        if intro.get("storming"):
+            storming.append(pid)
     if p95s:
         agg["ttft_p95_ms_max"] = max(p95s)
     if queue_p95s:
@@ -528,6 +576,16 @@ def fleet_view(local_peer_id: str, local_digest: dict, store: HealthStore,
         # fleet-wide stage idleness: the mean of the stage-hosting peers'
         # bubble fractions (nodes with no stage traffic report nothing)
         agg["bubble_fraction_mean"] = round(sum(bubbles) / len(bubbles), 4)
+    if goodputs:
+        agg["goodput_tokens_per_s_total"] = round(sum(goodputs), 3)
+    if mfus:
+        agg["mfu_mean"] = round(sum(mfus) / len(mfus), 6)
+    if headrooms:
+        worst = min(headrooms)
+        agg["hbm_headroom_frac_min"] = worst[0]
+        agg["hbm_headroom_min_peer"] = worst[1]
+    if storming:
+        agg["retrace_storming_peers"] = sorted(storming)
     agg["tokens_generated_total"] = tokens
     agg["paged_blocks_in_use_total"] = blocks
     agg["active_rows_total"] = rows
@@ -565,6 +623,19 @@ def render_fleet_prom(view: dict) -> str:
     bub = reg.gauge(
         "mesh.peer_bubble_fraction", "peer-reported pipeline bubble fraction"
     )
+    # engine economics (ISSUE 15): the digest `introspect` block's
+    # fleet-visible numbers under the same peer-labeled drop-out contract
+    mfu = reg.gauge("mesh.peer_mfu", "peer-reported engine MFU")
+    gput = reg.gauge(
+        "mesh.peer_goodput_tokens_per_s", "peer-reported useful tokens/s"
+    )
+    hbm = reg.gauge(
+        "mesh.peer_hbm_headroom_frac", "peer-reported device memory headroom"
+    )
+    storm = reg.gauge(
+        "mesh.peer_retrace_storming",
+        "1 while the peer reports a recent retrace storm",
+    )
     for pid, d in (view.get("peers") or {}).items():
         up.set(1, peer=pid)
         if d.get("age_s") is not None:
@@ -595,6 +666,16 @@ def render_fleet_prom(view: dict) -> str:
         bubble = d.get("pipeline_bubble") or {}
         if bubble.get("bubble_fraction") is not None:
             bub.set(bubble["bubble_fraction"], peer=pid)
+        intro = d.get("introspect") or {}
+        if intro.get("mfu") is not None:
+            mfu.set(intro["mfu"], peer=pid)
+        if intro.get("goodput_tokens_per_s") is not None:
+            gput.set(intro["goodput_tokens_per_s"], peer=pid)
+        headroom = (intro.get("hbm") or {}).get("headroom_frac")
+        if headroom is not None:
+            hbm.set(headroom, peer=pid)
+        if intro.get("storming"):
+            storm.set(1, peer=pid)
     return reg.render()
 
 

@@ -53,6 +53,7 @@ from ..router import (
     load_admission_config,
     load_tenant_config,
     paged_pool_free_fraction,
+    pool_exhaust_eta,
     static_sort,
 )
 from ..tracing import extract_trace, get_tracer, inject_trace, use_trace_ctx
@@ -251,6 +252,10 @@ class P2PNode(StageTaskMixin):
                 else self.slo.max_fast_burn()
             ),
             pool_free_fraction=paged_pool_free_fraction,
+            # pool-growth forecast (engine/introspect.py): sheds
+            # pool_exhausted while Retry-After still buys the client
+            # something, instead of waiting for the free-fraction floor
+            pool_eta=pool_exhaust_eta,
             draining=lambda: self.draining,
         )
 
@@ -1754,13 +1759,35 @@ class P2PNode(StageTaskMixin):
     def _record_metric_deltas(self, last: dict[str, float]) -> None:
         """One per-tick flight-recorder event with the counter deltas that
         tell an incident's story ('what changed in the last interval') —
-        never throws, like everything feeding the ring."""
+        never throws, like everything feeding the ring.
+
+        The counter list spans every subsystem that can STAR in an
+        incident: the PR 5/6 serving funnel, plus (ISSUE 15 fix — these
+        predated the ring) the quantized-KV pool churn, the adapter
+        pool's load/evict/request traffic, the fleet controller's
+        decision/action stream, live migrations, and the retrace
+        sentinel's compile/storm counters — so a bundle from any of those
+        subsystems carries its own state, not just the gen funnel's. A
+        compact gauge snapshot rides alongside (pool occupancy, adapter
+        residency, admission pressure, fleet role): gauges have no
+        deltas, but an incident reader needs the levels at the tick."""
         try:
             reg = get_registry()
             deltas: dict[str, float] = {}
             for name in (
                 "gen.requests", "gen.errors", "engine.tokens_generated",
                 "mesh.relay_hops", "pipeline.recoveries",
+                # spec decode (PR 4) + quantized-KV pool CoW churn (PR 12)
+                "engine.spec_drafted", "engine.spec_accepted",
+                # adapter pool (PR 14)
+                "adapter.pool_loads", "adapter.pool_evicted",
+                "adapter.requests",
+                # fleet controller (PR 13) + live migration (PR 9)
+                "fleet.decisions", "fleet.actions", "mesh.migrations",
+                # admission front door (PR 7)
+                "admission.shed",
+                # engine economics (ISSUE 15)
+                "engine.compiles", "engine.retrace_storms",
             ):
                 m = reg.get(name)
                 if m is None:
@@ -1770,8 +1797,22 @@ class P2PNode(StageTaskMixin):
                 last[name] = cur
                 if d:
                     deltas[name] = d
-            if deltas:
-                self.recorder.record("metrics_delta", deltas=deltas)
+            gauges: dict[str, float] = {}
+            for name in (
+                "engine.paged_blocks_in_use", "engine.paged_blocks_free",
+                "adapter.pool_resident",
+                "admission.inflight", "admission.queued",
+                "fleet.leader", "fleet.eligible_replicas",
+                "engine.hbm_headroom_frac", "engine.mfu",
+            ):
+                g = reg.get(name)
+                if g is None or not g.series():
+                    continue  # subsystem not running / gauge cleared
+                gauges[name] = g.value()
+            if deltas or gauges:
+                self.recorder.record(
+                    "metrics_delta", deltas=deltas, gauges=gauges
+                )
         except Exception:  # noqa: BLE001 — telemetry never throws
             pass
 

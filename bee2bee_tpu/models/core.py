@@ -44,6 +44,32 @@ def _dense_init(key, shape, scale=None, dtype=jnp.float32):
     return jax.random.normal(key, shape, dtype) * scale
 
 
+def matmul_params_per_token(cfg: ModelConfig) -> int:
+    """Matmul weight elements each token position streams through one
+    forward — the ``2·N`` half of the engine economics plane's FLOPs
+    model (engine/introspect.py): every counted element costs one
+    multiply + one add per position.
+
+    Counted: q/k/v/o projections, the dense MLP (gated → 3 matrices), the
+    lm head (tied or not — the logits matmul runs either way), and for
+    MoE the router plus only the ``n_experts_per_tok`` ACTIVE experts —
+    what a routed token actually pays, matching the "routed" impl (the
+    "dense" correctness impl physically computes all E experts, but MFU
+    is defined on the model's useful math, not an impl's redundancy).
+    Excluded: embeddings lookup, norms, biases, rope — O(D) noise next
+    to the O(D²) terms."""
+    D, F, L = cfg.d_model, cfg.d_ff, cfg.n_layers
+    H, Hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    attn = D * (H * hd) + 2 * D * (Hkv * hd) + (H * hd) * D
+    gated = cfg.activation in ("silu", "geglu")
+    mlp_one = (3 if gated else 2) * D * F
+    if cfg.is_moe:
+        mlp = D * cfg.n_experts + cfg.n_experts_per_tok * mlp_one
+    else:
+        mlp = mlp_one
+    return L * (attn + mlp) + D * cfg.vocab_size
+
+
 def init_params(cfg: ModelConfig, key, dtype=jnp.bfloat16) -> Params:
     """Random-init params with the layout the whole framework shares.
 

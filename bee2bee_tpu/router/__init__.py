@@ -14,6 +14,7 @@ from .admission import (
     AdmissionTicket,
     load_admission_config,
     paged_pool_free_fraction,
+    pool_exhaust_eta,
 )
 from .fairness import WdrrQueue
 from .policy import (
@@ -48,6 +49,7 @@ __all__ = [
     "load_tenant_config",
     "match_depth",
     "paged_pool_free_fraction",
+    "pool_exhaust_eta",
     "parse_tenant_config",
     "prompt_prefix_hashes",
     "static_sort",

@@ -99,6 +99,9 @@ class AdmissionConfig:
     queue_timeout_s: float = 60.0  # max wait for a slot (no request hangs)
     shed_burn_rate: float = 6.0   # SLO fast-window burn that starts shedding
     pool_free_frac_min: float = 0.02  # paged free fraction under which we shed
+    pool_eta_shed_s: float = 5.0  # shed when the pool-growth forecast
+    # (engine.pool_exhaust_eta_s) projects exhaustion inside this horizon
+    # while all slots are busy; 0 disables the forecast shed
     retry_after_s: float = 1.0    # base Retry-After hint for queue rejections
     shed_retry_after_s: float = 5.0  # Retry-After for node-state (503) sheds
     quantum: float = 256.0        # WDRR quantum (tokens)
@@ -146,6 +149,21 @@ def paged_pool_free_fraction() -> float | None:
         if t <= 0:
             return None
         return max(0.0, min(free.value() / t, 1.0))
+    except Exception:  # noqa: BLE001 — a telemetry read must not shed traffic
+        return None
+
+
+def pool_exhaust_eta() -> float | None:
+    """Projected seconds to paged-pool exhaustion from the forecast gauge
+    (engine/introspect.py PoolForecast), or None when the pool is not
+    growing / no paged engine runs here. Registry-read like
+    paged_pool_free_fraction, so the front door needs no engine import."""
+    reg = get_registry()
+    g = reg.get("engine.pool_exhaust_eta_s")
+    try:
+        if g is None or not g.series():
+            return None
+        return float(g.value())
     except Exception:  # noqa: BLE001 — a telemetry read must not shed traffic
         return None
 
@@ -250,6 +268,9 @@ class AdmissionController:
         budgets: dict[str, tuple[float, float]] | None = None,
         slo_burn=None,
         pool_free_fraction=None,
+        pool_eta=None,  # callable -> float | None: projected seconds to
+        # paged-pool exhaustion (engine/introspect.py PoolForecast) —
+        # sheds pool_exhausted BEFORE the free-fraction floor trips
         draining=None,  # callable -> bool: node drain state (migrate.py);
         # True rejects every new acquisition 503 `draining` + Retry-After
         now=time.monotonic,
@@ -261,6 +282,7 @@ class AdmissionController:
         }
         self._slo_burn = slo_burn
         self._pool_free = pool_free_fraction
+        self._pool_eta = pool_eta
         self._draining = draining
         self._free = int(self.config.max_concurrent)
         self._waiters = WdrrQueue(weights or {}, quantum=self.config.quantum)
@@ -325,6 +347,22 @@ class AdmissionController:
                     KIND_POOL, cfg.shed_retry_after_s,
                     f"paged KV pool {frac * 100:.1f}% free "
                     f"(< {cfg.pool_free_frac_min * 100:.1f}%) with all "
+                    "slots busy",
+                )
+        if (self._pool_eta is not None and self._free <= 0
+                and cfg.pool_eta_shed_s > 0):
+            # growth FORECAST (engine/introspect.py): the pool may still
+            # be above the free floor, but at the current allocation rate
+            # it runs dry inside the horizon — shed now, while the
+            # Retry-After still means something (all-slots-busy guarded
+            # like the floor check: with idle slots, retirements free
+            # blocks faster than the trend says)
+            eta = self._pool_eta()
+            if eta is not None and eta < cfg.pool_eta_shed_s:
+                self._reject(
+                    KIND_POOL, cfg.shed_retry_after_s,
+                    f"paged KV pool projected dry in {eta:.1f}s "
+                    f"(< {cfg.pool_eta_shed_s:g}s horizon) with all "
                     "slots busy",
                 )
 

@@ -89,6 +89,17 @@ class RouterWeights:
     queue_ref_ms: float = 500.0  # soft knee: p95 at the knee scores 0.5
     rtt_ref_ms: float = 100.0
     unknown: float = 0.5       # the explicit unknown tier for digest-less peers
+    # engine economics (digest `introspect` block, engine/introspect.py):
+    # a memory-squeezed peer — HBM headroom under the floor — ramps a
+    # penalty 0→1 as headroom falls to zero (peers without a ledger
+    # reading pay nothing: absent subsystem, not unknown pressure), and
+    # a peer reporting a recent retrace storm pays a flat penalty (its
+    # next requests eat compile wall-time, the exact latency a router
+    # exists to route around) — penalties, not exclusions: a degraded
+    # engine still beats a burning or draining one
+    hbm: float = 0.15
+    hbm_headroom_floor: float = 0.10
+    storm: float = 0.10
 
 
 def parse_router_weights(obj) -> RouterWeights:
@@ -154,6 +165,8 @@ class RouterPolicy:
                 if isinstance(names, (list, tuple))
             )
         )
+        hbm = 0.0
+        storming = False
         if digest is None:
             queue = fill = pool = w.unknown
             matched = 0
@@ -176,6 +189,18 @@ class RouterPolicy:
                 match_depth(prompt_hashes, digest.get("prefix_hashes")),
                 w.prefix_max_blocks,
             )
+            intro = digest.get("introspect") or {}
+            headroom = (intro.get("hbm") or {}).get("headroom_frac")
+            if headroom is not None and w.hbm_headroom_floor > 0:
+                hbm = min(
+                    max(
+                        (w.hbm_headroom_floor - float(headroom))
+                        / w.hbm_headroom_floor,
+                        0.0,
+                    ),
+                    1.0,
+                )
+            storming = bool(intro.get("storming"))
         rtt = 0.0 if cand.get("local") else (
             _soft(rtt_ms, w.rtt_ref_ms) if rtt_ms is not None else w.unknown
         )
@@ -184,6 +209,7 @@ class RouterPolicy:
         score = (
             w.queue * queue + w.fill * fill + w.pool * pool
             + w.rtt * rtt + w.price * pnorm
+            + w.hbm * hbm + (w.storm if storming else 0.0)
             - w.prefix_bonus * matched
             - (w.adapter_bonus if adapter_resident else 0.0)
         )
@@ -192,6 +218,7 @@ class RouterPolicy:
             "pool": round(pool, 4), "rtt": round(rtt, 4),
             "price": round(pnorm, 4), "prefix_blocks": matched,
             "adapter_resident": adapter_resident,
+            "hbm": round(hbm, 4), "storming": storming,
             "unknown": digest is None, "score": round(score, 4),
         }
 

@@ -158,6 +158,27 @@ def _bench_concurrency(eng, prompts: list[list[int]], new_tokens: int) -> dict:
     }
 
 
+def _introspect_stamp(eng=None) -> dict:
+    """Engine-economics stamp for a rung artifact (ISSUE 15): per-root
+    compile counts + wall-time from the process registry (cumulative —
+    they survive engine close), plus, given a still-live engine, its
+    MFU/goodput window and HBM ledger. Never throws: a stamp must not
+    fail a rung."""
+    try:
+        from bee2bee_tpu.engine.introspect import bench_snapshot
+
+        snap = bench_snapshot()
+        if eng is not None:
+            live = eng.introspect.refresh()
+            if live.get("goodput"):
+                snap["goodput"] = live["goodput"]
+            if live.get("hbm"):
+                snap["hbm"] = live["hbm"]
+        return snap
+    except Exception as e:  # noqa: BLE001
+        return {"error": str(e)}
+
+
 def bench_model(name: str, max_seq_len: int, concurrencies=(1, 8),
                 new_tokens: int = NEW_TOKENS, dtype: str = "bfloat16",
                 quantize: str = "none") -> dict:
@@ -224,6 +245,7 @@ def bench_model(name: str, max_seq_len: int, concurrencies=(1, 8),
         if peak:
             headline = out[f"batch{max(done_c)}"]["tok_per_s"]
             out["mfu"] = round(2 * n_params * headline / peak, 5)
+        out["introspect"] = _introspect_stamp(eng)
         return out
     finally:
         # a failed rung (e.g. OOM at high concurrency) is caught by main —
@@ -273,6 +295,7 @@ def bench_paged(msl: int, new_tokens: int) -> dict:
             f"max_batch=8; {out['blocks_read_per_step']} blocks/step read "
             f"vs rectangular-equivalent {out['rect_equiv_blocks_per_step']}"
         )
+        out["introspect"] = _introspect_stamp(eng)
         return out
     finally:
         eng.close()
@@ -335,6 +358,7 @@ def bench_spec(msl: int, new_tokens: int) -> dict:
         f"(x{out['speedup']}, acceptance "
         f"{out['spec_on'].get('acceptance')})"
     )
+    out["introspect"] = _introspect_stamp()
     return out
 
 
@@ -423,6 +447,7 @@ def bench_ragged(msl: int, new_tokens: int) -> dict:
         f"{out['ragged_on_spec'].get('acceptance_weighted_tok_per_s')} "
         f"tok/s)"
     )
+    out["introspect"] = _introspect_stamp()
     return out
 
 
@@ -1243,6 +1268,7 @@ def bench_kv_quant(msl: int = 256) -> dict:
         f"{q8['decode_tok_per_s_c4']} tok/s; migration bytes/row "
         f"{bf['migration_bytes_per_row']} vs {q8['migration_bytes_per_row']}"
     )
+    out["introspect"] = _introspect_stamp()
     return out
 
 
@@ -1362,6 +1388,7 @@ def bench_lora_multi(msl: int = 256, new_tokens: int = 32,
             f"parity {parity_ok}/2, mixed {mixed_tps} tok/s vs base "
             f"{base_tps} ({out['overhead']:.1%} overhead)"
         )
+        out["introspect"] = _introspect_stamp(eng)
         return out
     finally:
         eng.close()
@@ -1571,6 +1598,11 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — telemetry must not kill the bench
         extras["telemetry"] = {"error": str(e)}
 
+    # round-level engine-economics stamp (ISSUE 15): cumulative compile
+    # counts/wall-time per jit root across every rung above — benchdiff
+    # reads rung-level stamps; this is the round's compile bill
+    extras["introspect"] = _introspect_stamp()
+
     ref = bench_reference_path()
     headline_entry = distil.get("batch8") or {}
     metric = "serve_tokens_per_sec_distilgpt2_batch8"
@@ -1596,6 +1628,10 @@ def main() -> None:
                 "metric": metric,
                 "value": round(headline, 2),
                 "unit": "tok/s",
+                # artifact layout version (scripts/benchdiff.py refuses
+                # majors it doesn't understand, so the trajectory tool
+                # can evolve without silently misreading old rounds)
+                "schema_version": 2,
                 # prominent, TOP-LEVEL platform record (ROADMAP bench
                 # hygiene): BENCH_*.json consumers must never have to dig
                 # extras to learn what hardware produced the number

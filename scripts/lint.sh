@@ -12,6 +12,14 @@ echo "[lint] meshlint (python -m bee2bee_tpu.analysis)"
 echo "[lint] compileall"
 "$PY" -m compileall -q bee2bee_tpu
 
+# benchdiff self-check (docs/PERF.md): the perf-regression CI gate's own
+# contract suite — regression trips, cross-platform comparison refuses.
+# SKIP_BENCHDIFF=1 skips it.
+if [ "${SKIP_BENCHDIFF:-0}" != "1" ]; then
+  echo "[lint] benchdiff self-check"
+  "$PY" scripts/benchdiff.py --self-check
+fi
+
 # telemetry smoke (docs/OBSERVABILITY.md): loopback node + one generation;
 # /metrics must parse as Prometheus text with the mandatory series present.
 # SKIP_SMOKE=1 skips it (e.g. environments without aiohttp sockets).

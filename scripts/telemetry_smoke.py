@@ -513,6 +513,95 @@ async def run_adapter_smoke() -> None:
         await dht.stop()
 
 
+async def run_introspect_smoke() -> None:
+    """Engine economics leg (ISSUE 15): one loopback generation through a
+    real (tiny) engine, then assert the economics plane actually lit up —
+    nonzero per-root compile counters, an MFU gauge, and an HBM ledger
+    whose components sum to its own total (and stay under the device
+    total where the backend reports one; CPU reports none), all on
+    ``/metrics``, with the ``introspect`` block riding the digest."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from bee2bee_tpu.api import build_app
+    from bee2bee_tpu.engine import EngineConfig, InferenceEngine
+    from bee2bee_tpu.meshnet.node import P2PNode
+    from bee2bee_tpu.models import core, get_config
+    from bee2bee_tpu.services.tpu import TPUService
+
+    cfg = get_config("tiny-llama")
+    params = jax.tree.map(
+        np.asarray,
+        jax.device_get(core.init_params(cfg, jax.random.key(0),
+                                        dtype=jnp.float32)),
+    )
+    node = P2PNode(host="127.0.0.1", port=0)
+    await node.start()
+    engine = InferenceEngine(
+        cfg, params=params,
+        engine_config=EngineConfig(
+            max_seq_len=64, prefill_buckets=(16,), dtype="float32",
+            cache_dtype="float32", decode_chunk=4,
+        ),
+    )
+    client = None
+    try:
+        node.add_service(TPUService(cfg.name, engine=engine))
+        client = TestClient(TestServer(build_app(node)))
+        await client.start_server()
+        r = await client.post(
+            "/chat",
+            json={"prompt": "introspect smoke", "model": cfg.name,
+                  "max_new_tokens": 4, "temperature": 0.0},
+        )
+        assert r.status == 200, f"/chat returned {r.status}"
+
+        series = parse_prometheus(await (await client.get("/metrics")).text())
+        assert series.get("bee2bee_engine_compiles_total", 0) > 0, (
+            "engine.compiles_total never counted a jit trace"
+        )
+        assert "bee2bee_engine_mfu" in series, "MFU gauge missing"
+        assert series.get("bee2bee_engine_goodput_tokens_per_s", 0) > 0, (
+            "goodput gauge missing or zero after a generation"
+        )
+        assert "bee2bee_engine_hbm_bytes" in series, "HBM ledger missing"
+
+        ledger = engine.introspect.ledger.snapshot()
+        comp = dict(ledger["components"])
+        comp.pop("workspace_other", 0)
+        assert comp and sum(comp.values()) == ledger["accounted_bytes"], (
+            f"HBM ledger components {comp} do not sum to "
+            f"{ledger['accounted_bytes']}"
+        )
+        # the components must be the engine's REAL buffer sizes, not
+        # just internally consistent: weights == the live param tree's
+        # bytes, kv_pool == the paged pool's bytes (exact — same arrays)
+        expected_w = sum(x.nbytes for x in jax.tree.leaves(engine.params))
+        assert comp.get("weights") == expected_w, (
+            f"ledger weights {comp.get('weights')}B != param tree "
+            f"{expected_w}B"
+        )
+        assert comp.get("kv_pool", 0) > 0, "kv_pool component absent/zero"
+        total = ledger.get("bytes_in_use")
+        if total is not None:  # backends with memory_stats (TPU)
+            assert ledger["accounted_bytes"] <= total * 1.05, (
+                f"ledger accounts {ledger['accounted_bytes']}B but the "
+                f"device reports only {total}B in use"
+            )
+        intro = node.telemetry_digest().get("introspect")
+        assert intro and intro.get("compiles"), (
+            f"digest missing the introspect block: {intro!r}"
+        )
+    finally:
+        if client is not None:
+            await client.close()
+        engine.close()
+        await node.stop()
+
+
 def main() -> int:
     try:
         asyncio.run(run_smoke())
@@ -521,6 +610,7 @@ def main() -> int:
         asyncio.run(run_fleet_smoke())
         asyncio.run(run_pipeline_smoke())
         asyncio.run(run_adapter_smoke())
+        asyncio.run(run_introspect_smoke())
     except AssertionError as e:
         print(f"[telemetry-smoke] FAIL: {e}", file=sys.stderr)
         return 1
