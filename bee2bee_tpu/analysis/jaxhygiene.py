@@ -16,6 +16,17 @@ parallel/:
   TracerBoolConversionError at trace time or, worse, burns the first
   trace's branch into the compiled graph. Use ``jnp.where`` /
   ``lax.cond``.
+- ML-J003 — host sync inside the scheduler's decode hot-loop region:
+  ``.item()``/``.tolist()``/``.block_until_ready()``, ``np.asarray``/
+  ``np.array`` on the numpy alias, or ``jax.device_get`` lexically inside
+  the step-loop methods (engine/scheduler.py ``_step`` and the window
+  helpers it drives). The overlap design (docs/PERF.md "Decode hot
+  loop") permits exactly ONE host sync per readback window — the token
+  fetch in ``_fetch_window`` / the verdict fetch in ``_spec_step``,
+  each carrying a same-line suppression naming itself. Any other sync
+  in the region serializes the device behind host work the async ring
+  exists to overlap, and every occurrence must argue its case in a
+  suppression reason.
 
 "jit-reachable" is resolved statically: functions decorated with
 ``@jax.jit`` (directly or via partial), functions/methods wrapped as
@@ -37,6 +48,21 @@ _HOST_SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
 _NP_HOST_FNS = {"asarray", "array", "frombuffer", "copy"}
 _LAX_WRAPPERS = {"scan", "cond", "while_loop", "fori_loop", "switch"}
 _CAST_NAMES = {"float", "int", "bool"}
+# the decode hot-loop region (ML-J003): the scheduler step loop and the
+# window helpers it drives every readback. Matched by METHOD NAME within
+# engine/ files — the region is a contract on these names, so a renamed
+# helper must update this set (the known-bad fixture in test_meshlint
+# pins the coverage)
+_HOT_LOOP_FNS = {
+    "_step",
+    "_spec_step",
+    "_dispatch_window",
+    "_overlap_ready",
+    "_fetch_window",
+    "_process_window",
+    "_drain_inflight",
+    "_process_row_tokens",
+}
 
 
 class _Aliases:
@@ -79,6 +105,7 @@ class JaxHygienePass:
     rules = {
         "ML-J001": "implicit host sync inside a jit-compiled function",
         "ML-J002": "Python branch on a traced value inside jit",
+        "ML-J003": "host sync inside the scheduler's decode hot-loop region",
     }
 
     def applies(self, path: str) -> bool:
@@ -96,6 +123,15 @@ class JaxHygienePass:
             params = self._params(fn)
             for node in ast.walk(fn):
                 self._check(ctx, node, al, params, findings)
+        if ctx.path.startswith("engine/"):
+            for fn in ast.walk(ctx.tree):
+                if (
+                    isinstance(fn, ast.FunctionDef)
+                    and fn.name in _HOT_LOOP_FNS
+                    and id(fn) not in seen  # a jit root got ML-J001 already
+                ):
+                    for node in ast.walk(fn):
+                        self._check_hot_loop(ctx, node, al, findings)
         return findings
 
     # -------------------------------------------------------------- roots
@@ -245,3 +281,51 @@ class JaxHygienePass:
                         )
                     )
                     break
+
+    def _check_hot_loop(self, ctx, node, al: _Aliases, findings: list):
+        """ML-J003: the decode hot loop's sync budget is ONE fetch per
+        readback window. Every .item()/.tolist()/.block_until_ready(),
+        numpy-alias materialization, or jax.device_get in the region is a
+        finding — the sanctioned fetches carry same-line suppressions
+        whose reasons name the contract."""
+        if not isinstance(node, ast.Call):
+            return
+        name = _dotted(node.func)
+        last = name.rsplit(".", 1)[-1]
+        if isinstance(node.func, ast.Attribute) and last in _HOST_SYNC_ATTRS:
+            findings.append(
+                ctx.finding(
+                    "ML-J003",
+                    node,
+                    f".{last}() inside the decode hot-loop region",
+                    "blocks the step loop on a device→host sync the "
+                    "readback ring did not schedule — batch it into the "
+                    "window fetch or move it off the hot path",
+                )
+            )
+        elif (
+            "." in name
+            and name.rsplit(".", 1)[0] in al.numpy
+            and last in _NP_HOST_FNS
+        ):
+            findings.append(
+                ctx.finding(
+                    "ML-J003",
+                    node,
+                    f"{name}() in the decode hot-loop region",
+                    "materializing a device value here serializes the "
+                    "device behind host work — only the per-window token "
+                    "fetch may sync (suppress with the contract's reason)",
+                )
+            )
+        elif last == "device_get":
+            findings.append(
+                ctx.finding(
+                    "ML-J003",
+                    node,
+                    "jax.device_get() in the decode hot-loop region",
+                    "an unscheduled host sync in the step loop — the "
+                    "overlap design permits one fetch per readback window "
+                    "(suppress with the contract's reason)",
+                )
+            )

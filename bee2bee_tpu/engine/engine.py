@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -68,6 +69,25 @@ _C_TOKENS_OUT = get_registry().counter(
 )
 
 DEFAULT_BUCKETS = (64, 128, 256, 512, 1024, 2048, 4096, 8192)
+
+
+def _env_flag(name: str, default: bool) -> bool:
+    """Bool knob: unset -> default; "0"/"false"/"off"/"no" -> False."""
+    raw = os.environ.get(name)
+    if raw is None or raw.strip() == "":
+        return default
+    return raw.strip().lower() not in ("0", "false", "off", "no")
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw is None or raw.strip() == "":
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        logger.warning("%s=%r is not an int; using %d", name, raw, default)
+        return default
 
 
 @dataclass
@@ -184,6 +204,27 @@ class EngineConfig:
     # lora arguments entirely). 0 = off. Adapters page in/out at runtime
     # (engine.load_adapter / the mesh's DHT fetch) without a restart.
     max_adapters: int = 0
+    # ---- decode hot-loop mechanisms (docs/PERF.md "Decode hot loop").
+    # None = resolve from env at construction so node configs and tests
+    # can flip them without plumbing; the resolved value is always a
+    # plain bool/int after __post_init__.
+    # async dispatch overlap: dispatch window N+1 while window N's token
+    # readback is still in flight (BEE2BEE_OVERLAP, default on).
+    decode_overlap: bool | None = None
+    # depth of the in-flight readback ring. 2 = double-buffered: token
+    # emission / stop handling on window W never blocks W+1's dispatch
+    # (BEE2BEE_READBACK_DEPTH, default 2; clamped to >= 1).
+    readback_depth: int | None = None
+    # fused decode root: sampling + penalty-counts application live
+    # inside the ONE decode jit root, so a penalized row no longer parks
+    # the whole batch on the counts window (BEE2BEE_FUSED_ROOT, default
+    # on; off restores the split decode/decode_penalized roots).
+    fused_root: bool | None = None
+    # persistent-width batches: hold the batch at a sticky width
+    # (grow-only; idle-timeout release) instead of riding the pow2
+    # resize ladder, with HBM-ledger headroom gating growth
+    # (BEE2BEE_BATCH_STICKY, default on).
+    batch_sticky: bool | None = None
 
     def __post_init__(self):
         # <= 0 means "disabled" (NodeConfig uses 0 as its sentinel); a raw
@@ -204,6 +245,15 @@ class EngineConfig:
                 f"need 1 <= spec_min_match <= spec_max_match, got "
                 f"{self.spec_min_match}..{self.spec_max_match}"
             )
+        if self.decode_overlap is None:
+            self.decode_overlap = _env_flag("BEE2BEE_OVERLAP", True)
+        if self.fused_root is None:
+            self.fused_root = _env_flag("BEE2BEE_FUSED_ROOT", True)
+        if self.batch_sticky is None:
+            self.batch_sticky = _env_flag("BEE2BEE_BATCH_STICKY", True)
+        if self.readback_depth is None:
+            self.readback_depth = _env_int("BEE2BEE_READBACK_DEPTH", 2)
+        self.readback_depth = max(1, int(self.readback_depth))
 
 
 @dataclass
@@ -402,14 +452,18 @@ class InferenceEngine:
     @staticmethod
     def _spec_verify_key(params, cur, drafts, draft_lens, cache, offsets,
                          temps, topks, topps, minps=None, key=None,
-                         tables=None, adapters=None, aids=None, ascales=None):
+                         tables=None, adapters=None, aids=None, ascales=None,
+                         counts=None, reps=None, press=None, freqs=None):
         """Sentinel shape key for the spec-verify root: batch bucket,
-        draft width K, and the optional-operand flags."""
+        draft width K, and the optional-operand flags (counts rides along
+        when the batch holds penalized rows — the fused-root discipline,
+        docs/PERF.md "Decode hot loop")."""
         return (
             int(cur.shape[0]), int(drafts.shape[1]),
             minps is not None,
             None if tables is None else int(tables.shape[1]),
             adapters is not None,
+            counts is not None,
         )
 
     def _attn_fn(self):
@@ -581,9 +635,11 @@ class InferenceEngine:
 
     def _spec_verify_fn(self, params, cur, drafts, draft_lens, cache, offsets,
                         temps, topks, topps, minps, key, tables=None,
-                        adapters=None, aids=None, ascales=None):
+                        adapters=None, aids=None, ascales=None,
+                        counts=None, reps=None, press=None, freqs=None):
         """Speculative-decode verify: one [B, K+1] forward checks a whole
-        draft. Returns (next_tok [B], cache, accepted [B]).
+        draft. Returns (next_tok [B], cache, accepted [B]) — plus the
+        updated ``counts`` when penalty bookkeeping rides along.
 
         ``cur`` [B] is each row's last accepted token, ``drafts`` [B, K]
         the proposed continuations (padded with zeros past
@@ -621,8 +677,21 @@ class InferenceEngine:
         last = jnp.take_along_axis(
             logits, jnp.broadcast_to(idx, (B, 1, logits.shape[2])), axis=1
         )[:, 0, :]
-        nxt = sample_batched(last, key, temps, topks, topps, minps)
-        return nxt.astype(jnp.int32), cache, accepted
+        if counts is None:
+            nxt = sample_batched(last, key, temps, topks, topps, minps)
+            return nxt.astype(jnp.int32), cache, accepted
+        # fused penalty bookkeeping (docs/PERF.md "Decode hot loop"): a
+        # penalized row never drafts (scheduler._spec_eligible), so its
+        # accepted is 0 and the draft bump below is a masked no-op for it;
+        # non-drafting rows still need their ACCEPTED drafts counted so
+        # the shared [B,2,V] gen-counts stay coherent across the batch.
+        gain = (pos < accepted[:, None]).astype(counts.dtype)  # [B, K]
+        counts = counts.at[jnp.arange(B)[:, None], 1, drafts].add(gain)
+        nxt = sample_batched(last, key, temps, topks, topps, minps,
+                             counts, reps, press, freqs)
+        nxt = nxt.astype(jnp.int32)
+        counts = counts.at[jnp.arange(B), 1, nxt].add(1)
+        return nxt, cache, accepted, counts
 
     # ------------------------------------------------------------ helpers
 

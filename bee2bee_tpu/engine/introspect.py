@@ -114,6 +114,22 @@ _G_POOL_ETA = _REG.gauge(
     "projected seconds until the paged KV pool runs dry at the current "
     "growth rate (absent when the pool is not growing)",
 )
+_C_HOST_SYNCS = _REG.counter(
+    "engine.host_syncs",
+    "device->host token fetches in the decode hot loop (one per readback "
+    "window — the only blocking point the overlap design permits)",
+)
+_C_SYNC_STALLS = _REG.counter(
+    "engine.host_sync_stalls",
+    "host syncs that blocked with NO other decode window in flight — the "
+    "device sat idle while the host processed tokens (0 when overlap "
+    "keeps the ring full)",
+)
+_G_OVERLAP = _REG.gauge(
+    "engine.overlap_inflight",
+    "decode windows still in flight on-device at readback time (0 = "
+    "serialized loop, >=1 = async dispatch overlap is working)",
+)
 
 # ---------------------------------------------------------------- FLOPs model
 
@@ -991,7 +1007,8 @@ class EngineIntrospection:
         # reaches a consumer.
         try:
             for g in (_G_MFU, _G_GOODPUT, _G_SCHEDULED_TPS,
-                      _G_GOODPUT_FRAC, _G_POOL_ETA, _G_HBM_HEADROOM):
+                      _G_GOODPUT_FRAC, _G_POOL_ETA, _G_HBM_HEADROOM,
+                      _G_OVERLAP):
                 g.clear()
             for labels, _v in _G_HBM_BYTES.series():
                 _G_HBM_BYTES.clear(**dict(labels))

@@ -99,6 +99,15 @@ def sample_batched(
     requests' sampling settings (the scalar `sample` compiles one variant
     per signature — fine for a single stream, wrong for a shared batch).
 
+    This is the sampling stage of the FUSED decode root (scheduler
+    ._decode_fn and engine._spec_verify_fn call it inside their jit
+    graphs, threading ``counts`` through the scan carry): logits never
+    leave the device between the forward and the token, and a penalized
+    row rides the same compiled window as its greedy neighbors instead
+    of parking the whole batch on a split counts graph. ``counts=None``
+    lowers to a counts-free graph — the pre-fusion trace, bit-for-bit —
+    which is what an all-plain batch compiles and runs.
+
     Semantics per row match `sample`: [penalties →] temperature scale →
     top-k mask → nucleus mask over the already-masked logits →
     categorical; greedy rows short-circuit to argmax via a final where.

@@ -62,9 +62,20 @@ import numpy as np
 
 from ..health import get_recorder
 from ..metrics import get_registry
+from ..models import core
 from ..router.fairness import WdrrQueue
 from ..router.tenants import load_tenant_config
 from ..tracing import get_tracer
+from .introspect import _C_HOST_SYNCS, _C_SYNC_STALLS, _G_OVERLAP
+from .paged import (
+    BlockAllocator,
+    PagedPrefixCache,
+    ceil_div,
+    pow2_at_least,
+    prefill_chunk_positions,
+)
+from .sampling import sample_batched
+from .spec import NgramDrafter, should_disable
 
 logger = logging.getLogger("bee2bee_tpu.scheduler")
 
@@ -250,6 +261,14 @@ class SchedulerStats:
     spec_steps: int = 0
     spec_drafted: int = 0
     spec_accepted: int = 0
+    # decode hot loop (docs/PERF.md): windows whose dispatch carried the
+    # [B, 2, V] penalty counts (fused root or split pen root alike) — the
+    # "penalized rows park the whole batch on the counts window" cost is
+    # exactly this counter's growth rate vs chunks
+    counts_windows: int = 0
+    # sticky-width growth attempts the HBM ledger's headroom gate denied
+    # (the request requeues at the front and retries after retirements)
+    width_grow_denials: int = 0
     # live generation migration (meshnet/migrate.py). The acceptance
     # contract of the drain path pins on these: a happy-path migration is
     # migrated_out on the source + migrated_in on the target with
@@ -315,8 +334,6 @@ class BatchScheduler:
         # resizes with the batch bucket (row identity lives in the block
         # table), so grow/shrink/compaction cost zero device copies and
         # per-step cache traffic follows the table width.
-        from .paged import BlockAllocator
-
         self._block_size = e.engine_cfg.kv_block_size
         self._alloc = BlockAllocator(e.pool_blocks)
         self._tables = np.zeros((max_batch, e.blocks_per_row), np.int32)
@@ -373,8 +390,6 @@ class BatchScheduler:
 
             return jax.tree.map(cp, cache)
 
-        from .sampling import sample_batched
-
         self._counts_zeros = jax.jit(
             lambda b: jnp.zeros((b, 2, V), jnp.int32), static_argnums=0
         )
@@ -400,18 +415,51 @@ class BatchScheduler:
         ic.ledger.register("kv_pool", lambda: self._cache)
         tw_ok = self._declared_table_width
         bs_ok = engine._declared_batch_sizes
+        # decode hot-loop mechanisms (docs/PERF.md "Decode hot loop"):
+        # resolved once from EngineConfig (env knobs already folded in by
+        # its __post_init__) — the step loop branches on plain bools.
+        cfg = e.engine_cfg
+        self._fused = bool(cfg.fused_root)
+        self._overlap = bool(cfg.decode_overlap)
+        self._depth = max(1, int(cfg.readback_depth))
+        self._sticky = bool(cfg.batch_sticky)
+        # sticky-width idle release: an all-idle batch holds its bucket
+        # this long after the last dispatch before dropping to 1 (an
+        # instance attr so tests can collapse the hysteresis window)
+        self._sticky_idle_s = 5.0
+        self._last_dispatch_t = 0.0
+        # readback ring: dispatched-but-unread decode windows. Each entry
+        # carries the chained device cur/offsets, the per-chunk token
+        # buffers, and its own (row, request) map — row bookkeeping may
+        # drift (retirement nulls _rows[b]) between dispatch and fetch.
+        self._inflight: deque = deque()
+        # blocks freed by a retirement while windows were still in flight:
+        # those windows keep dead-row-scattering into them, so the deref
+        # waits for the ring to drain (reallocating them early would let
+        # an in-flight write corrupt another row's fresh block)
+        self._deferred_blocks: list[int] = []
+        # (cur, offsets) shardings of the decode root's outputs, captured
+        # at the first dispatch. Ring-empty dispatches re-enter the chain
+        # from the numpy host mirrors, which must be committed to these
+        # before the call — see the sharding note in _dispatch_window.
+        self._chain_sharding: tuple | None = None
         self._decode = ic.sentinel.watch(
             "decode",
             jax.jit(self._decode_fn, donate_argnums=(2,)),
             key_fn=self._decode_key,
             allowed=lambda key: key[0] in bs_ok and tw_ok(key[1]),
         )
-        self._decode_pen = ic.sentinel.watch(
-            "decode_penalized",
-            jax.jit(self._decode_pen_fn, donate_argnums=(2, 4)),
-            key_fn=self._decode_pen_key,
-            allowed=lambda key: key[0] in bs_ok and tw_ok(key[1]),
-        )
+        if self._fused:
+            # penalty counts ride the fused root (counts flag in
+            # _decode_key); the split pen root never compiles
+            self._decode_pen = None
+        else:
+            self._decode_pen = ic.sentinel.watch(
+                "decode_penalized",
+                jax.jit(self._decode_pen_fn, donate_argnums=(2, 4)),
+                key_fn=self._decode_pen_key,
+                allowed=lambda key: key[0] in bs_ok and tw_ok(key[1]),
+            )
         # jitted: sample_batched run eagerly is ~15 tiny ops = ~15 round
         # trips through a tunneled chip per admission
         self._sample_first = jax.jit(sample_batched)
@@ -457,8 +505,6 @@ class BatchScheduler:
 
             self._reset_scales = jax.jit(reset_scales, donate_argnums=(0,))
         if e.engine_cfg.prefix_cache_entries > 0:
-            from .paged import PagedPrefixCache
-
             self._prefix_cache = PagedPrefixCache(
                 e.engine_cfg.prefix_cache_entries, self._alloc
             )
@@ -494,8 +540,6 @@ class BatchScheduler:
                     e.engine_cfg.spec_tokens, e.max_seq_len,
                 )
             else:
-                from .spec import NgramDrafter
-
                 self._spec = NgramDrafter(
                     e.engine_cfg.spec_tokens,
                     e.engine_cfg.spec_min_match,
@@ -581,14 +625,17 @@ class BatchScheduler:
     @staticmethod
     def _decode_key(params, cur, cache, offsets, temps, topks, topps,
                     minps, key, tables=None, adapters=None, aids=None,
-                    ascales=None):
+                    ascales=None, counts=None, reps=None, press=None,
+                    freqs=None):
         """Sentinel shape key for the decode root: batch bucket, table
-        width bucket, and the optional-operand None-flags (min_p and the
-        adapter factors each select a distinct legitimate trace)."""
+        width bucket, and the optional-operand None-flags (min_p, the
+        adapter factors, and the fused penalty counts each select a
+        distinct legitimate trace)."""
         return (
             int(cur.shape[0]),
             None if tables is None else int(tables.shape[1]),
             minps is not None, adapters is not None,
+            counts is not None,
         )
 
     @staticmethod
@@ -604,32 +651,44 @@ class BatchScheduler:
 
     def _decode_fn(self, params, cur, cache, offsets, temps, topks, topps,
                    minps, key, tables=None, adapters=None, aids=None,
-                   ascales=None):
+                   ascales=None, counts=None, reps=None, press=None,
+                   freqs=None):
         """One chunk: decode K tokens for ALL rows. Returns
-        (cur', cache', offsets', toks [B, K]). `tables` [B, MBb] selects
-        the paged-pool path: attention gathers only the mapped blocks.
-        `adapters`/`aids`/`ascales` (adapters/pool.py) select per-row
-        LoRA deltas inside the same step; None keeps the base trace."""
-        from ..models import core
-        from .sampling import sample_batched
-
+        (cur', cache', offsets', counts', toks [B, K]). `tables` [B, MBb]
+        selects the paged-pool path: attention gathers only the mapped
+        blocks. `adapters`/`aids`/`ascales` (adapters/pool.py) select
+        per-row LoRA deltas inside the same step; None keeps the base
+        trace. THE FUSED ROOT (docs/PERF.md "Decode hot loop"): when
+        ``counts`` [B, 2, V] rides along, penalty application + the
+        per-token occurrence bump run inside this same scan — penalized
+        rows cost one extra trace (the counts None-flag in _decode_key),
+        never a separate root, and rep=1/pres=0/freq=0 rows pass through
+        apply_penalties unchanged, so mixed batches stay token-for-token
+        identical to the split-root path. counts=None keeps the
+        counts-free graph (None is a valid scan-carry pytree leaf)."""
         e = self.engine
+        B = cur.shape[0]
 
         def step(carry, key_t):
-            cur, cache, off = carry
+            cur, cache, off, cnt = carry
             logits, cache = core.forward(
                 params, e.model_cfg, cur[:, None], cache, off,
                 attn_fn=e._attn_fn(), block_tables=tables,
                 adapters=adapters, adapter_ids=aids, adapter_scales=ascales,
             )
             nxt = sample_batched(
-                logits[:, -1, :], key_t, temps, topks, topps, minps
+                logits[:, -1, :], key_t, temps, topks, topps, minps,
+                cnt, reps, press, freqs,
             )
-            return (nxt, cache, off + 1), nxt
+            if cnt is not None:
+                cnt = cnt.at[jnp.arange(B), 1, nxt].add(1)
+            return (nxt, cache, off + 1, cnt), nxt
 
         keys = jax.random.split(key, e.engine_cfg.decode_chunk)
-        (cur, cache, offsets), toks = jax.lax.scan(step, (cur, cache, offsets), keys)
-        return cur, cache, offsets, jnp.moveaxis(toks, 0, 1)
+        (cur, cache, offsets, counts), toks = jax.lax.scan(
+            step, (cur, cache, offsets, counts), keys
+        )
+        return cur, cache, offsets, counts, jnp.moveaxis(toks, 0, 1)
 
     def _decode_pen_fn(
         self, params, cur, cache, offsets, counts,
@@ -637,12 +696,11 @@ class BatchScheduler:
         adapters=None, aids=None, ascales=None,
     ):
         """Penalty-carrying decode chunk: counts ride the scan carry and
-        every sampled token scatters into its row. Compiled only when a
-        penalized row is active — the fast path keeps the counts-free
-        graph."""
-        from ..models import core
-        from .sampling import sample_batched
-
+        every sampled token scatters into its row. The PRE-FUSION split
+        root — registered only when fused_root is off (the parity
+        reference the fused path is tested against); the fused _decode_fn
+        carries counts in the same scan slot and samples with the same
+        key draws, so the two are token-for-token identical."""
         e = self.engine
         B = cur.shape[0]
 
@@ -678,9 +736,14 @@ class BatchScheduler:
                     self._fail_all("engine shut down")
                     return
             try:
+                if self._inflight and (self._checkpoints or self._queue):
+                    # admission and checkpoints need settled row state —
+                    # drain the readback ring before touching either
+                    if self._drain_inflight():
+                        self._compact_and_shrink()
                 self._service_checkpoints()
                 self._admit()
-                if self.active:
+                if self.active or self._inflight:
                     self._step()
             except Exception as e:  # noqa: BLE001 — the thread must survive:
                 # a dead scheduler thread would hang every blocked caller
@@ -705,6 +768,13 @@ class BatchScheduler:
         """Error-terminate every queued AND admitted request (callers are
         blocked on their event queues and must always get a done event).
         Caller must hold self._cond — submit() appends under it."""
+        # abandon the readback ring outright: its device futures may be
+        # poisoned, and with every row released below nobody needs them
+        self._inflight.clear()
+        _G_OVERLAP.set(0)
+        if self._deferred_blocks:
+            self._alloc.deref(self._deferred_blocks)
+            self._deferred_blocks = []
         for req in list(self._queue) + [r for r in self._rows if r is not None]:
             self._release_adapter(req)
             req.finish = "error"
@@ -725,10 +795,11 @@ class BatchScheduler:
         the whole pool/allocator/prefix-pin state is rebuilt — the pool
         was donated through the failed call and may hold poisoned
         buffers."""
-        from .paged import BlockAllocator, PagedPrefixCache
-
         self._bsz = 1
         e = self.engine
+        self._inflight.clear()
+        _G_OVERLAP.set(0)
+        self._deferred_blocks = []  # the allocator is rebuilt below
         self._alloc = BlockAllocator(e.pool_blocks)
         self._tables[:] = 0
         self._row_blocks = [[] for _ in range(self.max_batch)]
@@ -752,7 +823,12 @@ class BatchScheduler:
         other refs — prefix pins, CoW donors) and null its table row so
         dead-row decode writes land in the null block."""
         if self._row_blocks[b]:
-            self._alloc.deref(self._row_blocks[b])
+            if self._inflight:
+                # in-flight windows still dead-row-scatter into these
+                # blocks; deref when the ring drains (_release_deferred)
+                self._deferred_blocks.extend(self._row_blocks[b])
+            else:
+                self._alloc.deref(self._row_blocks[b])
             self._row_blocks[b] = []
         self._tables[b, :] = 0
         self._aids[b] = 0  # dead rows gather the null adapter (zeros)
@@ -784,8 +860,6 @@ class BatchScheduler:
                 f"{self._alloc.free_count} free of {self._alloc.num_blocks}"
             )
         if self._quantized and fresh:
-            from .paged import pow2_at_least
-
             # pow2-padded index (null block 0 pad) bounds compile variants
             width = pow2_at_least(len(fresh))
             idx = np.zeros((width,), np.int32)
@@ -799,8 +873,6 @@ class BatchScheduler:
         """Grow row b's block table to cover positions [0, upto) — the
         lazy allocation that makes short rows cheap. Raises _PoolExhausted
         (with row state untouched beyond already-owned blocks)."""
-        from .paged import ceil_div
-
         need = ceil_div(upto, self._block_size)
         have = len(self._row_blocks[b])
         if need <= have:
@@ -813,8 +885,6 @@ class BatchScheduler:
     def _table_width(self, nblocks: int) -> int:
         """Pow2-bucketed block-table width (bounds compile variants) —
         never below what any row maps, never past the physical table."""
-        from .paged import pow2_at_least
-
         return min(pow2_at_least(nblocks), self.engine.blocks_per_row)
 
     # ------------------------------------------------------------ migration
@@ -893,8 +963,6 @@ class BatchScheduler:
         offset == len(ids) + len(out) - 1 and cur == out[-1] (the last
         sampled token's K/V is written by the NEXT forward), so the
         blocks covering [0, offset) are the complete recoverable state."""
-        from .paged import ceil_div, pow2_at_least
-
         snap = self._snapshot_meta(req)
         offset = int(self._offsets[b])
         nb = ceil_div(offset, self._block_size)
@@ -920,8 +988,6 @@ class BatchScheduler:
         (the fallback rung, counted in import_reprefills). Raises
         _PoolExhausted with the row released — imports never requeue: the
         exporting node needs a fast typed verdict to try its next rung."""
-        from .paged import ceil_div, pow2_at_least
-
         e = self.engine
         BS = self._block_size
         kv = st.get("kv")
@@ -1004,6 +1070,25 @@ class BatchScheduler:
 
     # ------------------------------------------------------- batch resizing
 
+    # minimum HBM ledger headroom fraction required to grow the batch
+    # bucket (sticky widths make growth ~permanent, so a grow near the
+    # memory ceiling is a standing OOM invitation, not a transient)
+    _GROW_HEADROOM_MIN = 0.02
+
+    def _growth_headroom(self) -> bool:
+        """May the batch bucket grow? Gated on the HBM ledger's live
+        headroom fraction (engine/introspect.py). An unknown limit
+        (headroom_frac absent — e.g. CPU without BEE2BEE_HBM_BYTES)
+        always allows: the gate exists to stop growth into a KNOWN
+        ceiling, never to guess one."""
+        try:
+            frac = self.engine.introspect.ledger.snapshot().get(
+                "headroom_frac"
+            )
+        except Exception:  # noqa: BLE001 — telemetry never blocks admission
+            return True
+        return frac is None or frac > self._GROW_HEADROOM_MIN
+
     def _resize(self, new_bsz: int):
         """Move to a new batch bucket. The pool is batch-bucket-
         independent (row identity lives in the block table), so only the
@@ -1064,6 +1149,20 @@ class BatchScheduler:
             self._rows[last] = None
             self._row_params_dirty = True
         A = self.active
+        if self._sticky:
+            # persistent-width batches (docs/PERF.md "Decode hot loop"):
+            # the batch bucket is GROW-ONLY while work flows — each bucket
+            # size is a distinct decode trace, and the pow2 resize ladder's
+            # shrink-then-regrow churn showed up in the compile ledger as
+            # the dominant retrace source under bursty admission. A fully
+            # idle batch releases the bucket only after the hysteresis
+            # window, so a burst arriving right after a drain reuses the
+            # already-compiled width instead of re-climbing the ladder.
+            if (A == 0 and self._bsz > 1
+                    and time.perf_counter() - self._last_dispatch_t
+                    > self._sticky_idle_s):
+                self._resize(1)
+            return
         if A == 0 and self._bsz > 1:
             # the pool and prefix pins persist across idle — only the
             # host bucket shrinks (no device state to rebuild)
@@ -1089,8 +1188,6 @@ class BatchScheduler:
         ``seq`` overrides the token sequence prefilled (default: the
         prompt). The re-prefill import rung (_paged_import) passes
         prompt + accepted-so-far — one chunk walk, two consumers."""
-        from .paged import ceil_div, prefill_chunk_positions
-
         e = self.engine
         BS = self._block_size
         # goodput accounting: a re-prefill (migration/failover import —
@@ -1253,6 +1350,22 @@ class BatchScheduler:
                         )
                     continue
             if self.active == self._bsz:
+                if not self._growth_headroom():
+                    # HBM-ledger-gated growth (sticky widths never shrink
+                    # back, so a grow under memory pressure would pin the
+                    # wider bucket's footprint for good): requeue at the
+                    # front — retirements free rows at the CURRENT width
+                    # and the retry admits into a hole without growing
+                    self._release_adapter(req)
+                    with self._cond:
+                        # front requeue refunds the WDRR cost charged at
+                        # the pop, so the retry isn't double-billed
+                        self._queue.appendleft(
+                            req, tenant=req.tenant,
+                            cost=max(1.0, float(req.max_new_tokens)),
+                        )
+                    self.stats.width_grow_denials += 1
+                    break
                 self._resize(min(self._bsz * 2, self.max_batch))
             b = next(i for i, r in enumerate(self._rows) if r is None)
 
@@ -1549,7 +1662,7 @@ class BatchScheduler:
             "ascales": scales,
         }
 
-    def _window_size(self) -> int:
+    def _window_size(self, pending: int = 0) -> int:
         """Chunks to dispatch before the next host sync (see
         EngineConfig.max_inflight_chunks). Streaming requests pin the
         window to 1 chunk so tokens flush at chunk cadence; otherwise the
@@ -1559,7 +1672,12 @@ class BatchScheduler:
         decode hundreds of tokens between draft opportunities, so while
         such a row is live the drafter gets a look every chunk (rows
         whose content never repeats stop being eligible via the
-        miss-counting adaptive disable and full windows resume)."""
+        miss-counting adaptive disable and full windows resume).
+
+        ``pending`` is the token depth already in flight (overlap mode
+        dispatches ahead of the readback): it comes off the tightest
+        budget so look-ahead windows never stack past a row's remaining
+        tokens."""
         e = self.engine
         K = e.engine_cfg.decode_chunk
         if any(r is not None and r.stream for r in self._rows):
@@ -1577,7 +1695,7 @@ class BatchScheduler:
             r.max_new_tokens - len(r.out_ids)
             for r in self._rows
             if r is not None
-        )
+        ) - pending
         w = -(-min_left // K)  # ceil
         if self._queue:  # queued work wants a row soon: keep syncs frequent
             w = min(w, 2)
@@ -1659,18 +1777,22 @@ class BatchScheduler:
 
     def _spec_possible(self) -> bool:
         """Batch-level speculation gate, shared by _spec_drafts and the
-        _window_size pin so they can never disagree: no penalized row
-        (penalty counts ride only the window graphs) and no active row
-        within K+1 of capacity (ineligible rows still ride the [B, K+1]
-        forward, and its write extent past capacity would demand pool
-        blocks past blocks_per_row). A window pinned to 1 chunk
-        while every spec step is vetoed would be pure sync-cadence loss."""
+        _window_size pin so they can never disagree: no active row within
+        K+1 of capacity (ineligible rows still ride the [B, K+1] forward,
+        and its write extent past capacity would demand pool blocks past
+        blocks_per_row). A window pinned to 1 chunk while every spec step
+        is vetoed would be pure sync-cadence loss.
+
+        The penalized-row veto applies only to the SPLIT roots: with the
+        fused root on, counts ride the verify call too
+        (engine._spec_verify_fn), so one penalized row no longer parks
+        the whole batch's speculation on the counts window."""
         e = self.engine
         K = e.engine_cfg.spec_tokens
         for b, req in enumerate(self._rows):
             if req is None:
                 continue
-            if req.penalized:
+            if req.penalized and not self._fused:
                 return False
             if int(self._offsets[b]) + K + 1 > e.max_seq_len:
                 return False
@@ -1680,8 +1802,6 @@ class BatchScheduler:
         """Adaptive per-row disable: drafted tokens plus miss-equivalents
         (a no-match step weighs like a fully-rejected K-token draft)
         against the acceptance floor."""
-        from .spec import should_disable
-
         K = self.engine.engine_cfg.spec_tokens
         if should_disable(
             req.spec_drafted + K * req.spec_misses, req.spec_accepted,
@@ -1694,9 +1814,10 @@ class BatchScheduler:
         """Collect per-row drafts for one spec step. Returns
         (drafts [bsz, K], lens [bsz]) or None when this step must take
         the plain/penalized window instead: no row drafted anything, a
-        penalized row is active (penalty counts ride only the window
-        graphs), or any active row is too close to capacity for the
-        fixed [B, K+1] write extent (_spec_possible)."""
+        penalized row is active under the SPLIT roots (pre-fusion, the
+        counts graph existed only on the window path — see
+        _spec_possible), or any active row is too close to capacity for
+        the fixed [B, K+1] write extent (_spec_possible)."""
         e = self.engine
         K = e.engine_cfg.spec_tokens
         if not self._spec_possible():
@@ -1755,17 +1876,41 @@ class BatchScheduler:
             self._mean_active_ctx() + (e.engine_cfg.spec_tokens + 1) / 2.0,
             scheduled=self.active * (e.engine_cfg.spec_tokens + 1),
         )
+        # fused penalty bookkeeping: with the fused root on, a penalized
+        # row no longer vetoes the whole batch's speculation — its counts
+        # ride the verify call (engine._spec_verify_fn) and it advances
+        # its normal one penalty-sampled token per step
+        pen = (
+            self._fused and self._counts is not None
+            and any(r is not None and r.penalized for r in self._rows)
+        )
         t_step = time.perf_counter()
         with get_tracer().span(
             "engine.spec_verify", active=self.active, drafted=int(lens.sum())
         ):
-            nxt_d, self._cache, acc_d = e._spec_verify(
-                e.params, self._cur, drafts, lens, self._cache,
-                self._offsets, temps, topks, topps, minps,
-                e._next_key(), tables, **self._lora_args(),
-            )
-            nxt, acc = (np.asarray(x) for x in jax.device_get((nxt_d, acc_d)))
+            if pen:
+                nxt_d, self._cache, acc_d, self._counts = e._spec_verify(
+                    e.params, self._cur, drafts, lens, self._cache,
+                    self._offsets, temps, topks, topps, minps,
+                    e._next_key(), tables, **self._lora_args(),
+                    counts=self._counts, reps=self._reps,
+                    press=self._press, freqs=self._freqs,
+                )
+                self.stats.counts_windows += 1
+            else:
+                nxt_d, self._cache, acc_d = e._spec_verify(
+                    e.params, self._cur, drafts, lens, self._cache,
+                    self._offsets, temps, topks, topps, minps,
+                    e._next_key(), tables, **self._lora_args(),
+                )
+            # a spec step is always a serialized sync: the drafter needs
+            # the verdict before it can propose again
+            _C_HOST_SYNCS.inc()
+            _C_SYNC_STALLS.inc()
+            _G_OVERLAP.set(0)
+            nxt, acc = (np.asarray(x) for x in jax.device_get((nxt_d, acc_d)))  # meshlint: ignore[ML-J003] -- the spec verdict IS the readback window's one host sync
         _H_STEP.observe((time.perf_counter() - t_step) * 1000.0)
+        self._last_dispatch_t = time.perf_counter()
         self._cur = nxt.astype(np.int32).copy()
         self._offsets = (self._offsets + acc + 1).astype(np.int32)
         self.stats.spec_steps += 1
@@ -1848,19 +1993,80 @@ class BatchScheduler:
         return False
 
     def _step(self):
-        """One readback window: dispatch W decode chunks (async, chained
-        on device), sync once, process W*decode_chunk tokens per row.
-        With speculation enabled, a step where some greedy row drafted
-        becomes ONE [B, K+1] verify call instead (_spec_step)."""
-        e = self.engine
-        if self._spec is not None and self._spec_step():
+        """One hot-loop turn (docs/PERF.md "Decode hot loop"): keep the
+        readback ring full, fetch the OLDEST in-flight window (the only
+        host sync), refill the ring BEFORE processing its tokens — so
+        token emission/stop handling/accounting overlap the next window's
+        device time — then process. With overlap off the ring depth is 1
+        and this collapses to the classic dispatch→sync→process loop.
+        With speculation enabled, a turn where some greedy row drafted
+        becomes ONE serialized [B, K+1] verify call instead (_spec_step
+        — the drafter needs each verdict before proposing again, so spec
+        steps never ride the ring)."""
+        K = self.engine.engine_cfg.decode_chunk
+        if (not self._inflight and self._spec is not None
+                and self._spec_step()):
             return
-        W = self._window_size()
-        K = e.engine_cfg.decode_chunk
-        tables = self._prepare_window_tables(W * K)
-        if tables is None:
+        # fill the ring: the first window dispatches unconditionally (the
+        # classic step); look-ahead windows pass the _overlap_ready gate
+        depth = self._depth if self._overlap else 1
+        while len(self._inflight) < depth:
+            pending = sum(r["W"] for r in self._inflight) * K
+            if self._inflight and not self._overlap_ready(pending):
+                break
+            if not self._dispatch_window(pending):
+                break
+        if not self._inflight:
             self._compact_and_shrink()
             return
+        rec = self._inflight.popleft()
+        toks_host = self._fetch_window(rec)
+        # async dispatch overlap: with rec's tokens on the host, put the
+        # NEXT window in flight before doing any host-side token work
+        # (rec's tokens count toward pending — they are not in out_ids
+        # yet). At depth 1 this alone keeps the device busy through the
+        # processing below; at depth 2 the ring already holds a window
+        # and this tops it back up.
+        if self._overlap:
+            while len(self._inflight) < self._depth:
+                pending = (sum(r["W"] for r in self._inflight)
+                           + rec["W"]) * K
+                if not self._overlap_ready(pending):
+                    break
+                if not self._dispatch_window(pending):
+                    break
+        if not self._inflight:
+            # the device goes idle while the host processes this window —
+            # the stall the overlap machinery exists to remove
+            _C_SYNC_STALLS.inc()
+        retired_any = self._process_window(rec, toks_host)
+        self._release_deferred()
+        if self.active == 0 and self._inflight:
+            # every row retired mid-ring: the remaining windows are pure
+            # overshoot nobody will read — drain them now so the batch
+            # can compact and the next admission starts clean
+            retired_any |= self._drain_inflight()
+        if retired_any and not self._inflight:
+            # compaction moves rows; in-flight records carry row indices,
+            # so it must wait for an empty ring (holes cost dead-row
+            # positions until then — the same price a half-empty bucket
+            # already pays)
+            self._compact_and_shrink()
+
+    def _dispatch_window(self, pending: int = 0) -> bool:
+        """Dispatch one W-chunk decode window (async — no host sync) and
+        push its record onto the readback ring. Chains device state off
+        the ring tail (or the host mirrors when the ring is empty), so
+        windows form one dependency chain on device. Host offsets advance
+        AT DISPATCH — every pending-window consumer (_prepare_window_
+        tables, _spec_eligible, _overlap_ready) sees the post-in-flight
+        positions. Returns False when no active rows survive table prep."""
+        e = self.engine
+        K = e.engine_cfg.decode_chunk
+        W = self._window_size(pending)
+        tables = self._prepare_window_tables(W * K)
+        if tables is None:
+            return False
         temps, topks, topps = self._row_sampling_arrays()
         pen = self._counts is not None and any(
             r is not None and r.penalized for r in self._rows
@@ -1878,49 +2084,197 @@ class BatchScheduler:
             self._mean_active_ctx() + W * K / 2.0,
             scheduled=self.active * W * K,
         )
-        t_step = time.perf_counter()
-        with get_tracer().span("engine.decode_window", active=self.active, chunks=W):
-            # host mirrors go in as the first call's args; chunks chain on
-            # the returned DEVICE arrays; the host mirrors then advance
-            # from the same readback the tokens needed anyway — the whole
-            # window runs with zero eager device ops
+        # host mirrors go in as the first call's args; chunks chain on
+        # the returned DEVICE arrays; the host mirrors then advance
+        # from the same readback the tokens needed anyway — the whole
+        # window runs with zero eager device ops
+        if self._inflight:
+            tail = self._inflight[-1]
+            cur_d, off_d = tail["cur"], tail["off"]
+        else:
             cur_d, off_d = self._cur, self._offsets
-            lora = self._lora_args()
-            toks_parts = []
-            for _ in range(W):
+            if self._chain_sharding is not None:
+                # jax keys executables on input sharding as well as
+                # shape: a raw numpy mirror lowers as an UNcommitted
+                # arg while chained jit outputs carry the mesh's
+                # NamedSharding, which would silently DOUBLE the decode
+                # root's compile space (one executable per key per
+                # source) and land the second compile mid-serve.
+                # Committing the mirrors to the sharding the root's own
+                # outputs carry keeps one executable per sentinel key.
+                cur_d = jax.device_put(cur_d, self._chain_sharding[0])
+                off_d = jax.device_put(off_d, self._chain_sharding[1])
+        lora = self._lora_args()
+        toks_parts = []
+        for _ in range(W):
+            if self._fused:
+                cur_d, self._cache, off_d, cnts, toks = self._decode(
+                    e.params, cur_d, self._cache, off_d,
+                    temps, topks, topps, minps, e._next_key(), tables,
+                    counts=self._counts if pen else None,
+                    reps=self._reps if pen else None,
+                    press=self._press if pen else None,
+                    freqs=self._freqs if pen else None,
+                    **lora,
+                )
                 if pen:
-                    cur_d, self._cache, off_d, self._counts, toks = (
-                        self._decode_pen(
-                            e.params, cur_d, self._cache, off_d, self._counts,
-                            temps, topks, topps, minps,
-                            self._reps, self._press, self._freqs,
-                            e._next_key(), tables, **lora,
-                        )
+                    self._counts = cnts
+            elif pen:
+                cur_d, self._cache, off_d, self._counts, toks = (
+                    self._decode_pen(
+                        e.params, cur_d, self._cache, off_d, self._counts,
+                        temps, topks, topps, minps,
+                        self._reps, self._press, self._freqs,
+                        e._next_key(), tables, **lora,
                     )
-                else:
-                    cur_d, self._cache, off_d, toks = self._decode(
-                        e.params, cur_d, self._cache, off_d,
-                        temps, topks, topps, minps, e._next_key(), tables,
-                        **lora,
-                    )
-                toks_parts.append(toks)
-            parts_host = [np.asarray(x) for x in jax.device_get(toks_parts)]
-            toks_host = (
-                np.concatenate(parts_host, axis=1) if W > 1 else parts_host[0]
-            )  # [B, W*K]
-        _H_STEP.observe((time.perf_counter() - t_step) * 1000.0)
-        self._cur = toks_host[:, -1].astype(np.int32).copy()
+                )
+            else:
+                # _decode is the fused root in BOTH modes; with counts
+                # left None it lowers to the counts-free graph, so the
+                # unfused setting differs only in routing pen windows to
+                # the split _decode_pen root above
+                cur_d, self._cache, off_d, _, toks = self._decode(
+                    e.params, cur_d, self._cache, off_d,
+                    temps, topks, topps, minps, e._next_key(), tables,
+                    **lora,
+                )
+            toks_parts.append(toks)
+        if self._chain_sharding is None:
+            # metadata-only read (no sync): adopt the root's own output
+            # shardings as the canonical chain-entry commitment
+            self._chain_sharding = (cur_d.sharding, off_d.sharding)
+        self._inflight.append({
+            "cur": cur_d, "off": off_d, "toks": toks_parts, "W": W,
+            # each record carries its own (row, request) map: retirement
+            # nulls _rows[b] between dispatch and fetch, and the fetch
+            # must still route row b's tokens to the request that was
+            # live when the window launched
+            "rows": [
+                (b, r) for b, r in enumerate(self._rows) if r is not None
+            ],
+            "t0": time.perf_counter(),
+        })
         self._offsets = self._offsets + np.int32(W * K)
         self.stats.chunks += W
+        if pen:
+            self.stats.counts_windows += 1
+        self._last_dispatch_t = time.perf_counter()
+        return True
 
-        retired_any = False
-        for b, req in enumerate(self._rows):
-            if req is None:
+    def _overlap_ready(self, pending: int) -> bool:
+        """May a look-ahead window dispatch with ``pending`` tokens
+        already in flight? Look-ahead is strictly opportunistic — it must
+        never be DESTRUCTIVE (evict prefix pins, migrate or retire rows)
+        and never steal the sync cadence from work that wants the host
+        (queued admissions, checkpoints, streaming flushes, spec drafts).
+        Everything here reads post-in-flight offsets (_dispatch_window
+        advances them at dispatch)."""
+        if not self._overlap or self.active == 0:
+            return False
+        # queued/checkpoint work needs settled rows at the next sync;
+        # streaming rows need token flushes at chunk cadence, not
+        # pending*K tokens late
+        if self._queue or self._checkpoints:
+            return False
+        if any(r is not None and r.stream for r in self._rows):
+            return False
+        # a spec-eligible row wants a draft look at the NEXT readback —
+        # stacking plain windows ahead of it would decode past the
+        # repetition the drafter feeds on
+        if (
+            self._spec is not None
+            and self._spec_possible()
+            and any(
+                r is not None and self._spec_eligible(b, r)
+                for b, r in enumerate(self._rows)
+            )
+        ):
+            return False
+        e = self.engine
+        K = e.engine_cfg.decode_chunk
+        min_left = min(
+            r.max_new_tokens - len(r.out_ids)
+            for r in self._rows
+            if r is not None
+        )
+        # some row must still need tokens BEYOND what is already in
+        # flight, or the whole window would be budget overshoot
+        if min_left <= pending:
+            return False
+        W = self._window_size(pending)
+        need = 0
+        for b, r in enumerate(self._rows):
+            if r is None:
                 continue
-            req.chunks_decoded += W
+            upto = int(self._offsets[b]) + W * K
+            # hard capacity: the non-overlap path may overshoot into the
+            # decode_chunk margin once; stacked look-ahead may not
+            if upto > e.max_seq_len:
+                return False
+            need += max(
+                0, ceil_div(upto, self._block_size) - len(self._row_blocks[b])
+            )
+        # the free list must cover the window outright: look-ahead never
+        # reclaims prefix pins and never migrates/retires a row
+        return need <= self._alloc.free_count
+
+    def _fetch_window(self, rec) -> np.ndarray:
+        """THE host sync of the decode hot loop: block on one in-flight
+        window's token buffers. Everything else the step needs came back
+        with earlier fetches or never left the host."""
+        _G_OVERLAP.set(len(self._inflight))
+        _C_HOST_SYNCS.inc()
+        with get_tracer().span(
+            "engine.decode_window",
+            active=len(rec["rows"]), chunks=rec["W"],
+            inflight=len(self._inflight),
+        ):
+            parts = [np.asarray(x) for x in jax.device_get(rec["toks"])]  # meshlint: ignore[ML-J003] -- the one sanctioned sync per readback window (docs/PERF.md)
+        toks_host = (
+            np.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
+        )  # [B, W*K]
+        if not self._inflight:
+            # ring drained: the host mirror of the latest sampled token
+            # is this window's last column (mid-ring fetches skip this —
+            # a NEWER window is already chained off the device value)
+            self._cur = toks_host[:, -1].astype(np.int32).copy()
+        _H_STEP.observe((time.perf_counter() - rec["t0"]) * 1000.0)
+        return toks_host
+
+    def _process_window(self, rec, toks_host: np.ndarray) -> bool:
+        """Route one fetched window's tokens through the shared per-row
+        intake (_process_row_tokens). Rows that retired or moved since
+        dispatch are skipped — their overshoot tokens are scheduled-only
+        work the goodput meter already books as waste."""
+        retired_any = False
+        for b, req in rec["rows"]:
+            if self._rows[b] is not req or req.done:
+                continue
+            req.chunks_decoded += rec["W"]
             retired_any |= self._process_row_tokens(b, req, toks_host[b])
-        if retired_any:
-            self._compact_and_shrink()
+        return retired_any
+
+    def _drain_inflight(self) -> bool:
+        """Fetch + process every in-flight window (admission, checkpoints
+        and shutdown paths need settled row state). Each drained fetch is
+        a stall by definition — the device goes idle behind it."""
+        retired_any = False
+        while self._inflight:
+            rec = self._inflight.popleft()
+            _C_SYNC_STALLS.inc()
+            toks_host = self._fetch_window(rec)
+            retired_any |= self._process_window(rec, toks_host)
+        self._release_deferred()
+        return retired_any
+
+    def _release_deferred(self):
+        """Free blocks whose rows retired while windows were in flight —
+        only once the ring is empty (until then, in-flight windows still
+        dead-row-scatter into them)."""
+        if self._deferred_blocks and not self._inflight:
+            self._alloc.deref(self._deferred_blocks)
+            self._deferred_blocks = []
+            self.stats.paged_blocks_in_use = self._alloc.used_count
 
     def _retire(self, req: Request):
         self._release_adapter(req)

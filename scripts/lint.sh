@@ -18,6 +18,25 @@ echo "[lint] compileall"
 if [ "${SKIP_BENCHDIFF:-0}" != "1" ]; then
   echo "[lint] benchdiff self-check"
   "$PY" scripts/benchdiff.py --self-check
+
+  # decode hot-loop regression gate (docs/PERF.md "Decode hot loop"):
+  # re-run the rung and diff against the recorded round-16 baseline.
+  # Threshold 0.75 absorbs shared-CPU noise; the mechanism deltas the
+  # rung guards (sticky retrace avoidance, overlap stall ratio) are
+  # 6x-scale, far outside it. Cross-platform runs exit 2 = refused,
+  # which is a skip, not a failure (benchdiff's own contract).
+  echo "[lint] decode_hotloop rung vs BENCH_decode_hotloop_r01.json"
+  FRESH="$(mktemp /tmp/decode_hotloop.XXXXXX.json)"
+  JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" BEE2BEE_BENCH_NO_PROBE=1 \
+    "$PY" bench.py decode_hotloop | tail -1 > "$FRESH"
+  rc=0
+  "$PY" scripts/benchdiff.py BENCH_decode_hotloop_r01.json "$FRESH" \
+    --threshold 0.75 || rc=$?
+  rm -f "$FRESH"
+  if [ "$rc" -ne 0 ] && [ "$rc" -ne 2 ]; then
+    echo "[lint] decode_hotloop regression (benchdiff rc=$rc)" >&2
+    exit "$rc"
+  fi
 fi
 
 # telemetry smoke (docs/OBSERVABILITY.md): loopback node + one generation;

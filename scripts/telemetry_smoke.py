@@ -516,7 +516,9 @@ async def run_adapter_smoke() -> None:
 async def run_introspect_smoke() -> None:
     """Engine economics leg (ISSUE 15): one loopback generation through a
     real (tiny) engine, then assert the economics plane actually lit up —
-    nonzero per-root compile counters, an MFU gauge, and an HBM ledger
+    nonzero per-root compile counters (with the fused decode root's
+    ``root="decode"`` label, ISSUE 16), the overlap host-sync counter and
+    in-flight gauge, an MFU gauge, and an HBM ledger
     whose components sum to its own total (and stay under the device
     total where the backend reports one; CPU reports none), all on
     ``/metrics``, with the ``introspect`` block riding the digest."""
@@ -559,9 +561,24 @@ async def run_introspect_smoke() -> None:
         )
         assert r.status == 200, f"/chat returned {r.status}"
 
-        series = parse_prometheus(await (await client.get("/metrics")).text())
+        text = await (await client.get("/metrics")).text()
+        series = parse_prometheus(text)
         assert series.get("bee2bee_engine_compiles_total", 0) > 0, (
             "engine.compiles_total never counted a jit trace"
+        )
+        # decode hot loop (docs/PERF.md "Decode hot loop"): the FUSED
+        # decode root must be the trace that compiled (knobs default on),
+        # and the overlap instrumentation must light up — the host-sync
+        # counter ticks once per readback window and the in-flight gauge
+        # is set at every fetch (0 or more; presence proves the ring ran)
+        assert 'root="decode"' in text, (
+            "fused decode root never compiled under its sentinel label"
+        )
+        assert series.get("bee2bee_engine_host_syncs_total", 0) > 0, (
+            "engine.host_syncs never counted a readback window"
+        )
+        assert "bee2bee_engine_overlap_inflight" in series, (
+            "overlap in-flight gauge missing from /metrics"
         )
         assert "bee2bee_engine_mfu" in series, "MFU gauge missing"
         assert series.get("bee2bee_engine_goodput_tokens_per_s", 0) > 0, (

@@ -1,0 +1,367 @@
+"""Decode hot-loop tests (ISSUE 16, docs/PERF.md "Decode hot loop"):
+async dispatch overlap, double-buffered readback, the fused sampling
+root, and persistent-width (sticky) batches.
+
+The acceptance pins live here:
+
+- the FUSED decode root serves a mixed penalized/plain batch
+  token-for-token identical to the pre-fusion split-root path;
+- a penalized row no longer parks the whole batch: the split
+  ``decode_penalized`` root never exists under the fused root, and the
+  batch-level speculation gate stops vetoing on penalized rows;
+- overlap look-ahead changes NO tokens under retirement churn,
+  admission queueing, or re-admission — and actually removes host-sync
+  stalls on the uniform-budget steady state it is designed for;
+- the sticky batch bucket holds its width through retirement churn
+  (zero fresh decode traces where the resize ladder recompiles), grows
+  only under HBM-ledger headroom, and releases the bucket on idle;
+- the overlap chain's compile space stays pinned: repeat steady-state
+  batches — including ring-empty re-entries from the host mirrors,
+  which carry different arg shardings than chained device outputs —
+  trigger zero new decode compiles (the sharding-keyed double-compile
+  regression).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bee2bee_tpu.engine import EngineConfig, InferenceEngine
+from bee2bee_tpu.engine.introspect import _C_HOST_SYNCS, _C_SYNC_STALLS
+from bee2bee_tpu.engine.sampling import apply_penalties, sample_batched
+
+ROWS = 4
+PROMPTS = [[1 + (i * 37 + j) % 500 for j in range(32)] for i in range(ROWS)]
+
+
+def _cfg(**knobs) -> EngineConfig:
+    base = dict(
+        max_seq_len=256,
+        max_batch=ROWS,
+        prefill_buckets=(32,),
+        dtype="float32",
+        cache_dtype="float32",
+        decode_chunk=4,
+        spec_tokens=0,
+        rng_seed=7,
+    )
+    base.update(knobs)
+    return EngineConfig(**base)
+
+
+def _engine(**knobs) -> InferenceEngine:
+    return InferenceEngine("tiny-llama", engine_config=_cfg(**knobs))
+
+
+@pytest.fixture(scope="module")
+def fused_engine():
+    """All hot-loop mechanisms explicitly ON (the shipping default)."""
+    eng = _engine(decode_overlap=True, fused_root=True, batch_sticky=True,
+                  readback_depth=2)
+    yield eng
+    eng.close()
+
+
+@pytest.fixture(scope="module")
+def unfused_engine():
+    """The pre-fusion reference: split penalized root, no overlap."""
+    eng = _engine(decode_overlap=False, fused_root=False,
+                  batch_sticky=False, readback_depth=1)
+    yield eng
+    eng.close()
+
+
+def _run_batch(eng, budgets, penalize_last=False):
+    """Concurrent batch through the scheduler; returns per-row token_ids
+    in submission order. Greedy rows (+ optional repetition penalty on
+    the last row) keep the outputs deterministic for parity checks."""
+    results: list = [None] * len(budgets)
+
+    def run(i):
+        kw = {"max_new_tokens": budgets[i], "temperature": 0.0}
+        if penalize_last and i == len(budgets) - 1:
+            kw["repetition_penalty"] = 1.3
+        results[i] = eng.generate(PROMPTS[i % ROWS], **kw)
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(len(budgets))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(r is not None for r in results)
+    return [r.token_ids for r in results]
+
+
+def _decode_traces(eng) -> int:
+    return eng.introspect.sentinel.snapshot().get(
+        "decode", {"traces": 0}
+    )["traces"]
+
+
+# ------------------------------------------------- fused sampling root
+
+
+def test_sample_batched_counts_none_is_the_prefusion_graph():
+    """``counts=None`` must lower to the counts-free trace: identical
+    tokens to the explicit two-stage apply_penalties → sample path, and
+    all-off penalty values must be a no-op against the None graph."""
+    key = jax.random.key(0)
+    logits = jax.random.normal(jax.random.key(1), (3, 64), jnp.float32)
+    counts = jnp.zeros((3, 2, 64), jnp.int32)
+    counts = counts.at[0, 1, 5].set(3).at[0, 0, 9].set(1).at[2, 1, 11].set(2)
+    temp = jnp.zeros((3,), jnp.float32)  # greedy rows: parity is exact
+    top_k = jnp.zeros((3,), jnp.int32)
+    top_p = jnp.ones((3,), jnp.float32)
+    rep = jnp.asarray([1.7, 1.0, 1.3], jnp.float32)
+    pres = jnp.asarray([0.5, 0.0, 0.0], jnp.float32)
+    freq = jnp.asarray([0.1, 0.0, 0.9], jnp.float32)
+
+    fused = sample_batched(logits, key, temp, top_k, top_p,
+                           counts=counts, repetition=rep,
+                           presence=pres, frequency=freq)
+    staged = sample_batched(
+        apply_penalties(logits, counts, rep, pres, freq),
+        key, temp, top_k, top_p,
+    )
+    np.testing.assert_array_equal(np.asarray(fused), np.asarray(staged))
+
+    ones = jnp.ones((3,), jnp.float32)
+    zeros = jnp.zeros((3,), jnp.float32)
+    noop = sample_batched(logits, key, temp, top_k, top_p,
+                          counts=counts, repetition=ones,
+                          presence=zeros, frequency=zeros)
+    plain = sample_batched(logits, key, temp, top_k, top_p, counts=None)
+    np.testing.assert_array_equal(np.asarray(noop), np.asarray(plain))
+
+
+def test_fused_mixed_batch_token_parity(fused_engine, unfused_engine):
+    """THE fusion acceptance: a mixed batch (3 plain greedy rows + 1
+    repetition-penalized row) decodes token-for-token identically on the
+    fused root and on the pre-fusion split-root engine — and both match
+    the unbatched sequential ground truth."""
+    budgets = [16] * ROWS
+    fused = _run_batch(fused_engine, budgets, penalize_last=True)
+    split = _run_batch(unfused_engine, budgets, penalize_last=True)
+    assert fused == split, "fused root diverged from the pre-fusion path"
+
+    sequential = []
+    for i in range(ROWS):
+        kw = {"max_new_tokens": budgets[i], "temperature": 0.0}
+        if i == ROWS - 1:
+            kw["repetition_penalty"] = 1.3
+        sequential.append(
+            unfused_engine.generate(PROMPTS[i], **kw).token_ids
+        )
+    assert fused == sequential, "mixed batch diverged from sequential"
+
+
+def test_fused_root_retires_the_split_pen_root(fused_engine,
+                                               unfused_engine):
+    """Fused on: counts ride the ONE decode root — the split
+    ``decode_penalized`` root is never even registered, while the
+    counts-bearing windows are still accounted. Fused off: the split
+    root compiles and serves the penalized batch (the parked-batch
+    behavior the fusion removes)."""
+    before = fused_engine.scheduler.stats.counts_windows
+    _run_batch(fused_engine, [8] * ROWS, penalize_last=True)
+    assert fused_engine.scheduler._decode_pen is None
+    snap = fused_engine.introspect.sentinel.snapshot()
+    assert "decode_penalized" not in snap, (
+        "split pen root compiled despite the fused root"
+    )
+    assert snap["decode"]["traces"] >= 1
+    assert fused_engine.scheduler.stats.counts_windows > before
+
+    _run_batch(unfused_engine, [8] * ROWS, penalize_last=True)
+    snap = unfused_engine.introspect.sentinel.snapshot()
+    assert snap.get("decode_penalized", {"traces": 0})["traces"] >= 1, (
+        "pre-fusion engine never exercised the split pen root"
+    )
+
+
+def test_fused_root_unparks_batch_speculation():
+    """`_spec_possible` (the batch-level speculation gate): one
+    penalized row vetoes speculation for the WHOLE batch on split roots
+    (counts cannot thread the verify call), but not on the fused root —
+    the parked-batch acceptance pin at the gate level."""
+    for fused, expect in ((True, True), (False, False)):
+        eng = _engine(fused_root=fused, spec_tokens=2, max_seq_len=64,
+                      prefill_buckets=(16,))
+        try:
+            sch = eng.scheduler
+            saved = sch._rows, sch._offsets
+            sch._rows = [
+                SimpleNamespace(penalized=True),
+                SimpleNamespace(penalized=False),
+            ]
+            sch._offsets = np.zeros((2,), np.int32)
+            try:
+                assert sch._spec_possible() is expect, (
+                    f"fused={fused}: penalized-row veto wrong"
+                )
+            finally:
+                sch._rows, sch._offsets = saved
+        finally:
+            eng.close()
+
+
+# ------------------------------------------------- overlap / readback
+
+
+def test_overlap_parity_under_retirement_and_admission(fused_engine,
+                                                       unfused_engine):
+    """Overlap look-ahead must be invisible in the tokens: 6 requests
+    through 4 rows (queueing + re-admission) with staggered budgets
+    (retirement churn mid-flight) decode identically with the ring on
+    and off."""
+    budgets = [8, 12, 16, 20, 24, 28]
+    on = _run_batch(fused_engine, budgets)
+    off = _run_batch(unfused_engine, budgets)
+    assert on == off, "overlap changed tokens under retirement/admission"
+
+
+def test_overlap_removes_host_sync_stalls(fused_engine, unfused_engine):
+    """The overlap steady state (uniform budgets, no queue/stream/spec):
+    with the ring on, some readback windows must find another window
+    already in flight (stalls < syncs). With overlap off, EVERY sync is
+    a stall by construction — the serialized loop's 1.0 ratio."""
+    budgets = [48] * ROWS
+    _run_batch(fused_engine, budgets)  # warm: admission skew, compiles
+    s0, t0 = _C_HOST_SYNCS.value(), _C_SYNC_STALLS.value()
+    _run_batch(fused_engine, budgets)
+    syncs, stalls = _C_HOST_SYNCS.value() - s0, _C_SYNC_STALLS.value() - t0
+    assert syncs > 0
+    assert stalls < syncs, (
+        f"overlap never kept the ring full: {stalls}/{syncs} stalled"
+    )
+
+    _run_batch(unfused_engine, budgets)  # warm
+    s0, t0 = _C_HOST_SYNCS.value(), _C_SYNC_STALLS.value()
+    _run_batch(unfused_engine, budgets)
+    syncs, stalls = _C_HOST_SYNCS.value() - s0, _C_SYNC_STALLS.value() - t0
+    assert syncs > 0 and stalls == syncs, (
+        f"serialized loop must stall every sync: {stalls}/{syncs}"
+    )
+
+
+def test_overlap_chain_compile_space_is_pinned(fused_engine):
+    """Sharding-keyed double-compile regression: a ring-empty dispatch
+    re-enters the decode chain from the host numpy mirrors, which lower
+    with a DIFFERENT arg sharding than chained device outputs — without
+    the scheduler's device_put commitment that silently doubles the
+    decode root's executable space and lands a recompile mid-serve.
+    Post-warm, repeat steady-state batches (each one draining the ring
+    and re-entering from the mirrors) must compile NOTHING new."""
+    budgets = [32] * ROWS
+    _run_batch(fused_engine, budgets)  # warm every (bsz, width) key
+    traces0 = _decode_traces(fused_engine)
+    for _ in range(2):
+        _run_batch(fused_engine, budgets)
+    assert _decode_traces(fused_engine) == traces0, (
+        "steady-state repeat batches recompiled the decode root"
+    )
+    snap = fused_engine.introspect.sentinel.snapshot()
+    assert snap["decode"]["storms"] == 0
+
+
+# ------------------------------------------------- sticky-width batches
+
+
+def test_sticky_width_holds_bucket_and_releases_on_idle():
+    """Grow-only while work flows: after a staggered batch fully
+    retires, the sticky bucket holds its width through the hysteresis
+    window — and only an idle sweep past `_sticky_idle_s` drops it."""
+    eng = _engine(batch_sticky=True)
+    try:
+        _run_batch(eng, [4, 8, 12, 16])
+        sch = eng.scheduler
+        deadline = time.monotonic() + 5.0
+        while sch.active > 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert sch._bsz == ROWS, (
+            f"sticky bucket shrank to {sch._bsz} right after retirement"
+        )
+        # collapse the hysteresis window; the next sweep releases
+        sch._sticky_idle_s = 0.0
+        sch._compact_and_shrink()
+        assert sch._bsz == 1
+    finally:
+        eng.close()
+
+
+def test_nonsticky_width_walks_the_resize_ladder():
+    """The pre-sticky behavior the knob reverts to: quarter-occupancy
+    halving plus idle release — after the staggered batch retires the
+    bucket is back at 1."""
+    eng = _engine(batch_sticky=False)
+    try:
+        _run_batch(eng, [4, 8, 12, 16])
+        sch = eng.scheduler
+        deadline = time.monotonic() + 5.0
+        while sch._bsz != 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert sch._bsz == 1, (
+            f"non-sticky bucket held width {sch._bsz} after idle"
+        )
+    finally:
+        eng.close()
+
+
+def test_sticky_width_avoids_retirement_retraces():
+    """The retrace economics the sticky bucket buys (the decode_hotloop
+    rung's tok/s story): post-warm, a staggered-budget batch walks the
+    pow2 resize ladder through decode traces the warm server never
+    compiled on the non-sticky engine — and through ZERO new traces on
+    the sticky one."""
+    churn = [8, 16, 24, 32]
+    eng = _engine(batch_sticky=True)
+    try:
+        _run_batch(eng, [32] * ROWS)  # warm the full-width traces
+        traces0 = _decode_traces(eng)
+        _run_batch(eng, churn)
+        assert _decode_traces(eng) == traces0, (
+            "sticky engine recompiled decode during retirement churn"
+        )
+    finally:
+        eng.close()
+
+    eng = _engine(batch_sticky=False)
+    try:
+        _run_batch(eng, [32] * ROWS)
+        traces0 = _decode_traces(eng)
+        _run_batch(eng, churn)
+        assert _decode_traces(eng) > traces0, (
+            "expected the non-sticky resize ladder to hit fresh decode "
+            "traces under staggered retirement (the churn cost sticky "
+            "removes) — if this now passes without sticky, the rung's "
+            "mechanism story needs re-measuring"
+        )
+    finally:
+        eng.close()
+
+
+def test_sticky_growth_is_hbm_gated(monkeypatch):
+    """Growth into a KNOWN memory ceiling is refused: with a tiny
+    BEE2BEE_HBM_BYTES budget the headroom gate denies the bucket grow,
+    the denial is counted, and the queued requests still complete by
+    retrying into retirement holes at the current width."""
+    monkeypatch.setenv("BEE2BEE_HBM_BYTES", "1024")
+    eng = _engine(batch_sticky=True)
+    try:
+        tokens = _run_batch(eng, [4, 4, 4, 4])
+        assert all(len(t) == 4 for t in tokens)
+        sch = eng.scheduler
+        assert sch._bsz == 1, (
+            f"bucket grew to {sch._bsz} through a denied headroom gate"
+        )
+        assert sch.stats.width_grow_denials > 0
+    finally:
+        eng.close()
