@@ -1,0 +1,83 @@
+"""Pass family 5: clock-seam (ML-C*).
+
+The meshnet/fleet/router/health control planes are deterministic-sim
+capable: every timestamp, backoff, and timer routes through the injected
+``Clock`` (bee2bee_tpu/clock.py), so ``simnet`` can replace wall time
+with a virtual clock and replay 200-node chaos runs bit-identically.
+One stray ``time.time()`` silently re-couples a code path to the host
+clock — the sim still *runs*, but traces stop being replayable and
+virtual-time tests flake under load. Rule:
+
+- ML-C001 — direct wall-clock read or bare asyncio timer
+  (``time.time/monotonic/perf_counter/sleep``, ``asyncio.sleep``,
+  ``asyncio.wait_for``) inside a clock-seamed package (``meshnet/``,
+  ``fleet/``, ``router/``, ``health.py``). Use the seam instead:
+  ``self.clock.time()`` / ``self.clock.sleep()`` /
+  ``self.clock.wait_for()`` (or ``get_clock()`` where no instance is in
+  scope). Genuine wall-clock interactions — NAT round trips, thread
+  joins — carry ``# meshlint: ignore[ML-C001] -- reason``.
+
+The baseline for this family is empty and must stay empty: the seam was
+installed package-wide in the same PR that added the rule.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import dotted_name as _dotted
+
+# direct wall-clock / loop-timer targets by dotted name. `self.clock.sleep`
+# resolves to "self.clock.sleep" — never matched; only the bare module
+# calls are findings.
+_WALL_CLOCK = {
+    "time.time",
+    "time.monotonic",
+    "time.perf_counter",
+    "time.sleep",
+    "asyncio.sleep",
+    "asyncio.wait_for",
+}
+
+_SEAM_FOR = {
+    "time.time": "clock.time()",
+    "time.monotonic": "clock.monotonic()",
+    "time.perf_counter": "clock.monotonic()",
+    "time.sleep": "await clock.sleep()",
+    "asyncio.sleep": "await clock.sleep()",
+    "asyncio.wait_for": "await clock.wait_for()",
+}
+
+_SEAMED_PREFIXES = ("meshnet/", "fleet/", "router/")
+_SEAMED_FILES = {"health.py"}
+
+
+class ClockSeamPass:
+    family = "clock"
+    rules = {
+        "ML-C001": "direct wall-clock call in a clock-seamed package",
+    }
+
+    def applies(self, path: str) -> bool:
+        return path.startswith(_SEAMED_PREFIXES) or path in _SEAMED_FILES
+
+    def run(self, ctx) -> list:
+        findings: list = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            if name not in _WALL_CLOCK:
+                continue
+            findings.append(
+                ctx.finding(
+                    "ML-C001",
+                    node,
+                    f"direct {name}() in a clock-seamed package",
+                    f"breaks deterministic simulation — route through the "
+                    f"injected clock ({_SEAM_FOR[name]}; resolve via "
+                    f"get_clock() if no instance is in scope), or justify "
+                    f"with # meshlint: ignore[ML-C001] -- reason",
+                )
+            )
+        return findings

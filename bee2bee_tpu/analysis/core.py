@@ -209,11 +209,18 @@ def filter_baselined(
 def _passes():
     # imported lazily: the pass modules import this one for Finding/ctx
     from .asyncsafe import AsyncSafetyPass
+    from .clockseam import ClockSeamPass
     from .frames import FramesPass
     from .jaxhygiene import JaxHygienePass
     from .telemetry import TelemetryPass
 
-    return (FramesPass(), AsyncSafetyPass(), JaxHygienePass(), TelemetryPass())
+    return (
+        FramesPass(),
+        AsyncSafetyPass(),
+        JaxHygienePass(),
+        TelemetryPass(),
+        ClockSeamPass(),
+    )
 
 
 def rule_catalog() -> dict[str, str]:
@@ -231,10 +238,12 @@ _PACKAGE_DIRS = frozenset(
     {
         "analysis",
         "engine",
+        "fleet",
         "meshnet",
         "models",
         "ops",
         "parallel",
+        "router",
         "services",
         "train",
         "web",

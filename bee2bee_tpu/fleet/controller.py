@@ -38,11 +38,11 @@ import asyncio
 import contextlib
 import logging
 import os
-import time
 from collections import deque
 from dataclasses import asdict, dataclass, fields
 
 from .. import protocol
+from ..clock import get_clock
 from ..health import controller_aggregates
 from ..metrics import get_registry
 from ..utils import load_json_source, new_id
@@ -162,7 +162,12 @@ class FleetController:
         # same fail-at-construction contract as the SLO/router configs
         self.config = config or load_fleet_config()
         ttl = self.config.lease_ttl_s or 3.0 * node.ping_interval_s
-        self.lease = LeaseKeeper(ttl_s=ttl, scope=self.config.scope)
+        # the node's injected clock drives every fleet timer: lease TTLs,
+        # action deadlines, cooldowns, drain polls (clock.py seam)
+        self.clock = getattr(node, "clock", None) or get_clock()
+        self.lease = LeaseKeeper(
+            ttl_s=ttl, scope=self.config.scope, clock=self.clock
+        )
         self.provisioner = Provisioner(self)
         self.is_leader = False
         self.epoch = 0
@@ -353,7 +358,7 @@ class FleetController:
                 holder=self.node.peer_id,
                 **fields,
             ))
-            return await asyncio.wait_for(
+            return await self.clock.wait_for(
                 fut, timeout or self.config.ack_timeout_s
             )
         except asyncio.TimeoutError:
@@ -369,7 +374,7 @@ class FleetController:
         """One control-loop step. Never throws (the monitor loop hosts
         it); directly callable for deterministic tests."""
         try:
-            await self._tick(time.time() if now is None else now)
+            await self._tick(self.clock.time() if now is None else now)
         except asyncio.CancelledError:
             raise
         except Exception:  # noqa: BLE001 — the loop must outlive one bad tick
@@ -637,13 +642,13 @@ class FleetController:
     def _start_action(self, kind: str, target: str | None, coro) -> None:
         self._action = {
             "kind": kind, "target": target, "phase": "starting",
-            "rid": new_id("flact"), "started": time.time(),
+            "rid": new_id("flact"), "started": self.clock.time(),
         }
         self._action_task = self.node._spawn(coro)
 
     def _finish_action(self, ok: bool, incident_kind: str, detail: str) -> None:
         action = self._action or {}
-        now = time.time()
+        now = self.clock.time()
         # ANY completed action refreshes BOTH cooldowns: a scale-out
         # immediately followed by a scale-in (or vice versa) is flapping
         # by definition
@@ -778,9 +783,9 @@ class FleetController:
         """Drain quiescence: the target's FRESH digest shows draining
         with no live rows (`engine.active_rows` zero or absent — a
         model-free node has no gauge), or the peer left the mesh."""
-        deadline = time.monotonic() + timeout_s
+        deadline = self.clock.monotonic() + timeout_s
         poll = min(0.1, self.lease.ttl_s / 10.0)
-        while time.monotonic() < deadline:
+        while self.clock.monotonic() < deadline:
             if target not in self.node.peers:
                 return True
             d = self.node.health.fresh().get(target)
@@ -788,7 +793,7 @@ class FleetController:
                 rows = (d.get("gauge") or {}).get("engine.active_rows")
                 if not rows:
                     return True
-            await asyncio.sleep(poll)
+            await self.clock.sleep(poll)
         return False
 
     async def _run_rollback(self, target: str) -> None:
@@ -882,7 +887,7 @@ class FleetController:
         resume the loop anywhere; force a scale action on the leader —
         hysteresis is bypassed, the probe gate and one-in-flight are
         NOT."""
-        now = time.time()
+        now = self.clock.time()
         if action == "pause":
             self.paused = True
             self._journal(now, self.D_OVERRIDE, "paused by operator", {})
@@ -940,7 +945,7 @@ class FleetController:
 
     def status(self) -> dict:
         """The ``GET /fleet`` payload."""
-        now = time.time()
+        now = self.clock.time()
         return {
             "node": self.node.peer_id,
             "enabled": self.enabled,

@@ -35,8 +35,9 @@ simply hasn't heard yet.
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass, field
+
+from ..clock import Clock, get_clock, resolve_clock
 
 
 def lease_beats(epoch_a: int, holder_a: str, epoch_b: int, holder_b: str) -> bool:
@@ -57,18 +58,18 @@ class LeaseView:
     scope: str = "default"
     action: dict | None = None  # the leader's in-flight replica action
     released: bool = False
-    received_at: float = field(default_factory=time.time)
+    received_at: float = field(default_factory=lambda: get_clock().time())
 
     def fresh(self, now: float | None = None) -> bool:
-        now = time.time() if now is None else now
+        now = get_clock().time() if now is None else now
         return not self.released and now - self.received_at <= self.ttl_s
 
     def age_s(self, now: float | None = None) -> float:
-        now = time.time() if now is None else now
+        now = get_clock().time() if now is None else now
         return now - self.received_at
 
     def describe(self, now: float | None = None) -> dict:
-        now = time.time() if now is None else now
+        now = get_clock().time() if now is None else now
         return {
             "holder": self.holder,
             "epoch": self.epoch,
@@ -90,9 +91,11 @@ class LeaseKeeper:
     may be the target of a replica action and must be able to tell the
     rightful leader from a stale or split-brain-losing one."""
 
-    def __init__(self, ttl_s: float = 45.0, scope: str = "default"):
+    def __init__(self, ttl_s: float = 45.0, scope: str = "default",
+                 clock: Clock | None = None):
         self.ttl_s = ttl_s
         self.scope = scope
+        self._clock = resolve_clock(clock)
         self._view: LeaseView | None = None
         self.highest_epoch = 0
         # when the CURRENT view lapsed (or the keeper booted with none):
@@ -100,7 +103,7 @@ class LeaseKeeper:
         # been observed, lapsed_for adds one full TTL of boot grace on
         # top (see there) so a fresh node cannot claim before the
         # incumbent's gossip has had a chance to arrive.
-        self._lapse_started: float = time.time()
+        self._lapse_started: float = self._clock.time()
         # first-election deferral bound: set by the first
         # reset_boot_grace (node start) — see there
         self._grace_cap: float | None = None
@@ -120,7 +123,7 @@ class LeaseKeeper:
         fleet leaderless forever."""
         if self._view is not None:
             return
-        now = time.time() if now is None else now
+        now = self._clock.time() if now is None else now
         if self._grace_cap is None:
             # grace END = _lapse_started + ttl, so capping the anchor
             # at start + 2*ttl bounds the first claim to start + 3*ttl
@@ -134,7 +137,7 @@ class LeaseKeeper:
         view. A frame only replaces the held view when it wins the
         deterministic ordering, refreshes the same holder's reign, or
         the held view has lapsed (any live claim beats a dead reign)."""
-        now = time.time() if now is None else now
+        now = self._clock.time() if now is None else now
         holder = frame.get("holder")
         try:
             epoch = int(frame.get("epoch") or 0)
@@ -171,7 +174,7 @@ class LeaseKeeper:
     def current(self, now: float | None = None) -> LeaseView | None:
         """The held lease when FRESH, else None (marking the lapse start
         the first time it is observed lapsed)."""
-        now = time.time() if now is None else now
+        now = self._clock.time() if now is None else now
         v = self._view
         if v is None:
             return None
@@ -191,7 +194,7 @@ class LeaseKeeper:
         counts as a lapse. Without it a freshly booted claimant ranks
         itself on an empty view and can usurp a live incumbent (same
         epoch, smaller peer id) whose gossip simply hasn't arrived yet."""
-        now = time.time() if now is None else now
+        now = self._clock.time() if now is None else now
         if self.current(now) is not None:
             return None
         start = self._lapse_started

@@ -30,7 +30,6 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import time
 
 logger = logging.getLogger("bee2bee_tpu.fleet")
 
@@ -117,8 +116,9 @@ class Provisioner:
         """The warm-up generation gate, via the ordinary serving path.
         The chaos harness wraps exactly this method."""
         cfg = self.config
+        clock = self.controller.clock
         try:
-            t0 = time.perf_counter()
+            t0 = clock.monotonic()
             result = await self.node.request_generation(
                 target,
                 cfg.probe_prompt,
@@ -129,7 +129,7 @@ class Provisioner:
             )
             if not isinstance(result, dict) or result.get("error"):
                 return False, f"probe error: {(result or {}).get('error')}"
-            ms = (time.perf_counter() - t0) * 1000.0
+            ms = (clock.monotonic() - t0) * 1000.0
             return True, f"probe ok in {ms:.0f}ms"
         except Exception as e:  # noqa: BLE001 — a failed probe is a verdict
             return False, f"probe failed: {e}"
@@ -139,11 +139,12 @@ class Provisioner:
         land in our provider table — the probe needs a service name to
         address."""
         cfg = self.config
-        deadline = time.monotonic() + cfg.settle_timeout_s
-        while time.monotonic() < deadline:
+        clock = self.controller.clock
+        deadline = clock.monotonic() + cfg.settle_timeout_s
+        while clock.monotonic() < deadline:
             svcs = self.node.providers.get(target) or {}
             for meta in list(svcs.values()):
                 if _model_matches(cfg.model, meta.get("models")):
                     return True
-            await asyncio.sleep(0.05)
+            await clock.sleep(0.05)
         return False

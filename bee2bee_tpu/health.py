@@ -42,6 +42,7 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, Callable
 
+from .clock import Clock, get_clock, resolve_clock
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry, get_registry
 from .tracing import current_trace_ctx, get_tracer, stitch_trace
 from .utils import bee2bee_home, load_json_source, new_id
@@ -217,7 +218,7 @@ def local_stage_idleness(
     reading) and None is returned."""
     try:
         tr = tracer or get_tracer()
-        now_ms = time.time() * 1000.0
+        now_ms = get_clock().time() * 1000.0
         info = bubble_from_spans(
             tr.recent(limit=2048, name="stage.task"),
             now_ms - window_s * 1000.0, now_ms,
@@ -284,7 +285,7 @@ def build_digest(registry: MetricsRegistry | None = None) -> dict:
     built from throwaway registries stay pure registry summaries."""
     live = registry is None
     reg = registry or get_registry()
-    digest: dict[str, Any] = {"v": DIGEST_VERSION, "ts": time.time()}
+    digest: dict[str, Any] = {"v": DIGEST_VERSION, "ts": get_clock().time()}
     if live:
         bubble = local_stage_idleness()
         if bubble is not None:
@@ -351,8 +352,9 @@ class HealthStore:
     the registry's empty-gauge contract (a reading that stopped arriving
     must drop out, not serve forever as if current)."""
 
-    def __init__(self, ttl_s: float = 45.0):
+    def __init__(self, ttl_s: float = 45.0, clock: Clock | None = None):
         self.ttl_s = ttl_s
+        self._clock = resolve_clock(clock)
         self._lock = threading.Lock()
         self._digests: dict[str, dict] = {}  # peer_id -> digest
         self._received: dict[str, float] = {}  # peer_id -> local arrival time
@@ -362,7 +364,7 @@ class HealthStore:
             return
         with self._lock:
             self._digests[peer_id] = digest
-            self._received[peer_id] = time.time()
+            self._received[peer_id] = self._clock.time()
 
     def drop(self, peer_id: str) -> None:
         with self._lock:
@@ -372,11 +374,11 @@ class HealthStore:
     def age_s(self, peer_id: str) -> float | None:
         with self._lock:
             t = self._received.get(peer_id)
-        return None if t is None else time.time() - t
+        return None if t is None else self._clock.time() - t
 
     def fresh(self) -> dict[str, dict]:
         """{peer_id: digest} for peers heard from within the TTL."""
-        now = time.time()
+        now = self._clock.time()
         with self._lock:
             return {
                 pid: d
@@ -386,7 +388,7 @@ class HealthStore:
 
     def all(self) -> dict[str, dict]:
         """Every stored digest annotated with age/staleness (debug view)."""
-        now = time.time()
+        now = self._clock.time()
         with self._lock:
             return {
                 pid: {
@@ -398,7 +400,7 @@ class HealthStore:
             }
 
     def stale_peers(self) -> list[str]:
-        now = time.time()
+        now = self._clock.time()
         with self._lock:
             return sorted(
                 pid
@@ -824,12 +826,14 @@ class SloTracker:
         trip_burn_rate: float = 6.0,
         on_trip: Callable[[SloObjective, dict], None] | None = None,
         trip_cooldown_s: float = 300.0,
+        clock: Clock | None = None,
     ):
         self.objectives = (
             list(objectives) if objectives is not None
             else parse_slo_config(DEFAULT_SLO_CONFIG)
         )
         self._reg = registry or get_registry()
+        self._clock = resolve_clock(clock)
         self.fast_window_s = fast_window_s
         self.slow_window_s = slow_window_s
         self.trip_burn_rate = trip_burn_rate
@@ -891,7 +895,7 @@ class SloTracker:
             return self._last_eval
 
     def _evaluate(self, now: float | None) -> list[dict]:
-        now = time.time() if now is None else now
+        now = self._clock.time() if now is None else now
         out: list[dict] = []
         with self._lock:
             for o in self.objectives:
@@ -1046,7 +1050,11 @@ class FlightRecorder:
         """Append one ring event; never throws."""
         try:
             with self._lock:
-                self._events.append(_RingEvent(time.time(), str(kind), fields))
+                # the recorder is process-global and may outlive any one
+                # clock installation — resolve at call time, not __init__
+                self._events.append(
+                    _RingEvent(get_clock().time(), str(kind), fields)
+                )
         except Exception:  # noqa: BLE001 — telemetry never throws
             pass
 
@@ -1080,7 +1088,7 @@ class FlightRecorder:
         failed write costs the bundle, never serving — best-effort by
         contract."""
         try:
-            now = time.time()
+            now = get_clock().time()
             with self._lock:
                 last = self._last_incident.get(kind, -math.inf)
                 if now - last < self.cooldown_s:
@@ -1127,11 +1135,13 @@ class FlightRecorder:
 
     def flush(self, timeout_s: float = 5.0) -> None:
         """Join outstanding bundle writes (tests, orderly shutdown)."""
-        deadline = time.time() + timeout_s
+        # writer threads live in REAL time: joining them against a virtual
+        # deadline would mis-compute the remaining wait under a sim clock
+        deadline = time.time() + timeout_s  # meshlint: ignore[ML-C001] -- real thread-join deadline
         with self._lock:
             writers = list(self._writers)
         for w in writers:
-            w.join(max(0.0, deadline - time.time()))
+            w.join(max(0.0, deadline - time.time()))  # meshlint: ignore[ML-C001] -- real thread-join deadline
 
     def _write_bundle(self, inc_id: str, kind: str, detail: str, payload: str) -> None:
         try:

@@ -350,7 +350,7 @@ class ChaosStage:
                     await self._answer_error(ws, data)
                     return
                 self.triggered.set()
-                await asyncio.sleep(self.delay_s)
+                await self.node.clock.sleep(self.delay_s)
         await self._orig(ws, data)
 
     def restore(self) -> None:

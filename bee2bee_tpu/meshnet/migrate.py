@@ -51,11 +51,11 @@ import asyncio
 import contextlib
 import logging
 import queue as _queue
-import time
 
 import numpy as np
 
 from .. import protocol
+from ..clock import get_clock
 from ..health import get_recorder
 from ..metrics import get_registry
 from ..router import AdmissionReject
@@ -169,7 +169,7 @@ class _PendingImport:
         self.svc = svc
         self.expected = expected
         self.chunks: list[tuple[int, dict]] = []
-        self.t0 = time.perf_counter()
+        self.t0 = get_clock().monotonic()
 
 
 class MigrationManager:
@@ -181,6 +181,7 @@ class MigrationManager:
     def __init__(self, node, ack_timeout_s: float = 30.0,
                  bridge_timeout_s: float = 600.0):
         self.node = node
+        self.clock = getattr(node, "clock", None) or get_clock()
         self.ack_timeout_s = ack_timeout_s
         self.bridge_timeout_s = bridge_timeout_s
         # bench/chaos knob: skip the KV rung and exercise re-prefill
@@ -314,12 +315,12 @@ class MigrationManager:
 
     async def wait_idle(self, timeout_s: float = 60.0) -> bool:
         """Await in-flight source-side migrations (tests, drain-then-stop)."""
-        deadline = time.monotonic() + timeout_s
-        while self._tasks and time.monotonic() < deadline:
+        deadline = self.clock.monotonic() + timeout_s
+        while self._tasks and self.clock.monotonic() < deadline:
             with contextlib.suppress(Exception):
-                await asyncio.wait_for(
+                await self.clock.wait_for(
                     asyncio.gather(*list(self._tasks), return_exceptions=True),
-                    timeout=max(0.05, deadline - time.monotonic()),
+                    max(0.05, deadline - self.clock.monotonic()),
                 )
         return not self._tasks
 
@@ -328,7 +329,7 @@ class MigrationManager:
         """The fallback ladder. Returns the outcome: "ok" (KV rung),
         "reprefill", "forwarded" (queued request, nothing to resume) or
         "failed" (consumer got the typed error)."""
-        t0 = time.perf_counter()
+        t0 = self.clock.monotonic()
         excluded: set[str] = set()
         was_queued = not snap.get("out") and not snap.get("kv_blocks")
         with get_tracer().span(
@@ -481,7 +482,7 @@ class MigrationManager:
             except Exception as err:
                 raise MigrationError("export_failed", str(err), target)
             try:
-                verdict = await asyncio.wait_for(ack, self.ack_timeout_s)
+                verdict = await self.clock.wait_for(ack, self.ack_timeout_s)
             except asyncio.TimeoutError:
                 raise MigrationError(
                     "ack_timeout", f"no import ack from {target}", target
@@ -496,10 +497,10 @@ class MigrationManager:
                 raise MigrationError(
                     kind, str((verdict or {}).get("error") or ""), target
                 )
-            _H_MIGRATION_MS.observe((time.perf_counter() - t0) * 1000.0)
+            _H_MIGRATION_MS.observe((self.clock.monotonic() - t0) * 1000.0)
             # resumed: bridge frames until the remote's final result
             try:
-                wire = await asyncio.wait_for(
+                wire = await self.clock.wait_for(
                     bridge.done, self.bridge_timeout_s
                 )
             except asyncio.TimeoutError:
@@ -559,7 +560,7 @@ class MigrationManager:
         if req.finish is None:
             fr = wire.get("finish_reason")
             req.finish = fr if isinstance(fr, str) and fr else "stop"
-        req.timing.t_done = time.perf_counter()
+        req.timing.t_done = self.clock.monotonic()
         eng = getattr(svc, "engine", None)
         result = eng._build_result(req) if eng is not None else None
         req.events.put({"done": True, "result": result})
@@ -624,7 +625,7 @@ class MigrationManager:
     IMPORT_STALE_S = 120.0
 
     def _prune_stale_imports(self) -> None:
-        now = time.perf_counter()
+        now = self.clock.monotonic()
         for rid, imp in list(self._imports.items()):
             if now - imp.t0 > self.IMPORT_STALE_S:
                 self._imports.pop(rid, None)
@@ -787,11 +788,11 @@ class MigrationManager:
             # give up and re-migrate elsewhere while we later decode the
             # whole generation for nobody (wait_for's cancellation runs
             # acquire's own bookkeeping/refund path)
-            ticket = await asyncio.wait_for(
+            ticket = await self.clock.wait_for(
                 self.node.admission.acquire(
                     tenant, cost_tokens=remaining, migration=True
                 ),
-                timeout=self.ack_timeout_s * 0.5,
+                self.ack_timeout_s * 0.5,
             )
         except AdmissionReject as rej:
             await self._ack(imp.ws, imp.rid, ok=False, error=rej.detail,
@@ -988,8 +989,8 @@ class MigrationManager:
     async def _stop_after_drain(self, timeout_s: float = 300.0) -> None:
         """Exit clean once every local row finished and every bridge
         closed: stop() sends the GOODBYE peers retire us on."""
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
+        deadline = self.clock.monotonic() + timeout_s
+        while self.clock.monotonic() < deadline:
             busy = bool(self._tasks)
             for svc in list(self.node.local_services.values()):
                 eng = getattr(svc, "engine", None)
@@ -998,6 +999,6 @@ class MigrationManager:
                     busy = True
             if not busy:
                 break
-            await asyncio.sleep(0.1)
+            await self.clock.sleep(0.1)
         if not self.node._stopped:
             await self.node.stop()

@@ -25,19 +25,14 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import contextvars
+import json
 import logging
 import os
-import time
 from pathlib import Path
 from typing import Any, Callable
 
-try:
-    import websockets
-    import websockets.exceptions  # noqa: F401 — referenced as an attribute
-except ImportError:  # gate the missing dep: loopback shim (wscompat.py)
-    from .. import wscompat as websockets
-
 from .. import protocol
+from ..clock import Clock, resolve_clock
 from ..adapters import AdapterPoolBusy, clamp_adapter_name, split_model_adapter
 from ..fleet import FleetController
 from ..health import HealthStore, SloTracker, build_digest, get_recorder, load_slo_config
@@ -57,6 +52,7 @@ from ..router import (
     static_sort,
 )
 from ..tracing import extract_trace, get_tracer, inject_trace, use_trace_ctx
+from ..transport import Transport, resolve_transport
 from ..utils import (
     MetricsAggregator,
     get_lan_ip,
@@ -95,8 +91,17 @@ _C_FRAMES_RECV = get_registry().counter(
 _C_BYTES_RECV = get_registry().counter(
     "mesh.bytes_recv", "payload bytes received by op"
 )
+# per-op bound-series caches for the frame counters above (hot path —
+# see _send_raw/_reader); bounded because ops are clamped to the
+# protocol type set before lookup
+_FRAME_SENT_INCS: dict[str, tuple] = {}
+_FRAME_RECV_INCS: dict[str, tuple] = {}
 _C_RELAY_HOPS = get_registry().counter(
     "mesh.relay_hops", "gen_requests forwarded through the swarm relay"
+)
+_C_GOSSIP_SUPPRESSED = get_registry().counter(
+    "mesh.gossip_suppressed",
+    "telemetry broadcasts skipped by delta suppression (unchanged digest)",
 )
 # generation outcome counters: the event stream the gen_error_rate SLO
 # objective (health.DEFAULT_SLO_CONFIG) burns against. Counted at
@@ -151,7 +156,16 @@ class P2PNode(StageTaskMixin):
         fleet_controller: bool | None = None,  # compete for the fleet
         # controller lease (BEE2BEE_FLEET=controller); every node still
         # keeps a lease view and obeys epoch-gated fleet actions
+        clock: Clock | None = None,  # time seam (clock.py): None = the
+        # process-global clock. Everything this node constructs (health
+        # store, SLO tracker, lease, admission) inherits it, so a
+        # simulation's virtual clock drives the WHOLE control plane
+        transport: Transport | None = None,  # I/O seam (transport.py):
+        # None = real websockets, falling back to the wscompat loopback
+        # shim — the historical behavior, now as backend selection
     ):
+        self.clock = resolve_clock(clock)
+        self.transport = resolve_transport(transport)
         self.host = host
         self.accept_stages = accept_stages
         self.port = port
@@ -174,13 +188,31 @@ class P2PNode(StageTaskMixin):
         # the process-global incident flight recorder. ping_interval_s is
         # an attribute so tests shrink the cadence without monkeypatching.
         self.ping_interval_s = PING_INTERVAL_S
-        self.health = HealthStore(ttl_s=3 * self.ping_interval_s)
+        # gossip delta suppression (scaling fix, bench.py fleet_sim): on
+        # the monitor cadence an UNCHANGED digest is only re-broadcast
+        # every gossip_refresh_ticks ticks. The HealthStore TTL is 3
+        # ticks, so a refresh every 2 keeps every peer's view fresh while
+        # a steady-state fleet drops ~half its telemetry frames — and, at
+        # N peers per node, N× that many decodes fleet-wide. Direct
+        # gossip_telemetry() calls (tests, smoke gates, fleet actions)
+        # always send; only the monitor loop passes tick=True.
+        self.gossip_delta_enabled = True
+        self.gossip_refresh_ticks = 2
+        self._gossip_fp: str | None = None
+        self._gossip_ticks_since_send = 0
+        # pings carry a full get_system_metrics() sample (psutil + jax
+        # device introspection). One sample per TICK is the scaling fix
+        # (it used to run per PEER); large in-process sims turn it off
+        # entirely — FakeService control planes have nothing to report
+        self.ping_metrics_enabled = True
+        self.health = HealthStore(ttl_s=3 * self.ping_interval_s, clock=self.clock)
         self.recorder = get_recorder()
         # load_slo_config raises on a malformed BEE2BEE_SLO_CONFIG — a
         # mis-typed SLO must fail the node at construction, not route on
         # garbage later
         self.slo = SloTracker(
-            objectives=load_slo_config(), on_trip=self._on_slo_trip
+            objectives=load_slo_config(), on_trip=self._on_slo_trip,
+            clock=self.clock,
         )
 
         # SLO-aware front door (router/): tenant identity + budgets from
@@ -257,6 +289,7 @@ class P2PNode(StageTaskMixin):
             # something, instead of waiting for the free-fraction floor
             pool_eta=pool_exhaust_eta,
             draining=lambda: self.draining,
+            clock=self.clock,
         )
 
         # piece store: hash -> bytes (optionally spilled to piece_dir)
@@ -292,6 +325,9 @@ class P2PNode(StageTaskMixin):
         self.reconnect_max_s = RECONNECT_MAX_S
         self.reconnect_window_s = RECONNECT_WINDOW_S
         self._dial_addr_by_ws: dict[Any, str] = {}  # outbound ws -> addr dialed
+        self._dialing: set[str] = set()  # addrs with a dial in flight (dedup)
+        self._pid_by_ws: dict[Any, str] = {}  # ws -> peer_id (O(1) _peer_for)
+        self._pong_raw: tuple | None = None  # (ts, raw) last-encoded pong
         # scheme-less host:port — the wss→ws fallback changes the scheme of
         # the addr actually dialed, and a bootstrap peer must keep its
         # retry-forever status across that downgrade
@@ -307,7 +343,7 @@ class P2PNode(StageTaskMixin):
         return addr.split("://", 1)[-1]
 
     def _mark_departed(self, addr: str) -> None:
-        now = time.time()
+        now = self.clock.time()
         self._departed = {
             a: t for a, t in self._departed.items()
             if now - t < self.reconnect_window_s
@@ -316,7 +352,7 @@ class P2PNode(StageTaskMixin):
 
     def _is_departed(self, addr: str) -> bool:
         t = self._departed.get(addr)
-        return t is not None and time.time() - t < self.reconnect_window_s
+        return t is not None and self.clock.time() - t < self.reconnect_window_s
 
     def _spawn(self, coro) -> asyncio.Task:
         """Track a background task, self-pruning on completion (a churny
@@ -344,7 +380,7 @@ class P2PNode(StageTaskMixin):
         # the migration scheduler hook (a foreign thread) schedules async
         # work onto this loop — capture it once at boot
         self._loop = asyncio.get_running_loop()
-        self._server = await websockets.serve(
+        self._server = await self.transport.serve(
             self._handle_connection,
             self.host,
             self.port,
@@ -352,7 +388,7 @@ class P2PNode(StageTaskMixin):
         )
         if self.port == 0:  # resolve ephemeral port
             self.port = next(iter(self._server.sockets)).getsockname()[1]
-        self.started_at = time.time()
+        self.started_at = self.clock.time()
         # the lease boot grace counts from JOINING the mesh, not from
         # construction — a slow build (first jit compile) must not eat it
         self.fleet.lease.reset_boot_grace(self.started_at)
@@ -375,6 +411,7 @@ class P2PNode(StageTaskMixin):
             peers = list(self.peers.values())
             self.peers.clear()
             self.providers.clear()
+            self._pid_by_ws.clear()
         for info in peers:
             with contextlib.suppress(Exception):
                 await info["ws"].send(protocol.encode(protocol.msg(protocol.GOODBYE, peer_id=self.peer_id)))
@@ -403,7 +440,7 @@ class P2PNode(StageTaskMixin):
         """Inbound connection: read messages until close."""
         try:
             await self._reader(ws)
-        except (websockets.exceptions.ConnectionClosed, OSError):
+        except (self.transport.exceptions.ConnectionClosed, OSError):
             pass  # unclean peer death is normal mesh weather
         finally:
             await self._drop_peer(ws)
@@ -414,9 +451,21 @@ class P2PNode(StageTaskMixin):
                 return True
         if addr == self.addr:
             return False
+        # in-flight dedup (scaling fix): during a join burst the same addr
+        # arrives from several peer_lists before the first dial's hello-ack
+        # registers the peer — without this, every mention opens another
+        # socket and the remote logs an identity_rebind incident per extra
+        # dial. The entry lives until _drop_peer (the peers-table check
+        # above takes over once the ack lands), so a dropped link redials.
+        if addr in self._dialing:
+            return True
+        self._dialing.add(addr)
         try:
-            ws = await websockets.connect(addr, max_size=protocol.MAX_FRAME, open_timeout=10)
+            ws = await self.transport.dial(
+                addr, max_size=protocol.MAX_FRAME, open_timeout=10
+            )
         except Exception as e:
+            self._dialing.discard(addr)
             # wss→ws fallback mirrors the reference (p2p_runtime.py:353-361)
             if addr.startswith("wss://"):
                 return await self._connect_peer("ws://" + addr[6:])
@@ -431,6 +480,7 @@ class P2PNode(StageTaskMixin):
             # treat as a failed dial, not a raise — _reconnect_loop must see
             # False and keep backing off, and the dial record must not leak
             self._dial_addr_by_ws.pop(ws, None)
+            self._dialing.discard(addr)
             with contextlib.suppress(Exception):
                 await ws.close()
             logger.warning("hello to %s failed: %s", addr, e)
@@ -439,7 +489,7 @@ class P2PNode(StageTaskMixin):
         async def run_reader():
             try:
                 await self._reader(ws)
-            except (websockets.exceptions.ConnectionClosed, OSError):
+            except (self.transport.exceptions.ConnectionClosed, OSError):
                 pass  # unclean drop: _drop_peer schedules the redial
             finally:
                 await self._drop_peer(ws)
@@ -478,8 +528,14 @@ class P2PNode(StageTaskMixin):
                 # to one bucket keeps the label set (and the series table)
                 # bounded no matter what a hostile peer sends
                 op = "other"
-            _C_FRAMES_RECV.inc(op=op)
-            _C_BYTES_RECV.inc(_frame_bytes(raw), op=op)
+            incs = _FRAME_RECV_INCS.get(op)
+            if incs is None:  # bounded: op clamped above (see _send_raw)
+                incs = _FRAME_RECV_INCS[op] = (
+                    _C_FRAMES_RECV.bind(op=op),
+                    _C_BYTES_RECV.bind(op=op),
+                )
+            incs[0]()
+            incs[1](_frame_bytes(raw))
             if op in _NOTABLE_OPS:  # frame-op events land in the incident ring
                 self.recorder.record(
                     "frame", op=op, peer=data.get("peer_id"),
@@ -499,6 +555,7 @@ class P2PNode(StageTaskMixin):
             for pid in dead:
                 self.peers.pop(pid, None)
                 self.providers.pop(pid, None)
+            self._pid_by_ws.pop(ws, None)
         for pid in dead:
             logger.info("peer %s disconnected", pid)
         # fail fast anything awaiting a reply on this connection — the
@@ -520,6 +577,8 @@ class P2PNode(StageTaskMixin):
         # (or we are shutting down). Inbound connections are the remote
         # dialer's job to restore.
         dial_addr = self._dial_addr_by_ws.pop(ws, None)
+        if dial_addr:
+            self._dialing.discard(dial_addr)  # a future dial is legitimate
         if (
             dial_addr
             and self.reconnect_enabled
@@ -540,16 +599,16 @@ class P2PNode(StageTaskMixin):
             deadline = (
                 None
                 if self._addr_key(addr) in self._bootstrap_addrs
-                else time.time() + self.reconnect_window_s
+                else self.clock.time() + self.reconnect_window_s
             )
             while not self._stopped:
-                await asyncio.sleep(delay)
+                await self.clock.sleep(delay)
                 if self._stopped or self._is_departed(addr):
                     return
                 if await self._connect_peer(addr):
                     logger.info("reconnected to %s", addr)
                     return
-                if deadline is not None and time.time() >= deadline:
+                if deadline is not None and self.clock.time() >= deadline:
                     logger.info("giving up reconnecting to %s", addr)
                     return
                 delay = min(delay * 2, self.reconnect_max_s)
@@ -563,20 +622,43 @@ class P2PNode(StageTaskMixin):
         # pre-encoded binary tensor frames would cost a header decode to
         # attribute; they count under one "tensor" op instead
         op = message.get("type") if isinstance(message, dict) else "tensor"
+        await self._send_raw(ws, raw, op)
+
+    async def _send_raw(self, ws, raw: str | bytes, op):
         if op not in protocol.MESSAGE_TYPES and op != "tensor":
             op = "other"  # keep the label set bounded (see _listen)
-        _C_FRAMES_SENT.inc(op=op)
+        # bound per-op series (metrics.Counter.bind): this runs per frame
+        # on the wire, and re-resolving the label key each time was a
+        # visible slice of a large fleet's gossip tick. Bounded: op is
+        # clamped to the protocol's type set just above.
+        incs = _FRAME_SENT_INCS.get(op)
+        if incs is None:
+            incs = _FRAME_SENT_INCS[op] = (
+                _C_FRAMES_SENT.bind(op=op),
+                _C_BYTES_SENT.bind(op=op),
+            )
+        incs[0]()
         # len(raw) IS the wire size here: bytes frames trivially, and text
         # frames because protocol.encode emits pure-ASCII JSON (see
         # _frame_bytes) — no re-encode on the send hot path
-        _C_BYTES_SENT.inc(len(raw), op=op)
+        incs[1](len(raw))
         await ws.send(raw)
 
     async def broadcast(self, message: dict):
         async with self._lock:
             targets = [info["ws"] for info in self.peers.values()]
+        if not targets:
+            return 0
+        # scaling fix (sim-measured, bench.py fleet_sim): encode ONCE and
+        # fan the raw frame out. The old per-peer _send re-ran
+        # protocol.encode per recipient, which made each gossip tick cost
+        # O(peers) JSON serializations per node — O(N²) encodes fleet-wide
+        # for a frame whose bytes are identical at every peer.
+        raw = protocol.encode(message)
+        op = message.get("type")
         results = await asyncio.gather(
-            *(self._send(ws, message) for ws in targets), return_exceptions=True
+            *(self._send_raw(ws, raw, op) for ws in targets),
+            return_exceptions=True,
         )
         return sum(1 for r in results if not isinstance(r, Exception))
 
@@ -588,42 +670,55 @@ class P2PNode(StageTaskMixin):
             peer_id=self.peer_id,
             addr=self.addr,
             region=self.region,
-            metrics=get_system_metrics(self.throughput),
+            # same gate as the ping sample: sims run engine-less control
+            # planes, and a psutil snapshot's digits would make hello
+            # frame sizes differ between same-seed replays
+            metrics=get_system_metrics(self.throughput)
+            if self.ping_metrics_enabled
+            else {},
             services={n: s.get_metadata() for n, s in self.local_services.items()},
             api_port=self.api_port,
             api_host=self.announce_host or get_lan_ip(),
             accepts_stages=self.accept_stages,
         )
 
+    # type -> handler ATTRIBUTE NAME: dispatch goes through getattr on
+    # every message so chaos tooling (and tests) can monkeypatch a
+    # node's `_handle_*` method and be seen immediately — while the
+    # table itself is built once, not per frame (scaling fix: the old
+    # per-message dict literal re-created 26 bound methods per frame,
+    # a measurable slice of a large fleet's gossip tick)
+    _HANDLER_NAMES = {
+        protocol.HELLO: "_handle_hello",
+        protocol.PEER_LIST: "_handle_peer_list",
+        protocol.PING: "_handle_ping",
+        protocol.PONG: "_handle_pong",
+        protocol.SERVICE_ANNOUNCE: "_handle_service_announce",
+        protocol.GEN_REQUEST: "_handle_gen_request",
+        protocol.GEN_CHUNK: "_handle_gen_chunk",
+        protocol.GEN_SUCCESS: "_handle_gen_result",
+        protocol.GEN_RESULT: "_handle_gen_result",
+        protocol.GEN_ERROR: "_handle_gen_result",
+        protocol.PIECE_REQUEST: "_handle_piece_request",
+        protocol.PIECE_DATA: "_handle_piece_data",
+        protocol.PIECE_HAVE: "_handle_piece_have",
+        protocol.GOODBYE: "_handle_goodbye",
+        protocol.TELEMETRY: "_handle_telemetry",
+        protocol.KV_EXPORT: "_handle_kv_export",
+        protocol.KV_BLOCKS: "_handle_kv_blocks",
+        protocol.KV_IMPORT_ACK: "_handle_kv_import_ack",
+        protocol.FLEET_LEASE: "_handle_fleet_lease",
+        protocol.FLEET_ACTION: "_handle_fleet_action",
+        protocol.FLEET_ACK: "_handle_fleet_ack",
+        protocol.ADAPTER_ANNOUNCE: "_handle_adapter_announce",
+        protocol.TASK: "_handle_task",
+        protocol.RESULT: "_handle_result",
+        protocol.TASK_ERROR: "_handle_result",
+    }
+
     async def _on_message(self, ws, data: dict):
-        handlers = {
-            protocol.HELLO: self._handle_hello,
-            protocol.PEER_LIST: self._handle_peer_list,
-            protocol.PING: self._handle_ping,
-            protocol.PONG: self._handle_pong,
-            protocol.SERVICE_ANNOUNCE: self._handle_service_announce,
-            protocol.GEN_REQUEST: self._handle_gen_request,
-            protocol.GEN_CHUNK: self._handle_gen_chunk,
-            protocol.GEN_SUCCESS: self._handle_gen_result,
-            protocol.GEN_RESULT: self._handle_gen_result,
-            protocol.GEN_ERROR: self._handle_gen_result,
-            protocol.PIECE_REQUEST: self._handle_piece_request,
-            protocol.PIECE_DATA: self._handle_piece_data,
-            protocol.PIECE_HAVE: self._handle_piece_have,
-            protocol.GOODBYE: self._handle_goodbye,
-            protocol.TELEMETRY: self._handle_telemetry,
-            protocol.KV_EXPORT: self._handle_kv_export,
-            protocol.KV_BLOCKS: self._handle_kv_blocks,
-            protocol.KV_IMPORT_ACK: self._handle_kv_import_ack,
-            protocol.FLEET_LEASE: self._handle_fleet_lease,
-            protocol.FLEET_ACTION: self._handle_fleet_action,
-            protocol.FLEET_ACK: self._handle_fleet_ack,
-            protocol.ADAPTER_ANNOUNCE: self._handle_adapter_announce,
-            protocol.TASK: self._handle_task,
-            protocol.RESULT: self._handle_result,
-            protocol.TASK_ERROR: self._handle_result,
-        }
-        handler = handlers.get(data.get("type"))
+        name = self._HANDLER_NAMES.get(data.get("type"))
+        handler = getattr(self, name) if name else None
         if handler is None:
             logger.debug("unknown message type %r", data.get("type"))
             return
@@ -675,28 +770,65 @@ class P2PNode(StageTaskMixin):
             live_rebind = (
                 prev is not None
                 and prev.get("ws") is not ws
-                and time.time() - prev.get("last_seen", 0.0)
+                and self.clock.time() - prev.get("last_seen", 0.0)
                 <= 3 * self.ping_interval_s
             )
-            self.peers[pid] = {
-                "ws": ws,
-                "addr": data.get("addr"),
-                "region": data.get("region"),
-                "metrics": data.get("metrics") or {},
-                "api_port": data.get("api_port"),
-                "api_host": data.get("api_host"),
-                # failover replacement candidates rank by this (pre-taxonomy
-                # peers omit it → still eligible, just deprioritized)
-                "accepts_stages": bool(data.get("accepts_stages")),
-                "health": "online",
-                "last_seen": time.time(),
-                "rtt_ms": prev.get("rtt_ms") if prev else None,
-            }
-            services = data.get("services") or {}
-            if services:
-                self.providers.setdefault(pid, {}).update(services)
+            # dual-dial tie-break: when both sides dialed each other
+            # concurrently, each holds one outbound and one inbound
+            # connection to the same peer — and "latest hello wins" lets
+            # the two ends settle on DIFFERENT sockets. Pongs echo on
+            # whatever socket the ping rode, so liveness stays green,
+            # but every identity-resolved inbound frame (telemetry,
+            # fleet ops, tasks) resolves `_peer_for() -> None` and is
+            # dropped forever: a silent half-deaf link (found by the
+            # simnet split-brain scenario). Both ends instead keep the
+            # connection DIALED BY THE LOWER peer id — a rule each side
+            # can evaluate locally (dialed-by-me ⇔ in _dial_addr_by_ws)
+            # with the same result — and close the loser.
+            loser_ws = None
+            if live_rebind:
+                new_out = ws in self._dial_addr_by_ws
+                old_out = prev.get("ws") in self._dial_addr_by_ws
+                if new_out != old_out:
+                    keep_out = self.peer_id < pid
+                    loser_ws = ws if old_out == keep_out else prev.get("ws")
+            if loser_ws is ws:
+                # canonical registration survives on the previous socket;
+                # this hello still proves liveness and may carry services
+                prev["health"] = "online"
+                prev["last_seen"] = self.clock.time()
+                services = data.get("services") or {}
+                if services:
+                    self.providers.setdefault(pid, {}).update(services)
+            else:
+                if prev is not None and prev.get("ws") is not ws:
+                    self._pid_by_ws.pop(prev.get("ws"), None)
+                self._pid_by_ws[ws] = pid
+                self.peers[pid] = {
+                    "ws": ws,
+                    "addr": data.get("addr"),
+                    "region": data.get("region"),
+                    "metrics": data.get("metrics") or {},
+                    "api_port": data.get("api_port"),
+                    "api_host": data.get("api_host"),
+                    # failover replacement candidates rank by this (pre-taxonomy
+                    # peers omit it → still eligible, just deprioritized)
+                    "accepts_stages": bool(data.get("accepts_stages")),
+                    "health": "online",
+                    "last_seen": self.clock.time(),
+                    "rtt_ms": prev.get("rtt_ms") if prev else None,
+                }
+                services = data.get("services") or {}
+                if services:
+                    self.providers.setdefault(pid, {}).update(services)
             peer_addrs = [p["addr"] for p in self.peers.values() if p.get("addr")]
-        if live_rebind:
+        if loser_ws is not None:
+            # the losing socket's dialer will short-circuit its redial:
+            # _connect_peer sees the peer already registered by addr
+            logger.info("dual-dial with %s converged; closing extra link", pid)
+            with contextlib.suppress(Exception):
+                await loser_ws.close()
+        elif live_rebind:
             logger.warning(
                 "hello rebinds %s away from a live connection", pid
             )
@@ -722,11 +854,25 @@ class P2PNode(StageTaskMixin):
             await self._send(ws, protocol.msg(protocol.PEER_LIST, peers=peer_addrs))
 
     async def _handle_peer_list(self, ws, data):
+        # prefilter against already-connected / in-flight addrs ONCE per
+        # list (scaling fix): during a join burst every edge handshake
+        # carries a full peer list, so spawning a dial task per mention —
+        # each redoing an O(peers) scan under the lock — is O(N³) work
+        # fleet-wide. One set build per list makes the steady-state cost
+        # of a redundant peer list O(N) and spawns only genuinely new dials.
+        addrs = data.get("peers") or []
+        async with self._lock:
+            connected = {p.get("addr") for p in self.peers.values()}
         # connect concurrently off the reader task: a serial await here would
         # stall all message processing on this connection for up to
         # open_timeout per dead address in a churned peer list
-        for addr in data.get("peers") or []:
-            if addr and addr != self.addr:
+        for addr in addrs:
+            if (
+                addr
+                and addr != self.addr
+                and addr not in connected
+                and addr not in self._dialing
+            ):
                 self._spawn(self._connect_peer_quiet(addr))
 
     async def _connect_peer_quiet(self, addr: str):
@@ -739,19 +885,28 @@ class P2PNode(StageTaskMixin):
             async with self._lock:
                 if pid in self.peers:
                     self.peers[pid]["metrics"] = data["metrics"]
-                    self.peers[pid]["last_seen"] = time.time()
-        await self._send(ws, protocol.msg(protocol.PONG, ts=data.get("ts")))
+                    self.peers[pid]["last_seen"] = self.clock.time()
+        # a pong's bytes are a pure function of the echoed ts, and a ping
+        # burst from one sender tick shares its ts — one-slot encode cache
+        # (cache-miss cost is a tuple compare, so the unsynchronized
+        # production case loses nothing)
+        ts = data.get("ts")
+        cached = self._pong_raw
+        if cached is None or cached[0] != ts:
+            cached = (ts, protocol.encode(protocol.msg(protocol.PONG, ts=ts)))
+            self._pong_raw = cached
+        await self._send_raw(ws, cached[1], protocol.PONG)
 
     async def _handle_pong(self, ws, data):
         pid = await self._peer_for(ws)
         ts = data.get("ts")
         if pid and isinstance(ts, (int, float)):
-            rtt = (time.time() - ts) * 1000.0
+            rtt = (self.clock.time() - ts) * 1000.0
             async with self._lock:
                 if pid in self.peers:
                     self.peers[pid]["rtt_ms"] = round(rtt, 2)
                     self.peers[pid]["health"] = "online"
-                    self.peers[pid]["last_seen"] = time.time()
+                    self.peers[pid]["last_seen"] = self.clock.time()
 
     async def _handle_service_announce(self, ws, data):
         svc, meta = data.get("service"), data.get("meta") or {}
@@ -853,15 +1008,41 @@ class P2PNode(StageTaskMixin):
             digest["fleet_controller"] = True
         return digest
 
-    async def gossip_telemetry(self) -> int:
+    async def gossip_telemetry(self, tick: bool = False) -> int:
         """Broadcast this node's digest as one TELEMETRY frame; returns the
         number of peers reached. Rides the ping cadence (_monitor_loop) but
-        is callable directly (tests, smoke gates) for deterministic gossip."""
+        is callable directly (tests, smoke gates) for deterministic gossip.
+
+        tick=True applies delta suppression (see __init__): an unchanged
+        digest is skipped until gossip_refresh_ticks ticks have passed
+        since the last send. The fingerprint excludes the "ts" stamp and
+        the per-peer RTT block — both are measurement noise that changes
+        on EVERY tick (RTT jitters by design), and either would defeat
+        the comparison forever. Peers still get fresh RTTs on each
+        refresh tick, so RTT staleness is bounded at gossip_refresh_ticks
+        ticks; anything operationally actionable (counters, gauges,
+        histograms, draining/fleet state) re-gossips immediately."""
+        digest = self.telemetry_digest()
+        if tick and self.gossip_delta_enabled:
+            body = {
+                k: v for k, v in digest.items()
+                if k not in ("ts", "peer_rtt_ms")
+            }
+            fp = json.dumps(body, sort_keys=True, default=str)
+            if (
+                fp == self._gossip_fp
+                and self._gossip_ticks_since_send + 1 < self.gossip_refresh_ticks
+            ):
+                self._gossip_ticks_since_send += 1
+                _C_GOSSIP_SUPPRESSED.inc()
+                return 0
+            self._gossip_fp = fp
+            self._gossip_ticks_since_send = 0
         return await self.broadcast(
             protocol.msg(
                 protocol.TELEMETRY,
                 peer_id=self.peer_id,
-                digest=self.telemetry_digest(),
+                digest=digest,
             )
         )
 
@@ -905,9 +1086,19 @@ class P2PNode(StageTaskMixin):
         return None
 
     async def _peer_for(self, ws) -> str | None:
+        # reverse map maintained by _handle_hello/_drop_peer/stop
+        # (scaling fix): this runs for EVERY ping/pong/telemetry receipt,
+        # and the old linear peers scan made each gossip tick O(peers²)
+        # per node — the dominant per-tick cost at fleet scale
         async with self._lock:
+            pid = self._pid_by_ws.get(ws)
+            if pid is not None and self.peers.get(pid, {}).get("ws") is ws:
+                return pid
+            # slow path: direct writes into node.peers (tests, chaos
+            # tooling) bypass the map — fall back to the scan and repair
             for pid, info in self.peers.items():
                 if info["ws"] is ws:
+                    self._pid_by_ws[ws] = pid
                     return pid
         return None
 
@@ -1169,7 +1360,7 @@ class P2PNode(StageTaskMixin):
                         **(extra or {}),
                     )),
                 )
-                result = await asyncio.wait_for(fut, timeout=timeout)
+                result = await self.clock.wait_for(fut, timeout)
                 # raise inside the span so remote-error results count as
                 # span errors in /trace, same as timeouts do
                 if isinstance(result, dict) and result.get("error"):
@@ -1290,7 +1481,7 @@ class P2PNode(StageTaskMixin):
                     for line in svc.execute_stream(params):
                         feed(line, threadsafe=True)
 
-                t0 = time.time()
+                t0 = self.clock.time()
                 stream_async = getattr(svc, "execute_stream_async", None)
                 if stream_async is not None:
                     # loop-native service (e.g. PipelineService): no
@@ -1308,7 +1499,7 @@ class P2PNode(StageTaskMixin):
                     max(1, len("".join(text_parts)) // 4) if text_parts else 0
                 )
                 if est:
-                    self.throughput.record(est, time.time() - t0)
+                    self.throughput.record(est, self.clock.time() - t0)
                 out = {
                     "text": "".join(text_parts),
                     "tokens": final.get("tokens"),
@@ -1673,7 +1864,7 @@ class P2PNode(StageTaskMixin):
             await self._send(
                 info["ws"], protocol.msg(protocol.PIECE_REQUEST, rid=rid, hash=digest)
             )
-            result = await asyncio.wait_for(fut, timeout=timeout)
+            result = await self.clock.wait_for(fut, timeout)
         finally:
             async with self._pending_lock:
                 self._pending.pop(rid, None)
@@ -1722,20 +1913,28 @@ class P2PNode(StageTaskMixin):
         last_counts: dict[str, float] = {}
         while not self._stopped:
             try:
-                await asyncio.sleep(self.ping_interval_s)
+                await self.clock.sleep(self.ping_interval_s)
                 async with self._lock:
                     targets = list(self.peers.items())
-                now = time.time()
+                now = self.clock.time()
+                # one metrics sample + one encode per TICK, not per peer:
+                # get_system_metrics walks psutil and jax devices (slow),
+                # and the ping frame's bytes are identical at every peer
+                # (scaling fix, bench.py fleet_sim). Sims with hundreds of
+                # engine-less control planes disable the sample outright.
+                metrics = (
+                    get_system_metrics(self.throughput)
+                    if self.ping_metrics_enabled and targets
+                    else None
+                )
+                raw_ping = protocol.encode(protocol.msg(
+                    protocol.PING,
+                    ts=now,
+                    **({"metrics": metrics} if metrics is not None else {}),
+                ))
                 for pid, info in targets:
                     try:
-                        await self._send(
-                            info["ws"],
-                            protocol.msg(
-                                protocol.PING,
-                                ts=now,
-                                metrics=get_system_metrics(self.throughput),
-                            ),
-                        )
+                        await self._send_raw(info["ws"], raw_ping, protocol.PING)
                     except Exception:
                         await self._drop_peer(info["ws"])
                 async with self._lock:
@@ -1746,7 +1945,7 @@ class P2PNode(StageTaskMixin):
                 # rates (refreshes the slo.* gauges, fires trip incidents),
                 # gossip the digest, and drop a metric-delta ring event
                 self.slo.evaluate()
-                await self.gossip_telemetry()
+                await self.gossip_telemetry(tick=True)
                 self._record_metric_deltas(last_counts)
                 # elastic fleet control loop, same cadence: lease renew/
                 # claim + (leaders only) one hysteresis-guarded decision
@@ -1823,7 +2022,7 @@ class P2PNode(StageTaskMixin):
             "peer_id": self.peer_id,
             "addr": self.addr,
             "region": self.region,
-            "uptime_s": round(time.time() - self.started_at, 1) if self.started_at else 0,
+            "uptime_s": round(self.clock.time() - self.started_at, 1) if self.started_at else 0,
             "peers": len(self.peers),
             "local_services": list(self.local_services),
             "providers": sum(len(v) for v in self.providers.values()),

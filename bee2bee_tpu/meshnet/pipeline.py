@@ -29,13 +29,13 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import time
 from collections import deque
 from urllib.parse import urlparse
 
 import numpy as np
 
 from .. import protocol
+from ..clock import get_clock
 from ..health import get_recorder
 from ..metrics import get_registry
 from ..tracing import extract_trace, get_tracer, inject_trace, use_trace_ctx
@@ -267,7 +267,7 @@ class StageTaskMixin:
                             self.stage_next[data["model"]] = pid
                             relay = True
                             break
-                        await asyncio.sleep(0.1)
+                        await self.clock.sleep(0.1)
             except Exception:  # noqa: BLE001 — relay optional; fall back
                 logger.exception("next-stage dial %s failed", next_addr)
         await self._send(
@@ -478,7 +478,7 @@ class StageTaskMixin:
         # ---- last stage: sample, accumulate, circulate or answer ----
         tok = self._ring_sample(out[0], data)
         otid = data["origin_task_id"]
-        now = time.time()
+        now = self.clock.time()
         for stale in [k for k, v in self.stage_bursts.items()
                       if now - v["t"] > self.BURST_STALE_S]:
             self.stage_bursts.pop(stale, None)
@@ -571,7 +571,7 @@ class StageTaskMixin:
                     f"send {kind} to {peer_id!r} failed: {e}", peer=peer_id
                 ) from e
             try:
-                result = await asyncio.wait_for(fut, timeout=timeout)
+                result = await self.clock.wait_for(fut, timeout)
             except asyncio.TimeoutError:
                 raise StageTimeout(
                     f"{kind} on {peer_id!r}: no reply in {timeout:.0f}s",
@@ -664,6 +664,7 @@ class PipelineCoordinator:
         generation_deadline_s: float = DEFAULT_GENERATION_DEADLINE_S,
     ):
         self.node = node
+        self.clock = getattr(node, "clock", None) or get_clock()
         self.model = model
         self.stage_peers = list(stage_peers)
         self.max_seq_len = max_seq_len
@@ -917,7 +918,7 @@ class PipelineCoordinator:
             max_new_tokens = max(0, self.max_seq_len - 1 - n)
         if max_new_tokens <= 0:
             return []
-        deadline = time.time() + (
+        deadline = self.clock.time() + (
             self.generation_deadline_s if deadline_s is None else deadline_s
         )
         out: list[int] = []
@@ -956,7 +957,7 @@ class PipelineCoordinator:
                     )
                 except StageError as e:
                     attempt += 1
-                    remaining = deadline - time.time()
+                    remaining = deadline - self.clock.time()
                     # migration-preferring rung: an ALIVE chain (typed
                     # timeout/error, no re-placement happened, tokens
                     # accepted) keeps every stage's KV — resume decode in
@@ -999,7 +1000,7 @@ class PipelineCoordinator:
                         self.max_failover_retries, len(out),
                         " (resuming in place)" if resume_in_place else "",
                     )
-                    await asyncio.sleep(min(
+                    await self.clock.sleep(min(
                         self.failover_backoff_s * 2 ** (attempt - 1),
                         max(remaining, 0.0),
                     ))
@@ -1017,14 +1018,14 @@ class PipelineCoordinator:
                     # deadline budget: a wedged stage that also swallows
                     # release/part_load must not stretch time-to-failure
                     # past generation_deadline_s
-                    budget = max(deadline - time.time(), 1.0)
+                    budget = max(deadline - self.clock.time(), 1.0)
                     await self.release(  # survivors drop the old caches
                         rid, timeout=min(self.step_timeout, budget)
                     )
                     try:
                         await self.recover(
                             timeout=min(self.load_timeout,
-                                        max(deadline - time.time(), 1.0)),
+                                        max(deadline - self.clock.time(), 1.0)),
                             observed_epoch=attempt_epoch,
                         )
                     except StageDead as rec_err:
@@ -1464,6 +1465,7 @@ class PipelineSession:
         inflight_window: int | None = None,
     ):
         self.node = node
+        self.clock = getattr(node, "clock", None) or get_clock()
         self.model = model
         self.stage_peers = stage_peers
         self.max_batch = max_batch
@@ -1823,7 +1825,7 @@ class PipelineSession:
             if not g.queue and not g.active():
                 g.wake.clear()
                 try:
-                    await asyncio.wait_for(g.wake.wait(), timeout=30.0)
+                    await self.clock.wait_for(g.wake.wait(), 30.0)
                 except asyncio.TimeoutError:
                     # a generate() can land during wait_for's cancellation
                     # window (an await point) — park only when still idle
@@ -1851,7 +1853,7 @@ class PipelineSession:
             if not self._any_pending and not self._any_active:
                 self._wake.clear()
                 try:
-                    await asyncio.wait_for(self._wake.wait(), timeout=30.0)
+                    await self.clock.wait_for(self._wake.wait(), 30.0)
                 except asyncio.TimeoutError:
                     if self._any_pending or self._any_active:
                         continue
@@ -1948,7 +1950,7 @@ class PipelineSession:
             # one in-place try per failure burst (failovers resets on a
             # whole successful step); a repeat escalates to re-prefill
             g.failovers += 1
-            await asyncio.sleep(self.failover_backoff_s)
+            await self.clock.sleep(self.failover_backoff_s)
             # re-check AFTER the sleep: a concurrent failover may have
             # rebuilt the chain meanwhile, invalidating this group's
             # stage caches on any replaced peer — fall through to the
@@ -1986,7 +1988,7 @@ class PipelineSession:
             g.failovers += 1
             _C_SESSION_FAILOVERS.inc()
             try:
-                await asyncio.sleep(min(
+                await self.clock.sleep(min(
                     self.failover_backoff_s * 2 ** (g.failovers - 1), 5.0
                 ))
                 # observed_epoch: if another group/generation already

@@ -11,6 +11,7 @@ import asyncio
 import contextlib
 import logging
 
+from ..clock import get_clock
 from ..config import NodeConfig, load_config, parse_mesh_shape
 from .node import P2PNode
 
@@ -191,7 +192,7 @@ async def run_p2p_node(
             loop = asyncio.get_running_loop()
             forwarder = nat.PortForwarder()
             with contextlib.suppress(Exception):
-                mapping = await asyncio.wait_for(
+                mapping = await asyncio.wait_for(  # meshlint: ignore[ML-C001] -- real NAT/STUN round trip in an executor thread
                     loop.run_in_executor(None, forwarder.auto_forward, node.port), 15.0
                 )
                 if mapping.ok and mapping.public_ip:
@@ -312,7 +313,7 @@ async def run_p2p_node(
             await shutdown_event.wait()
         else:
             while True:
-                await asyncio.sleep(3600)
+                await get_clock().sleep(3600)
     finally:
         if tun is not None:
             with contextlib.suppress(Exception):
@@ -329,7 +330,7 @@ async def run_p2p_node(
         if forwarder is not None and forwarder.mappings:
             loop = asyncio.get_running_loop()
             with contextlib.suppress(Exception):
-                await asyncio.wait_for(
+                await asyncio.wait_for(  # meshlint: ignore[ML-C001] -- real NAT teardown in an executor thread
                     loop.run_in_executor(None, forwarder.cleanup), 10.0
                 )
         await node.stop()

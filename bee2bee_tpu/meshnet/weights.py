@@ -104,7 +104,7 @@ async def _peer_for_addr(node, addr: str) -> str | None:
                 for pid, info in node.peers.items():
                     if info.get("addr") == addr:
                         return pid
-                await asyncio.sleep(0.05)
+                await node.clock.sleep(0.05)
     return None
 
 

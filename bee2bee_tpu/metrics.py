@@ -41,6 +41,15 @@ def log_buckets(lo: float, hi: float, factor: float = 2.0) -> tuple:
 
 
 def _labels_key(labels: dict) -> tuple:
+    # fast paths for the hot-path shapes (`inc()`, `inc(op=...)`): the
+    # per-frame mesh counters pay this on every send/receive, and the
+    # generator + sorted() pipeline below is several times the cost of
+    # the whole inc() otherwise
+    if not labels:
+        return ()
+    if len(labels) == 1:
+        ((k, v),) = labels.items()
+        return ((k if type(k) is str else str(k), v if type(v) is str else str(v)),)
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
 
@@ -97,6 +106,23 @@ class Counter(_Metric):
     def value(self, **labels) -> float:
         with self._lock:
             return float(self._series.get(_labels_key(labels), 0.0))
+
+    def bind(self, **labels):
+        """Pre-resolve one labeled series; returns `inc(n=1.0)` for it.
+        The per-frame mesh counters call inc() for every frame on the
+        wire with the same label values — binding once hoists the
+        label-key construction out of the hot path (the moral equivalent
+        of prometheus clients' `counter.labels(...).inc()`)."""
+        key = _labels_key(labels)
+
+        def _inc(n: float = 1.0) -> None:
+            try:
+                with self._lock:
+                    self._series[key] = float(self._series.get(key, 0.0)) + n
+            except Exception:  # noqa: BLE001 — telemetry never throws
+                pass
+
+        return _inc
 
     def total(self) -> float:
         """Sum across every labeled series (the digest-friendly scalar)."""
@@ -375,6 +401,19 @@ class MetricsRegistry:
             else:
                 out[m.name] = m.snapshot()
         return out
+
+    def reset_all(self) -> None:
+        """Zero every registered metric IN PLACE. Modules bind metric
+        handles at import time (`_C = get_registry().counter(...)`), so
+        swapping the registry object would leave those handles writing
+        into the old one — the only way to get a clean slate (simnet
+        needs one between same-seed replays so telemetry digests match
+        bit-for-bit) is to clear the series tables the handles share."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            with m._lock:
+                m._series.clear()
 
 
 _REGISTRY = MetricsRegistry()

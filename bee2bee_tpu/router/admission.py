@@ -32,9 +32,9 @@ from __future__ import annotations
 
 import asyncio
 import math
-import time
 from dataclasses import dataclass, fields
 
+from ..clock import Clock, get_clock, resolve_clock
 from ..metrics import get_registry
 from ..utils import load_json_source
 from .fairness import WdrrQueue
@@ -171,10 +171,10 @@ def pool_exhaust_eta() -> float | None:
 class _TokenBucket:
     """Sustained-rate token budget with burst capacity."""
 
-    def __init__(self, rate_per_s: float, burst: float, now=time.monotonic):
+    def __init__(self, rate_per_s: float, burst: float, now=None):
         self.rate = float(rate_per_s)
         self.burst = max(float(burst), 1.0)
-        self._now = now
+        self._now = now if now is not None else (lambda: get_clock().monotonic())
         self._tokens = self.burst
         self._t = now()
 
@@ -273,9 +273,15 @@ class AdmissionController:
         # sheds pool_exhausted BEFORE the free-fraction floor trips
         draining=None,  # callable -> bool: node drain state (migrate.py);
         # True rejects every new acquisition 503 `draining` + Retry-After
-        now=time.monotonic,
+        now=None,
+        clock: Clock | None = None,  # time seam (clock.py): queue
+        # timeouts + token buckets follow the node's injected clock; an
+        # explicit `now` callable still wins for bucket tests
     ):
         self.config = config or AdmissionConfig()
+        self._clock = resolve_clock(clock)
+        if now is None:
+            now = self._clock.monotonic
         self._buckets = {
             t: _TokenBucket(rate, burst, now)
             for t, (rate, burst) in (budgets or {}).items()
@@ -433,7 +439,7 @@ class AdmissionController:
         self._queued_by_tenant[tenant] = self._queued_by_tenant.get(tenant, 0) + 1
         _G_QUEUED.set(self._queued_total)
         try:
-            await asyncio.wait_for(fut, timeout=cfg.queue_timeout_s)
+            await self._clock.wait_for(fut, cfg.queue_timeout_s)
         except asyncio.TimeoutError:
             # the abandoning side owns the bookkeeping: counts come off
             # NOW (a stalled node must not reject new arrivals against a

@@ -1,0 +1,36 @@
+"""simnet — deterministic in-process fleet simulation.
+
+Runs hundreds of FakeService-backed `P2PNode` control planes (no
+engines, no sockets, no wall clock) in ONE process, faster than real
+time, with bit-identical event traces across same-seed replays:
+
+- `VirtualClock` (clock.py): a timer-heap clock injected through the
+  `bee2bee_tpu.clock` seam. `run_for(60)` advances 60 virtual seconds
+  in however many milliseconds the pending work actually takes.
+- `SimNet` / `SimTransport` (transport.py): a virtual network injected
+  through the `bee2bee_tpu.transport` seam. Seeded per-link latency,
+  loss, and partitionable regions; delivery order is a pure function
+  of the seed.
+- `FleetSim` (harness.py): builds an N-node mesh on both seams,
+  bootstraps it, runs scripted chaos scenarios, and extracts the event
+  trace + `/fleet` decision journals for replay comparison.
+- `dht.py`: a pure-data Kademlia model for lookup-depth scaling claims
+  (the in-memory DHT the mesh ships has no routed lookup to measure).
+
+See docs/SIMULATION.md for the seam design and determinism contract.
+"""
+
+from .clock import VirtualClock
+from .dht import KademliaModel
+from .harness import FleetSim, SimService
+from .transport import LinkProfile, SimNet, SimTransport
+
+__all__ = [
+    "FleetSim",
+    "KademliaModel",
+    "LinkProfile",
+    "SimNet",
+    "SimService",
+    "SimTransport",
+    "VirtualClock",
+]

@@ -1,0 +1,117 @@
+"""VirtualClock: a timer-heap clock for deterministic fleet simulation.
+
+Time only moves when the harness says so. `sleep(d)` parks the caller on
+a heap keyed `(deadline, seq)`; `run_for(duration)` pops due timers in
+that order, fires them, and lets the event loop settle between batches.
+With a single-threaded loop and strictly ordered timers, the schedule —
+and therefore every downstream decision the mesh makes — is a pure
+function of the program and the SimNet seed. A 200-node fleet burns
+through minutes of lease TTLs and ping cadences in wall-clock
+milliseconds.
+
+Two timer kinds share the heap:
+
+- futures (from `sleep`) — resolved in order; a cancelled sleeper is
+  skipped, so `node.stop()`'s task cancellation composes.
+- callbacks (from `call_at`) — SimNet schedules one per frame delivery
+  without paying for a task per message.
+
+`wait_for` is inherited from the generic `Clock` base: it races the
+awaitable against `self.sleep(timeout)`, so timeouts fire in virtual
+time too (a lease-acquire timeout set to 30 s expires after 30 *virtual*
+seconds, instantly in wall time).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+from typing import Callable
+
+from ..clock import Clock
+
+
+class VirtualClock(Clock):
+    def __init__(self, start: float = 1_700_000_000.0, settle_idle_rounds: int = 25):
+        # an epoch-plausible start keeps time.time()-shaped consumers
+        # (digest "ts" fields, journal timestamps) in a familiar range
+        self._now = float(start)
+        self._seq = 0
+        # heap of (deadline, seq, future-or-callback)
+        self._timers: list[tuple[float, int, object]] = []
+        # settle() returns after this many consecutive loop passes during
+        # which no new timer was registered: passes where nothing is ready
+        # cost ~µs, so the threshold buys safety for deep await chains
+        # (lock → handler → send → …) without a per-batch tax that scales
+        # with fleet size
+        self.settle_idle_rounds = settle_idle_rounds
+
+    # ------------------------------------------------------------ Clock API
+
+    def time(self) -> float:
+        return self._now
+
+    def monotonic(self) -> float:
+        return self._now
+
+    async def sleep(self, delay: float) -> None:
+        if delay is None or delay <= 0:
+            await asyncio.sleep(0)
+            return
+        fut = asyncio.get_running_loop().create_future()
+        self._push(self._now + float(delay), fut)
+        await fut
+
+    # ------------------------------------------------------------ scheduling
+
+    def _push(self, deadline: float, item: object) -> None:
+        self._seq += 1
+        heapq.heappush(self._timers, (deadline, self._seq, item))
+
+    def call_at(self, when: float, fn: Callable[[], None]) -> None:
+        """Run `fn` when virtual time reaches `when` (synchronously, in
+        timer order). For plain-function effects like frame delivery —
+        no task, no future."""
+        self._push(max(when, self._now), fn)
+
+    def pending_timers(self) -> int:
+        return len(self._timers)
+
+    def next_deadline(self) -> float | None:
+        return self._timers[0][0] if self._timers else None
+
+    # ------------------------------------------------------------ advancing
+
+    async def settle(self) -> None:
+        """Yield to the event loop until it quiesces: every runnable task
+        has run to its next timer-wait (or completion) and no new timers
+        appeared for `settle_idle_rounds` consecutive passes."""
+        idle = 0
+        while idle < self.settle_idle_rounds:
+            before = self._seq
+            await asyncio.sleep(0)
+            idle = idle + 1 if self._seq == before else 0
+
+    async def run_for(self, duration: float) -> None:
+        """Advance virtual time by `duration` seconds, firing every timer
+        that falls due, in (deadline, registration-order) order."""
+        target = self._now + float(duration)
+        await self.settle()
+        while self._timers and self._timers[0][0] <= target:
+            deadline = self._timers[0][0]
+            if deadline > self._now:
+                self._now = deadline
+            fired = False
+            while self._timers and self._timers[0][0] <= self._now:
+                _, _, item = heapq.heappop(self._timers)
+                if isinstance(item, asyncio.Future):
+                    if not item.done():  # skip cancelled sleepers
+                        item.set_result(None)
+                        fired = True
+                else:
+                    item()  # delivery callback
+                    fired = True
+            if fired:
+                await self.settle()
+        self._now = target
+        await self.settle()

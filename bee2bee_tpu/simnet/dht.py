@@ -1,0 +1,105 @@
+"""KademliaModel: a pure-data Kademlia routing model for scaling claims.
+
+The mesh's shipped DHT (`bee2bee_tpu/dht.py`) is either an in-memory
+dict (no routing at all) or the external `kademlia` package (real UDP —
+unusable for a deterministic 500-peer depth measurement). This model
+implements just the routing math — 160-bit XOR metric, k-buckets,
+iterative α-parallel lookup — over seeded ids, so the sim can answer
+"how many hops does a lookup take at N peers?" with exact, replayable
+numbers. Expected depth is O(log₂ N/k)-ish; the regression test pins
+the measured depth envelope so a routing-table regression (or a future
+real implementation that diverges from Kademlia's contract) shows up as
+a failed assertion instead of a production latency cliff.
+
+No wire, no clock: one lookup round = one hop. Determinism comes from
+`random.Random(seed)` ids and sorted candidate selection.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+
+ID_BITS = 160
+
+
+def _node_id(rng: random.Random) -> int:
+    # hash a seeded draw so ids spread uniformly over the full space
+    # regardless of the rng's internal structure
+    return int.from_bytes(
+        hashlib.sha1(rng.getrandbits(64).to_bytes(8, "big")).digest(), "big"
+    )
+
+
+class KademliaModel:
+    def __init__(self, n_peers: int, seed: int = 0, k: int = 20, alpha: int = 3):
+        self.k = k
+        self.alpha = alpha
+        rng = random.Random(seed)
+        self.rng = rng
+        ids = set()
+        while len(ids) < n_peers:
+            ids.add(_node_id(rng))
+        self.peers = sorted(ids)
+        #: peer id -> routing table: bucket index -> [peer ids], k-capped.
+        #: Build order is seeded (shuffled join order), so which of the
+        #: >k candidates make it into a full bucket is replay-stable.
+        self.tables: dict[int, dict[int, list[int]]] = {p: {} for p in self.peers}
+        join_order = list(self.peers)
+        rng.shuffle(join_order)
+        for i, p in enumerate(join_order):
+            # a joining peer and the existing network learn of each other
+            for q in join_order[:i]:
+                self._insert(p, q)
+                self._insert(q, p)
+
+    @staticmethod
+    def bucket_index(a: int, b: int) -> int:
+        return (a ^ b).bit_length() - 1  # -1 never queried (a != b)
+
+    def _insert(self, owner: int, other: int) -> None:
+        if owner == other:
+            return
+        bucket = self.tables[owner].setdefault(self.bucket_index(owner, other), [])
+        if other not in bucket and len(bucket) < self.k:
+            bucket.append(other)
+
+    def closest_known(self, owner: int, target: int, limit: int) -> list[int]:
+        known = [q for b in self.tables[owner].values() for q in b]
+        known.sort(key=lambda q: q ^ target)
+        return known[:limit]
+
+    def lookup_depth(self, origin: int, target: int, max_hops: int = 64) -> int:
+        """Iterative FIND_NODE: query the α closest unqueried candidates
+        each round until the k-closest set stops improving. Returns the
+        number of rounds (hops) — the latency-determining figure."""
+        shortlist = self.closest_known(origin, target, self.k)
+        queried: set[int] = set()
+        hops = 0
+        while hops < max_hops:
+            batch = [q for q in shortlist if q not in queried][: self.alpha]
+            if not batch:
+                break
+            hops += 1
+            queried.update(batch)
+            improved = False
+            merged = set(shortlist)
+            for q in batch:
+                merged.update(self.closest_known(q, target, self.k))
+            new_shortlist = sorted(merged, key=lambda q: q ^ target)[: self.k]
+            if new_shortlist != shortlist:
+                improved = True
+            shortlist = new_shortlist
+            if not improved:
+                break
+        return hops
+
+    def sample_depths(self, lookups: int = 50) -> list[int]:
+        """Seeded (origin, random-target) lookup depths — the sim's DHT
+        scaling measurement."""
+        out = []
+        for _ in range(lookups):
+            origin = self.peers[self.rng.randrange(len(self.peers))]
+            target = _node_id(self.rng)
+            out.append(self.lookup_depth(origin, target))
+        return out

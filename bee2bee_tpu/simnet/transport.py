@@ -1,0 +1,372 @@
+"""SimNet: the in-process virtual network behind the Transport seam.
+
+One `SimNet` is one universe: a listener table keyed (host, port), a
+seeded `random.Random` that decides every latency jitter and loss roll,
+per-link latency/loss profiles, partitionable regions, and the event
+trace. Each node gets a `SimTransport` bound to its own virtual host
+("10.0.x.y") so `P2PNode.addr` resolves without touching the real
+network stack.
+
+Determinism contract (docs/SIMULATION.md):
+
+- All delivery happens on `VirtualClock.call_at` timers, never directly:
+  even a zero-latency universe orders frames by (deadline, registration
+  seq), which the single-threaded loop replays identically.
+- Per-connection FIFO is preserved (`delivery_t = max(prev_t, …)`) —
+  a websocket is an ordered stream and the real mesh never sees
+  intra-link reorder. *Cross*-link reorder emerges from jitter, which is
+  the reorder that actually happens in production.
+- Delivery times quantize UP to a coarse grid (`quantum_s`) so the
+  thousands of frames of a ping tick land in a handful of timer batches
+  instead of thousands — the difference between a 200-node tick costing
+  milliseconds and costing minutes — while the (deadline, seq) order
+  stays seed-deterministic.
+- The RNG is consumed in scheduling order only, so a replay draws the
+  identical stream.
+
+Partitions black-hole frames (TCP stalls, it doesn't RST) and refuse
+new dials; `heal()` restores both. Loss drops individual frames. Both
+are recorded in the trace (`drop` / `part` events) so a chaos run's
+story is auditable.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+import re
+from collections import deque
+from dataclasses import dataclass
+
+from .. import wscompat
+from ..transport import Transport
+from .clock import VirtualClock
+
+#: protocol.msg puts "type" first and protocol.encode is plain
+#: json.dumps, so the op name sits in the frame's first few bytes
+_OP_RE = re.compile(r'"type":\s*"([a-z_]+)"')
+
+
+def frame_op(raw: str | bytes) -> str:
+    if isinstance(raw, bytes):
+        head = raw[:120].decode("utf-8", "replace")
+    else:
+        head = raw[:120]
+    m = _OP_RE.search(head)
+    return m.group(1) if m else "?"
+
+
+@dataclass
+class LinkProfile:
+    """Delivery model for one directed link (or a default for all).
+
+    Keep `jitter_s` a few multiples of the net's `quantum_s`: jitter is
+    what lets the seed pick *which* delivery batch a frame lands in —
+    jitter smaller than one quantum rounds away entirely and every seed
+    replays the same schedule."""
+
+    latency_s: float = 0.002
+    jitter_s: float = 0.012
+    loss: float = 0.0
+
+
+class SimNet:
+    def __init__(
+        self,
+        clock: VirtualClock,
+        seed: int = 0,
+        default_profile: LinkProfile | None = None,
+        quantum_s: float = 0.005,
+        trace_enabled: bool = True,
+    ):
+        self.clock = clock
+        self.rng = random.Random(seed)
+        self.seed = seed
+        self.quantum_s = quantum_s
+        self.default_profile = default_profile or LinkProfile()
+        self.trace_enabled = trace_enabled
+        self._listeners: dict[tuple[str, int], SimServer] = {}
+        #: (src_host, dst_host) -> LinkProfile overrides
+        self.links: dict[tuple[str, str], LinkProfile] = {}
+        #: host -> region name ("default" unless assigned)
+        self.regions: dict[str, str] = {}
+        #: blocked region pairs (frozenset of two names)
+        self._partitions: set[frozenset] = set()
+        #: (t, kind, src_host, dst_host, op, size) — the replay-compared
+        #: event record. kinds: dial / frame / drop / part / close
+        self.trace: list[tuple] = []
+        self.frames_delivered = 0
+        self.frames_dropped = 0
+
+    # ------------------------------------------------------------ topology
+
+    def set_region(self, host: str, region: str) -> None:
+        self.regions[host] = region
+
+    def set_link(self, src_host: str, dst_host: str, profile: LinkProfile) -> None:
+        self.links[(src_host, dst_host)] = profile
+
+    def partition(self, region_a: str, region_b: str) -> None:
+        self._partitions.add(frozenset((region_a, region_b)))
+
+    def heal(self, region_a: str | None = None, region_b: str | None = None) -> None:
+        if region_a is None:
+            self._partitions.clear()
+        else:
+            self._partitions.discard(frozenset((region_a, region_b)))
+
+    def partitioned(self, src_host: str, dst_host: str) -> bool:
+        if not self._partitions:
+            return False
+        a = self.regions.get(src_host, "default")
+        b = self.regions.get(dst_host, "default")
+        return frozenset((a, b)) in self._partitions
+
+    def profile(self, src_host: str, dst_host: str) -> LinkProfile:
+        return self.links.get((src_host, dst_host), self.default_profile)
+
+    # ------------------------------------------------------------ plumbing
+
+    def transport(self, host: str) -> "SimTransport":
+        """The per-node Transport: binds every serve/dial to `host` so
+        links know their endpoints."""
+        return SimTransport(self, host)
+
+    def record(self, kind: str, src: str, dst: str, op: str = "", size: int = 0):
+        if self.trace_enabled:
+            self.trace.append(
+                (round(self.clock.time(), 6), kind, src, dst, op, size)
+            )
+
+    def _delivery_time(self, conn: "SimConn", size: int) -> float | None:
+        """Schedule one frame on `conn`: returns the virtual delivery
+        time, or None when the frame is lost/partitioned. Consumes the
+        RNG in scheduling order — part of the determinism contract."""
+        prof = self.profile(conn.src_host, conn.dst_host)
+        jitter = self.rng.random() * prof.jitter_s
+        lost = prof.loss > 0 and self.rng.random() < prof.loss
+        if self.partitioned(conn.src_host, conn.dst_host):
+            self.record("part", conn.src_host, conn.dst_host, size=size)
+            self.frames_dropped += 1
+            return None
+        if lost:
+            self.record("drop", conn.src_host, conn.dst_host, size=size)
+            self.frames_dropped += 1
+            return None
+        t = self.clock.time() + prof.latency_s + jitter
+        # quantize UP so batches share deadlines; FIFO via prev-time clamp
+        q = self.quantum_s
+        if q > 0:
+            t = math.ceil(t / q) * q
+        return max(t, conn.last_delivery_t)
+
+    # ------------------------------------------------------------ dial/serve
+
+    def open(self, src_host: str, dst_host: str, dst_port: int,
+             max_size: int | None) -> "SimConn":
+        server = self._listeners.get((dst_host, dst_port))
+        if server is None or server.closed:
+            raise OSError(f"sim: connection refused {dst_host}:{dst_port}")
+        if self.partitioned(src_host, dst_host):
+            raise OSError(f"sim: unreachable {src_host} -> {dst_host} (partition)")
+        client = SimConn(self, src_host, dst_host, max_size)
+        remote = SimConn(self, dst_host, src_host, server.max_size)
+        client.peer = remote
+        remote.peer = client
+        self.record("dial", src_host, dst_host)
+        server.accept(remote)
+        return client
+
+    def listen(self, host: str, port: int, handler, max_size: int | None) -> "SimServer":
+        key = (host, port)
+        if key in self._listeners and not self._listeners[key].closed:
+            raise OSError(f"sim: address in use {host}:{port}")
+        server = SimServer(self, host, port, handler, max_size)
+        self._listeners[key] = server
+        return server
+
+
+class _SimSocket:
+    """Just enough socket for `server.sockets[0].getsockname()`."""
+
+    def __init__(self, host: str, port: int):
+        self._addr = (host, port)
+
+    def getsockname(self):
+        return self._addr
+
+
+class SimServer:
+    def __init__(self, net: SimNet, host: str, port: int, handler,
+                 max_size: int | None):
+        import asyncio
+
+        self._asyncio = asyncio
+        self.net = net
+        self.host = host
+        self.port = port
+        self.handler = handler
+        self.max_size = max_size
+        self.closed = False
+        self.sockets = [_SimSocket(host, port)]
+        self.conns: list[SimConn] = []
+        self._tasks: list = []
+
+    def accept(self, conn: "SimConn") -> None:
+        self.conns.append(conn)
+        task = self._asyncio.get_running_loop().create_task(self._run(conn))
+        self._tasks.append(task)
+        task.add_done_callback(self._tasks.remove)
+
+    async def _run(self, conn: "SimConn") -> None:
+        try:
+            await self.handler(conn)
+        finally:
+            conn.abort()
+
+    def close(self) -> None:
+        """wscompat contract: kills the listener AND established conns."""
+        self.closed = True
+        self.net._listeners.pop((self.host, self.port), None)
+        for conn in list(self.conns):
+            conn.abort()
+
+    async def wait_closed(self) -> None:
+        tasks = list(self._tasks)
+        if tasks:
+            await self._asyncio.gather(*tasks, return_exceptions=True)
+
+
+class SimConn:
+    """One direction-pair endpoint. Mirrors the wscompat/websockets slice
+    the mesh uses: send/recv/close, async iteration ending on any close,
+    `wscompat.exceptions.ConnectionClosed` on dead-peer operations."""
+
+    def __init__(self, net: SimNet, src_host: str, dst_host: str,
+                 max_size: int | None):
+        import asyncio
+
+        self._asyncio = asyncio
+        self.net = net
+        self.src_host = src_host
+        self.dst_host = dst_host
+        self.max_size = max_size
+        self.peer: SimConn | None = None
+        self.closed = False  # local end: send() refused
+        self.recv_closed = False  # remote FIN delivered: recv() drains then raises
+        self.last_delivery_t = 0.0  # FIFO clamp for frames *we* send
+        self._queue: deque = deque()
+        self._waiter = None
+
+    # ---------------------------------------------------------------- send
+
+    async def send(self, data: str | bytes) -> None:
+        if self.closed or self.peer is None:
+            raise wscompat.ConnectionClosedError("sim connection is closed")
+        size = len(data) if isinstance(data, bytes) else len(data.encode("utf-8"))
+        if self.peer.max_size and size > self.peer.max_size:
+            raise wscompat.ConnectionClosedError(
+                f"sim frame of {size} bytes exceeds max_size"
+            )
+        t = self.net._delivery_time(self, size)
+        if t is None:
+            return  # lost or partitioned: the bytes just never arrive
+        self.last_delivery_t = t
+        peer = self.peer
+        op = frame_op(data)
+        src, dst = self.src_host, self.dst_host
+
+        def deliver(data=data, op=op, size=size):
+            if peer.recv_closed:
+                return  # arrived after the receiver died
+            self.net.record("frame", src, dst, op, size)
+            self.net.frames_delivered += 1
+            peer._queue.append(data)
+            peer._wake()
+
+        self.net.clock.call_at(t, deliver)
+
+    # ---------------------------------------------------------------- recv
+
+    def _wake(self) -> None:
+        if self._waiter is not None and not self._waiter.done():
+            self._waiter.set_result(None)
+
+    async def recv(self) -> str | bytes:
+        while True:
+            if self._queue:
+                return self._queue.popleft()
+            if self.recv_closed:
+                raise wscompat.ConnectionClosed("sim connection closed")
+            self._waiter = self._asyncio.get_running_loop().create_future()
+            try:
+                await self._waiter
+            finally:
+                self._waiter = None
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        try:
+            return await self.recv()
+        except wscompat.ConnectionClosed:
+            raise StopAsyncIteration
+
+    # ---------------------------------------------------------------- close
+
+    async def close(self) -> None:
+        """Graceful close: stop sending now; the peer sees EOF after the
+        frames already in flight (FIFO with data, like a real FIN)."""
+        if self.closed:
+            return
+        self.closed = True
+        self.net.record("close", self.src_host, self.dst_host)
+        peer = self.peer
+        if peer is None or peer.recv_closed:
+            return
+        t = max(self.net.clock.time(), self.last_delivery_t)
+
+        def fin():
+            peer.recv_closed = True
+            peer.closed = True
+            peer._wake()
+
+        self.net.clock.call_at(t, fin)
+
+    def abort(self) -> None:
+        """Hard kill both directions immediately (server shutdown, chaos
+        hard_kill): queued frames still drain, nothing new arrives."""
+        self.closed = True
+        self.recv_closed = True
+        self._wake()
+        if self.peer is not None and not self.peer.recv_closed:
+            self.peer.closed = True
+            self.peer.recv_closed = True
+            self.peer._wake()
+
+
+class SimTransport(Transport):
+    """The Transport seam's sim backend: one per node, bound to the
+    node's virtual host. Reuses wscompat's exception family so the
+    mesh's except clauses need no sim-awareness."""
+
+    name = "sim"
+    exceptions = wscompat.exceptions
+
+    def __init__(self, net: SimNet, host: str):
+        self.net = net
+        self.host = host
+
+    async def dial(self, addr: str, *, max_size: int | None = None,
+                   open_timeout: float = 10):
+        m = re.match(r"wss?://([^:/]+):(\d+)", addr)
+        if not m:
+            raise OSError(f"sim: bad address {addr!r}")
+        return self.net.open(self.host, m.group(1), int(m.group(2)), max_size)
+
+    async def serve(self, handler, host: str, port: int, *,
+                    max_size: int | None = None):
+        # nodes bind "0.0.0.0"; the universe knows us by our virtual host
+        bind = self.host if host in ("0.0.0.0", "::", "localhost") else host
+        return self.net.listen(bind, port, handler, max_size)

@@ -797,6 +797,155 @@ def bench_fleet_elastic(duration_s: float = 24.0, tail_s: float = 12.0,
     return out
 
 
+def bench_fleet_sim(sizes=(10, 50, 200), seed: int = 0,
+                    delta_n: int = 50, delta_ticks: int = 6) -> dict:
+    """Deterministic fleet-sim rung (ISSUE 17 acceptance): N P2PNode
+    control planes on one loop over the simnet virtual transport/clock —
+    gossip convergence and router decision quality at N ∈ {10, 50, 200},
+    plus the delta-gossip scaling fix measured before/after by toggling
+    ``gossip_delta_enabled`` on the same seeded 50-node fleet.
+
+    Per size: bootstrap cost (virtual AND wall — wall is the python work,
+    the scaling-fix regression surface), ticks to full (observer,
+    subject) digest coverage, and the scored-routing fraction — for every
+    node, the share of its remote candidates the router can score from
+    fresh digests when asked to pick (1.0 = every decision is
+    telemetry-informed, the fleet claim). Model-free, wire-free,
+    platform-independent; virtual time costs nothing, so the numbers are
+    replay-stable modulo host speed. Standalone:
+    ``python bench.py fleet_sim``."""
+    import asyncio
+    import statistics as _stats
+
+    from bee2bee_tpu.metrics import get_registry
+    from bee2bee_tpu.simnet import FleetSim
+
+    def _scored_fraction(sim) -> dict:
+        """Router decision quality: fraction of remote candidates with a
+        fresh digest at pick time, plus whether a real pick() runs in
+        scored mode fleet-wide."""
+        fracs = []
+        scored_mode = 0
+        for node in sim.alive():
+            cands = [
+                {
+                    "provider_id": pid,
+                    "price_per_token": 0.0,
+                    "_latency": info.get("rtt_ms"),
+                    "local": False,
+                }
+                for pid, info in node.peers.items()
+            ]
+            if not cands:
+                continue
+            fresh = node.health.fresh()
+            fracs.append(
+                sum(1 for c in cands if c["provider_id"] in fresh) / len(cands)
+            )
+            winner, decision = node.router.pick(cands, fresh)
+            if winner is not None and decision.get("mode") == "scored":
+                scored_mode += 1
+        return {
+            "mean": round(_stats.mean(fracs), 4) if fracs else 0.0,
+            "min": round(min(fracs), 4) if fracs else 0.0,
+            "picks_scored": scored_mode,
+        }
+
+    async def measure_size(n: int) -> dict:
+        sim = FleetSim(n, seed=seed, trace_enabled=False)
+        t_wall = time.time()
+        try:
+            await sim.start()
+            boot_wall = time.time() - t_wall
+            boot_virtual = sim.clock.time() - 1_700_000_000.0
+            ticks = 0
+            while sim.gossip_coverage() < 1.0 and ticks < 10:
+                await sim.run_for(sim.ping_interval_s)
+                ticks += 1
+            return {
+                "n": n,
+                "bootstrap_wall_s": round(boot_wall, 3),
+                "bootstrap_virtual_s": round(boot_virtual, 3),
+                "converge_ticks": ticks,
+                "gossip_coverage": round(sim.gossip_coverage(), 4),
+                "routing": _scored_fraction(sim),
+                "wall_s": round(time.time() - t_wall, 3),
+            }
+        finally:
+            await sim.stop()
+
+    async def measure_delta(enabled: bool) -> dict:
+        sim = FleetSim(delta_n, seed=seed, trace_enabled=False)
+        t_wall = time.time()
+        try:
+            await sim.start()
+            for node in sim.nodes:
+                node.gossip_delta_enabled = enabled
+            await sim.run_for(delta_ticks * sim.ping_interval_s)
+            reg = get_registry()
+            return {
+                "delta_enabled": enabled,
+                "telemetry_frames": int(
+                    reg.counter("mesh.frames_sent", "frames sent by op")
+                    .value(op="telemetry")
+                ),
+                "telemetry_bytes": int(
+                    reg.counter("mesh.bytes_sent", "payload bytes sent by op")
+                    .value(op="telemetry")
+                ),
+                "suppressed": int(
+                    reg.counter(
+                        "mesh.gossip_suppressed",
+                        "telemetry broadcasts skipped by delta suppression",
+                    ).total()
+                ),
+                "wall_s": round(time.time() - t_wall, 3),
+            }
+        finally:
+            await sim.stop()
+
+    async def run() -> dict:
+        out: dict = {"seed": seed, "sizes": {}}
+        for n in sizes:
+            out["sizes"][str(n)] = await measure_size(n)
+        # the scaling-fix before/after: same fleet, same seed, delta
+        # suppression off vs on — frames/bytes on the wire per 6 ticks
+        off = await measure_delta(False)
+        on = await measure_delta(True)
+        ratio = (
+            round(off["telemetry_frames"] / on["telemetry_frames"], 2)
+            if on["telemetry_frames"] else None
+        )
+        out["delta_gossip"] = {
+            "n": delta_n, "ticks": delta_ticks,
+            "off": off, "on": on, "frames_ratio_off_over_on": ratio,
+        }
+        return out
+
+    out = asyncio.run(run())
+    # the PR 6 platform stamp — model-free, but the artifact still says
+    # what machine produced the numbers
+    try:
+        import jax
+
+        out["platform"] = jax.devices()[0].platform
+    except Exception:  # noqa: BLE001 — standalone runs skip the probe
+        out["platform"] = "unknown"
+    biggest = out["sizes"][str(max(sizes))]
+    dg = out["delta_gossip"]
+    log(
+        f"fleet_sim rung: {biggest['n']} nodes bootstrap "
+        f"{biggest['bootstrap_wall_s']}s wall / "
+        f"{biggest['bootstrap_virtual_s']}s virtual, converged in "
+        f"{biggest['converge_ticks']} tick(s), scored-routing "
+        f"{biggest['routing']['mean']}; delta-gossip "
+        f"{dg['off']['telemetry_frames']}→{dg['on']['telemetry_frames']} "
+        f"telemetry frames over {dg['ticks']} ticks at n={dg['n']} "
+        f"({dg['frames_ratio_off_over_on']}x)"
+    )
+    return out
+
+
 def bench_migration(duration_tokens: int = 96, n_streams: int = 3) -> dict:
     """Live-migration rung (ISSUE 9 acceptance): a 3-node loopback mesh
     under concurrent streaming load; node A drains mid-decode and the
@@ -1703,6 +1852,15 @@ def main() -> None:
         log(f"fleet_elastic rung failed: {e}")
         extras["fleet_elastic"] = {"error": str(e)}
 
+    # deterministic fleet-sim rung (ISSUE 17 acceptance: gossip
+    # convergence + scored-routing fraction at 10/50/200 virtual nodes,
+    # delta-gossip before/after) — model-free, virtual transport/clock
+    try:
+        extras["fleet_sim"] = bench_fleet_sim()
+    except Exception as e:  # noqa: BLE001 — the rung must not kill the bench
+        log(f"fleet_sim rung failed: {e}")
+        extras["fleet_sim"] = {"error": str(e)}
+
     # live-migration rung (ISSUE 9 acceptance: drain pause for KV resume
     # vs re-prefill failover on a 3-node loopback mesh under load; the
     # happy path must show zero re-prefills). tiny-model, any platform —
@@ -1844,6 +2002,11 @@ if __name__ == "__main__":
     # standalone (model-free loopback fleet — no accelerator probe)
     if len(sys.argv) > 1 and sys.argv[1] == "fleet_elastic":
         print(json.dumps(bench_fleet_elastic()), flush=True)
+        sys.exit(0)
+    # `python bench.py fleet_sim`: the deterministic fleet-sim rung
+    # standalone (virtual transport + clock — no accelerator probe)
+    if len(sys.argv) > 1 and sys.argv[1] == "fleet_sim":
+        print(json.dumps(bench_fleet_sim()), flush=True)
         sys.exit(0)
     # `python bench.py migration`: the live-migration drain rung standalone
     # (tiny random-init model — runs on whatever backend jax resolves)

@@ -244,11 +244,11 @@ async def good(self, addr):
     async with self._lock:
         targets = list(self.peers)
     ws = await websockets.connect(addr, open_timeout=10)
-    await asyncio.sleep(0.1)
+    await self.clock.sleep(0.1)  # the clock seam — also ML-C001-clean
 
     def offloaded():
         import time
-        time.sleep(1)  # runs in an executor thread, not the loop
+        time.sleep(1)  # meshlint: ignore[ML-C001] -- real wall wait in an executor thread
 
     await asyncio.get_running_loop().run_in_executor(None, offloaded)
 '''
@@ -702,3 +702,78 @@ def test_telemetry_pass_scans_whole_package():
 
 def test_telemetry_rule_in_catalog():
     assert "ML-T001" in rule_catalog()
+
+
+# --------------------------------------------------- clock-seam pass fixtures
+
+
+def test_clock_pass_known_bad_fixture():
+    """ML-C001: every direct wall-clock read and bare asyncio timer in a
+    clock-seamed package is a finding — each one silently re-couples a
+    code path to the host clock and breaks deterministic simulation."""
+    src = '''
+import asyncio
+import time
+
+async def tick(self):
+    start = time.time()
+    mono = time.monotonic()
+    perf = time.perf_counter()
+    await asyncio.sleep(1.0)
+    await asyncio.wait_for(self.q.get(), timeout=2.0)
+    time.sleep(0.1)
+'''
+    rules = _rules(analyze_source(src, "meshnet/fixture.py"))
+    assert rules.count("ML-C001") == 6, rules
+
+
+def test_clock_pass_seam_calls_are_clean():
+    """The seam itself — clock.time()/sleep()/wait_for(), however the
+    clock is reached — never matches the bare-module names."""
+    src = '''
+from ..clock import get_clock
+
+async def tick(self):
+    now = self.clock.time()
+    await self.clock.sleep(1.0)
+    await self.clock.wait_for(self.q.get(), timeout=2.0)
+    mono = get_clock().monotonic()
+'''
+    assert analyze_source(src, "meshnet/fixture.py") == []
+
+
+def test_clock_pass_scope_covers_all_seamed_packages():
+    from bee2bee_tpu.analysis.clockseam import ClockSeamPass
+
+    p = ClockSeamPass()
+    for path in ("meshnet/node.py", "fleet/controller.py",
+                 "router/policy.py", "health.py"):
+        assert p.applies(path), path
+    # unseamed packages keep their wall clocks without findings
+    for path in ("engine/scheduler.py", "services/base.py", "bench.py",
+                 "simnet/clock.py"):
+        assert not p.applies(path), path
+    src = "import time\n\ndef f():\n    return time.time()\n"
+    assert analyze_source(src, "engine/fixture.py") == []
+
+
+def test_clock_pass_suppression_and_real_exemptions():
+    """A justified same-line ignore suppresses the finding; the shipped
+    exemptions (NAT round trips in runtime.py, thread joins in
+    health.py) carry one, so the ratchet baseline stays EMPTY."""
+    src = '''
+import time
+
+def deadline(timeout_s):
+    return time.time() + timeout_s  # meshlint: ignore[ML-C001] -- real thread-join deadline
+'''
+    assert analyze_source(src, "health.py") == []
+    runtime_py = PACKAGE_ROOT / "meshnet" / "runtime.py"
+    health_py = PACKAGE_ROOT / "health.py"
+    assert "ignore[ML-C001]" in runtime_py.read_text()
+    assert "ignore[ML-C001]" in health_py.read_text()
+    assert analyze_paths([runtime_py, health_py]) == []
+
+
+def test_clock_rule_in_catalog():
+    assert "ML-C001" in rule_catalog()

@@ -1,0 +1,326 @@
+"""Deterministic fleet-scale simulation (bee2bee_tpu/simnet/).
+
+These are the sim-backed REGRESSION tests for the fleet claims: every
+scenario runs hundreds of FakeService-backed P2PNode control planes on
+one loop in VIRTUAL time (the wall cost is only the python work), and
+the determinism contract — same seed ⇒ bit-identical event trace and
+fleet decision journal — is itself a pinned test, not a comment.
+
+Scale notes: the 200-node replay pair is the single most expensive test
+in the file (~2 × (bootstrap + 3 gossip ticks)); everything else rides
+smaller fleets. All timeouts are wall-clock caps via the conftest
+``async_timeout`` marker — virtual time inside is unbounded-cheap.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import statistics
+
+import pytest
+
+from bee2bee_tpu.simnet import (
+    FleetSim,
+    KademliaModel,
+    LinkProfile,
+    SimNet,
+    VirtualClock,
+)
+
+pytestmark = pytest.mark.filterwarnings("ignore::RuntimeWarning")
+
+
+# ------------------------------------------------------------ virtual clock
+
+
+async def test_virtual_clock_orders_sleepers_and_costs_no_wall_time():
+    clock = VirtualClock()
+    t0 = clock.time()
+    order: list[str] = []
+
+    async def napper(tag: str, delay: float):
+        await clock.sleep(delay)
+        order.append(tag)
+
+    tasks = [
+        asyncio.ensure_future(napper("c", 0.3)),
+        asyncio.ensure_future(napper("a", 0.1)),
+        asyncio.ensure_future(napper("b", 0.2)),
+    ]
+    await clock.run_for(1.0)
+    assert order == ["a", "b", "c"]
+    assert clock.time() == pytest.approx(t0 + 1.0)
+    for t in tasks:
+        assert t.done()
+
+
+async def test_virtual_clock_call_at_fires_in_deadline_order():
+    clock = VirtualClock()
+    fired: list[int] = []
+    now = clock.time()
+    clock.call_at(now + 0.2, lambda: fired.append(2))
+    clock.call_at(now + 0.1, lambda: fired.append(1))
+    clock.call_at(now + 0.1, lambda: fired.append(11))  # FIFO within a tick
+    await clock.run_for(0.5)
+    assert fired == [1, 11, 2]
+
+
+# ------------------------------------------------------------ sim transport
+
+
+async def test_sim_transport_echo_roundtrip():
+    clock = VirtualClock()
+    net = SimNet(clock, seed=0)
+
+    async def handler(ws):
+        async for m in ws:
+            await ws.send(f"echo:{m}")
+
+    t_srv = net.transport("10.0.0.1")
+    server = await t_srv.serve(handler, "0.0.0.0", 9000)
+    t_cli = net.transport("10.0.0.2")
+    ws = await t_cli.dial("ws://10.0.0.1:9000")
+    fut = asyncio.ensure_future(ws.recv())
+    await ws.send('{"type": "ping"}')
+    await clock.run_for(1.0)
+    assert fut.result() == 'echo:{"type": "ping"}'
+    await ws.close()
+    server.close()
+    await clock.run_for(1.0)
+
+
+async def test_sim_transport_partition_refuses_dials_and_drops_frames():
+    clock = VirtualClock()
+    net = SimNet(clock, seed=0)
+    net.set_region("10.0.0.1", "east")
+    net.set_region("10.0.0.2", "west")
+
+    async def handler(ws):
+        async for _ in ws:
+            pass
+
+    await net.transport("10.0.0.1").serve(handler, "0.0.0.0", 9000)
+    cli = net.transport("10.0.0.2")
+    ws = await cli.dial("ws://10.0.0.1:9000")  # pre-partition: fine
+    net.partition("east", "west")
+    await ws.send("lost")  # black-holed, not an error
+    await clock.run_for(1.0)
+    with pytest.raises(OSError):
+        await cli.dial("ws://10.0.0.1:9000")
+    kinds = {e[1] for e in net.trace}
+    assert "part" in kinds or "drop" in kinds
+    net.heal()
+    ws2 = await cli.dial("ws://10.0.0.1:9000")
+    assert ws2 is not None
+
+
+# -------------------------------------------------------- determinism contract
+
+
+def _fingerprints(trace_fp: str, journal_fp: str) -> tuple[str, str]:
+    return trace_fp, journal_fp
+
+
+async def _replay_run(n: int, seed: int, virtual_s: float) -> tuple[str, str, int]:
+    sim = FleetSim(n, seed=seed)
+    try:
+        await sim.start()
+        await sim.run_for(virtual_s)
+        journals = sim.journals()
+        assert journals, "no controller journal — the comparison would be vacuous"
+        assert any(journals.values()), "controller never decided anything"
+        return sim.trace_fingerprint(), sim.journal_fingerprint(), len(sim.net.trace)
+    finally:
+        await sim.stop()
+
+
+@pytest.mark.async_timeout(420)
+async def test_same_seed_200_node_replay_is_bit_identical():
+    """THE determinism contract at fleet scale: two runs of the same
+    200-node scenario with the same seed produce byte-identical event
+    traces AND byte-identical /fleet decision journals. Any wall-clock
+    leak, thread race, or unseeded draw in the control plane breaks
+    this equality."""
+    # 4.5 virtual s: past the lease-lapse claim point (~4 ticks), so the
+    # journal comparison is non-vacuous
+    t1, j1, n1 = await _replay_run(200, seed=7, virtual_s=4.5)
+    t2, j2, n2 = await _replay_run(200, seed=7, virtual_s=4.5)
+    assert n1 > 1000, f"trace suspiciously small ({n1} events)"
+    assert t1 == t2, "same-seed event traces diverged"
+    assert j1 == j2, "same-seed fleet decision journals diverged"
+
+
+@pytest.mark.async_timeout(120)
+async def test_different_seeds_produce_different_schedules():
+    """The seed must actually matter: jitter draws reorder deliveries."""
+    t1, _, _ = await _replay_run(20, seed=1, virtual_s=5.0)
+    t2, _, _ = await _replay_run(20, seed=2, virtual_s=5.0)
+    assert t1 != t2, "seed had no observable effect on the schedule"
+
+
+# ------------------------------------------------------------ fleet claims
+
+
+@pytest.mark.async_timeout(180)
+async def test_gossip_convergence_within_tick_budget_as_n_grows():
+    """Telemetry gossip must reach full (observer, subject) coverage in
+    a bounded number of ticks regardless of N — the claim that lets the
+    router trust its digests fleet-wide. Regression surface for the
+    delta-gossip/digest-fanout path."""
+    budgets = {}
+    for n in (10, 30):
+        sim = FleetSim(n, seed=3)
+        try:
+            await sim.start()
+            t0 = sim.clock.time()
+            ticks = 0
+            while sim.gossip_coverage() < 1.0 and ticks < 8:
+                await sim.run_for(sim.ping_interval_s)
+                ticks += 1
+            assert sim.gossip_coverage() == 1.0, (
+                f"gossip never converged at n={n}: "
+                f"coverage={sim.gossip_coverage():.3f} after {ticks} ticks"
+            )
+            budgets[n] = sim.clock.time() - t0
+        finally:
+            await sim.stop()
+    # the budget is ticks, not node count: 3x the fleet must not need 3x
+    # the ticks (full mesh: every digest is one hop + relay freshness)
+    assert budgets[30] <= budgets[10] + 2 * 1.0, budgets
+
+
+@pytest.mark.async_timeout(240)
+async def test_controller_survives_half_fleet_churn_with_zero_dropped_generations():
+    """Kill 50% of a 24-node fleet while generations are in flight on
+    the survivors: every in-flight generation on a surviving pair must
+    complete, and the controller (a survivor) must keep journaling
+    decisions afterwards."""
+    sim = FleetSim(24, seed=11)
+    try:
+        await sim.start()
+        # slow the surviving providers so the requests are genuinely
+        # in flight when the churn wave hits
+        pairs = [(1, 2), (3, 4), (5, 6), (7, 8), (9, 10), (11, 1)]
+        for _, b in pairs:
+            sim.nodes[b].local_services["fake"].exec_delay_s = 3.0
+        futs = [
+            asyncio.ensure_future(
+                sim.nodes[a].request_generation(
+                    sim.nodes[b].peer_id,
+                    f"prompt-{k}",
+                    model="sim-model",
+                    timeout=60.0,
+                )
+            )
+            for k, (a, b) in enumerate(pairs)
+        ]
+        await sim.run_for(0.5)  # requests on the wire, providers mid-sleep
+        assert not any(f.done() for f in futs), "generations finished too early"
+        for i in range(12, 24):  # the churn wave: hard kills, no GOODBYE
+            await sim.kill(i)
+        await sim.run_for(10.0)
+        assert all(f.done() for f in futs), "generation still pending after churn"
+        for f in futs:
+            result = f.result()  # raises if any generation was dropped
+            assert result.get("text"), result
+        # the controller keeps making decisions after the wave
+        before = sum(len(v) for v in sim.journals().values())
+        await sim.run_for(3.0)
+        after = sum(len(v) for v in sim.journals().values())
+        assert after > before, "controller stopped journaling after churn"
+    finally:
+        await sim.stop()
+
+
+@pytest.mark.async_timeout(300)
+async def test_split_brain_partition_and_heal_at_100_nodes():
+    """Region split-brain: black-hole the link between two 50-node
+    regions (the middlebox failure mode — connections stay open, frames
+    vanish). The health plane must mark every cross-region peer
+    unreachable and expire its telemetry digests; on heal, reachability
+    and full gossip coverage must recover without operator action."""
+    regions = {i: ("east" if i < 50 else "west") for i in range(100)}
+    far_of = {}  # node_id index -> far-region peer_id set
+    sim = FleetSim(100, seed=5, regions=regions)
+    try:
+        await sim.start()
+        assert sim.mesh_connected()
+        for _ in range(6):
+            if sim.gossip_coverage() == 1.0:
+                break
+            await sim.run_for(1.0)
+        assert sim.gossip_coverage() == 1.0, "fleet never converged pre-split"
+        sim.net.partition("east", "west")
+        await sim.run_for(10.0)  # > 3-tick TTL: far side goes stale
+        for node in sim.nodes:
+            far = {
+                p
+                for p in node.peers
+                if regions[int(p.rsplit("-", 1)[-1])] != node.region
+            }
+            far_of[node.peer_id] = far
+            assert len(far) == 50, (node.peer_id, len(far))
+            bad = {
+                p for p in far
+                if node.peers[p].get("health") != "unreachable"
+            }
+            assert not bad, (
+                f"{node.peer_id}: cross-region peers not marked unreachable: "
+                f"{sorted(bad)[:5]}"
+            )
+            # far-region telemetry digests expired out of the fresh set
+            stale_leak = set(node.health.fresh()) & far
+            assert not stale_leak, (
+                f"{node.peer_id} still trusts far-region digests {stale_leak}"
+            )
+        # coverage collapses to the intra-region fraction (50·49·2 pairs)
+        intra = (50 * 49 * 2) / (100 * 99)
+        assert sim.gossip_coverage() == pytest.approx(intra, abs=0.02)
+        sim.net.heal()
+        deadline = sim.clock.time() + 30.0
+        while sim.gossip_coverage() < 1.0 and sim.clock.time() < deadline:
+            await sim.run_for(1.0)
+        assert sim.gossip_coverage() == 1.0, (
+            f"coverage never recovered after heal: {sim.gossip_coverage():.3f}"
+        )
+        for node in sim.nodes:
+            still_dark = {
+                p for p in far_of[node.peer_id]
+                if node.peers.get(p, {}).get("health") == "unreachable"
+            }
+            assert not still_dark, (
+                f"{node.peer_id}: peers still unreachable post-heal "
+                f"{sorted(still_dark)[:5]}"
+            )
+    finally:
+        await sim.stop()
+
+
+# ------------------------------------------------------------ DHT scaling
+
+
+def test_dht_lookup_depth_stays_logarithmic_at_500_peers():
+    """Kademlia routing-model regression: lookup depth at 500 peers must
+    stay in the O(log N) envelope (measured: max 3, mean ~2.1). A
+    routing-table regression shows up here as a depth cliff, not as a
+    production latency incident."""
+    model = KademliaModel(500, seed=3)
+    depths = model.sample_depths(50)
+    assert max(depths) <= 4, f"lookup depth blew the envelope: {max(depths)}"
+    assert statistics.mean(depths) <= 3.0, depths
+    # replay-stable: the depth measurement itself is deterministic
+    assert KademliaModel(500, seed=3).sample_depths(50) == depths
+    # and depth grows (weakly) with fleet size — the model is not flat
+    small = statistics.mean(KademliaModel(50, seed=3).sample_depths(50))
+    assert statistics.mean(depths) >= small
+
+
+def test_link_profile_jitter_spans_quanta():
+    """The seed only matters if jitter can move a delivery across the
+    quantization grid — pin the default relationship so a future 'perf
+    tweak' can't silently turn every seed into the same schedule."""
+    p = LinkProfile()
+    assert p.jitter_s > 0
+    clock = VirtualClock()
+    net = SimNet(clock, seed=0)
+    assert p.jitter_s > net.quantum_s
