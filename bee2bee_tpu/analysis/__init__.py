@@ -12,6 +12,11 @@ machine-checked invariants (rule catalog: docs/ANALYSIS.md):
 - **jax** (ML-J*) — implicit host syncs and Python branches on traced
   values inside jit-compiled functions in engine/, models/, ops/,
   parallel/.
+- **race** (ML-R*) — async interleaving hazards in the mesh control
+  plane: check-then-act split across an await, dropped create_task
+  handles, unlocked multi-entry container mutation, await inside
+  iteration over shared state (dynamic twin: the simnet interleaving
+  fuzzer).
 
 CLI: ``python -m bee2bee_tpu.analysis [paths...]`` (exit 1 on any finding
 not grandfathered by analysis/baseline.json). Library:

@@ -21,7 +21,7 @@ from .core import (
     write_baseline,
 )
 
-FAMILIES = ("frames", "async", "jax", "telemetry")
+FAMILIES = ("frames", "async", "jax", "telemetry", "clock", "race")
 
 
 def main(argv: list[str] | None = None) -> int:

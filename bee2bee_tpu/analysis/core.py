@@ -212,6 +212,7 @@ def _passes():
     from .clockseam import ClockSeamPass
     from .frames import FramesPass
     from .jaxhygiene import JaxHygienePass
+    from .raceguard import RaceGuardPass
     from .telemetry import TelemetryPass
 
     return (
@@ -220,6 +221,7 @@ def _passes():
         JaxHygienePass(),
         TelemetryPass(),
         ClockSeamPass(),
+        RaceGuardPass(),
     )
 
 
@@ -245,6 +247,7 @@ _PACKAGE_DIRS = frozenset(
         "parallel",
         "router",
         "services",
+        "simnet",
         "train",
         "web",
     }

@@ -1,0 +1,519 @@
+"""Pass family 6: raceguard (ML-R*) — async interleaving hazards.
+
+Every interleaving bug that shipped in the mesh control plane (the
+dual-dial half-deaf links, the mid-action epoch races) had the same
+anatomy: a coroutine read shared state, awaited, and acted on the stale
+read. This pass segments each ``async def`` at its await points and
+flags the four shapes that anatomy takes:
+
+- ML-R001 — check-then-act split across an await: an ``if`` whose test
+  reads ``self.X``, whose guarded body awaits, and then mutates the same
+  ``self.X`` without re-checking it. The await is a suspension point —
+  any other coroutine can invalidate the check before the act lands.
+  Re-checking the attribute after the await (or holding a lock around
+  the whole check+act) clears the finding.
+- ML-R002 — fire-and-forget task: a ``create_task``/``ensure_future``
+  whose handle is dropped (bare statement, or bound to a name that is
+  never read again). Exceptions in the task vanish, and asyncio keeps
+  only a weak reference — GC can cancel the task mid-flight. Await it,
+  route it through a tracked spawn helper (``utils.TaskTracker`` /
+  ``node._spawn``), or attach a done-callback.
+- ML-R003 — a shared container attribute mutated from 2+ distinct
+  coroutine entry points (roots of the intra-class async call graph,
+  plus dispatch-table handlers and spawned loops) with no guarding lock
+  on any mutation path, at least one mutation landing after an await.
+- ML-R004 — ``await`` inside iteration over a shared container
+  (``for x in self.X``): mutation during the suspension invalidates the
+  iterator (dict/set raise RuntimeError; lists silently skip). Snapshot
+  first: ``for x in list(self.X.values())``.
+
+The dynamic twin of this pass is the simnet interleaving fuzzer
+(``bee2bee_tpu/simnet/fuzz.py``, docs/SIMULATION.md): what raceguard
+flags statically, the fuzzer provokes by perturbing schedules.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .asyncsafe import _names_a_lock
+from .core import dotted_name as _dotted
+
+# spawn calls whose returned handle must not be dropped (ML-R002), matched
+# by last dotted segment so loop.create_task / asyncio.ensure_future both hit
+_SPAWN_CALLS = {"create_task", "ensure_future"}
+
+# tracked-spawn wrappers: a self-method call inside their args is a new
+# coroutine entry point for the ML-R003 call graph (a spawned loop)
+_SPAWN_WRAPPERS = _SPAWN_CALLS | {"_spawn", "spawn"}
+
+# method calls that mutate their receiver in place (container mutation)
+_MUTATORS = {
+    "add",
+    "append",
+    "appendleft",
+    "clear",
+    "discard",
+    "extend",
+    "insert",
+    "pop",
+    "popitem",
+    "popleft",
+    "remove",
+    "setdefault",
+    "update",
+}
+
+
+def _self_chain(expr: ast.AST) -> str:
+    """Dotted chain for attribute expressions rooted at ``self`` ("" else)."""
+    name = _dotted(expr)
+    return name if name.startswith("self.") else ""
+
+
+def _attrs_read(expr: ast.AST) -> frozenset:
+    """Every ``self.…`` chain read anywhere in an expression (walking an
+    attribute chain yields its prefixes too, so ``self.peers.get(pid)``
+    credits both "self.peers.get" and "self.peers")."""
+    attrs = set()
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Attribute):
+            chain = _self_chain(n)
+            if chain:
+                attrs.add(chain)
+    return frozenset(attrs)
+
+
+def _holds_lock(node) -> bool:
+    """Does this With/AsyncWith acquire something lock-shaped?"""
+    return any(
+        _names_a_lock(
+            _dotted(
+                item.context_expr.func
+                if isinstance(item.context_expr, ast.Call)
+                else item.context_expr
+            )
+        )
+        for item in node.items
+    )
+
+
+# -------------------------------------------------- execution-order events
+#
+# A flat event stream over a statement list, in approximate execution
+# order, skipping nested def/lambda/class bodies (they run off this
+# coroutine's await flow). Events:
+#   ("await", None, node)   — a suspension point
+#   ("check", attrs, node)  — an If/While test reading self attrs
+#   ("write", attr, node)   — a mutation of self.<attr> (rebind, subscript
+#                             store/delete, or in-place mutator call)
+
+
+def _stmt_events(stmts):
+    for s in stmts:
+        yield from _node_events(s)
+
+
+def _node_events(node):
+    if isinstance(
+        node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+    ):
+        return
+    if isinstance(node, ast.Assign):
+        # value evaluates before the store lands
+        yield from _node_events(node.value)
+        for t in node.targets:
+            yield from _target_events(t)
+        return
+    if isinstance(node, ast.AugAssign):
+        yield from _node_events(node.value)
+        yield from _target_events(node.target)
+        return
+    if isinstance(node, ast.AnnAssign):
+        if node.value is not None:
+            yield from _node_events(node.value)
+            yield from _target_events(node.target)
+        return
+    if isinstance(node, ast.Delete):
+        for t in node.targets:
+            yield from _target_events(t)
+        return
+    if isinstance(node, (ast.If, ast.While)):
+        yield from _node_events(node.test)
+        attrs = _attrs_read(node.test)
+        if attrs:
+            yield ("check", attrs, node)
+        yield from _stmt_events(node.body)
+        yield from _stmt_events(node.orelse)
+        return
+    if isinstance(node, ast.Await):
+        yield from _node_events(node.value)
+        yield ("await", None, node)
+        return
+    if isinstance(node, ast.Call):
+        for child in ast.iter_child_nodes(node):
+            yield from _node_events(child)
+        if isinstance(node.func, ast.Attribute) and node.func.attr in _MUTATORS:
+            chain = _self_chain(node.func.value)
+            if chain:
+                yield ("write", chain, node)
+        return
+    for child in ast.iter_child_nodes(node):
+        yield from _node_events(child)
+
+
+def _target_events(t):
+    if isinstance(t, ast.Attribute):
+        chain = _self_chain(t)
+        if chain:
+            yield ("write", chain, t)
+    elif isinstance(t, ast.Subscript):
+        chain = _self_chain(t.value)
+        if chain:
+            yield ("write", chain, t)
+        yield from _node_events(t.slice)
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            yield from _target_events(e)
+    elif isinstance(t, ast.Starred):
+        yield from _target_events(t.value)
+
+
+def _container_writes(events):
+    """The subset of write events that are container mutations (subscript
+    store/delete or in-place mutator call) — a plain attribute rebind is
+    not a container mutation."""
+    for kind, attr, node in events:
+        if kind != "write":
+            continue
+        if isinstance(node, ast.Attribute):
+            continue  # rebind: ML-R001's business, not ML-R003's
+        yield attr, node
+
+
+class RaceGuardPass:
+    family = "race"
+    rules = {
+        "ML-R001": "check-then-act on shared state split across an await",
+        "ML-R002": "fire-and-forget task: create_task handle dropped",
+        "ML-R003": (
+            "shared container mutated from multiple coroutine entry points "
+            "without a lock"
+        ),
+        "ML-R004": "await inside iteration over a shared container",
+    }
+
+    def applies(self, path: str) -> bool:
+        return path == "api.py" or path.startswith(
+            ("meshnet/", "router/", "fleet/", "web/", "simnet/", "services/")
+        )
+
+    def run(self, ctx) -> list:
+        findings: list = []
+        parents = {
+            child: parent
+            for parent in ast.walk(ctx.tree)
+            for child in ast.iter_child_nodes(parent)
+        }
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                self._scan_toctou(ctx, node, findings)
+                self._scan_iteration(ctx, node, findings)
+            elif isinstance(node, ast.ClassDef):
+                self._scan_entry_points(ctx, node, findings)
+        self._scan_dropped_handles(ctx, parents, findings)
+        return findings
+
+    # ------------------------------------------------------------- ML-R001
+
+    def _scan_toctou(self, ctx, fn, findings):
+        for stmt, in_lock in _walk_with_lock(fn.body, False):
+            if not isinstance(stmt, ast.If) or in_lock:
+                continue
+            guards = _attrs_read(stmt.test)
+            if not guards:
+                continue
+            awaited = False
+            pending = set(guards)
+            for kind, attr, node in _stmt_events(stmt.body):
+                if kind == "await":
+                    awaited = True
+                elif kind == "check" and awaited:
+                    pending -= attr  # re-validated after the suspension
+                elif kind == "write" and awaited and attr in pending:
+                    pending.discard(attr)
+                    findings.append(
+                        ctx.finding(
+                            "ML-R001",
+                            node,
+                            f"{attr} checked at line {stmt.lineno}, then "
+                            "mutated after an await without re-checking",
+                            "the await is a suspension point — another "
+                            "coroutine can invalidate the check before the "
+                            "act lands; re-check after the await or hold a "
+                            "lock around check+act",
+                        )
+                    )
+
+    # ------------------------------------------------------------- ML-R002
+
+    def _scan_dropped_handles(self, ctx, parents, findings):
+        for node in ast.walk(ctx.tree):
+            call = None
+            if isinstance(node, ast.Expr):
+                call = node.value
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                call = node.value
+            if not (
+                isinstance(call, ast.Call)
+                and _dotted(call.func).rsplit(".", 1)[-1] in _SPAWN_CALLS
+            ):
+                continue
+            if isinstance(node, ast.Expr):
+                self._r002(ctx, call, findings, "not stored anywhere")
+                continue
+            target = node.targets[0]
+            if isinstance(target, ast.Name):
+                scope = _enclosing(
+                    node, parents, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ) or ctx.tree
+                if not _name_loaded(scope, target.id):
+                    self._r002(
+                        ctx, call, findings,
+                        f"bound to `{target.id}` which is never read",
+                    )
+            elif isinstance(target, ast.Attribute):
+                chain = _self_chain(target)
+                if not chain:
+                    continue
+                scope = _enclosing(node, parents, (ast.ClassDef,)) or ctx.tree
+                if not _attr_loaded(scope, chain):
+                    self._r002(
+                        ctx, call, findings,
+                        f"bound to `{chain}` which is never read",
+                    )
+
+    def _r002(self, ctx, call, findings, how):
+        findings.append(
+            ctx.finding(
+                "ML-R002",
+                call,
+                f"task handle from {_dotted(call.func)}(...) is dropped "
+                f"({how})",
+                "exceptions in the task vanish and asyncio's weak ref lets "
+                "GC cancel it mid-flight — await it, route it through a "
+                "tracked spawn helper (utils.TaskTracker / node._spawn), or "
+                "attach a done-callback",
+            )
+        )
+
+    # ------------------------------------------------------------- ML-R003
+
+    def _scan_entry_points(self, ctx, cls, findings):
+        methods = {
+            m.name: m for m in cls.body if isinstance(m, ast.AsyncFunctionDef)
+        }
+        if len(methods) < 2:
+            return
+        mutations = {}  # attr -> list[(method, post_await, in_lock, node)]
+        edges = {name: set() for name in methods}
+        forced_roots = set()
+        called = set()
+        for name, m in methods.items():
+            awaited = False
+            for stmt, in_lock in _walk_with_lock(m.body, False):
+                for kind, attr, node in _node_own_events(stmt):
+                    if kind == "await":
+                        awaited = True
+                    elif kind == "write" and not isinstance(node, ast.Attribute):
+                        mutations.setdefault(attr, []).append(
+                            (name, awaited, in_lock, node)
+                        )
+                # intra-class call edges + spawned-loop roots
+                if isinstance(stmt, ast.Call):
+                    wrapper = (
+                        _dotted(stmt.func).rsplit(".", 1)[-1] in _SPAWN_WRAPPERS
+                    )
+                    for arg in ast.walk(stmt):
+                        if arg is stmt or not isinstance(arg, ast.Call):
+                            continue
+                        callee = self._self_method(arg, methods)
+                        if callee and wrapper:
+                            forced_roots.add(callee)
+                    callee = self._self_method(stmt, methods)
+                    if callee and not wrapper:
+                        edges[name].add(callee)
+                        called.add(callee)
+        roots = (
+            {n for n in methods if n not in called}
+            | forced_roots
+            | {n for n in methods if n.startswith("_handle_")}
+        )
+        reach = {r: _reachable(r, edges) for r in roots}
+        for attr, sites in sorted(mutations.items()):
+            if any(in_lock for _, _, in_lock, _ in sites):
+                continue  # some path locks: lock discipline exists
+            writers = {m for m, _, _, _ in sites}
+            covering = sorted(r for r in roots if reach[r] & writers)
+            post = [s for s in sites if s[1]]
+            if len(covering) < 2 or not post:
+                continue
+            _, _, _, node = post[0]
+            findings.append(
+                ctx.finding(
+                    "ML-R003",
+                    node,
+                    f"{attr} mutated from {len(covering)} coroutine entry "
+                    f"points ({', '.join(covering)}) with no lock on any "
+                    "path",
+                    "concurrent entry points interleave at every await — "
+                    "guard the mutations with one asyncio.Lock or funnel "
+                    "them through a single owner task",
+                )
+            )
+
+    @staticmethod
+    def _self_method(call, methods):
+        name = _dotted(call.func)
+        if name.startswith("self.") and name.count(".") == 1:
+            attr = name.split(".", 1)[1]
+            if attr in methods:
+                return attr
+        return None
+
+    # ------------------------------------------------------------- ML-R004
+
+    def _scan_iteration(self, ctx, fn, findings):
+        for stmt, in_lock in _walk_with_lock(fn.body, False):
+            if not isinstance(stmt, ast.For) or in_lock:
+                continue
+            chain = self._shared_iter(stmt.iter)
+            if not chain:
+                continue
+            if any(True for k, _, _ in _stmt_events(stmt.body) if k == "await"):
+                findings.append(
+                    ctx.finding(
+                        "ML-R004",
+                        stmt,
+                        f"await inside iteration over shared container "
+                        f"{chain}",
+                        "a coroutine scheduled during the await can mutate "
+                        f"{chain} and invalidate the iterator — snapshot "
+                        f"first: `for … in list({chain}…)`",
+                    )
+                )
+
+    @staticmethod
+    def _shared_iter(it):
+        if isinstance(it, ast.Attribute):
+            return _self_chain(it)
+        if (
+            isinstance(it, ast.Call)
+            and not it.args
+            and isinstance(it.func, ast.Attribute)
+            and it.func.attr in ("items", "values", "keys")
+        ):
+            return _self_chain(it.func.value)
+        return ""
+
+
+# ----------------------------------------------------------------- helpers
+
+
+def _walk_with_lock(body, in_lock):
+    """Yield (node, lock_held) over a statement subtree in source order,
+    skipping nested def/lambda/class bodies, tracking With/AsyncWith lock
+    acquisition the same way asyncsafe does."""
+    for node in body:
+        if isinstance(
+            node,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef),
+        ):
+            continue
+        yield node, in_lock
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            holds = in_lock or _holds_lock(node)
+            yield from _walk_with_lock(node.body, holds)
+            continue
+        children = [
+            c
+            for c in ast.iter_child_nodes(node)
+            if not isinstance(c, (ast.expr_context, ast.operator))
+        ]
+        yield from _walk_with_lock(children, in_lock)
+
+
+def _node_own_events(stmt):
+    """Events contributed by this node itself (not statement children —
+    _walk_with_lock already visits those), so compound statements don't
+    double-count their bodies."""
+    if isinstance(stmt, ast.Await):
+        yield ("await", None, stmt)
+    elif isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            yield from _target_events_shallow(t)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        if not (isinstance(stmt, ast.AnnAssign) and stmt.value is None):
+            yield from _target_events_shallow(stmt.target)
+    elif isinstance(stmt, ast.Delete):
+        for t in stmt.targets:
+            yield from _target_events_shallow(t)
+    elif isinstance(stmt, ast.Call):
+        if isinstance(stmt.func, ast.Attribute) and stmt.func.attr in _MUTATORS:
+            chain = _self_chain(stmt.func.value)
+            if chain:
+                yield ("write", chain, stmt)
+
+
+def _target_events_shallow(t):
+    if isinstance(t, ast.Attribute):
+        chain = _self_chain(t)
+        if chain:
+            yield ("write", chain, t)
+    elif isinstance(t, ast.Subscript):
+        chain = _self_chain(t.value)
+        if chain:
+            yield ("write", chain, t)
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            yield from _target_events_shallow(e)
+    elif isinstance(t, ast.Starred):
+        yield from _target_events_shallow(t.value)
+
+
+def _reachable(root, edges):
+    seen = {root}
+    stack = [root]
+    while stack:
+        for callee in edges.get(stack.pop(), ()):
+            if callee not in seen:
+                seen.add(callee)
+                stack.append(callee)
+    return seen
+
+
+def _enclosing(node, parents, types):
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, types):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def _name_loaded(scope, name) -> bool:
+    return any(
+        isinstance(n, ast.Name)
+        and n.id == name
+        and isinstance(n.ctx, ast.Load)
+        for n in ast.walk(scope)
+    )
+
+
+def _attr_loaded(scope, chain) -> bool:
+    return any(
+        isinstance(n, ast.Attribute)
+        and isinstance(n.ctx, ast.Load)
+        and _dotted(n) == chain
+        for n in ast.walk(scope)
+    )

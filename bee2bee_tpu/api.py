@@ -12,6 +12,7 @@ aiohttp (fastapi/uvicorn are not in this image).
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import hmac
 import json
 import logging
@@ -1070,45 +1071,58 @@ async def _stream_p2p(
     )
     resp = None
     getter = asyncio.create_task(q.get())
-    while True:
-        done, _ = await asyncio.wait({getter, gen_task}, return_when=asyncio.FIRST_COMPLETED)
-        if resp is None:
-            # the FIRST event decides the response: a failure arriving
-            # before any chunk (typed remote shed, dead provider) must
-            # surface as a real HTTP status — the middleware turns an
-            # AdmissionReject into 429/503 + Retry-After — not as a 200
-            # whose body smuggles an error line no backoff logic reads
-            if getter not in done and gen_task.exception() is not None:
-                getter.cancel()
-                raise gen_task.exception()
-            resp = web.StreamResponse(
-                headers={
-                    "Content-Type": (
-                        "text/event-stream" if sse else "application/x-ndjson"
-                    ),
-                    **dict(cors),
-                }
-            )
-            await resp.prepare(request)
-        if getter in done:
-            await resp.write(frame(getter.result()))
-            getter = asyncio.create_task(q.get())
-            continue
-        getter.cancel()
-        try:
-            await gen_task
-            while not q.empty():
-                await resp.write(frame(q.get_nowait()))
-            await resp.write(frame(json.dumps({"done": True}) + "\n"))
-        except Exception as e:
-            # mid-stream failure: the 200 is already on the wire — the
-            # in-stream error line is all that's left to say
-            await resp.write(
-                frame(json.dumps({"status": "error", "message": str(e)}) + "\n")
-            )
-        break
-    await resp.write_eof()
-    return resp
+    try:
+        while True:
+            done, _ = await asyncio.wait({getter, gen_task}, return_when=asyncio.FIRST_COMPLETED)
+            if resp is None:
+                # the FIRST event decides the response: a failure arriving
+                # before any chunk (typed remote shed, dead provider) must
+                # surface as a real HTTP status — the middleware turns an
+                # AdmissionReject into 429/503 + Retry-After — not as a 200
+                # whose body smuggles an error line no backoff logic reads
+                if getter not in done and gen_task.exception() is not None:
+                    raise gen_task.exception()
+                resp = web.StreamResponse(
+                    headers={
+                        "Content-Type": (
+                            "text/event-stream" if sse else "application/x-ndjson"
+                        ),
+                        **dict(cors),
+                    }
+                )
+                await resp.prepare(request)
+            if getter in done:
+                await resp.write(frame(getter.result()))
+                getter = asyncio.create_task(q.get())
+                continue
+            # cancel BEFORE draining: a live q.get() would steal a chunk
+            # from the post-completion drain below
+            getter.cancel()
+            try:
+                await gen_task
+                while not q.empty():
+                    await resp.write(frame(q.get_nowait()))
+                await resp.write(frame(json.dumps({"done": True}) + "\n"))
+            except Exception as e:
+                # mid-stream failure: the 200 is already on the wire — the
+                # in-stream error line is all that's left to say
+                await resp.write(
+                    frame(json.dumps({"status": "error", "message": str(e)}) + "\n")
+                )
+            break
+        await resp.write_eof()
+        return resp
+    finally:
+        # an abandoned stream (client hung up: resp.prepare/write raises,
+        # or aiohttp cancels the handler) must not leave the generation
+        # decoding to its token budget for nobody, nor a q.get() task
+        # dangling for the GC to cancel
+        if not getter.done():
+            getter.cancel()
+        if not gen_task.done():
+            gen_task.cancel()
+            with contextlib.suppress(BaseException):
+                await gen_task
 
 
 async def start_api_server(node: P2PNode, host: str, port: int, api_key: str | None = None):

@@ -366,7 +366,7 @@ class FleetController:
         except Exception as e:  # noqa: BLE001 — typed verdict, not a raise
             return {"ok": False, "error": str(e)}
         finally:
-            self._acks.pop(rid, None)
+            self._acks.pop(rid, None)  # meshlint: ignore[ML-R003] -- rid-keyed ack futures: each awaiter registers and pops only its own rid
 
     # ---------------------------------------------------------------- tick
 
@@ -714,7 +714,7 @@ class FleetController:
             ok, detail = False, f"scale-out crashed: {e!r}"
             logger.exception("scale-out crashed")
         if ok:
-            self.stats["scale_out"] += 1
+            self.stats["scale_out"] += 1  # meshlint: ignore[ML-R003] -- atomic counter bump: no read of stats spans an await
             self._finish_action(
                 True, "fleet:scale_out",
                 f"replica {target} probed and flipped eligible ({detail})",

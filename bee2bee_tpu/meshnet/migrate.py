@@ -60,7 +60,7 @@ from ..health import get_recorder
 from ..metrics import get_registry
 from ..router import AdmissionReject
 from ..tracing import get_tracer, inject_trace
-from ..utils import new_id, sha256_hex
+from ..utils import TaskTracker, log_task_exception, new_id, sha256_hex
 
 logger = logging.getLogger("bee2bee_tpu.migrate")
 
@@ -191,7 +191,7 @@ class MigrationManager:
         self._acks: dict[str, asyncio.Future] = {}
         self._bridges: dict[str, _Bridge] = {}
         self._rid_ws: dict[str, object] = {}
-        self._tasks: set[asyncio.Task] = set()
+        self._tasks = TaskTracker("migration")  # strong refs + crash logging
         # target side
         self._imports: dict[str, _PendingImport] = {}
         self.stats = {
@@ -306,12 +306,9 @@ class MigrationManager:
 
     def spawn_migration(self, req, svc, snap: dict, kv, reason: str):
         """Entry from the scheduler hook (already on the loop)."""
-        task = asyncio.create_task(
+        return self._tasks.spawn(
             self._migrate_with_fallback(req, svc, snap, kv, reason)
         )
-        self._tasks.add(task)
-        task.add_done_callback(self._tasks.discard)
-        return task
 
     async def wait_idle(self, timeout_s: float = 60.0) -> bool:
         """Await in-flight source-side migrations (tests, drain-then-stop)."""
@@ -676,14 +673,12 @@ class MigrationManager:
         if n_chunks == 0:
             self._spawn_finish(imp, kv=None)
         else:
-            self._imports[rid] = imp
+            self._imports[rid] = imp  # meshlint: ignore[ML-R003] -- rid-keyed: one import's export/blocks frames arrive on one connection reader, serialized
 
     def _spawn_finish(self, imp: _PendingImport, kv) -> None:
         """Admission may queue under saturation — never block the
         connection reader on it (pings/chunks must keep flowing)."""
-        task = asyncio.create_task(self._finish_import(imp, kv))
-        self._tasks.add(task)
-        task.add_done_callback(self._tasks.discard)
+        self._tasks.spawn(self._finish_import(imp, kv))
 
     async def handle_blocks(self, ws, data: dict) -> None:
         rid = data.get("rid")
@@ -814,9 +809,7 @@ class MigrationManager:
             await self._ack(imp.ws, imp.rid, ok=False, error=str(err),
                             error_kind="incompatible")
             return
-        task = asyncio.create_task(self._serve_import(imp, req, ticket))
-        self._tasks.add(task)
-        task.add_done_callback(self._tasks.discard)
+        self._tasks.spawn(self._serve_import(imp, req, ticket))
 
     def _next_event(self, req) -> dict:
         """Blocking event read with a liveness escape (runs in executor)."""
@@ -965,14 +958,13 @@ class MigrationManager:
                 await asyncio.gather(*jobs)
             else:
                 for job in jobs:
-                    t = asyncio.create_task(job)
-                    self._tasks.add(t)
-                    t.add_done_callback(self._tasks.discard)
+                    self._tasks.spawn(job)
                 summary["pending"] = len(jobs)
         if stop:
             # NOT node._spawn: stop() cancels node tasks, and a tracked
             # task awaiting stop() would cancel itself mid-teardown
             self._stop_task = asyncio.create_task(self._stop_after_drain())
+            self._stop_task.add_done_callback(log_task_exception)
         return summary
 
     async def _drain_one(self, svc, sch, req, summary: dict) -> None:

@@ -57,6 +57,7 @@ from ..utils import (
     MetricsAggregator,
     get_lan_ip,
     get_system_metrics,
+    log_task_exception,
     new_id,
     pump_queue_until,
     sha256_hex,
@@ -327,6 +328,13 @@ class P2PNode(StageTaskMixin):
         self._dial_addr_by_ws: dict[Any, str] = {}  # outbound ws -> addr dialed
         self._dialing: set[str] = set()  # addrs with a dial in flight (dedup)
         self._pid_by_ws: dict[Any, str] = {}  # ws -> peer_id (O(1) _peer_for)
+        # sockets our hello has gone out on (dial-time or as a reply). A
+        # hello arriving on a ws NOT in this set must be answered even if
+        # the peer is already known — the sender's end of that link stays
+        # unidentified until our hello lands on it (a dual-dial winner or
+        # post-drop redial left mute is a permanent half-open link; found
+        # by the interleaving fuzzer, simnet.fuzz churn schedule 4)
+        self._helloed_ws: set = set()
         self._pong_raw: tuple | None = None  # (ts, raw) last-encoded pong
         # scheme-less host:port — the wss→ws fallback changes the scheme of
         # the addr actually dialed, and a bootstrap peer must keep its
@@ -355,12 +363,19 @@ class P2PNode(StageTaskMixin):
         return t is not None and self.clock.time() - t < self.reconnect_window_s
 
     def _spawn(self, coro) -> asyncio.Task:
-        """Track a background task, self-pruning on completion (a churny
-        mesh would otherwise grow _tasks without bound)."""
+        """Track a background task: strong ref until done, self-pruning on
+        completion (a churny mesh would otherwise grow _tasks without
+        bound), exception surfaced through the task log instead of dying
+        with the GC's "never retrieved" warning."""
         task = asyncio.create_task(coro)
         self._tasks.append(task)
-        task.add_done_callback(lambda t: self._tasks.remove(t) if t in self._tasks else None)
+        task.add_done_callback(self._reap_task)
         return task
+
+    def _reap_task(self, task: asyncio.Task) -> None:
+        if task in self._tasks:
+            self._tasks.remove(task)
+        log_task_exception(task)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -392,7 +407,7 @@ class P2PNode(StageTaskMixin):
         # the lease boot grace counts from JOINING the mesh, not from
         # construction — a slow build (first jit compile) must not eat it
         self.fleet.lease.reset_boot_grace(self.started_at)
-        self._tasks.append(asyncio.create_task(self._monitor_loop()))
+        self._spawn(self._monitor_loop())
         logger.info("node %s listening on %s", self.peer_id, self.addr)
         return self
 
@@ -465,16 +480,17 @@ class P2PNode(StageTaskMixin):
                 addr, max_size=protocol.MAX_FRAME, open_timeout=10
             )
         except Exception as e:
-            self._dialing.discard(addr)
+            self._dialing.discard(addr)  # meshlint: ignore[ML-R003] -- claim-release dedup: addr claimed before the dial await, released only by its claimant
             # wss→ws fallback mirrors the reference (p2p_runtime.py:353-361)
             if addr.startswith("wss://"):
                 return await self._connect_peer("ws://" + addr[6:])
             logger.warning("connect %s failed: %s", addr, e)
             return False
-        self._dial_addr_by_ws[ws] = addr
-        self._departed.pop(addr, None)  # fresh dial resets a past goodbye
+        self._dial_addr_by_ws[ws] = addr  # meshlint: ignore[ML-R003] -- ws-keyed: each socket object has exactly one writer (its dialer/reader)
+        self._departed.pop(addr, None)  # meshlint: ignore[ML-R003] -- last-writer-wins by design: a fresh dial resets a past goodbye
         try:
             await self._send(ws, self._hello_msg())
+            self._helloed_ws.add(ws)  # meshlint: ignore[ML-R003] -- ws-keyed: each socket's hello lifecycle has one writer (its dialer or its reader), and set add/discard are atomic on the loop
         except Exception as e:
             # peer accepted the socket but died before hello (mid-shutdown):
             # treat as a failed dial, not a raise — _reconnect_loop must see
@@ -556,6 +572,7 @@ class P2PNode(StageTaskMixin):
                 self.peers.pop(pid, None)
                 self.providers.pop(pid, None)
             self._pid_by_ws.pop(ws, None)
+        self._helloed_ws.discard(ws)
         for pid in dead:
             logger.info("peer %s disconnected", pid)
         # fail fast anything awaiting a reply on this connection — the
@@ -613,7 +630,7 @@ class P2PNode(StageTaskMixin):
                     return
                 delay = min(delay * 2, self.reconnect_max_s)
         finally:
-            self._reconnecting.discard(addr)
+            self._reconnecting.discard(addr)  # meshlint: ignore[ML-R003] -- claim-release dedup set: claimed before the backoff loop, released in finally
 
     # ------------------------------------------------------------ sending
 
@@ -850,6 +867,19 @@ class P2PNode(StageTaskMixin):
                 # one TTL) from deferring the first election forever.
                 self._greeted.add(pid)
                 self.fleet.lease.reset_boot_grace()
+        # reply whenever OUR hello has never gone out on THIS socket:
+        # first contact, or a hello from an already-known peer over a new
+        # link (a dual-dial winner we only ever helloed on the loser we
+        # closed, or a redial after a one-sided drop). Replying only on
+        # first contact leaves those links mute — the other end never
+        # receives our hello, never registers us, and the link stays
+        # half-open forever while this end keeps serving a live
+        # registration (found by the interleaving fuzzer: simnet.fuzz
+        # churn scenario, a dual-dial loser's FIN racing the winner's
+        # hello). No ping-pong: our reply lands on a socket the peer has
+        # already helloed on, so the peer stays quiet.
+        if not known or ws not in self._helloed_ws:
+            self._helloed_ws.add(ws)
             await self._send(ws, self._hello_msg())
             await self._send(ws, protocol.msg(protocol.PEER_LIST, peers=peer_addrs))
 

@@ -39,7 +39,7 @@ from ..clock import get_clock
 from ..health import get_recorder
 from ..metrics import get_registry
 from ..tracing import extract_trace, get_tracer, inject_trace, use_trace_ctx
-from ..utils import new_id
+from ..utils import log_task_exception, new_id
 
 logger = logging.getLogger("bee2bee_tpu.pipeline")
 
@@ -865,7 +865,7 @@ class PipelineCoordinator:
                 reply_from=self.stage_peers[-1],
             )
             return result["_tensors"]["out"]
-        for peer in self.stage_peers:
+        for peer in list(self.stage_peers):  # snapshot: replacement can rebind mid-chain
             result = await self.node.run_stage_task(
                 peer, protocol.TASK_PART_FORWARD, fields, tensors={"x": x},
                 timeout=self.step_timeout,
@@ -1158,7 +1158,7 @@ class PipelineCoordinator:
 
     async def _train_step_inner(self, rid, input_ids, targets, lr, step_timeout):
         x = np.asarray(input_ids, np.int32)
-        for peer in self.stage_peers:
+        for peer in list(self.stage_peers):  # snapshot: replacement can rebind mid-chain
             result = await self.node.run_stage_task(
                 peer, protocol.TASK_LAYER_FORWARD_TRAIN,
                 {"model": self.model, "request_id": rid},
@@ -1600,8 +1600,10 @@ class PipelineSession:
             for g in self.groups:
                 if g.task is None or g.task.done():
                     g.task = loop.create_task(self._group_loop(g))
+                    g.task.add_done_callback(log_task_exception)
         elif self._task is None or self._task.done():
             self._task = loop.create_task(self._lockstep_loop())
+            self._task.add_done_callback(log_task_exception)
 
     @property
     def _any_active(self) -> bool:
@@ -1662,7 +1664,7 @@ class PipelineSession:
                 )
                 return result["_tensors"]["out"]
             for peer in self.stage_peers[:-1]:
-                self.stats["tasks_sent"] += 1
+                self.stats["tasks_sent"] += 1  # meshlint: ignore[ML-R003] -- atomic counter bump: no read of stats spans an await
                 result = await self.node.run_stage_task(
                     peer, protocol.TASK_PART_FORWARD, fields,
                     tensors={"x": x}, timeout=self.step_timeout,
@@ -1859,7 +1861,7 @@ class PipelineSession:
                         continue
                     break
                 continue
-            for g in self.groups:
+            for g in list(self.groups):  # snapshot: admit() appends mid-drain
                 await self._drain_admissions(g)
             busy = [g for g in self.groups if g.active()]
             if not busy:
@@ -2014,7 +2016,7 @@ class PipelineSession:
                     # a RE-PLACED stage lost every group's caches with
                     # its process: evacuate the healthy groups too (their
                     # rows requeue into their own groups and re-prefill)
-                    for other in self.groups:
+                    for other in list(self.groups):  # snapshot: evacuation awaits per group
                         if other is g:
                             continue
                         other_rows = await self._evacuate(other)

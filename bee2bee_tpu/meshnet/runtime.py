@@ -13,6 +13,7 @@ import logging
 
 from ..clock import get_clock
 from ..config import NodeConfig, load_config, parse_mesh_shape
+from ..utils import TaskTracker
 from .node import P2PNode
 
 logger = logging.getLogger("bee2bee_tpu.runtime")
@@ -168,7 +169,7 @@ async def run_p2p_node(
     # everything after start() runs under the teardown guard: a failed
     # service build/load must not leak the listening node/gateway/monitor
     api_runner = None
-    registry_task = None
+    registry_tasks = None
     forwarder = None
     tun = None
     own_dht = dht is None  # stop a DHT we created ourselves
@@ -303,7 +304,8 @@ async def run_p2p_node(
 
             client = RegistryClient()
             if client.enabled:
-                registry_task = asyncio.create_task(client.sync_loop(node))
+                registry_tasks = TaskTracker("runtime")
+                registry_tasks.spawn(client.sync_loop(node))
 
         if post_start is not None:
             await post_start(node)
@@ -321,10 +323,8 @@ async def run_p2p_node(
         if own_dht and dht is not None:
             with contextlib.suppress(Exception):
                 await dht.stop()
-        if registry_task:
-            registry_task.cancel()
-            with contextlib.suppress(asyncio.CancelledError):
-                await registry_task
+        if registry_tasks is not None:
+            await registry_tasks.cancel_all()
         if api_runner is not None:
             await api_runner.cleanup()
         if forwarder is not None and forwarder.mappings:

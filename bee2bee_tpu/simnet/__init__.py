@@ -16,21 +16,29 @@ time, with bit-identical event traces across same-seed replays:
   trace + `/fleet` decision journals for replay comparison.
 - `dht.py`: a pure-data Kademlia model for lookup-depth scaling claims
   (the in-memory DHT the mesh ships has no routed lookup to measure).
+- `fuzz.py`: the seeded interleaving fuzzer — replays scenarios under
+  perturbed-but-legal schedules and flags outcome divergence, dropped
+  generations, and unhandled task exceptions (the dynamic half of the
+  raceguard; see analysis/raceguard.py for the static half).
 
 See docs/SIMULATION.md for the seam design and determinism contract.
 """
 
 from .clock import VirtualClock
 from .dht import KademliaModel
+from .fuzz import FuzzFinding, SchedulePerturbation, fuzz
 from .harness import FleetSim, SimService
 from .transport import LinkProfile, SimNet, SimTransport
 
 __all__ = [
     "FleetSim",
+    "FuzzFinding",
     "KademliaModel",
     "LinkProfile",
+    "SchedulePerturbation",
     "SimNet",
     "SimService",
     "SimTransport",
     "VirtualClock",
+    "fuzz",
 ]

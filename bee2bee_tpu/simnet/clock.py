@@ -37,8 +37,13 @@ class VirtualClock(Clock):
         # (digest "ts" fields, journal timestamps) in a familiar range
         self._now = float(start)
         self._seq = 0
-        # heap of (deadline, seq, future-or-callback)
-        self._timers: list[tuple[float, int, object]] = []
+        # heap of (deadline, bias, seq, future-or-callback); bias is 0.0
+        # except when an interleaving perturbation (simnet.fuzz) biases
+        # same-deadline sleeper order to explore alternative schedules
+        self._timers: list[tuple[float, float, int, object]] = []
+        #: optional SchedulePerturbation (simnet.fuzz); None = canonical
+        #: (deadline, seq) order, bit-identical to the unperturbed clock
+        self.perturb = None
         # settle() returns after this many consecutive loop passes during
         # which no new timer was registered: passes where nothing is ready
         # cost ~µs, so the threshold buys safety for deep await chains
@@ -66,7 +71,13 @@ class VirtualClock(Clock):
 
     def _push(self, deadline: float, item: object) -> None:
         self._seq += 1
-        heapq.heappush(self._timers, (deadline, self._seq, item))
+        bias = 0.0
+        if self.perturb is not None and isinstance(item, asyncio.Future):
+            # only sleepers get biased: delivery callbacks keep FIFO
+            # registration order (a websocket is an ordered stream), so a
+            # perturbed schedule is still one the real network could produce
+            bias = self.perturb.sleep_bias()
+        heapq.heappush(self._timers, (deadline, bias, self._seq, item))
 
     def call_at(self, when: float, fn: Callable[[], None]) -> None:
         """Run `fn` when virtual time reaches `when` (synchronously, in
@@ -103,7 +114,7 @@ class VirtualClock(Clock):
                 self._now = deadline
             fired = False
             while self._timers and self._timers[0][0] <= self._now:
-                _, _, item = heapq.heappop(self._timers)
+                _, _, _, item = heapq.heappop(self._timers)
                 if isinstance(item, asyncio.Future):
                     if not item.done():  # skip cancelled sleepers
                         item.set_result(None)

@@ -1,0 +1,463 @@
+"""Seeded interleaving fuzzer: the dynamic half of the raceguard.
+
+meshlint's ML-R0xx passes (analysis/raceguard.py) find interleaving
+hazards statically; this module *provokes* them. It replays simnet
+scenarios under N perturbed-but-still-legal schedules and reports any
+run whose observable outcome differs from the canonical deterministic
+baseline — plus any unhandled task exception or dropped generation the
+perturbation shakes loose.
+
+Perturbation model — three knobs, all schedules the real network could
+produce (per-connection FIFO is never violated):
+
+- **sleeper tie-break bias** (`VirtualClock._push`): same-deadline
+  sleepers are reordered among themselves. Delivery callbacks keep
+  registration order — a websocket is an ordered stream.
+- **extra delivery quanta** (`SimNet._delivery_time`): a frame lands
+  0..`max_extra_quanta` batches later than its jitter draw said,
+  applied *before* the per-conn FIFO clamp. Only cross-link
+  interleaving changes.
+- **forced yields** (`SimConn.send`): `await asyncio.sleep(0)` at the
+  send point with probability `yield_prob` — the "another task ran
+  first" schedule that check-then-act bugs need.
+
+Every perturbed run is itself deterministic: one `SchedulePerturbation`
+is one seeded RNG consumed in scheduling order, so any finding replays
+from `(scenario, net_seed, schedule_seed)` alone:
+
+    python -m bee2bee_tpu.simnet.fuzz --scenario toctou_demo \
+        --net-seed 0 --schedules 20
+
+Divergence is judged on a schedule-INDEPENDENT outcome digest per
+scenario (leader counts after failover, generations completed, drain
+summaries) — raw event traces legitimately differ across schedules;
+outcomes must not. `toctou_demo` is the deliberately raceable control:
+its check-then-act grant booth diverges under perturbation (and its
+source trips ML-R001 when the suppression below is stripped), proving
+both halves of the raceguard see the same bug.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import gc
+import json
+import random
+from dataclasses import asdict, dataclass
+
+from .clock import VirtualClock
+from .harness import FleetSim
+from .transport import LinkProfile, SimNet
+
+
+class SchedulePerturbation:
+    """One seeded source of schedule noise, consumed in scheduling order.
+
+    Installed on a `VirtualClock` (`.perturb`) and a `SimNet`
+    (`.perturb`); `FleetSim(perturb=...)` wires both. The same
+    (net_seed, schedule_seed) pair always replays the same run."""
+
+    def __init__(self, seed: int, yield_prob: float = 0.25,
+                 max_extra_quanta: int = 2):
+        self.seed = seed
+        self.yield_prob = yield_prob
+        self.max_extra_quanta = max_extra_quanta
+        self._rng = random.Random(seed)
+
+    def sleep_bias(self) -> float:
+        """Tie-break key for same-deadline sleepers (VirtualClock._push)."""
+        return self._rng.random()
+
+    def extra_quanta(self) -> int:
+        """Whole delivery batches to delay one frame (SimNet._delivery_time)."""
+        return self._rng.randrange(self.max_extra_quanta + 1)
+
+    def should_yield(self) -> bool:
+        """Force a task switch at this send point (SimConn.send)."""
+        return self._rng.random() < self.yield_prob
+
+
+@dataclass(frozen=True)
+class FuzzFinding:
+    """One interleaving bug, replayable from its coordinates.
+
+    kinds: outcome_divergence (perturbed outcome != baseline),
+    unhandled_exception (loop exception handler fired),
+    dropped_generation (a generation the scenario started never
+    completed), replay_divergence (two UNperturbed runs disagreed —
+    the determinism contract itself is broken)."""
+
+    kind: str
+    scenario: str
+    net_seed: int
+    schedule: int | None  # SchedulePerturbation seed; None = baseline run
+    detail: str
+
+
+# ------------------------------------------------------------- scenarios
+#
+# A scenario is `async (net_seed, perturb) -> outcome dict`. The dict
+# must be SCHEDULE-INDEPENDENT: invariants (counts, booleans, the only
+# possible survivor) — never timestamps, traces, or timing-dependent
+# identities. A `_dropped` key (list) is stripped by the runner and
+# reported as dropped_generation findings instead of compared.
+
+
+async def _scenario_fleet_election(net_seed: int, perturb) -> dict:
+    """Leader failover: kill the sitting leader, the surviving
+    controller must claim the lease — exactly one leader, same identity,
+    under every schedule."""
+    sim = FleetSim(5, seed=net_seed, controllers=2, perturb=perturb)
+    try:
+        await sim.start()
+        await sim.run_for(6.0)  # past the claim stagger: a leader exists
+        initial = [
+            n.peer_id for n in sim.alive()
+            if n.fleet.enabled and n.fleet.is_leader
+        ]
+        await sim.kill(0)  # the rank-0 claimant — process death, no GOODBYE
+        await sim.run_for(15.0)  # > 3-tick lease TTL + claim stagger
+        after = [
+            n.peer_id for n in sim.alive()
+            if n.fleet.enabled and n.fleet.is_leader
+        ]
+        return {
+            "initial_leaders": len(initial),
+            "failover_leaders": len(after),
+            # only one controller survives the kill, so the identity is
+            # schedule-independent too
+            "failover_leader": after[0] if len(after) == 1 else None,
+            "mesh_connected": sim.mesh_connected(),
+        }
+    finally:
+        await sim.stop()
+
+
+async def _scenario_drain_migrate(net_seed: int, perturb) -> dict:
+    """Drain with a generation in flight: `begin_drain` must wait for
+    the in-flight request, the generation must complete, and the node
+    must end up draining — under every schedule."""
+    sim = FleetSim(4, seed=net_seed, perturb=perturb)
+    fut = None
+    try:
+        await sim.start()
+        prov = sim.nodes[2]
+        prov.local_services["fake"].exec_delay_s = 2.0
+        fut = asyncio.ensure_future(
+            sim.nodes[1].request_generation(
+                prov.peer_id, "drain-me", model="sim-model", timeout=60.0
+            )
+        )
+        await sim.run_for(0.5)  # request on the wire, provider mid-decode
+        in_flight_when_drained = not fut.done()
+        summary = await sim.drive(prov.begin_drain())
+        await sim.run_for(5.0)
+        dropped = []
+        gen_ok = False
+        if fut.done() and not fut.cancelled() and fut.exception() is None:
+            gen_ok = bool(fut.result().get("text"))
+        if not gen_ok:
+            state = (
+                "pending" if not fut.done()
+                else repr(fut.exception() or fut.result())
+            )
+            dropped.append(f"generation 'drain-me' did not complete: {state}")
+        return {
+            "in_flight_when_drained": in_flight_when_drained,
+            "gen_completed": gen_ok,
+            "draining": bool(prov.draining),
+            "drain_summary_ok": isinstance(summary, dict),
+            "_dropped": dropped,
+        }
+    finally:
+        if fut is not None and not fut.done():
+            fut.cancel()
+        await sim.stop()
+
+
+async def _scenario_churn(net_seed: int, perturb) -> dict:
+    """Hard-kill bystanders while generations are in flight on the
+    survivors: every generation completes, the controller keeps
+    journaling — under every schedule."""
+    sim = FleetSim(8, seed=net_seed, perturb=perturb)
+    futs: list = []
+    try:
+        await sim.start()
+        pairs = [(1, 2), (3, 4)]
+        for _, b in pairs:
+            sim.nodes[b].local_services["fake"].exec_delay_s = 2.0
+        futs = [
+            asyncio.ensure_future(
+                sim.nodes[a].request_generation(
+                    sim.nodes[b].peer_id, f"p-{k}",
+                    model="sim-model", timeout=60.0,
+                )
+            )
+            for k, (a, b) in enumerate(pairs)
+        ]
+        await sim.run_for(0.4)  # requests in flight
+        for i in (6, 7):  # bystander churn: hard kills, no GOODBYE
+            await sim.kill(i)
+        await sim.run_for(10.0)
+        dropped = []
+        done = 0
+        for k, f in enumerate(futs):
+            ok = (
+                f.done() and not f.cancelled() and f.exception() is None
+                and bool(f.result().get("text"))
+            )
+            if ok:
+                done += 1
+            else:
+                state = (
+                    "pending" if not f.done()
+                    else repr(f.exception() if f.exception() else f.result())
+                )
+                dropped.append(f"generation 'p-{k}' did not complete: {state}")
+        journaled = sum(len(v) for v in sim.journals().values())
+        return {
+            "generations_completed": done,
+            "controller_journaled": journaled > 0,
+            "_dropped": dropped,
+        }
+    finally:
+        for f in futs:
+            if not f.done():
+                f.cancel()
+        await sim.stop()
+
+
+class _GrantBooth:
+    """Deliberately raceable exclusive-grant server: the fuzzer's
+    seeded TOCTOU. `handle` checks `self.holder`, awaits grant
+    bookkeeping, then writes it — the textbook ML-R001 shape. Under the
+    canonical schedule the second request arrives after the first grant
+    lands (one grant); a perturbed schedule that parks both requests
+    inside the bookkeeping window double-grants."""
+
+    def __init__(self, clock: VirtualClock):
+        self.clock = clock
+        self.holder: str | None = None
+        self.grants: list[str] = []
+
+    async def handle(self, ws) -> None:
+        async for who in ws:
+            if self.holder is None:
+                # the suspension point that makes the check stale
+                await self.clock.sleep(0.004)
+                self.holder = who  # meshlint: ignore[ML-R001] -- deliberate raceable demo: the fuzzer must catch this dynamically and raceguard statically (tests strip this suppression and re-run the pass)
+                self.grants.append(who)
+                await ws.send("granted")
+            else:
+                await ws.send("busy")
+
+
+async def _scenario_toctou_demo(net_seed: int, perturb) -> dict:
+    """Two clients race for one grant, staggered so the canonical
+    schedule serializes them. Jitter is zeroed: the ONLY schedule noise
+    is the perturbation, so baseline yields exactly one grant for every
+    net_seed and any double-grant is the fuzzer's doing."""
+    clock = VirtualClock()
+    clock.perturb = perturb
+    net = SimNet(
+        clock, seed=net_seed,
+        default_profile=LinkProfile(latency_s=0.002, jitter_s=0.0, loss=0.0),
+    )
+    net.perturb = perturb
+    booth = _GrantBooth(clock)
+    server = await net.transport("10.0.0.1").serve(
+        booth.handle, "0.0.0.0", 9000
+    )
+    alpha = await net.transport("10.0.0.2").dial("ws://10.0.0.1:9000")
+    beta = await net.transport("10.0.0.3").dial("ws://10.0.0.1:9000")
+    replies: dict[str, str] = {}
+
+    async def acquire(ws, name: str, delay_s: float) -> None:
+        await clock.sleep(delay_s)
+        await ws.send(name)
+        replies[name] = await ws.recv()
+
+    tasks = [
+        asyncio.ensure_future(acquire(alpha, "alpha", 0.0)),
+        # 6 ms stagger: baseline arrival (5 ms batch + 4 ms window) has
+        # beta landing at 10 ms, after alpha's grant at 9 ms. One extra
+        # delivery quantum on alpha (or one fewer... there are none on
+        # beta's side to remove — only alpha slipping a batch) overlaps
+        # the windows.
+        asyncio.ensure_future(acquire(beta, "beta", 0.006)),
+    ]
+    try:
+        await clock.run_for(1.0)
+        return {
+            "grants": len(booth.grants),
+            "replied": sorted(replies),
+        }
+    finally:
+        for t in tasks:
+            if not t.done():
+                t.cancel()
+        await alpha.close()
+        await beta.close()
+        server.close()
+        await clock.run_for(0.5)
+
+
+SCENARIOS = {
+    "fleet_election": _scenario_fleet_election,
+    "drain_migrate": _scenario_drain_migrate,
+    "churn": _scenario_churn,
+    "toctou_demo": _scenario_toctou_demo,
+}
+
+#: scenarios that must be fuzz-clean (toctou_demo is the deliberately
+#: broken control — it PASSES by diverging)
+CLEAN_SCENARIOS = ("fleet_election", "drain_migrate", "churn")
+
+
+# ------------------------------------------------------------- the runner
+
+
+@dataclass
+class RunResult:
+    outcome: dict
+    dropped: list
+    exceptions: list
+
+
+def _run_scenario(fn, net_seed: int, perturb) -> RunResult:
+    """One scenario run on a fresh loop, unhandled task exceptions
+    captured via the loop's exception handler (plus a gc pass so
+    dropped-handle exceptions surface before the loop dies)."""
+    exceptions: list[str] = []
+
+    def on_exception(loop, context) -> None:
+        exc = context.get("exception")
+        detail = (
+            f"{type(exc).__name__}: {exc}" if exc is not None
+            else str(context.get("message", "unknown"))
+        )
+        exceptions.append(detail)
+
+    async def main():
+        asyncio.get_running_loop().set_exception_handler(on_exception)
+        try:
+            out = await fn(net_seed, perturb)
+        except Exception as exc:
+            # a stalled bootstrap / crashed scenario IS an outcome — it
+            # diverges from the baseline instead of killing the sweep
+            out = {"scenario_error": f"{type(exc).__name__}: {exc}"}
+        # surface exceptions held by about-to-be-collected tasks NOW,
+        # while the handler is still the one we installed
+        gc.collect()
+        await asyncio.sleep(0)
+        return out
+
+    outcome = asyncio.run(main())
+    gc.collect()  # late task finalizers still route to our handler
+    dropped = outcome.pop("_dropped", [])
+    return RunResult(outcome, dropped, exceptions)
+
+
+def _harvest(result: RunResult, scenario: str, net_seed: int,
+             schedule: int | None, findings: list) -> None:
+    for exc in result.exceptions:
+        findings.append(FuzzFinding(
+            "unhandled_exception", scenario, net_seed, schedule, exc,
+        ))
+    for d in result.dropped:
+        findings.append(FuzzFinding(
+            "dropped_generation", scenario, net_seed, schedule, d,
+        ))
+
+
+def fuzz(scenario: str, net_seed: int = 0, schedules: int = 20,
+         yield_prob: float = 0.25, max_extra_quanta: int = 2,
+         ) -> list[FuzzFinding]:
+    """Replay `scenario` under `schedules` perturbed schedules and
+    return every finding. Empty list = interleaving-clean."""
+    fn = SCENARIOS[scenario]
+    findings: list[FuzzFinding] = []
+
+    baseline = _run_scenario(fn, net_seed, None)
+    _harvest(baseline, scenario, net_seed, None, findings)
+    if "scenario_error" in baseline.outcome:
+        findings.append(FuzzFinding(
+            "unhandled_exception", scenario, net_seed, None,
+            f"baseline run failed: {baseline.outcome['scenario_error']}",
+        ))
+    replay = _run_scenario(fn, net_seed, None)
+    if replay.outcome != baseline.outcome:
+        findings.append(FuzzFinding(
+            "replay_divergence", scenario, net_seed, None,
+            f"unperturbed replay disagreed: {baseline.outcome!r} "
+            f"!= {replay.outcome!r}",
+        ))
+
+    for k in range(1, schedules + 1):
+        perturb = SchedulePerturbation(
+            k, yield_prob=yield_prob, max_extra_quanta=max_extra_quanta,
+        )
+        r = _run_scenario(fn, net_seed, perturb)
+        _harvest(r, scenario, net_seed, k, findings)
+        if r.outcome != baseline.outcome:
+            findings.append(FuzzFinding(
+                "outcome_divergence", scenario, net_seed, k,
+                f"{r.outcome!r} != baseline {baseline.outcome!r}",
+            ))
+    return findings
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m bee2bee_tpu.simnet.fuzz",
+        description="seeded interleaving fuzzer over simnet scenarios",
+    )
+    ap.add_argument(
+        "--scenario", default="clean",
+        choices=sorted(SCENARIOS) + ["clean", "all"],
+        help="one scenario, 'clean' (all fuzz-clean scenarios), or 'all'",
+    )
+    ap.add_argument("--net-seed", type=int, default=0)
+    ap.add_argument("--schedules", type=int, default=20,
+                    help="perturbed schedules per scenario")
+    ap.add_argument("--yield-prob", type=float, default=0.25)
+    ap.add_argument("--max-extra-quanta", type=int, default=2)
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    if args.scenario == "clean":
+        names = list(CLEAN_SCENARIOS)
+    elif args.scenario == "all":
+        names = sorted(SCENARIOS)
+    else:
+        names = [args.scenario]
+
+    all_findings: list[FuzzFinding] = []
+    for name in names:
+        found = fuzz(
+            name, net_seed=args.net_seed, schedules=args.schedules,
+            yield_prob=args.yield_prob,
+            max_extra_quanta=args.max_extra_quanta,
+        )
+        all_findings.extend(found)
+        if not args.as_json:
+            print(f"{name}: {args.schedules} schedules, "
+                  f"{len(found)} finding(s)")
+            for f in found:
+                where = (
+                    "baseline" if f.schedule is None
+                    else f"schedule {f.schedule}"
+                )
+                print(f"  [{f.kind}] net_seed={f.net_seed} {where}: "
+                      f"{f.detail}")
+    if args.as_json:
+        print(json.dumps([asdict(f) for f in all_findings], indent=2))
+    return 1 if all_findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -95,12 +95,19 @@ class FleetSim:
         quantum_s: float = 0.005,
         with_service: bool = True,
         trace_enabled: bool = True,
+        perturb=None,
     ):
         self.clock = VirtualClock()
         self.net = SimNet(
             self.clock, seed=seed, default_profile=profile,
             quantum_s=quantum_s, trace_enabled=trace_enabled,
         )
+        # interleaving fuzzer hook (simnet.fuzz.SchedulePerturbation):
+        # biases same-deadline sleeper order, stretches delivery times by
+        # whole quanta, and forces yields at send points. None = canonical
+        # deterministic schedule.
+        self.clock.perturb = perturb
+        self.net.perturb = perturb
         self.n = n
         self.seed = seed
         self.controllers = controllers
@@ -153,7 +160,7 @@ class FleetSim:
         get_registry().reset_all()
         for i in range(self.n):
             self.nodes.append(self.build_node(i))
-        for node in self.nodes:
+        for node in list(self.nodes):  # snapshot: add_node() appends mid-start
             await node.start()
         if bootstrap:
             await self.bootstrap()

@@ -97,6 +97,9 @@ class SimNet:
         self.trace: list[tuple] = []
         self.frames_delivered = 0
         self.frames_dropped = 0
+        #: optional SchedulePerturbation (simnet.fuzz): adds whole extra
+        #: delivery quanta and forced send-point yields. None = canonical.
+        self.perturb = None
 
     # ------------------------------------------------------------ topology
 
@@ -158,6 +161,11 @@ class SimNet:
         q = self.quantum_s
         if q > 0:
             t = math.ceil(t / q) * q
+            if self.perturb is not None:
+                # whole extra quanta shift a frame into a later delivery
+                # batch; applied before the FIFO clamp so per-conn order
+                # is preserved — only *cross*-link interleaving changes
+                t += self.perturb.extra_quanta() * q
         return max(t, conn.last_delivery_t)
 
     # ------------------------------------------------------------ dial/serve
@@ -261,6 +269,12 @@ class SimConn:
     # ---------------------------------------------------------------- send
 
     async def send(self, data: str | bytes) -> None:
+        if self.net.perturb is not None and self.net.perturb.should_yield():
+            # forced task switch at an instrumented await point: models a
+            # loop that schedules another runnable task before this send
+            # proceeds. The liveness checks below re-run after the switch,
+            # exactly as real code must tolerate.
+            await self._asyncio.sleep(0)
         if self.closed or self.peer is None:
             raise wscompat.ConnectionClosedError("sim connection is closed")
         size = len(data) if isinstance(data, bytes) else len(data.encode("utf-8"))

@@ -15,6 +15,7 @@ import asyncio
 import contextlib
 import hashlib
 import json
+import logging
 import os
 import socket
 import tempfile
@@ -277,3 +278,67 @@ async def pump_queue_until(task, q, emit):
         with contextlib.suppress(BaseException):
             await task
         raise
+
+
+_task_logger = logging.getLogger("bee2bee_tpu.tasks")
+
+
+def log_task_exception(task: asyncio.Task) -> None:
+    """Done-callback that surfaces a background task's exception instead of
+    letting it vanish into "Task exception was never retrieved" at GC time.
+    Retrieving the exception here also marks it retrieved, so the asyncio
+    destructor warning never fires."""
+    if task.cancelled():
+        return
+    exc = task.exception()
+    if exc is not None:
+        _task_logger.error(
+            "background task %r crashed: %r",
+            task.get_name(),
+            exc,
+            exc_info=exc,
+        )
+
+
+class TaskTracker:
+    """Tracked background-task spawning: the `node._spawn` pattern as a
+    reusable helper, and the blessed route past meshlint ML-R002.
+
+    A raw ``asyncio.create_task`` whose handle is dropped has two failure
+    modes: its exception is silently swallowed, and asyncio holds only a
+    weak reference so GC can cancel it mid-flight. The tracker keeps a
+    strong reference until the task finishes, logs any exception via
+    `log_task_exception`, and cancels everything still running on
+    `cancel_all()` (stop/teardown). Policy (docs/ANALYSIS.md): a raw
+    create_task is fine only when the handle is awaited on every path in
+    the same function (e.g. `pump_queue_until`); every background task
+    goes through a tracker.
+    """
+
+    def __init__(self, name: str = "tasks"):
+        self.name = name
+        self._tasks: set[asyncio.Task] = set()
+
+    def spawn(self, coro, name: str | None = None) -> asyncio.Task:
+        task = asyncio.get_running_loop().create_task(coro, name=name)
+        self._tasks.add(task)
+        task.add_done_callback(self._reap)
+        return task
+
+    def _reap(self, task: asyncio.Task) -> None:
+        self._tasks.discard(task)
+        log_task_exception(task)
+
+    def __len__(self) -> int:
+        return len(self._tasks)
+
+    def __iter__(self):
+        return iter(list(self._tasks))
+
+    async def cancel_all(self) -> None:
+        tasks = [t for t in self._tasks if not t.done()]
+        for t in tasks:
+            t.cancel()
+        for t in tasks:
+            with contextlib.suppress(BaseException):
+                await t

@@ -35,7 +35,7 @@ except ImportError:  # gate the missing dep: loopback shim (wscompat.py)
 
 from .. import protocol
 from ..joinlink import parse_join_link
-from ..utils import new_id
+from ..utils import TaskTracker, new_id
 
 logger = logging.getLogger("bee2bee_tpu.web.bridge")
 
@@ -55,6 +55,7 @@ class MeshBridge:
         self.pending: dict[str, dict] = {}
         self.total_requests = 0
         self.total_tokens = 0
+        self._tasks = TaskTracker("bridge")  # logs crashes, cancelled on stop
         self._reader_task: asyncio.Task | None = None
         self._reconnect_task: asyncio.Task | None = None
         self._stopped = False
@@ -67,20 +68,16 @@ class MeshBridge:
 
     async def stop(self):
         self._stopped = True
-        for task in (self._reader_task, self._reconnect_task):
-            if task:
-                task.cancel()
-                with contextlib.suppress(asyncio.CancelledError):
-                    await task
-        if self.active_ws is not None:
+        await self._tasks.cancel_all()
+        ws, self.active_ws = self.active_ws, None
+        if ws is not None:
             with contextlib.suppress(Exception):
-                await self.active_ws.close()
-        self.active_ws = None
+                await ws.close()
         self.active_url = None
         for req in self.pending.values():
             if not req["fut"].done():
                 req["fut"].set_exception(RuntimeError("bridge stopped"))
-        self.pending.clear()
+        self.pending.clear()  # meshlint: ignore[ML-R003] -- rid-keyed futures map: request/_reader touch only their own rid; stop sweeps after cancel_all
 
     async def connect(self) -> bool:
         """Dial the registered node first, then the seeds, keeping the
@@ -110,7 +107,7 @@ class MeshBridge:
             self.active_ws, self.active_url = ws, url
             if self._reader_task:
                 self._reader_task.cancel()
-            self._reader_task = asyncio.create_task(self._reader(ws))
+            self._reader_task = self._tasks.spawn(self._reader(ws))
             logger.info("bridge connected to %s", url)
             return True
         return False
@@ -124,7 +121,7 @@ class MeshBridge:
             if not self._stopped and self.active_ws is None:
                 await self.connect()
 
-        self._reconnect_task = asyncio.create_task(later())
+        self._reconnect_task = self._tasks.spawn(later())
 
     # ------------------------------------------------------------ dialect
 
@@ -214,10 +211,12 @@ class MeshBridge:
         if not addrs:
             raise ValueError("join link carries no addresses")
         self.registered_node = addrs[0]
-        if self.active_ws is not None:
+        # claim-then-close: null the attr BEFORE the await so a reconnect
+        # landing during close() can't be clobbered (ML-R001 window)
+        stale, self.active_ws = self.active_ws, None
+        if stale is not None:
             with contextlib.suppress(Exception):
-                await self.active_ws.close()
-            self.active_ws = None
+                await stale.close()
         ok = await self.connect()
         return {"ok": ok, "node_id": node_id, "addr": addrs[0]}
 

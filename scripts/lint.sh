@@ -39,6 +39,16 @@ if [ "${SKIP_BENCHDIFF:-0}" != "1" ]; then
   fi
 fi
 
+# interleaving-fuzzer smoke (docs/SIMULATION.md "The interleaving
+# fuzzer"): the fleet-election scenario under 3 perturbed schedules must
+# stay finding-free. Bounded (~4s, fully virtual time); the full 20-
+# schedule sweeps over every clean scenario live in tests/test_simnet_fuzz.py.
+# SKIP_FUZZ=1 skips it.
+if [ "${SKIP_FUZZ:-0}" != "1" ]; then
+  echo "[lint] interleaving fuzzer smoke (fleet_election, 3 schedules)"
+  "$PY" -m bee2bee_tpu.simnet.fuzz --scenario fleet_election --schedules 3
+fi
+
 # telemetry smoke (docs/OBSERVABILITY.md): loopback node + one generation;
 # /metrics must parse as Prometheus text with the mandatory series present.
 # SKIP_SMOKE=1 skips it (e.g. environments without aiohttp sockets).

@@ -496,3 +496,53 @@ def test_auth_non_ascii_header_rejected_not_500():
     assert not _auth_ok(_Req({"X-API-KEY": "café"}), "sekrit")
     assert not _auth_ok(_Req({"Authorization": "Bearer café"}), "sekrit")
     assert _auth_ok(_Req({"X-API-KEY": "café"}), "café")
+
+
+async def test_abandoned_p2p_stream_cancels_generation_and_getter():
+    """An abandoned stream (client hangs up mid-body) must not leave the
+    P2P generation decoding to its token budget for nobody, nor a
+    pending q.get() task dangling: _stream_p2p's finally cancels both."""
+    import asyncio
+
+    async with mesh(2) as (gateway, provider):
+        provider.add_service(
+            FakeService("slow-model", reply="x" * 200, chunk_size=1,
+                        delay_s=0.02)
+        )
+        await gateway.connect_bootstrap(provider.addr)
+        assert await _settle(lambda: gateway.providers)
+        client = await _client(gateway)
+        try:
+            r = await client.post(
+                "/chat",
+                json={"prompt": "q", "model": "slow-model", "stream": True},
+            )
+            assert r.status == 200
+            await r.content.read(8)  # stream is live, generation in flight
+
+            def gen_tasks():
+                return [
+                    t for t in asyncio.all_tasks()
+                    if "request_generation" in getattr(
+                        t.get_coro(), "__qualname__", ""
+                    )
+                ]
+
+            assert gen_tasks(), "generation task never started"
+            r.close()  # the hang-up: connection dies mid-stream
+            assert await _settle(lambda: not gen_tasks(), timeout=3.0), (
+                "request_generation task survived the abandoned stream"
+            )
+            # no orphaned q.get() getter either (its cancellation lands
+            # one loop pass later)
+            def getters():
+                return [
+                    t for t in asyncio.all_tasks()
+                    if "Queue.get" in getattr(t.get_coro(), "__qualname__", "")
+                ]
+
+            assert await _settle(lambda: not getters(), timeout=2.0), (
+                "q.get() getter task survived the abandoned stream"
+            )
+        finally:
+            await client.close()

@@ -777,3 +777,217 @@ def deadline(timeout_s):
 
 def test_clock_rule_in_catalog():
     assert "ML-C001" in rule_catalog()
+
+
+# ---------------------------------------------------- raceguard pass fixtures
+
+
+def test_raceguard_r001_known_bad_fixture():
+    """ML-R001: check `self.X`, await, then write `self.X` without
+    re-checking — the await is a suspension point where another
+    coroutine can invalidate the check."""
+    src = '''
+class Booth:
+    async def grant(self, who):
+        if self.holder is None:
+            await self.bookkeeping(who)
+            self.holder = who
+'''
+    rules = _rules(analyze_source(src, "meshnet/fixture.py"))
+    assert "ML-R001" in rules, rules
+
+
+def test_raceguard_r001_clean_twins():
+    """Re-checking after the await, or holding a lock around the whole
+    check+act, clears the finding."""
+    rechecked = '''
+class Booth:
+    async def grant(self, who):
+        if self.holder is None:
+            await self.bookkeeping(who)
+            if self.holder is None:
+                self.holder = who
+'''
+    locked = '''
+class Booth:
+    async def grant(self, who):
+        async with self._lock:
+            if self.holder is None:
+                await self.bookkeeping(who)
+                self.holder = who
+'''
+    for src in (rechecked, locked):
+        rules = _rules(analyze_source(src, "meshnet/fixture.py"))
+        assert "ML-R001" not in rules, rules
+
+
+def test_raceguard_r002_known_bad_fixture():
+    """ML-R002: a create_task handle that is dropped (bare statement) or
+    bound to a name never read again — exceptions vanish and asyncio's
+    weak reference lets GC cancel the task mid-flight."""
+    src = '''
+import asyncio
+
+class Svc:
+    async def start(self):
+        asyncio.create_task(self.loop())
+        t = asyncio.create_task(self.other())
+        self.ready = True
+'''
+    rules = _rules(analyze_source(src, "meshnet/fixture.py"))
+    assert rules.count("ML-R002") == 2, rules
+
+
+def test_raceguard_r002_clean_twins():
+    """Awaiting the handle, reading the bound attribute (cancellation,
+    done-callback), or a tracked spawn helper all clear the finding."""
+    src = '''
+import asyncio
+
+class Svc:
+    async def start(self):
+        t = asyncio.create_task(self.loop())
+        await t
+        self._task = asyncio.create_task(self.other())
+        self._task.add_done_callback(print)
+        self._tasks.spawn(self.third())
+'''
+    rules = _rules(analyze_source(src, "meshnet/fixture.py"))
+    assert "ML-R002" not in rules, rules
+
+
+def test_raceguard_r003_known_bad_fixture():
+    """ML-R003: a shared container mutated after awaits from two
+    distinct coroutine entry points with no lock on any mutation path."""
+    src = '''
+class Hub:
+    async def _handle_join(self, ws, data):
+        await self.notify(ws)
+        self.subs[data["id"]] = ws
+
+    async def _handle_leave(self, ws, data):
+        await self.notify(ws)
+        self.subs.pop(data["id"], None)
+'''
+    rules = _rules(analyze_source(src, "meshnet/fixture.py"))
+    assert "ML-R003" in rules, rules
+
+
+def test_raceguard_r003_clean_twins():
+    """A lock on the mutation paths — or a single entry point — clears
+    the finding."""
+    locked = '''
+class Hub:
+    async def _handle_join(self, ws, data):
+        async with self._lock:
+            await self.notify(ws)
+            self.subs[data["id"]] = ws
+
+    async def _handle_leave(self, ws, data):
+        async with self._lock:
+            await self.notify(ws)
+            self.subs.pop(data["id"], None)
+'''
+    single = '''
+class Hub:
+    async def _handle_join(self, ws, data):
+        await self.notify(ws)
+        self.subs[data["id"]] = ws
+'''
+    for src in (locked, single):
+        rules = _rules(analyze_source(src, "meshnet/fixture.py"))
+        assert "ML-R003" not in rules, rules
+
+
+def test_raceguard_r004_known_bad_fixture():
+    """ML-R004: awaiting inside iteration over a shared container —
+    a mutation during the suspension invalidates the iterator."""
+    src = '''
+class Hub:
+    async def broadcast(self, msg):
+        for ws in self.conns:
+            await ws.send(msg)
+'''
+    rules = _rules(analyze_source(src, "meshnet/fixture.py"))
+    assert "ML-R004" in rules, rules
+
+
+def test_raceguard_r004_clean_twins():
+    """Materializing a snapshot (list()/tuple()/sorted()) or holding a
+    lock across the loop clears the finding."""
+    src = '''
+class Hub:
+    async def broadcast(self, msg):
+        for ws in list(self.conns):
+            await ws.send(msg)
+        for ws in sorted(self.conns):
+            await ws.send(msg)
+        async with self._lock:
+            for ws in self.conns:
+                await ws.send(msg)
+'''
+    rules = _rules(analyze_source(src, "meshnet/fixture.py"))
+    assert "ML-R004" not in rules, rules
+
+
+def test_seeded_toctou_in_real_node_is_caught():
+    """The acceptance seed: rewrite node.py's begin_drain into a
+    check-then-act split across the drain await — ML-R001 must fire on
+    the real source."""
+    src = (PACKAGE_ROOT / "meshnet" / "node.py").read_text()
+    seeded = src.replace(
+        "        self.drain_source = source\n"
+        "        return await self.migration.drain(stop=stop, wait=wait)",
+        "        if self.drain_source is None:\n"
+        "            await self.migration.drain(stop=stop, wait=wait)\n"
+        "            self.drain_source = source\n"
+        "        return {}",
+        1,
+    )
+    assert seeded != src, "begin_drain body moved; update the seed"
+    assert any(
+        f.rule == "ML-R001" and "drain_source" in f.message
+        for f in analyze_source(seeded, "meshnet/node.py")
+    )
+
+
+def test_seeded_dropped_handle_in_real_migrate_is_caught():
+    """Drop the stop-task binding in migrate.py — the bare create_task
+    statement must trip ML-R002 on the real source."""
+    src = (PACKAGE_ROOT / "meshnet" / "migrate.py").read_text()
+    seeded = src.replace(
+        "self._stop_task = asyncio.create_task", "asyncio.create_task", 1
+    )
+    assert seeded != src, "migrate.py stop-task spawn moved; update the seed"
+    assert any(
+        f.rule == "ML-R002" for f in analyze_source(seeded, "meshnet/migrate.py")
+    )
+
+
+def test_toctou_demo_suppression_and_static_detection():
+    """The fuzzer's deliberately raceable demo (simnet/fuzz.py) ships
+    with a reasoned suppression — stripping it must expose ML-R001, so
+    the SAME bug the fuzzer provokes dynamically is also caught
+    statically."""
+    fuzz_py = PACKAGE_ROOT / "simnet" / "fuzz.py"
+    src = fuzz_py.read_text()
+    assert "ignore[ML-R001]" in src
+    assert analyze_paths([fuzz_py]) == []
+    stripped = src.replace("# meshlint: ignore[ML-R001]", "# stripped", 1)
+    assert any(
+        f.rule == "ML-R001" and "holder" in f.message
+        for f in analyze_source(stripped, "simnet/fuzz.py")
+    )
+
+
+def test_raceguard_scope_and_catalog():
+    from bee2bee_tpu.analysis.raceguard import RaceGuardPass
+
+    p = RaceGuardPass()
+    for path in ("meshnet/node.py", "router/policy.py", "fleet/controller.py",
+                 "web/bridge.py", "api.py", "simnet/fuzz.py"):
+        assert p.applies(path), path
+    for path in ("engine/scheduler.py", "models/llama.py", "bench.py"):
+        assert not p.applies(path), path
+    for rule in ("ML-R001", "ML-R002", "ML-R003", "ML-R004"):
+        assert rule in rule_catalog(), rule

@@ -1,0 +1,153 @@
+"""Interleaving fuzzer (bee2bee_tpu/simnet/fuzz.py): the dynamic raceguard.
+
+The clean scenarios (fleet election, drain+migrate, churn) must survive
+20 perturbed-but-legal schedules each with zero findings — that is the
+sanitizer gate. The deliberately raceable TOCTOU demo must diverge
+(double-grant), proving the fuzzer actually provokes the bug class the
+static ML-R001 pass flags; its findings must replay bit-identically
+from their (scenario, net_seed, schedule) coordinates.
+
+These are SYNC tests: fuzz() drives its own event loops via asyncio.run,
+one fresh loop per scheduled run.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from bee2bee_tpu.simnet.fuzz import (
+    CLEAN_SCENARIOS,
+    SCENARIOS,
+    FuzzFinding,
+    SchedulePerturbation,
+    _run_scenario,
+    fuzz,
+)
+
+# ------------------------------------------------------------ sanitizer gate
+
+
+def test_fleet_election_is_interleaving_clean_over_20_schedules():
+    findings = fuzz("fleet_election", net_seed=0, schedules=20)
+    assert findings == [], [f"{f.kind}@{f.schedule}: {f.detail}" for f in findings]
+
+
+def test_drain_migrate_is_interleaving_clean_over_20_schedules():
+    findings = fuzz("drain_migrate", net_seed=0, schedules=20)
+    assert findings == [], [f"{f.kind}@{f.schedule}: {f.detail}" for f in findings]
+
+
+def test_churn_is_interleaving_clean_over_20_schedules():
+    """The scenario that found the dual-dial half-open-link bug
+    (schedule 4: a loser's FIN racing the winner's hello left one side
+    permanently deaf) — pinned clean after the _helloed_ws fix."""
+    findings = fuzz("churn", net_seed=0, schedules=20)
+    assert findings == [], [f"{f.kind}@{f.schedule}: {f.detail}" for f in findings]
+
+
+# ------------------------------------------------------------ the demo bug
+
+
+def test_toctou_demo_is_caught_by_the_fuzzer():
+    """The seeded check-then-act demo must double-grant under at least
+    one perturbed schedule while the baseline stays single-grant."""
+    findings = fuzz("toctou_demo", net_seed=0, schedules=20)
+    assert findings, "the TOCTOU demo never diverged — fuzzer lost its teeth"
+    assert all(f.kind == "outcome_divergence" for f in findings), findings
+    assert all(f.schedule is not None for f in findings), "baseline diverged"
+    assert any("'grants': 2" in f.detail for f in findings), findings
+
+
+def test_findings_replay_from_their_coordinates():
+    """A finding is reproducible from (scenario, net_seed, schedule)
+    alone: re-running the exact perturbed schedule yields the exact
+    divergent outcome, twice."""
+    findings = fuzz("toctou_demo", net_seed=0, schedules=20)
+    f = findings[0]
+    runs = [
+        _run_scenario(
+            SCENARIOS[f.scenario], f.net_seed, SchedulePerturbation(f.schedule)
+        ).outcome
+        for _ in range(2)
+    ]
+    assert runs[0] == runs[1]
+    assert runs[0]["grants"] == 2, runs[0]
+
+
+# ------------------------------------------------------- detection plumbing
+
+
+def test_unhandled_task_exception_is_a_finding():
+    """A task that dies unawaited must surface as an unhandled_exception
+    finding via the loop exception handler + gc pass."""
+
+    async def bad(net_seed, perturb):
+        async def boom():
+            raise ValueError("kaboom")
+
+        asyncio.ensure_future(boom())
+        await asyncio.sleep(0)
+        await asyncio.sleep(0)
+        return {"ok": True}
+
+    SCENARIOS["_test_bad"] = bad
+    try:
+        findings = fuzz("_test_bad", schedules=1)
+    finally:
+        del SCENARIOS["_test_bad"]
+    assert any(
+        f.kind == "unhandled_exception" and "kaboom" in f.detail
+        for f in findings
+    ), findings
+
+
+def test_dropped_generation_is_a_finding():
+    async def dropper(net_seed, perturb):
+        return {"ok": True, "_dropped": ["generation 'g-1' did not complete"]}
+
+    SCENARIOS["_test_drop"] = dropper
+    try:
+        findings = fuzz("_test_drop", schedules=1)
+    finally:
+        del SCENARIOS["_test_drop"]
+    kinds = [f.kind for f in findings]
+    # baseline + 1 schedule both report the drop
+    assert kinds.count("dropped_generation") == 2, findings
+
+
+def test_scenario_crash_is_an_outcome_not_an_abort():
+    """A scenario that stalls/crashes under one schedule must register
+    as a divergence (scenario_error outcome), not kill the sweep."""
+
+    async def flaky(net_seed, perturb):
+        if perturb is not None and perturb.seed == 1:
+            raise RuntimeError("bootstrap stalled")
+        return {"ok": True}
+
+    SCENARIOS["_test_flaky"] = flaky
+    try:
+        findings = fuzz("_test_flaky", schedules=2)
+    finally:
+        del SCENARIOS["_test_flaky"]
+    assert len(findings) == 1, findings
+    assert findings[0].kind == "outcome_divergence"
+    assert "bootstrap stalled" in findings[0].detail
+
+
+def test_perturbation_streams_are_seed_deterministic():
+    a, b = SchedulePerturbation(7), SchedulePerturbation(7)
+    assert [a.sleep_bias() for _ in range(8)] == [b.sleep_bias() for _ in range(8)]
+    assert [a.extra_quanta() for _ in range(8)] == [b.extra_quanta() for _ in range(8)]
+    assert [a.should_yield() for _ in range(8)] == [b.should_yield() for _ in range(8)]
+    c = SchedulePerturbation(8)
+    assert [a.sleep_bias() for _ in range(8)] != [c.sleep_bias() for _ in range(8)]
+
+
+def test_clean_scenario_registry_excludes_the_demo():
+    assert set(CLEAN_SCENARIOS) <= set(SCENARIOS)
+    assert "toctou_demo" in SCENARIOS and "toctou_demo" not in CLEAN_SCENARIOS
+
+
+def test_finding_is_a_value_object():
+    f = FuzzFinding("outcome_divergence", "churn", 0, 4, "x != y")
+    assert f.schedule == 4 and f.scenario == "churn"
