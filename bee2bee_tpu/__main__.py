@@ -85,6 +85,8 @@ def _apply_common_cfg(cfg, kw):
         cfg.paged = True
     if kw.get("spec_tokens") is not None:
         cfg.spec_tokens = kw["spec_tokens"]
+    if kw.get("drafter") is not None:
+        cfg.drafter = kw["drafter"]
     if kw.get("adapters"):
         cfg.adapters = kw["adapters"]
     if kw.get("max_adapters") is not None:
@@ -182,6 +184,13 @@ def cli():
                    "step by n-gram lookup over the request's own "
                    "prompt+output and verify them in one batched forward "
                    "(greedy rows; BEE2BEE_SPEC; 0 = off)")
+@click.option("--drafter", default=None,
+              help="model-tier speculative drafter (requires --spec > 0): a "
+                   "registry model name or checkpoint dir loaded resident "
+                   "beside the target, or 'mesh' to stream drafts from a "
+                   "BEE2BEE_DISAGG=draft peer. Rows where the n-gram tier "
+                   "disables itself escalate to this tier instead of going "
+                   "dark (BEE2BEE_DRAFTER; empty = n-gram only)")
 @click.option("--adapters", default=None,
               help="batched multi-LoRA serving: comma-separated "
                    "name=path.npz adapters preloaded into the hot-swap "
@@ -199,13 +208,14 @@ def cli():
                    "(zero local checkpoint)")
 @_common_opts
 def serve_tpu(model, checkpoint, lora, mesh_shape, attention, quantize,
-              kv_quant, paged, spec_tokens, adapters, max_adapters,
+              kv_quant, paged, spec_tokens, drafter, adapters, max_adapters,
               publish_weights, from_mesh, **kw):
     """Serve a model on TPU via the jit engine (the flagship entrypoint)."""
     _serve(
         "tpu", model, checkpoint=checkpoint, lora=lora, mesh_shape=mesh_shape,
         attention=attention, quantize=quantize, kv_quant=kv_quant, paged=paged,
-        spec_tokens=spec_tokens, adapters=adapters, max_adapters=max_adapters,
+        spec_tokens=spec_tokens, drafter=drafter, adapters=adapters,
+        max_adapters=max_adapters,
         publish_weights=publish_weights, from_mesh=from_mesh, **kw
     )
 

@@ -236,6 +236,26 @@ FRAME_SCHEMAS: dict[str, FrameSchema] = {
             required=frozenset({"peer_id", "service", "adapters"}),
             optional=frozenset({"models"}),
         ),
+        # mesh-tiered speculative decoding (meshnet/draft.py): the serving
+        # node streams one row's context to the draft-role peer. `base` is
+        # the context length the server already holds for this rid (0 = full
+        # resend), `tokens` the delta to append, `k` the draft width,
+        # `model` the target model name (the server refuses a mismatched
+        # drafter); {rid, done:true} frees the server-side row.
+        _fs(
+            P.DRAFT_REQUEST,
+            required=frozenset({"rid"}),
+            optional=frozenset({"base", "tokens", "k", "done", "model"}),
+        ),
+        # the draft answer: `pos` is the context length the draft continues
+        # from (the client drops stale results after a rejection re-sync),
+        # `draft` the proposed tokens, `reprime` asks for a full resend
+        # (server lost/never had the row), `error` the typed failure
+        _fs(
+            P.DRAFT_RESULT,
+            required=frozenset({"rid"}),
+            optional=frozenset({"pos", "draft", "reprime", "error"}),
+        ),
         # task protocol: per-kind field contracts live in TASK_SCHEMAS —
         # the TASK envelope itself only promises kind + correlation id
         _fs(P.TASK, required=frozenset({"kind", "task_id"}), allow_extra=True),

@@ -35,6 +35,7 @@ _ENV_MAP = {
     "BEE2BEE_KV_POOL_BLOCKS": "kv_pool_blocks",
     "BEE2BEE_KV_QUANT": "kv_quant",
     "BEE2BEE_SPEC": "spec_tokens",
+    "BEE2BEE_DRAFTER": "drafter",
     "BEE2BEE_ADAPTERS": "adapters",
     "BEE2BEE_MAX_ADAPTERS": "max_adapters",
     "BEE2BEE_QUANTIZE": "quantize",
@@ -96,6 +97,13 @@ class NodeConfig:
     # them in one batched forward (BEE2BEE_SPEC / --spec; 0 = off —
     # EngineConfig.spec_tokens)
     spec_tokens: int = 0
+    # model-tier speculative drafter (BEE2BEE_DRAFTER / --drafter):
+    # "" = n-gram tier only; "mesh" = drafts stream from a draft-role
+    # peer (BEE2BEE_DISAGG=draft); any other value = a registry model
+    # name or checkpoint path loaded resident beside the target. On a
+    # draft-role node this names the model the DraftServer hosts.
+    # Requires spec_tokens > 0 (EngineConfig.drafter)
+    drafter: str = ""
     # batched multi-LoRA serving (adapters/): comma-separated
     # name=path.npz adapters preloaded into the engine's hot-swap pool
     # AND published as pieces manifests on the DHT (BEE2BEE_ADAPTERS /
@@ -140,6 +148,7 @@ class NodeConfig:
             kv_block_size=self.kv_block_size,
             kv_pool_blocks=self.kv_pool_blocks or None,
             spec_tokens=self.spec_tokens,
+            drafter=self.drafter,
             # --adapters implies a pool even when no slot count was set:
             # the operator clearly wants multi-adapter serving
             max_adapters=self.max_adapters or (8 if self.adapters else 0),

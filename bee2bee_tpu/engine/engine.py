@@ -197,6 +197,21 @@ class EngineConfig:
     # non-repetitive content).
     spec_min_accept: float = 0.25
     spec_probe_tokens: int = 64
+    # model-tier drafter (engine/drafter.py): "" / None = n-gram only;
+    # "mesh" = a BEE2BEE_DISAGG=draft peer hosts the model and streams
+    # drafts over draft_request/draft_result frames; anything else is a
+    # registry name or checkpoint path for a small model loaded RESIDENT
+    # beside the target (vocab/tokenizer-compat gated at boot — a
+    # mismatch is a typed DrafterLoadError, never a silent garbage-draft
+    # loop). Rows where n-gram fails its probe escalate to this tier
+    # instead of dropping to plain decode. Requires spec_tokens > 0.
+    # None = resolve from BEE2BEE_DRAFTER at construction.
+    drafter: str | None = None
+    # rng seed for a random-init (registry-name, no checkpoint) drafter.
+    # None = the engine's rng_seed — which makes a same-name drafter
+    # WEIGHT-IDENTICAL to a random-init target (the bench's CPU proxy
+    # for a well-distilled drafter: greedy acceptance ~1).
+    drafter_seed: int | None = None
     # batched multi-LoRA serving (adapters/pool.py): slots for hot-
     # swappable adapters over the one resident base model — per-row
     # adapter selection inside the SAME decode step (a mixed batch
@@ -254,6 +269,15 @@ class EngineConfig:
         if self.readback_depth is None:
             self.readback_depth = _env_int("BEE2BEE_READBACK_DEPTH", 2)
         self.readback_depth = max(1, int(self.readback_depth))
+        if self.drafter is None:
+            self.drafter = (os.environ.get("BEE2BEE_DRAFTER") or "").strip()
+        if self.drafter_seed is None:
+            self.drafter_seed = self.rng_seed
+        if self.drafter and not self.spec_tokens:
+            raise ValueError(
+                "drafter set but spec_tokens is 0: the drafter feeds the "
+                "speculative verify path — set spec_tokens (--spec) too"
+            )
 
 
 @dataclass
@@ -430,6 +454,35 @@ class InferenceEngine:
             # show ((None, None) before the first load reads as 0)
             self.introspect.ledger.register(
                 "adapter_pool", lambda: self.adapter_pool.device_args()
+            )
+        # model-tier drafter (engine/drafter.py): loaded RESIDENT beside
+        # the target, tokenizer-compat gated (typed DrafterLoadError at
+        # boot — never a silent garbage-draft loop at serve time).
+        # "mesh" loads nothing here: the scheduler builds the MeshDrafter
+        # client and meshnet/draft.py attaches the transport.
+        self.drafter_model = None
+        if self.engine_cfg.drafter and self.engine_cfg.drafter != "mesh":
+            from .drafter import DraftModel, validate_drafter_compat
+
+            spec = self.engine_cfg.drafter
+            ckpt = spec if os.path.exists(spec) else None
+            self.drafter_model = DraftModel(
+                "auto" if ckpt else spec,
+                spec_tokens=self.engine_cfg.spec_tokens,
+                batch=self.engine_cfg.max_batch,
+                target_max_seq_len=self.max_seq_len,
+                dtype=self.dtype,
+                seed=self.engine_cfg.drafter_seed,
+                checkpoint_path=ckpt,
+                sentinel=self.introspect.sentinel,
+            )
+            validate_drafter_compat(
+                self.model_cfg, self.tokenizer, self.drafter_model.cfg,
+                self.drafter_model.tokenizer or self.tokenizer,
+            )
+            self.introspect.ledger.register(
+                "drafter", lambda: self.drafter_model.hbm_source()
+                if self.drafter_model is not None else None
             )
 
     # ------------------------------------------------------------ compiled fns
@@ -857,6 +910,9 @@ class InferenceEngine:
             sch, self._scheduler = self._scheduler, None
         if sch is not None:
             sch.shutdown()
+        if self.drafter_model is not None:
+            self.drafter_model.close()
+            self.drafter_model = None
         # drop out of the economics digest (a closed engine must not keep
         # its params pinned through the ledger, nor report stale gauges)
         self.introspect.close()
@@ -1258,6 +1314,12 @@ class InferenceEngine:
                 round(st.spec_accepted / drafted, 4) if drafted else 0.0
             ),
         }
+        # tiered drafting: per-tier split only when a drafter is
+        # configured (the base dict shape above is pinned by tests and
+        # the dashboards' scrape schema)
+        if self.engine_cfg.drafter:
+            out["spec"]["drafter"] = self.engine_cfg.drafter
+            out["spec"]["tiers"] = dict(st.spec_tiers) if st else {}
         # multi-adapter serving: residency + pool churn (dashboards, the
         # mesh hello's service metadata, and the router's placement input
         # all read this through TPUService.get_metadata)

@@ -102,6 +102,11 @@ _G_GOODPUT_FRAC = _REG.gauge(
     "engine.goodput_fraction",
     "useful / scheduled tokens over the trailing window (0..1)",
 )
+_G_SPEC_ACCEPT = _REG.gauge(
+    "engine.spec_acceptance",
+    "cumulative accepted/drafted speculative tokens per drafter tier "
+    "(tier label; absent until that tier has drafted)",
+)
 _G_HBM_BYTES = _REG.gauge(
     "engine.hbm_bytes", "live device memory by component (bytes)"
 )
@@ -434,16 +439,19 @@ def _tree_device_bytes(tree) -> int:
 
     total = 0
     for leaf in jax.tree.leaves(tree):
-        shards = getattr(leaf, "addressable_shards", None)
-        if shards:
-            try:
+        try:
+            # even the attribute READ raises on a donated/deleted array —
+            # a source torn down concurrently (e.g. a closed drafter)
+            # must count as 0 bytes, not break the whole snapshot
+            shards = getattr(leaf, "addressable_shards", None)
+            if shards:
                 total += sum(s.data.nbytes for s in shards)
                 continue
-            except Exception:  # noqa: BLE001 — fall through to nbytes
-                pass
-        nbytes = getattr(leaf, "nbytes", None)
-        if nbytes:
-            total += int(nbytes)
+            nbytes = getattr(leaf, "nbytes", None)
+            if nbytes:
+                total += int(nbytes)
+        except Exception:  # noqa: BLE001 — deleted buffers count as 0
+            continue
     return total
 
 
@@ -645,6 +653,9 @@ class GoodputMeter:
         # vanish from the denominator (useful > scheduled for a window)
         self._snaps.append((time.time(), 0, 0, 0.0))
         self._last_snap = 0.0
+        # tier -> [drafted, accepted], cumulative. The tier label set is
+        # closed (spec.TIER_LADDER), so cardinality is bounded.
+        self._spec_tiers: dict[str, list] = {}
 
     def record_dispatch(self, positions: float, ctx: float,
                         scheduled: int) -> None:
@@ -667,6 +678,23 @@ class GoodputMeter:
             with self._lock:
                 self.useful_total += int(n)
             self._maybe_snap()
+        except Exception:  # noqa: BLE001 — telemetry never throws
+            pass
+
+    def note_spec(self, tier: str, drafted: int, accepted: int) -> None:
+        """Book one row's verify outcome against its drafter tier.
+
+        Rejected drafts are already inside the scheduled/useful split
+        (record_dispatch counts the [B,K+1] width, note_useful only the
+        survivors); this adds the per-tier acceptance view on top so the
+        goodput snapshot can say WHICH tier is paying for itself."""
+        try:
+            if drafted <= 0:
+                return
+            with self._lock:
+                t = self._spec_tiers.setdefault(tier, [0, 0])
+                t[0] += int(drafted)
+                t[1] += int(accepted)
         except Exception:  # noqa: BLE001 — telemetry never throws
             pass
 
@@ -706,6 +734,17 @@ class GoodputMeter:
                 "useful_tokens_total": self.useful_total,
                 "model_flops_total": self.flops_total,
             }
+            with self._lock:
+                spec_tiers = {k: tuple(v) for k, v in self._spec_tiers.items()}
+            if spec_tiers:
+                out["spec_tiers"] = {
+                    k: {"drafted": d, "accepted": a,
+                        "acceptance": round(a / d, 4) if d else 0.0}
+                    for k, (d, a) in spec_tiers.items()
+                }
+                for k, (d, a) in spec_tiers.items():
+                    if d:
+                        _G_SPEC_ACCEPT.set(a / d, tier=k)
             if snaps[-1][0] - ref[0] <= 0:
                 for g in (_G_MFU, _G_GOODPUT, _G_SCHEDULED_TPS,
                           _G_GOODPUT_FRAC):

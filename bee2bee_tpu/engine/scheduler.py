@@ -75,7 +75,13 @@ from .paged import (
     prefill_chunk_positions,
 )
 from .sampling import sample_batched
-from .spec import NgramDrafter, should_disable
+from .spec import (
+    TIER_OFF,
+    DrafterStack,
+    MeshDrafter,
+    NgramDrafter,
+    should_disable,
+)
 
 logger = logging.getLogger("bee2bee_tpu.scheduler")
 
@@ -98,10 +104,14 @@ _G_BATCH_FILL = _REG.gauge(
 )
 _G_ACTIVE_ROWS = _REG.gauge("engine.active_rows", "rows decoding this step")
 _C_SPEC_DRAFTED = _REG.counter(
-    "engine.spec_drafted", "speculative tokens proposed"
+    "engine.spec_drafted", "speculative tokens proposed (tier label)"
 )
 _C_SPEC_ACCEPTED = _REG.counter(
-    "engine.spec_accepted", "speculative tokens accepted"
+    "engine.spec_accepted", "speculative tokens accepted (tier label)"
+)
+_C_SPEC_DEGRADED = _REG.counter(
+    "engine.spec_mesh_degraded",
+    "rows degraded off the mesh draft tier (reason label)",
 )
 
 
@@ -177,17 +187,27 @@ class Request:
         self.bucket = 0
         self.chunks_decoded = 0  # observability: early-exit is visible here
         self._flushed_text = ""
-        # self-speculative decoding bookkeeping (engine/spec.py): per-row
-        # drafted/accepted counters feed the adaptive disable — a row
-        # whose acceptance collapses stops paying for draft lookups.
-        # spec_misses counts eligible steps where the drafter found no
-        # repeating n-gram at all; each weighs like a fully-rejected
-        # K-token draft in the disable math, so non-repetitive rows
-        # also revert to full decode windows after the probe budget.
+        # speculative-decoding bookkeeping (engine/spec.py): lifetime
+        # drafted/accepted/miss totals feed stats/info; the spec_tier_*
+        # triple is the CURRENT tier's probe ledger — it resets on every
+        # tier transition so each tier gets its own probe budget. A row
+        # starts on the stack's cheapest tier (lazily, at its first
+        # draft attempt) and moves through the ladder instead of dying:
+        # a tier that fails its probe joins spec_tiers_failed (never
+        # retried) and the row demotes/escalates via DrafterStack
+        # .next_tier until the ladder is exhausted (spec_tier == "off").
+        # spec_misses counts eligible steps where the tier proposed
+        # nothing; each weighs like a fully-rejected K-token draft in
+        # the probe math, so a tier blind to this row's content fails
+        # its probe without ever drafting.
         self.spec_drafted = 0
         self.spec_accepted = 0
         self.spec_misses = 0
-        self.spec_disabled = False
+        self.spec_tier: str | None = None  # None = not yet assigned
+        self.spec_tiers_failed: set = set()
+        self.spec_tier_drafted = 0
+        self.spec_tier_accepted = 0
+        self.spec_tier_misses = 0
 
     # ---- token accounting (runs on the scheduler thread) ----
 
@@ -261,6 +281,11 @@ class SchedulerStats:
     spec_steps: int = 0
     spec_drafted: int = 0
     spec_accepted: int = 0
+    # per-tier split of the two totals above: tier name ("ngram"/"model"/
+    # "mesh") -> {"drafted": n, "accepted": n}. Dashboards judge EACH
+    # tier's acceptance — the model tier earning 0.6 while n-gram sits
+    # at 0.05 is exactly the signal the tier ladder acts on.
+    spec_tiers: dict = field(default_factory=dict)
     # decode hot loop (docs/PERF.md): windows whose dispatch carried the
     # [B, 2, V] penalty counts (fused root or split pen root alike) — the
     # "penalized rows park the whole batch on the counts window" cost is
@@ -540,11 +565,32 @@ class BatchScheduler:
                     e.engine_cfg.spec_tokens, e.max_seq_len,
                 )
             else:
-                self._spec = NgramDrafter(
-                    e.engine_cfg.spec_tokens,
-                    e.engine_cfg.spec_min_match,
-                    e.engine_cfg.spec_max_match,
-                )
+                # the tiered drafter stack (engine/spec.py): n-gram is
+                # always present as the zero-cost floor; the resident
+                # model tier joins when the engine loaded one
+                # (--drafter <model>); the mesh tier joins when the
+                # drafter is remote (--drafter mesh) — meshnet wires its
+                # transport via attach_drafter_transport. Per-row tier
+                # choice + probe-driven transitions live in _spec_drafts.
+                tiers = {
+                    "ngram": NgramDrafter(
+                        e.engine_cfg.spec_tokens,
+                        e.engine_cfg.spec_min_match,
+                        e.engine_cfg.spec_max_match,
+                    )
+                }
+                if getattr(e, "drafter_model", None) is not None:
+                    tiers["model"] = e.drafter_model
+                if e.engine_cfg.drafter == "mesh":
+                    tiers["mesh"] = MeshDrafter(
+                        e.engine_cfg.spec_tokens,
+                        model=getattr(e.model_cfg, "name", "") or "",
+                    )
+                self._spec = DrafterStack(tiers, e.engine_cfg.spec_tokens)
+        self.mesh_drafter = (
+            self._spec.tiers.get("mesh") if self._spec is not None else None
+        )
+        self._draft_tier: dict[int, str] = {}  # row -> tier that drafted
 
         self._thread = threading.Thread(
             target=self._loop, name="bee2bee-batch-scheduler", daemon=True
@@ -605,6 +651,10 @@ class BatchScheduler:
             self._shutdown = True
             self._cond.notify()
         self._thread.join(timeout=5)
+        if self.mesh_drafter is not None:
+            # drop the transport; the resident model tier (if any) is
+            # owned by the engine and closed there
+            self.mesh_drafter.close()
 
     @property
     def active(self) -> int:
@@ -1754,21 +1804,22 @@ class BatchScheduler:
         return np.ascontiguousarray(self._tables[:self._bsz, :tw])
 
     def _spec_eligible(self, b: int, req: Request) -> bool:
-        """Row-level speculation gate: greedy, not penalized, not
-        adaptively disabled, enough budget that a draft could beat the
-        single bonus token, and enough cache headroom for the fixed
-        [B, K+1] write extent. The headroom clause matters for
-        _window_size too: a spec_tokens larger than any row's remaining
-        capacity (or a row approaching the end of the cache) must stop
-        counting as eligible, or the batch would pay pinned 1-chunk
-        windows for the rest of the generation with zero speculation
-        possible — and no misses ever accruing to trigger the adaptive
-        disable, since drafting never even starts."""
+        """Row-level speculation gate: greedy, not penalized, some tier
+        still untried (spec_tier "off" is the ladder-exhausted terminal),
+        enough budget that a draft could beat the single bonus token, and
+        enough cache headroom for the fixed [B, K+1] write extent. The
+        headroom clause matters for _window_size too: a spec_tokens
+        larger than any row's remaining capacity (or a row approaching
+        the end of the cache) must stop counting as eligible, or the
+        batch would pay pinned 1-chunk windows for the rest of the
+        generation with zero speculation possible — and no misses ever
+        accruing to fail the tier's probe, since drafting never even
+        starts."""
         e = self.engine
         return (
             req.temperature <= 0.0
             and not req.penalized
-            and not req.spec_disabled
+            and req.spec_tier != TIER_OFF
             and not req.cancelled
             and req.max_new_tokens - len(req.out_ids) >= 2
             and int(self._offsets[b]) + e.engine_cfg.spec_tokens + 1
@@ -1798,33 +1849,74 @@ class BatchScheduler:
                 return False
         return True
 
-    def _spec_check_disable(self, req: Request):
-        """Adaptive per-row disable: drafted tokens plus miss-equivalents
+    def _spec_transition(self, req: Request, failed_tier: str):
+        """Move a row whose CURRENT tier just failed (probe miss budget
+        or a dead remote) to the next tier on the ladder — demotion to a
+        cheaper tier when one remains untried, the n-gram -> model
+        escalation otherwise, "off" when the ladder is exhausted. The
+        failed tier never gets retried (requests are short-lived); the
+        probe counters reset so the new tier gets a full budget."""
+        req.spec_tiers_failed.add(failed_tier)
+        self._spec.tiers[failed_tier].forget(req)
+        req.spec_tier = self._spec.next_tier(
+            failed_tier, req.spec_tiers_failed
+        )
+        req.spec_tier_drafted = 0
+        req.spec_tier_accepted = 0
+        req.spec_tier_misses = 0
+
+    def _spec_tier_check(self, req: Request):
+        """Per-tier probe verdict: drafted tokens plus miss-equivalents
         (a no-match step weighs like a fully-rejected K-token draft)
-        against the acceptance floor."""
+        against the acceptance floor — same should_disable math as ever,
+        fed with the CURRENT tier's counters, so the probe budget is per
+        tier and failure means transition, not death."""
         K = self.engine.engine_cfg.spec_tokens
+        if req.spec_tier in (None, TIER_OFF):
+            return
         if should_disable(
-            req.spec_drafted + K * req.spec_misses, req.spec_accepted,
+            req.spec_tier_drafted + K * req.spec_tier_misses,
+            req.spec_tier_accepted,
             self.engine.engine_cfg.spec_probe_tokens,
             self.engine.engine_cfg.spec_min_accept,
         ):
-            req.spec_disabled = True
+            self._spec_transition(req, req.spec_tier)
+
+    def _spec_degrade_dead(self, req: Request, tier: str, drafter):
+        """Typed degradation off a dead remote tier: the row lands on the
+        next LOCAL tier immediately — a dead draft peer must never stall
+        or starve the decode loop."""
+        reason = getattr(drafter, "dead_reason", None) or "peer_lost"
+        _C_SPEC_DEGRADED.inc(1, reason=reason)
+        if not getattr(drafter, "_degrade_logged", False):
+            drafter._degrade_logged = True
+            logger.warning(
+                "mesh drafter dead (%s): degrading rows to the local tier",
+                reason,
+            )
+        self._spec_transition(req, tier)
 
     def _spec_drafts(self):
-        """Collect per-row drafts for one spec step. Returns
+        """Collect per-row drafts for one spec step, grouped by tier so
+        each drafter sees its rows in ONE batched propose call (the model
+        tier turns that into a single [B, 2]+scan device pass). Returns
         (drafts [bsz, K], lens [bsz]) or None when this step must take
         the plain/penalized window instead: no row drafted anything, a
         penalized row is active under the SPLIT roots (pre-fusion, the
         counts graph existed only on the window path — see
         _spec_possible), or any active row is too close to capacity for
-        the fixed [B, K+1] write extent (_spec_possible)."""
+        the fixed [B, K+1] write extent (_spec_possible).
+
+        Tier bookkeeping per row: a None proposal is PENDING (mesh tier,
+        draft still in flight — the row just skips this step, no
+        accounting); [] is a miss that feeds the tier's probe; a dead
+        remote tier degrades the row to the local ladder typed, right
+        here, before it could cost a step."""
         e = self.engine
         K = e.engine_cfg.spec_tokens
         if not self._spec_possible():
             return None
-        drafts = np.zeros((self._bsz, K), np.int32)
-        lens = np.zeros((self._bsz,), np.int32)
-        any_draft = False
+        by_tier: dict[str, list] = {}
         for b, req in enumerate(self._rows):
             if req is None:
                 continue
@@ -1832,16 +1924,42 @@ class BatchScheduler:
             # along advancing their normal one token per forward
             if not self._spec_eligible(b, req):
                 continue
-            d = self._spec.propose(req.ids, req.out_ids)
-            if not d:
-                req.spec_misses += 1
-                self._spec_check_disable(req)
+            if req.spec_tier is None:
+                req.spec_tier = self._spec.start_tier()
+            tier = req.spec_tier
+            drafter = self._spec.tiers.get(tier)
+            if drafter is not None and getattr(drafter, "dead", False):
+                self._spec_degrade_dead(req, tier, drafter)
+                tier = req.spec_tier
+                drafter = self._spec.tiers.get(tier)
+            if tier == TIER_OFF or drafter is None:
                 continue
-            left = req.max_new_tokens - len(req.out_ids)
-            d = d[:left - 1]  # past-budget draft positions are dead weight
-            drafts[b, :len(d)] = d
-            lens[b] = len(d)
-            any_draft = True
+            by_tier.setdefault(tier, []).append((b, req))
+        drafts = np.zeros((self._bsz, K), np.int32)
+        lens = np.zeros((self._bsz,), np.int32)
+        self._draft_tier = {}
+        any_draft = False
+        for tier, rows in by_tier.items():
+            proposals = self._spec.tiers[tier].propose_batch(rows)
+            for b, req in rows:
+                d = proposals.get(b)
+                if d is None:
+                    continue  # pending (mesh pipeline): not a miss
+                if not d:
+                    req.spec_misses += 1
+                    req.spec_tier_misses += 1
+                    self._spec_tier_check(req)
+                    continue
+                left = req.max_new_tokens - len(req.out_ids)
+                # past-budget draft positions are dead weight; a remote
+                # drafter gets clipped to K defensively too
+                d = list(d)[:K][:left - 1]
+                if not d:
+                    continue
+                drafts[b, :len(d)] = d
+                lens[b] = len(d)
+                self._draft_tier[b] = tier
+                any_draft = True
         return (drafts, lens) if any_draft else None
 
     def _spec_step(self) -> bool:
@@ -1921,18 +2039,38 @@ class BatchScheduler:
                 continue
             req.chunks_decoded += 1
             a = int(acc[b])
-            if lens[b]:
-                req.spec_drafted += int(lens[b])
+            drafted_here = int(lens[b])
+            tier = self._draft_tier.get(b, "ngram")
+            if drafted_here:
+                req.spec_drafted += drafted_here
                 req.spec_accepted += a
-                self.stats.spec_drafted += int(lens[b])
+                req.spec_tier_drafted += drafted_here
+                req.spec_tier_accepted += a
+                self.stats.spec_drafted += drafted_here
                 self.stats.spec_accepted += a
-                _C_SPEC_DRAFTED.inc(int(lens[b]))
-                _C_SPEC_ACCEPTED.inc(a)
-                self._spec_check_disable(req)
+                ts = self.stats.spec_tiers.setdefault(
+                    tier, {"drafted": 0, "accepted": 0}
+                )
+                ts["drafted"] += drafted_here
+                ts["accepted"] += a
+                _C_SPEC_DRAFTED.inc(drafted_here, tier=tier)
+                _C_SPEC_ACCEPTED.inc(a, tier=tier)
+                self._meter.note_spec(tier, drafted_here, a)
             # accepted draft prefix, then the verify's own next token
-            retired_any |= self._process_row_tokens(
+            retired = self._process_row_tokens(
                 b, req, list(drafts[b, :a]) + [nxt[b]]
             )
+            retired_any |= retired
+            if drafted_here and not retired:
+                # the verdict rolls the drafter's state forward (model:
+                # KV frontier; mesh: pipeline the next draft_request NOW
+                # so its RTT overlaps the target's next step) — AFTER
+                # _process_row_tokens so the drafter sees the grown
+                # context. Then the probe check, which may transition.
+                drafter = self._spec.tiers.get(tier)
+                if drafter is not None:
+                    drafter.observe(req, a)
+                self._spec_tier_check(req)
         if retired_any:
             self._compact_and_shrink()
         return True
@@ -2278,6 +2416,8 @@ class BatchScheduler:
 
     def _retire(self, req: Request):
         self._release_adapter(req)
+        if self._spec is not None:
+            self._spec.forget(req)  # drafter KV slot / mesh server row
         req.timing.t_done = time.perf_counter()
         self.stats.retired += 1
         self.stats.history.append(
@@ -2290,6 +2430,8 @@ class BatchScheduler:
         (retired/history/t_done) — `admitted - retired` must not drift for
         rows the pool failed mid-decode."""
         self._release_adapter(req)
+        if self._spec is not None:
+            self._spec.forget(req)
         req.finish = "error"
         req.timing.t_done = time.perf_counter()
         self.stats.retired += 1

@@ -277,6 +277,10 @@ class MigrationManager:
                 continue
             if decode_only and d.get("disagg_role") != "decode":
                 continue
+            if d.get("disagg_role") == "draft":
+                # a draft-role peer hosts ONLY the drafter model — it has
+                # no target engine to resume a migrated generation on
+                continue
             for meta in list(svcs.values()):
                 models = [str(m) for m in (meta.get("models") or [])]
                 if model is None or any(

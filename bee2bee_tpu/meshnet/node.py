@@ -239,12 +239,19 @@ class P2PNode(StageTaskMixin):
             if disagg_role is not None
             else (os.environ.get("BEE2BEE_DISAGG") or "").strip().lower()
         ) or None
-        if role not in (None, "prefill", "decode"):
+        if role not in (None, "prefill", "decode", "draft"):
             raise ValueError(
-                f"disagg_role must be 'prefill', 'decode' or unset, got {role!r}"
+                f"disagg_role must be 'prefill', 'decode', 'draft' or "
+                f"unset, got {role!r}"
             )
         self.disagg_role = role
         self.migration = MigrationManager(self)
+        # mesh-tiered speculative decoding (meshnet/draft.py): a draft-role
+        # node hosts the DraftServer (enable_draft_server at boot); serving
+        # nodes whose engine runs the mesh drafter tier get a DraftClient
+        # bound in add_service
+        self.draft_server = None
+        self.draft_client = None
         # peer ids EVER greeted (never pruned — only their first hello
         # re-anchors the lease boot grace, see _handle_hello)
         self._greeted: set[str] = set()
@@ -419,6 +426,10 @@ class P2PNode(StageTaskMixin):
             await self.fleet.release()
         # fail outstanding migrations typed before sockets go away
         self.migration.close()
+        if self.draft_server is not None:
+            self.draft_server.close()
+        if self.draft_client is not None:
+            self.draft_client.close()
         # say goodbye and close sockets FIRST — cancelling reader tasks
         # first would purge the peer table before anything gets closed,
         # leaving outbound connections dangling on the remote side
@@ -566,6 +577,9 @@ class P2PNode(StageTaskMixin):
         # migrations riding this connection fail typed NOW (the fallback
         # ladder re-prefills elsewhere instead of waiting out a timeout)
         self.migration.on_ws_drop(ws)
+        # mesh drafter: re-pick another draft peer or degrade typed
+        if self.draft_client is not None:
+            self.draft_client.on_ws_drop(ws)
         async with self._lock:
             dead = [pid for pid, info in self.peers.items() if info["ws"] is ws]
             for pid in dead:
@@ -728,6 +742,8 @@ class P2PNode(StageTaskMixin):
         protocol.FLEET_ACTION: "_handle_fleet_action",
         protocol.FLEET_ACK: "_handle_fleet_ack",
         protocol.ADAPTER_ANNOUNCE: "_handle_adapter_announce",
+        protocol.DRAFT_REQUEST: "_handle_draft_request",
+        protocol.DRAFT_RESULT: "_handle_draft_result",
         protocol.TASK: "_handle_task",
         protocol.RESULT: "_handle_result",
         protocol.TASK_ERROR: "_handle_result",
@@ -1146,6 +1162,46 @@ class P2PNode(StageTaskMixin):
         # live-migration hook: drain/handoff/pool-pressure rows leave via
         # this node's migration plane (no-op for engine-less services)
         self.migration.wire_scheduler(svc)
+        # mesh drafter tier (BEE2BEE_DRAFTER=mesh): bind the scheduler's
+        # MeshDrafter to this node's transport so drafts stream from a
+        # draft-role peer (wire_scheduler above already forced the lazy
+        # scheduler into existence for engine-backed services)
+        md = getattr(sched, "mesh_drafter", None)
+        if md is not None:
+            from .draft import DraftClient
+
+            if self.draft_client is None:
+                self.draft_client = DraftClient(self)
+            self.draft_client.bind(md)
+
+    def enable_draft_server(self, model: str, spec_tokens: int = 6,
+                            **kw) -> None:
+        """Host the drafter program on this node (the `draft` disagg
+        role). Loads the draft model NOW so a bad spec fails the node
+        typed at boot, never at the first frame."""
+        from .draft import DraftServer
+
+        self.draft_server = DraftServer(
+            self, model, spec_tokens=spec_tokens, **kw
+        )
+
+    async def _handle_draft_request(self, ws, data):
+        srv = self.draft_server
+        if srv is None:
+            # not a draft node (stale gossip routed here): typed refusal
+            # — the client books a failure and degrades to its local tier
+            if not data.get("done"):
+                await self._send(ws, protocol.msg(
+                    protocol.DRAFT_RESULT,
+                    rid=str(data.get("rid") or ""), error="no_drafter",
+                ))
+            return
+        pid = await self._peer_for(ws)
+        srv.submit(ws, pid or "?", data)
+
+    async def _handle_draft_result(self, ws, data):
+        if self.draft_client is not None:
+            self.draft_client.deliver(data)
 
     async def announce_service(self, svc) -> int:
         self.add_service(svc)

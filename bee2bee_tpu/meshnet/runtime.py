@@ -238,6 +238,32 @@ async def run_p2p_node(
         if dht is not None:
             node.dht = dht  # ensure_adapter's fetch path reads this
 
+        if backend == "tpu" and node.disagg_role == "draft":
+            # the draft disagg role hosts ONLY the drafter program
+            # (meshnet/draft.py): no target engine, no gen_request
+            # service — serving peers stream draft_request frames here.
+            # Loaded in an executor (weights init/load is sync compute);
+            # a bad drafter spec fails the boot typed (DrafterLoadError).
+            drafter_model = (
+                cfg.drafter if cfg.drafter and cfg.drafter != "mesh"
+                else model
+            )
+            k = cfg.spec_tokens or 6
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(
+                None,
+                lambda: node.enable_draft_server(
+                    "auto" if checkpoint_path else drafter_model,
+                    spec_tokens=k, dtype=cfg.dtype,
+                    checkpoint_path=checkpoint_path,
+                ),
+            )
+            backend = None  # skip the target-service build below
+            logger.info(
+                "hosting draft role (%s, K=%s); join link: %s",
+                drafter_model, k, node.join_link(),
+            )
+
         if backend == "tpu" and from_mesh:
             if lora_path:
                 # silently serving the base while the operator believes the
@@ -275,7 +301,7 @@ async def run_p2p_node(
                 await loop.run_in_executor(None, svc.load_sync)
             await node.announce_service(svc)
             logger.info("serving %s via %s; join link: %s", model, backend, node.join_link())
-        elif stage_runner is None:
+        elif stage_runner is None and node.draft_server is None:
             logger.info(
                 "stage worker awaiting part_load for %s; join link: %s",
                 model, node.join_link(),

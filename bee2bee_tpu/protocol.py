@@ -68,6 +68,21 @@ FLEET_ACK = "fleet_ack"
 # set; old peers ignore the frame and simply route adapter traffic by
 # the fuzzy model match alone.
 ADAPTER_ANNOUNCE = "adapter_announce"
+# mesh-tiered speculative decoding (meshnet/draft.py): a peer running the
+# `draft` disagg role hosts ONLY a small drafter model; serving nodes
+# stream per-row contexts to it and get K-token draft batches back.
+# DRAFT_REQUEST carries {rid, base, tokens, k, model} — `base` is the
+# context length the server already holds for rid, `tokens` the delta
+# (base=0 resends from scratch; {rid, done:true} frees the row).
+# DRAFT_RESULT answers {rid, pos, draft} where `pos` is the context
+# length the draft continues from (the client drops stale results after
+# a rejection re-sync), `reprime:true` asks the client for a full
+# resend, and `error` is the server's typed failure. Pipelined one step
+# ahead so the RTT hides under the target's own decode step; not in the
+# reference message set (old peers ignore the frames — the client's
+# timeout ladder degrades the row to the local drafter tier).
+DRAFT_REQUEST = "draft_request"
+DRAFT_RESULT = "draft_result"
 
 # ---- coordinator/worker task protocol (reference protocol.py:25-53, node.py:89+)
 REGISTER = "register"
@@ -124,6 +139,8 @@ MESSAGE_TYPES = frozenset(
         FLEET_ACTION,
         FLEET_ACK,
         ADAPTER_ANNOUNCE,
+        DRAFT_REQUEST,
+        DRAFT_RESULT,
         REGISTER,
         INFO,
         TASK,

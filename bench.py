@@ -362,6 +362,250 @@ def bench_spec(msl: int, new_tokens: int) -> dict:
     return out
 
 
+def bench_spec_model(new_tokens: int = 64, n_streams: int = 2) -> dict:
+    """Model-tier speculative decoding rung (ISSUE 19 acceptance): four
+    cells on NON-repetitive prompts — the workload class where n-gram
+    lookup finds nothing and the tier ladder must escalate to a real
+    drafter model. Cells: spec off / n-gram only / model tier resident
+    beside the target / model tier streamed from a BEE2BEE_DISAGG=draft
+    mesh peer (killed mid-generation to certify the typed degradation
+    path: peer_lost -> local tier, zero dropped generations). The
+    drafter is the SAME tiny-llama at the same seed — weight-identical
+    to the target, the CPU proxy for a well-trained small drafter, so
+    model-tier acceptance approaches 1.0 while n-gram sits near 0. Each
+    spec cell reports measured per-tier acceptance and acceptance-
+    weighted tok/s (tok/s x acceptance — the share of throughput that
+    arrived via verified drafts). Standalone: ``python bench.py
+    spec_model``."""
+    import asyncio
+    import contextlib
+    import time as _time
+
+    import jax
+
+    from bee2bee_tpu.engine import EngineConfig, InferenceEngine
+
+    K = 6
+    plen = 48
+    # j*97 mod 499 has period 499: within 48+64 tokens no n-gram ever
+    # recurs, so the prompt gives the n-gram tier nothing to match
+    prompts = [
+        [1 + (j * 97 + s * 131) % 499 for j in range(plen)]
+        for s in range(max(n_streams, 1))
+    ]
+    ekw = dict(
+        max_seq_len=256, dtype="float32", cache_dtype="float32",
+        decode_chunk=4, prefill_buckets=(16, 32, 64),
+        # small probe budget so the n-gram tier fails its audition
+        # within ~2 spec steps and the run actually exercises the model
+        # tier (at the default 64, short generations never escalate)
+        spec_probe_tokens=12,
+    )
+
+    def _spec_tiers(eng) -> dict:
+        return (eng.introspect.meter.refresh() or {}).get("spec_tiers", {})
+
+    def _tiers_delta(before: dict, after: dict) -> dict:
+        out = {}
+        for tier, e in after.items():
+            d = e["drafted"] - before.get(tier, {}).get("drafted", 0)
+            a = e["accepted"] - before.get(tier, {}).get("accepted", 0)
+            if d > 0:
+                out[tier] = {
+                    "drafted": d, "accepted": a,
+                    "acceptance": round(a / d, 3),
+                }
+        return out
+
+    out: dict = {
+        "platform": jax.devices()[0].platform,
+        "spec_tokens": K,
+        "new_tokens": new_tokens,
+    }
+
+    def one_local(spec: int, drafter: str) -> dict:
+        eng = InferenceEngine(
+            "tiny-llama",
+            engine_config=EngineConfig(
+                max_batch=1, spec_tokens=spec, drafter=drafter, **ekw
+            ),
+        )
+        try:
+            # warm long enough that the ladder escalates and the drafter
+            # tier compiles its roots DURING warm-up — the timed run must
+            # measure steady-state decode, not first-compile
+            eng.generate(prompts[0], max_new_tokens=24, temperature=0.0)
+            # counters start AFTER warm-up; tier state is per request, so
+            # the timed run starts fresh on the n-gram tier and escalates
+            # mid-run exactly as production rows do
+            st = eng.scheduler.stats
+            d0, a0 = st.spec_drafted, st.spec_accepted
+            tiers0 = _spec_tiers(eng)
+            t0 = _time.perf_counter()
+            r = eng.generate(
+                prompts[0], max_new_tokens=new_tokens, temperature=0.0
+            )
+            wall = _time.perf_counter() - t0
+            entry = {
+                "tok_per_s": round(r.new_tokens / wall, 2) if wall > 0 else 0.0,
+                "new_tokens": r.new_tokens,
+                "token_ids": list(r.token_ids),
+            }
+            if spec:
+                drafted = st.spec_drafted - d0
+                accepted = st.spec_accepted - a0
+                acc = accepted / drafted if drafted else 0.0
+                entry.update(
+                    drafted=drafted, accepted=accepted,
+                    acceptance=round(acc, 3),
+                    acceptance_weighted_tok_per_s=round(
+                        entry["tok_per_s"] * acc, 2
+                    ),
+                    tiers=_tiers_delta(tiers0, _spec_tiers(eng)),
+                )
+            else:
+                entry["acceptance_weighted_tok_per_s"] = 0.0
+            return entry
+        finally:
+            eng.close()
+
+    out["off"] = one_local(0, "")
+    out["ngram"] = one_local(K, "")
+    out["model_local"] = one_local(K, "tiny-llama")
+
+    async def mesh_cell() -> dict:
+        from bee2bee_tpu.engine import scheduler as sched_mod
+        from bee2bee_tpu.meshnet.node import P2PNode
+        from bee2bee_tpu.services.tpu import TPUService
+
+        serve_node = P2PNode(host="127.0.0.1", port=0)
+        draft_node = P2PNode(host="127.0.0.1", port=0, disagg_role="draft")
+        eng = None
+        try:
+            for n in (serve_node, draft_node):
+                n.ping_interval_s = 0.2
+                await n.start()
+            await draft_node.connect_bootstrap(serve_node.addr)
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(
+                None,
+                lambda: draft_node.enable_draft_server(
+                    "tiny-llama", spec_tokens=K, dtype="float32",
+                    max_rows=max(4, n_streams),
+                ),
+            )
+            eng = InferenceEngine(
+                "tiny-llama",
+                engine_config=EngineConfig(
+                    max_batch=n_streams, spec_tokens=K, drafter="mesh", **ekw
+                ),
+            )
+            serve_node.add_service(TPUService("tiny-llama", engine=eng))
+            # the serving node picks its draft peer off the gossiped
+            # telemetry digest (disagg_role rides it) — push one round
+            await draft_node.gossip_telemetry()
+            await asyncio.sleep(0.3)
+            await asyncio.to_thread(  # compile warm, long enough for the
+                # ladder to escalate and exercise the mesh round trip
+                eng.generate, prompts[0], max_new_tokens=24, temperature=0.0
+            )
+            deg0 = sched_mod._C_SPEC_DEGRADED.total()
+            tiers0 = _spec_tiers(eng)
+            t0 = _time.perf_counter()
+            tasks = [
+                asyncio.create_task(asyncio.to_thread(
+                    eng.generate, prompts[s], max_new_tokens=new_tokens,
+                    temperature=0.0,
+                ))
+                for s in range(n_streams)
+            ]
+            # wait until the mesh tier has actually served drafts, then
+            # kill the draft peer MID-generation: the typed degradation
+            # ladder (peer_lost -> local tier, zero dropped generations)
+            # is the thing this cell certifies
+            engaged = False
+            for _ in range(600):
+                await asyncio.sleep(0.05)
+                if any(t.done() for t in tasks):
+                    break
+                d = _spec_tiers(eng).get("mesh", {}).get("drafted", 0)
+                if d > tiers0.get("mesh", {}).get("drafted", 0):
+                    engaged = True
+                    break
+            with contextlib.suppress(Exception):
+                await draft_node.stop()
+            results = await asyncio.gather(*tasks, return_exceptions=True)
+            wall = _time.perf_counter() - t0
+            ok = [r for r in results if not isinstance(r, BaseException)]
+            total_new = sum(r.new_tokens for r in ok)
+            tiers = _tiers_delta(tiers0, _spec_tiers(eng))
+            mesh_t = tiers.get("mesh", {})
+            acc = mesh_t.get("acceptance", 0.0)
+            md = getattr(eng.scheduler, "mesh_drafter", None)
+            return {
+                "streams": n_streams,
+                "completed": len(ok),
+                "dropped": n_streams - len(ok),
+                "new_tokens_total": total_new,
+                "tok_per_s": round(total_new / wall, 2) if wall > 0 else 0.0,
+                "mesh_engaged_before_kill": engaged,
+                "degraded_rows": sched_mod._C_SPEC_DEGRADED.total() - deg0,
+                "dead_reason": getattr(md, "dead_reason", None),
+                "tiers": tiers,
+                "acceptance_weighted_tok_per_s": round(
+                    (total_new / wall if wall > 0 else 0.0) * acc, 2
+                ),
+                # greedy parity: drafts (mesh or local) must never change
+                # the sampled sequence — stream 0 matches the spec-off run
+                "parity_vs_off": bool(
+                    not isinstance(results[0], BaseException)
+                    and list(results[0].token_ids) == out["off"]["token_ids"]
+                ),
+            }
+        finally:
+            if eng is not None:
+                eng.close()
+            for n in (draft_node, serve_node):
+                with contextlib.suppress(Exception):
+                    await n.stop()
+
+    try:
+        out["model_mesh"] = asyncio.run(mesh_cell())
+    except Exception as e:  # noqa: BLE001 — keep the local cells' artifact
+        log(f"spec_model mesh cell failed: {e}")
+        out["model_mesh"] = {"error": str(e)}
+
+    off_ids = out["off"].pop("token_ids")
+    for cell in ("ngram", "model_local"):
+        out[cell]["parity_vs_off"] = out[cell].pop("token_ids") == off_ids
+    ml = out["model_local"]
+    out["acceptance_gate"] = {
+        "model_tier_acceptance": ml.get("tiers", {}).get("model", {}).get(
+            "acceptance"
+        ),
+        "ngram_acceptance": out["ngram"].get("acceptance"),
+        "weighted_beats_off": (
+            ml["acceptance_weighted_tok_per_s"]
+            > out["off"]["acceptance_weighted_tok_per_s"]
+        ),
+        "weighted_beats_ngram": (
+            ml["acceptance_weighted_tok_per_s"]
+            > out["ngram"]["acceptance_weighted_tok_per_s"]
+        ),
+    }
+    log(
+        f"spec_model rung: model tier acceptance "
+        f"{out['acceptance_gate']['model_tier_acceptance']} vs ngram "
+        f"{out['acceptance_gate']['ngram_acceptance']}; weighted tok/s "
+        f"{ml['acceptance_weighted_tok_per_s']} (model-local) vs "
+        f"{out['ngram']['acceptance_weighted_tok_per_s']} (ngram); mesh "
+        f"cell completed {out['model_mesh'].get('completed')}/{n_streams} "
+        f"(degraded typed: {out['model_mesh'].get('dead_reason')})"
+    )
+    out["introspect"] = _introspect_stamp()
+    return out
+
+
 def bench_ragged(msl: int, new_tokens: int) -> dict:
     """Ragged paged-attention rung (ISSUE 8): the kernel OFF (dense
     attention over the gathered block view) vs ON (attention='flash' —
@@ -1798,6 +2042,16 @@ def main() -> None:
         log(f"spec rung failed: {e}")
         extras["spec_distilgpt2"] = {"error": str(e)}
 
+    # model-tier speculative decoding rung (ISSUE 19 acceptance: model
+    # drafter acceptance > 0.4 where n-gram ~0 on non-repetitive
+    # prompts, acceptance-weighted tok/s beats the off and ngram cells,
+    # mesh cell degrades typed with zero dropped generations)
+    try:
+        extras["spec_model"] = bench_spec_model()
+    except Exception as e:  # noqa: BLE001 — the rung must not kill the bench
+        log(f"spec_model rung failed: {e}")
+        extras["spec_model"] = {"error": str(e)}
+
     # ragged paged-attention rung (ISSUE 8 acceptance: paged + flash +
     # spec composed — decode tok/s and spec acceptance-weighted tok/s,
     # kernel off vs on, judged per the rung's own platform stamp)
@@ -2035,6 +2289,27 @@ if __name__ == "__main__":
     # platform stamp, rung under extras) rather than the bare rung so
     # scripts/benchdiff.py can gate two standalone runs against each
     # other — that is the scripts/lint.sh trajectory gate.
+    # `python bench.py spec_model`: the model-tier speculative-decoding
+    # rung standalone (tiny random-init models, loopback mesh cell, any
+    # platform). Prints a FULL mini-artifact like decode_hotloop so
+    # scripts/benchdiff.py can gate two standalone runs against each
+    # other — that is the scripts/lint.sh trajectory gate.
+    if len(sys.argv) > 1 and sys.argv[1] == "spec_model":
+        ensure_live_backend()
+        import jax as _jax
+
+        rung = bench_spec_model()
+        print(json.dumps({
+            "metric": "spec_model_acceptance_weighted_tok_per_s",
+            "value": rung["model_local"]["acceptance_weighted_tok_per_s"],
+            "unit": "tok/s",
+            "schema_version": 2,
+            "platform": _jax.devices()[0].platform,
+            "platform_fallback": os.environ.get(
+                "_BEE2BEE_BENCH_CPU_FALLBACK") == "1",
+            "extras": {"spec_model": rung},
+        }), flush=True)
+        sys.exit(0)
     if len(sys.argv) > 1 and sys.argv[1] == "decode_hotloop":
         ensure_live_backend()
         import jax as _jax

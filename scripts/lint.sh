@@ -37,6 +37,26 @@ if [ "${SKIP_BENCHDIFF:-0}" != "1" ]; then
     echo "[lint] decode_hotloop regression (benchdiff rc=$rc)" >&2
     exit "$rc"
   fi
+
+  # model-tier speculative-decoding gate (docs/PERF.md "Model-tier
+  # speculative decoding"): re-run the spec_model rung and diff against
+  # the recorded round-19 baseline. The headline is acceptance-weighted
+  # tok/s for the resident model drafter; the rung also re-certifies the
+  # mesh cell's typed degradation (kill mid-generation, zero drops).
+  # Threshold 0.5: the metric multiplies tok/s by acceptance, so shared-
+  # CPU noise compounds; the off/ngram cells this must beat sit at ~0.
+  echo "[lint] spec_model rung vs BENCH_spec_model_r01.json"
+  FRESH="$(mktemp /tmp/spec_model.XXXXXX.json)"
+  JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" BEE2BEE_BENCH_NO_PROBE=1 \
+    "$PY" bench.py spec_model | tail -1 > "$FRESH"
+  rc=0
+  "$PY" scripts/benchdiff.py BENCH_spec_model_r01.json "$FRESH" \
+    --threshold 0.5 || rc=$?
+  rm -f "$FRESH"
+  if [ "$rc" -ne 0 ] && [ "$rc" -ne 2 ]; then
+    echo "[lint] spec_model regression (benchdiff rc=$rc)" >&2
+    exit "$rc"
+  fi
 fi
 
 # interleaving-fuzzer smoke (docs/SIMULATION.md "The interleaving

@@ -513,6 +513,113 @@ async def run_adapter_smoke() -> None:
         await dht.stop()
 
 
+async def run_drafter_smoke() -> None:
+    """Mesh drafter leg (ISSUE 19): a 2-node loopback mesh where one node
+    carries ``disagg_role="draft"`` and hosts ONLY the drafter
+    (DraftServer over a tiny random-init model), while the serving node
+    runs the same model with ``drafter="mesh"``. A generation on a
+    non-repetitive prompt must escalate off the n-gram tier, stream
+    drafts over draft_request/draft_result frames, and complete — then
+    the per-tier speculative counters (``tier="mesh"``) and the draft
+    node's served counter must show on ``/metrics``."""
+    import asyncio as aio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from bee2bee_tpu.api import build_app
+    from bee2bee_tpu.engine import EngineConfig, InferenceEngine
+    from bee2bee_tpu.meshnet.node import P2PNode
+    from bee2bee_tpu.metrics import get_registry
+    from bee2bee_tpu.services.tpu import TPUService
+
+    serve = P2PNode(host="127.0.0.1", port=0)
+    draft = P2PNode(host="127.0.0.1", port=0, disagg_role="draft")
+    await serve.start()
+    await draft.start()
+    engine = None
+    client = None
+    try:
+        loop = aio.get_running_loop()
+        # drafter weights load/compile at boot — a bad spec fails typed here
+        await loop.run_in_executor(
+            None,
+            lambda: draft.enable_draft_server(
+                "tiny-llama", spec_tokens=6, dtype="float32", max_rows=2
+            ),
+        )
+        engine = InferenceEngine(
+            "tiny-llama",
+            engine_config=EngineConfig(
+                max_seq_len=256, dtype="float32", cache_dtype="float32",
+                decode_chunk=4, prefill_buckets=(16, 32, 64),
+                spec_tokens=6, drafter="mesh",
+                # small probe budget: the n-gram tier must fail its
+                # audition within this one smoke generation
+                spec_probe_tokens=12,
+            ),
+        )
+        serve.add_service(TPUService("tiny-llama", engine=engine))
+        assert serve.draft_client is not None, (
+            "add_service never bound a DraftClient to the mesh drafter"
+        )
+        assert await draft.connect_bootstrap(serve.addr), "bootstrap failed"
+        for _ in range(100):
+            if serve.peers and draft.peers:
+                break
+            await aio.sleep(0.05)
+        # the serving node picks its draft peer off the gossiped digest
+        await draft.gossip_telemetry()
+        for _ in range(100):
+            fresh = serve.health.fresh().get(draft.peer_id)
+            if fresh and fresh.get("disagg_role") == "draft":
+                break
+            await aio.sleep(0.05)
+
+        # warm on a REPETITIVE prompt: the n-gram tier drafts instantly,
+        # so the [B, K+1] verify root compiles here — the mesh leg below
+        # then measures the protocol, not a first-compile stall
+        await aio.to_thread(
+            engine.generate, [5, 6, 7, 8] * 8, max_new_tokens=12,
+            temperature=0.0,
+        )
+        served0 = get_registry().counter("mesh.draft_served").total()
+        prompt = [1 + (j * 97) % 499 for j in range(48)]
+        r = await aio.to_thread(
+            engine.generate, prompt, max_new_tokens=64, temperature=0.0
+        )
+        assert r.new_tokens == 64, f"generation produced {r.new_tokens}"
+        tiers = (engine.introspect.meter.refresh() or {}).get(
+            "spec_tiers", {}
+        )
+        assert tiers.get("mesh", {}).get("drafted", 0) > 0, (
+            f"mesh tier never drafted (spec_tiers={tiers!r})"
+        )
+        assert get_registry().counter("mesh.draft_served").total() > served0, (
+            "draft node never counted a served draft_request"
+        )
+
+        client = TestClient(TestServer(build_app(serve)))
+        await client.start_server()
+        text = await (await client.get("/metrics")).text()
+        series = parse_prometheus(text)
+        assert "bee2bee_engine_spec_drafted_total" in series, (
+            "per-tier spec drafted counter missing from /metrics"
+        )
+        assert 'tier="mesh"' in text, (
+            "mesh tier label missing from the spec counters on /metrics"
+        )
+        assert "bee2bee_mesh_draft_served_total" in series, (
+            "draft served counter missing from /metrics"
+        )
+    finally:
+        if client is not None:
+            await client.close()
+        if engine is not None:
+            engine.close()
+        await draft.stop()
+        await serve.stop()
+
+
 async def run_introspect_smoke() -> None:
     """Engine economics leg (ISSUE 15): one loopback generation through a
     real (tiny) engine, then assert the economics plane actually lit up —
@@ -627,6 +734,7 @@ def main() -> int:
         asyncio.run(run_fleet_smoke())
         asyncio.run(run_pipeline_smoke())
         asyncio.run(run_adapter_smoke())
+        asyncio.run(run_drafter_smoke())
         asyncio.run(run_introspect_smoke())
     except AssertionError as e:
         print(f"[telemetry-smoke] FAIL: {e}", file=sys.stderr)

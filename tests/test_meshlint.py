@@ -215,6 +215,79 @@ async def _handle_adapter_announce(ws, data):
     assert analyze_source(good, "meshnet/fixture.py") == []
 
 
+def test_frames_pass_draft_frames_declared_and_checked():
+    """ISSUE 19 CI satellite: the mesh-drafting wire protocol is registry-
+    declared — draft_request/draft_result (meshnet/draft.py) — and the
+    known-bad fixture proves each bug class is caught (a typo'd draft key
+    is a silently-empty draft stream: the target decodes plain forever
+    while the draft peer burns compute into dropped frames)."""
+    assert protocol.DRAFT_REQUEST in FRAME_SCHEMAS
+    assert protocol.DRAFT_RESULT in FRAME_SCHEMAS
+    assert "rid" in FRAME_SCHEMAS[protocol.DRAFT_REQUEST].required
+    assert "tokens" in FRAME_SCHEMAS[protocol.DRAFT_REQUEST].optional
+    assert "rid" in FRAME_SCHEMAS[protocol.DRAFT_RESULT].required
+    assert "pos" in FRAME_SCHEMAS[protocol.DRAFT_RESULT].optional
+    src = '''
+from .. import protocol
+
+async def request_draft(node, ws, rid, ctx):
+    await ws.send(protocol.encode(protocol.msg(
+        protocol.DRAFT_REQUEST, rid=rid, base=0, tokns=ctx, k=6)))
+
+async def answer_draft(node, ws, draft):
+    await ws.send(protocol.encode(protocol.msg(
+        protocol.DRAFT_RESULT, pos=3, draft=draft)))
+
+async def _handle_draft_result(ws, data):
+    return data.get("drft")
+'''
+    rules = _rules(analyze_source(src, "meshnet/fixture.py"))
+    assert "ML-F001" in rules  # `tokns` undeclared on draft_request
+    assert "ML-F002" in rules  # draft_result missing its required `rid`
+    assert "ML-F003" in rules  # read of undeclared "drft"
+    good = '''
+from .. import protocol
+
+async def request_draft(node, ws, rid, ctx):
+    await ws.send(protocol.encode(protocol.msg(
+        protocol.DRAFT_REQUEST, rid=rid, base=0, tokens=ctx, k=6,
+        model="tiny-llama")))
+
+async def answer_draft(node, ws, rid, draft):
+    await ws.send(protocol.encode(protocol.msg(
+        protocol.DRAFT_RESULT, rid=rid, pos=3, draft=draft)))
+
+async def _handle_draft_result(ws, data):
+    return data.get("pos"), data.get("draft"), data.get("reprime")
+'''
+    assert analyze_source(good, "meshnet/fixture.py") == []
+
+
+def test_seeded_draft_frame_typos_are_caught():
+    """Typo the draft protocol in the REAL sources and meshlint must
+    object: a misspelled construct key on the server's draft_result
+    (meshnet/draft.py) and a misspelled read in the node's draft_request
+    handler (meshnet/node.py)."""
+    src = (PACKAGE_ROOT / "meshnet" / "draft.py").read_text()
+    seeded = src.replace(
+        "protocol.DRAFT_RESULT, rid=rid, pos=pos,",
+        "protocol.DRAFT_RESULT, rid=rid, poss=pos,", 1,
+    )
+    assert seeded != src, "draft.py result literal moved; update the seed"
+    assert "ML-F001" in _rules(analyze_source(seeded, "meshnet/draft.py"))
+
+    src = (PACKAGE_ROOT / "meshnet" / "node.py").read_text()
+    seeded = src.replace(
+        'rid=str(data.get("rid") or ""), error="no_drafter",',
+        'rid=str(data.get("ird") or ""), error="no_drafter",', 1,
+    )
+    assert seeded != src, "node.py draft handler moved; update the seed"
+    assert any(
+        f.rule == "ML-F003" and "ird" in f.message
+        for f in analyze_source(seeded, "meshnet/node.py")
+    )
+
+
 # -------------------------------------------------------- async pass fixtures
 
 
