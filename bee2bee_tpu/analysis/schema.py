@@ -171,7 +171,9 @@ FRAME_SCHEMAS: dict[str, FrameSchema] = {
         # health-plane gossip (health.build_digest rides the ping cadence);
         # the digest is ONE opaque dict on the wire — its internal layout
         # is versioned by health.DIGEST_VERSION, not by frame schema
-        # (drain state and the disagg role ride INSIDE it as digest keys)
+        # (drain state and the disagg role ride INSIDE it as digest keys,
+        # as does the observatory's trend block — digest["trend"], its
+        # own layout versioned by obs.TREND_DIGEST_VERSION)
         _fs(P.TELEMETRY, required=frozenset({"peer_id", "digest"})),
         # live generation migration (meshnet/migrate.py). `gen` is the
         # generation snapshot (one opaque dict, layout versioned by its

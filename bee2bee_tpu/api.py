@@ -34,6 +34,7 @@ from .adapters import (
 from .health import fleet_view, render_fleet_prom
 from .meshnet.node import P2PNode
 from .metrics import PROMETHEUS_CONTENT_TYPE, get_registry
+from .obs import SERIES_BY_NAME, SERIES_NAMES
 from .protocol import copy_sampling
 from .router import DEFAULT_TENANT, AdmissionReject
 from .tracing import get_tracer, stitch_trace
@@ -537,6 +538,145 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
             )
         return web.json_response(view)
 
+    def _platform_stamp() -> str:
+        """Best-effort accelerator platform for /metrics/history, so
+        benchdiff --live can apply the PR 6 cross-platform refusal. Reads
+        jax only if something else already imported it — a control-plane
+        node must not pay a jax import for a telemetry stamp."""
+        import sys as _sys
+
+        jax = _sys.modules.get("jax")
+        if jax is not None:
+            try:
+                return jax.devices()[0].platform
+            except Exception:  # noqa: BLE001 — stamp is best-effort
+                pass
+        return "unknown"
+
+    def _parse_history_query(request):
+        """(names, window_s) shared by /metrics/history + /mesh/history;
+        raises web.HTTPBadRequest with a typed body on garbage."""
+        names_q = (request.query.get("series") or "").strip()
+        names = None
+        if names_q:
+            names = [n.strip() for n in names_q.split(",") if n.strip()]
+            unknown = sorted(n for n in names if n not in SERIES_BY_NAME)
+            if unknown:
+                raise web.HTTPBadRequest(
+                    text=json.dumps({
+                        "detail": f"unknown series: {unknown}",
+                        "known": list(SERIES_NAMES),
+                    }),
+                    content_type="application/json",
+                )
+        try:
+            window_s = float(request.query.get("window", 3600.0))
+        except ValueError:
+            raise web.HTTPBadRequest(
+                text=json.dumps({"detail": "window must be a number"}),
+                content_type="application/json",
+            )
+        return names, window_s
+
+    async def metrics_history(request):
+        """The observatory's retained time-series (obs/tsring.py):
+        ``?series=a,b`` restricts to named series (400 on unknown names),
+        ``?window=`` trims to the trailing seconds (default 3600), and
+        the payload is delta-encoded by default — ``?format=raw`` returns
+        plain ``[[ts, value], ...]`` points instead. The ``platform``
+        stamp lets scripts/benchdiff.py --live refuse cross-platform
+        comparisons, same rule as recorded artifacts."""
+        names, window_s = _parse_history_query(request)
+        raw = request.query.get("format") == "raw"
+        return web.json_response({
+            "node": node.peer_id,
+            "cadence_s": node.obs.cadence_s,
+            "window_s": window_s,
+            "retained": len(node.obs.ring),
+            "platform": _platform_stamp(),
+            "encoding": "raw" if raw else "delta",
+            "series": node.obs.history(names, window_s, raw=raw),
+        })
+
+    async def mesh_history(request):
+        """Fleet-level curves: this node's retained history merged with
+        every connected peer's (fetched from their /metrics/history —
+        same best-effort fan-out as /trace?stitch=1: unreachable peers
+        and peers with no advertised API endpoint are typed, never
+        silently dropped). The ``fleet`` block buckets all reporters
+        onto the sampling-cadence grid and aggregates each series by its
+        catalog rule — throughput sums, levels average."""
+        names, window_s = _parse_history_query(request)
+        peers_out: dict[str, dict] = {
+            node.peer_id: {"series": node.obs.history(names, window_s, raw=True)}
+        }
+        import aiohttp
+
+        async def fetch_history(s, pid, host, port):
+            try:
+                params = {"window": str(window_s), "format": "raw"}
+                if names:
+                    params["series"] = ",".join(names)
+                async with s.get(
+                    f"http://{host}:{port}/metrics/history",
+                    params=params,
+                    timeout=aiohttp.ClientTimeout(total=3),
+                ) as r:
+                    if r.status == 200:
+                        got = await r.json()
+                        if isinstance(got, dict) and isinstance(
+                            got.get("series"), dict
+                        ):
+                            return pid, {"series": got["series"]}
+            except Exception:  # noqa: BLE001 — merge what answers
+                pass
+            return pid, {"unreachable": True}
+
+        tasks = []
+        for pid, info in list(node.peers.items()):
+            if info.get("api_host") and info.get("api_port"):
+                tasks.append((pid, info["api_host"], info["api_port"]))
+            else:
+                peers_out[pid] = {"no_endpoint": True}
+        if tasks:
+            async with aiohttp.ClientSession() as s:
+                got = await asyncio.gather(*(
+                    fetch_history(s, pid, host, port)
+                    for pid, host, port in tasks
+                ))
+            peers_out.update({pid: entry for pid, entry in got})
+        cadence = node.obs.cadence_s
+        fleet: dict[str, list] = {}
+        for name in (names or SERIES_NAMES):
+            spec = SERIES_BY_NAME[name]
+            buckets: dict[int, list[float]] = {}
+            for entry in peers_out.values():
+                for point in (entry.get("series") or {}).get(name) or []:
+                    try:
+                        t, v = float(point[0]), float(point[1])
+                    except (TypeError, ValueError, IndexError):
+                        continue
+                    buckets.setdefault(int(t // cadence), []).append(v)
+            if not buckets:
+                continue
+            fleet[name] = [
+                [
+                    round(b * cadence, 3),
+                    round(
+                        sum(vs) if spec.agg == "sum" else sum(vs) / len(vs), 6
+                    ),
+                ]
+                for b, vs in sorted(buckets.items())
+            ]
+        return web.json_response({
+            "node": node.peer_id,
+            "cadence_s": cadence,
+            "window_s": window_s,
+            "agg": {n: SERIES_BY_NAME[n].agg for n in (names or SERIES_NAMES)},
+            "peers": peers_out,
+            "fleet": fleet,
+        })
+
     async def slo(request):
         """Per-objective SLO status: a FRESH burn-rate evaluation (also
         refreshes the bee2bee_slo_* gauges served by /metrics)."""
@@ -860,7 +1000,9 @@ def build_app(node: P2PNode, api_key: str | None = None) -> web.Application:
     app.router.add_get("/providers", providers)
     app.router.add_get("/trace", trace)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/metrics/history", metrics_history)
     app.router.add_get("/mesh/health", mesh_health)
+    app.router.add_get("/mesh/history", mesh_history)
     app.router.add_get("/slo", slo)
     app.router.add_get("/debug/incidents", debug_incidents)
     app.router.add_get("/debug/profile", debug_profile)

@@ -86,6 +86,13 @@ class FleetConfig:
     # several times the fast one, Google-SRE multi-window style)
     headroom_fill_max: float = 0.35
     headroom_queue_p95_ms: float = 250.0
+    # pool-occupancy trend forecast (ISSUE 20): scale out when the
+    # observatory's trend digest projects some eligible replica's paged
+    # pool exhausting within this horizon (aggregates' pool_eta_s, from
+    # the gossiped pool_free_frac slope) — capacity arrives BEFORE the
+    # instantaneous burn does, instead of only reacting to it. Same
+    # sustain/cooldown/standby ladder as the burn path; 0 disables.
+    pool_eta_out_s: float = 120.0
     scale_out_cooldown_s: float = 30.0
     scale_in_cooldown_s: float = 120.0
     ack_timeout_s: float = 10.0       # fleet_action round-trip bound
@@ -533,14 +540,27 @@ class FleetController:
             eligible > 0
             and float(agg.get("burning_frac") or 0.0) >= cfg.burn_quorum
         )
+        # pool-occupancy forecast (aggregates' pool_eta_s, derived from
+        # the gossiped trend digests): projected exhaustion inside the
+        # horizon is scale-out pressure NOW, not when the burn lands
+        pool_eta = agg.get("pool_eta_s")
+        forecast_low = (
+            eligible > 0
+            and cfg.pool_eta_out_s > 0
+            and pool_eta is not None
+            and float(pool_eta) <= cfg.pool_eta_out_s
+        )
         headroom = (
             eligible > 0
             and burning == 0
+            and not forecast_low
             and float(agg.get("fill_mean") or 0.0) <= cfg.headroom_fill_max
             and float(agg.get("queue_p95_max") or 0.0)
             <= cfg.headroom_queue_p95_ms
         )
-        self._burn_streak = self._burn_streak + 1 if fleet_burning else 0
+        self._burn_streak = (
+            self._burn_streak + 1 if (fleet_burning or forecast_low) else 0
+        )
         self._headroom_streak = self._headroom_streak + 1 if headroom else 0
         # REPAIR before load-following: a crashed replica's digest goes
         # stale and simply vanishes from the aggregates — it reports no
@@ -562,18 +582,24 @@ class FleetController:
                     f"{cfg.min_replicas} — repairing", target)
         if self._burn_streak >= cfg.out_sustain_ticks:
             if eligible >= cfg.max_replicas:
-                return self.D_NOOP, "burning but at max_replicas", None
+                return self.D_NOOP, "scale-out pressure but at max_replicas", None
             if now - self._last_out < cfg.scale_out_cooldown_s:
-                return self.D_NOOP, "burning but in scale-out cooldown", None
+                return self.D_NOOP, "scale-out pressure but in cooldown", None
             target = self.provisioner.pick_standby(digests)
             if target is None:
-                return self.D_NOOP, "burning but no standby available", None
-            return (
-                self.D_SCALE_OUT,
-                f"fast-burn fleet-wide for {self._burn_streak} ticks "
-                f"({burning}/{eligible} replicas burning)",
-                target,
-            )
+                return self.D_NOOP, "scale-out pressure but no standby available", None
+            if fleet_burning:
+                reason = (
+                    f"fast-burn fleet-wide for {self._burn_streak} ticks "
+                    f"({burning}/{eligible} replicas burning)"
+                )
+            else:
+                reason = (
+                    f"pool-occupancy forecast: exhaustion in ~{pool_eta}s "
+                    f"on {agg.get('pool_eta_peer')} (horizon "
+                    f"{cfg.pool_eta_out_s}s, {self._burn_streak} ticks)"
+                )
+            return self.D_SCALE_OUT, reason, target
         if self._headroom_streak >= cfg.in_sustain_ticks:
             if eligible <= cfg.min_replicas:
                 return self.D_NOOP, "headroom but at min_replicas", None

@@ -458,7 +458,18 @@ def controller_aggregates(
     Headroom/burn signals over the ELIGIBLE set only: ``burning`` /
     ``burn_fast_max`` from the SLO briefs, ``fill_mean`` (absent
     batch-fill gauges count as 0 — no engine, no pressure),
-    ``queue_p95_max``, ``pool_free_min``, ``active_rows_total``."""
+    ``queue_p95_max``, ``pool_free_min``, ``active_rows_total``.
+
+    ``pool_eta_s`` (ISSUE 20) is the pool-occupancy trend FORECAST: the
+    soonest projected paged-pool exhaustion across eligible peers, read
+    from their gossiped trend digests (obs/). A trend slope is relative
+    — fraction of the level per minute, normalized by
+    ``max(mean, scale_floor)`` (tsring.SeriesSpec; pool_free_frac's
+    floor is 0.05, kept in lockstep by tests/test_obs.py) — so with the
+    current level ``m`` and relative slope ``s < 0`` the absolute drain
+    rate is ``s * max(m, 0.05)`` per minute and exhaustion lands in
+    ``m / (-s * max(m, 0.05))`` minutes. None when no eligible peer
+    reports a falling pool trend."""
     eligible: dict[str, dict] = {}
     draining: list[str] = []
     standby: list[str] = []
@@ -486,6 +497,7 @@ def controller_aggregates(
     fills: list[float] = []
     q95s: list[float] = []
     pool_fracs: list[float] = []
+    pool_etas: list[tuple[float, str]] = []
     rows = 0.0
     for pid, d in eligible.items():
         burn, is_burning = digest_slo_burn(d)
@@ -503,7 +515,19 @@ def controller_aggregates(
             free = float(gauge.get("engine.paged_blocks_free") or 0.0)
             pool_fracs.append(min(max(free / total, 0.0), 1.0))
         rows += float(gauge.get("engine.active_rows") or 0.0)
+        pf = ((d.get("trend") or {}).get("series") or {}).get(
+            "pool_free_frac"
+        ) or {}
+        try:
+            mean = float(pf["mean"])
+            slope = float(pf["slope"])
+        except (KeyError, TypeError, ValueError):
+            mean = slope = 0.0
+        if slope < -1e-4 and mean > 0:
+            drain_per_min = -slope * max(mean, 0.05)  # tsring scale_floor
+            pool_etas.append((round(60.0 * mean / drain_per_min, 1), pid))
     n = len(eligible)
+    pool_eta = min(pool_etas) if pool_etas else None
     return {
         "nodes": len(digests),
         "eligible": n,
@@ -519,6 +543,8 @@ def controller_aggregates(
         "fill_mean": round(sum(fills) / n, 4) if n else 0.0,
         "queue_p95_max": round(max(q95s), 3) if q95s else 0.0,
         "pool_free_min": round(min(pool_fracs), 4) if pool_fracs else None,
+        "pool_eta_s": pool_eta[0] if pool_eta else None,
+        "pool_eta_peer": pool_eta[1] if pool_eta else None,
         "active_rows_total": rows,
     }
 

@@ -38,6 +38,7 @@ from ..fleet import FleetController
 from ..health import HealthStore, SloTracker, build_digest, get_recorder, load_slo_config
 from ..joinlink import generate_join_link, parse_join_link
 from ..metrics import get_registry
+from ..obs import Observatory
 from ..pieces import ShardManifest
 from ..router import (
     AdmissionController,
@@ -214,6 +215,23 @@ class P2PNode(StageTaskMixin):
         self.slo = SloTracker(
             objectives=load_slo_config(), on_trip=self._on_slo_trip,
             clock=self.clock,
+        )
+        # fleet observatory (obs/): retained time-series on its own
+        # sampling loop + trend watchdog. The trend digest it derives
+        # rides the TELEMETRY gossip (telemetry_digest), the history
+        # rides /metrics/history. BEE2BEE_OBS=0 disables the sampling
+        # loop (the ring stays empty; every surface reports absence);
+        # BEE2BEE_OBS_CADENCE_S overrides the 5 s default.
+        self.obs_enabled = (os.environ.get("BEE2BEE_OBS") or "").strip() != "0"
+        try:
+            obs_cadence = float(
+                os.environ.get("BEE2BEE_OBS_CADENCE_S") or 0
+            ) or None
+        except ValueError:
+            obs_cadence = None
+        self.obs = Observatory(
+            node=self, clock=self.clock,
+            **({"cadence_s": obs_cadence} if obs_cadence else {}),
         )
 
         # SLO-aware front door (router/): tenant identity + budgets from
@@ -415,6 +433,8 @@ class P2PNode(StageTaskMixin):
         # construction — a slow build (first jit compile) must not eat it
         self.fleet.lease.reset_boot_grace(self.started_at)
         self._spawn(self._monitor_loop())
+        if self.obs_enabled:
+            self._spawn(self.obs.run(lambda: self._stopped))
         logger.info("node %s listening on %s", self.peer_id, self.addr)
         return self
 
@@ -1052,6 +1072,12 @@ class P2PNode(StageTaskMixin):
             digest["fleet_state"] = self.fleet_state
         if self.fleet.enabled:
             digest["fleet_controller"] = True
+        # trend digest (obs/): window mean + relative slope + anomaly
+        # flags per retained series — what the router's degrading
+        # penalty and the controller's pool forecast read off peers
+        trend = self.obs.trend_digest()
+        if trend is not None:
+            digest["trend"] = trend
         return digest
 
     async def gossip_telemetry(self, tick: bool = False) -> int:
@@ -1067,12 +1093,17 @@ class P2PNode(StageTaskMixin):
         the comparison forever. Peers still get fresh RTTs on each
         refresh tick, so RTT staleness is bounded at gossip_refresh_ticks
         ticks; anything operationally actionable (counters, gauges,
-        histograms, draining/fleet state) re-gossips immediately."""
+        histograms, draining/fleet state) re-gossips immediately. The
+        "trend" block is excluded for the same reason — its window means
+        drift a little every sample by construction, and including it
+        would re-defeat the suppression the fleet_sim bench exists to
+        hold — so trend staleness at peers is bounded by the same
+        gossip_refresh_ticks deal RTTs get."""
         digest = self.telemetry_digest()
         if tick and self.gossip_delta_enabled:
             body = {
                 k: v for k, v in digest.items()
-                if k not in ("ts", "peer_rtt_ms")
+                if k not in ("ts", "peer_rtt_ms", "trend")
             }
             fp = json.dumps(body, sort_keys=True, default=str)
             if (

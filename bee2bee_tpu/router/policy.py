@@ -100,6 +100,16 @@ class RouterWeights:
     hbm: float = 0.15
     hbm_headroom_floor: float = 0.10
     storm: float = 0.10
+    # trend watchdog (obs/, ISSUE 20): a DEGRADING peer — queue-wait
+    # sloping up or goodput sloping down in its gossiped trend digest —
+    # pays a penalty that ramps with the relative slope and saturates
+    # when the peer's own watchdog flags either series anomalous. A
+    # penalty, not an exclusion: the point is to demote a sinking peer
+    # BEFORE its SLO trips, and a mildly-degrading engine still beats a
+    # burning or draining one. degrading_slope_ref is the relative slope
+    # (fraction of the level per minute) that counts as fully degrading.
+    degrading: float = 0.15
+    degrading_slope_ref: float = 0.10
 
 
 def parse_router_weights(obj) -> RouterWeights:
@@ -167,6 +177,7 @@ class RouterPolicy:
         )
         hbm = 0.0
         storming = False
+        degrading = 0.0
         if digest is None:
             queue = fill = pool = w.unknown
             matched = 0
@@ -201,6 +212,24 @@ class RouterPolicy:
                     1.0,
                 )
             storming = bool(intro.get("storming"))
+            # trend digest (obs/): relative slopes, fraction of the
+            # level per minute. Rising queue wait and falling goodput
+            # are the two "sinking peer" signatures; either series
+            # flagged anomalous by the peer's own watchdog saturates
+            # the penalty. Absent trend block = absent subsystem = no
+            # penalty (same contract as every other digest signal).
+            tser = (digest.get("trend") or {}).get("series") or {}
+            q_trend = tser.get("queue_wait_p95_ms") or {}
+            g_trend = tser.get("goodput_tok_s") or {}
+            try:
+                bad_slope = max(float(q_trend.get("slope") or 0.0), 0.0) + \
+                    max(-float(g_trend.get("slope") or 0.0), 0.0)
+            except (TypeError, ValueError):
+                bad_slope = 0.0
+            if w.degrading_slope_ref > 0:
+                degrading = min(bad_slope / w.degrading_slope_ref, 1.0)
+            if q_trend.get("anom") or g_trend.get("anom"):
+                degrading = 1.0
         rtt = 0.0 if cand.get("local") else (
             _soft(rtt_ms, w.rtt_ref_ms) if rtt_ms is not None else w.unknown
         )
@@ -210,6 +239,7 @@ class RouterPolicy:
             w.queue * queue + w.fill * fill + w.pool * pool
             + w.rtt * rtt + w.price * pnorm
             + w.hbm * hbm + (w.storm if storming else 0.0)
+            + w.degrading * degrading
             - w.prefix_bonus * matched
             - (w.adapter_bonus if adapter_resident else 0.0)
         )
@@ -219,6 +249,7 @@ class RouterPolicy:
             "price": round(pnorm, 4), "prefix_blocks": matched,
             "adapter_resident": adapter_resident,
             "hbm": round(hbm, 4), "storming": storming,
+            "degrading": round(degrading, 4),
             "unknown": digest is None, "score": round(score, 4),
         }
 

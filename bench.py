@@ -1966,6 +1966,104 @@ def bench_decode_hotloop(new_tokens: int = 96) -> dict:
     return out
 
 
+def bench_obs_overhead(
+    tokens: int = 200_000, cadence_s: float = 0.005, repeats: int = 3,
+) -> dict:
+    """Observatory sampler overhead rung (ISSUE 20 acceptance): a tight
+    token-shaped hot loop (counter incs + gauge/histogram feeds — the
+    metric writes a real decode step makes) timed with the observatory
+    OFF, then with a background thread running the real registry-backed
+    collectors at a cadence compressed 1000x below production (5 ms vs
+    5 s), so the measured ratio is a hard upper bound on the production
+    duty cycle. No model, no accelerator: platform-independent and
+    runnable standalone via ``python bench.py obs_overhead``."""
+    import threading
+
+    from bee2bee_tpu.metrics import get_registry
+    from bee2bee_tpu.obs import OBS_CADENCE_S, Observatory
+
+    reg = get_registry()
+    c_tok = reg.counter("engine.tokens_generated", "tokens generated")
+    g_goodput = reg.gauge("engine.goodput_tokens_per_s", "goodput")
+    h_wait = reg.histogram("engine.queue_wait_ms", "queue wait")
+
+    def hot_loop(n: int) -> float:
+        """The loop under measurement: per-token metric writes plus the
+        gauge/histogram feeds a real decode step performs per window."""
+        t0 = time.perf_counter()
+        for i in range(n):
+            c_tok.inc()
+            if i % 64 == 0:
+                g_goodput.set(float(i % 4096))
+                h_wait.observe(float(i % 97))
+        return n / (time.perf_counter() - t0)
+
+    class _NullRecorder:
+        """The synthetic gauge feed looks like collapsing goodput to the
+        watchdog; swallow its incidents so the measurement times the
+        sampler, not incident-bundle snapshots of a fake collapse."""
+
+        def incident(self, *a, **kw):
+            return None
+
+    def timed_on(n: int) -> tuple[float, int]:
+        obs = Observatory(
+            collectors=None, cadence_s=cadence_s, recorder=_NullRecorder()
+        )
+        stop = threading.Event()
+        samples = {"n": 0}
+
+        def sampler() -> None:
+            while not stop.is_set():
+                obs.sample_once()
+                samples["n"] += 1
+                stop.wait(cadence_s)
+
+        th = threading.Thread(target=sampler, daemon=True)
+        th.start()
+        try:
+            rate = hot_loop(n)
+        finally:
+            stop.set()
+            th.join(timeout=5.0)
+        return rate, samples["n"]
+
+    hot_loop(tokens // 10)  # warmup: interned ints, branch caches
+    off_rates, on_rates, sample_counts = [], [], []
+    for _ in range(repeats):
+        off_rates.append(hot_loop(tokens))
+        rate, n_samples = timed_on(tokens)
+        on_rates.append(rate)
+        sample_counts.append(n_samples)
+    # best-of across repeats on both sides: scheduler noise only ever
+    # subtracts throughput, so max-vs-max is the cleanest overhead ratio
+    off, on = max(off_rates), max(on_rates)
+    ratio = round(on / off, 4) if off > 0 else 0.0
+    compression = OBS_CADENCE_S / cadence_s
+    out = {
+        "off": {"tok_per_s": round(off, 1), "tokens": tokens},
+        "on": {
+            "tok_per_s": round(on, 1),
+            "tokens": tokens,
+            "samples": sum(sample_counts),
+        },
+        "ratio_on_off": ratio,
+        "sample_cadence_s": cadence_s,
+        "cadence_compression_x": compression,
+        # overhead observed at the compressed cadence, scaled back to the
+        # production cadence: the number OBSERVABILITY.md quotes
+        "production_overhead_frac": round(max(1.0 - ratio, 0.0) / compression, 8),
+        "repeats": repeats,
+    }
+    log(
+        f"obs_overhead rung: on {out['on']['tok_per_s']} tok/s vs off "
+        f"{out['off']['tok_per_s']} tok/s at {cadence_s * 1000:.0f}ms cadence "
+        f"(x{ratio} — production-cadence overhead "
+        f"~{out['production_overhead_frac'] * 100:.5f}%)"
+    )
+    return out
+
+
 def bench_reference_path() -> float:
     """The reference's hot loop: HF transformers greedy generate on torch CPU
     (reference hf.py:35-44 minus tokenization — token ids in, ids out)."""
@@ -2294,6 +2392,25 @@ if __name__ == "__main__":
     # platform). Prints a FULL mini-artifact like decode_hotloop so
     # scripts/benchdiff.py can gate two standalone runs against each
     # other — that is the scripts/lint.sh trajectory gate.
+    # `python bench.py obs_overhead`: the observatory sampler-overhead
+    # rung standalone (pure-python hot loop, no model, no accelerator
+    # probe). Prints a FULL mini-artifact whose headline is the on/off
+    # throughput RATIO so scripts/benchdiff.py can gate it run-to-run —
+    # a ratio near 1.0 is the ISSUE 20 "negligible overhead" criterion.
+    if len(sys.argv) > 1 and sys.argv[1] == "obs_overhead":
+        rung = bench_obs_overhead()
+        print(json.dumps({
+            "metric": "obs_overhead_tok_per_s_ratio",
+            "value": rung["ratio_on_off"],
+            "unit": "ratio",
+            "schema_version": 2,
+            # pure-python CPU loop: the platform stamp is honest and
+            # constant, so benchdiff never refuses on platform mismatch
+            "platform": "cpu",
+            "platform_fallback": False,
+            "extras": {"obs_overhead": rung},
+        }), flush=True)
+        sys.exit(0)
     if len(sys.argv) > 1 and sys.argv[1] == "spec_model":
         ensure_live_backend()
         import jax as _jax

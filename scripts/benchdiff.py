@@ -7,6 +7,8 @@ honestly — this tool is the comparator:
 
     python scripts/benchdiff.py BENCH_r05.json BENCH_r06.json
     python scripts/benchdiff.py BENCH_r0*.json --threshold 0.10
+    python scripts/benchdiff.py BENCH_decode_hotloop_r01.json \\
+        --live http://127.0.0.1:8080 --series decode_tok_s --window 600
     python scripts/benchdiff.py --self-check
 
 - Diffs two or more artifacts **rung by rung**: every throughput-class
@@ -23,6 +25,11 @@ honestly — this tool is the comparator:
 - ``--self-check`` runs the built-in synthetic suite (regression catch +
   cross-platform refusal) — wired into scripts/lint.sh (SKIP_BENCHDIFF=1
   to skip).
+- ``--live URL`` (ISSUE 20) gates a running node's retained history
+  against ONE recorded artifact: the window mean of an observatory
+  series from ``GET /metrics/history`` is compared to the artifact's
+  headline ``value``, under the same platform-stamp refusal — live
+  production telemetry as a regression gate, no bench re-run.
 
 Artifacts may be raw bench.py output or the driver wrapper shape
 (``{"parsed": {...}}``); ``schema_version`` (bench.py stamps 2+) guards
@@ -197,6 +204,114 @@ def diff(
     return 0
 
 
+# ------------------------------------------------------------- live mode
+
+
+def fetch_history(url: str, series: str, window_s: float) -> dict:
+    """GET the node's raw history window (stdlib only — this script must
+    run on an operator box with no repo deps installed)."""
+    import urllib.parse
+    import urllib.request
+
+    q = urllib.parse.urlencode(
+        {"series": series, "window": str(window_s), "format": "raw"}
+    )
+    req = f"{url.rstrip('/')}/metrics/history?{q}"
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
+def compare_live(
+    baseline: dict,
+    history: dict,
+    series: str,
+    threshold: float = 0.15,
+    allow_cross_platform: bool = False,
+    min_points: int = 3,
+    out=print,
+) -> int:
+    """Gate a live /metrics/history payload against one recorded
+    artifact's headline value. Returns 0 ok / 1 regression / 2 refused —
+    the diff() exit contract. Pure so --self-check can exercise it
+    without a server."""
+    base_plat = artifact_platform(baseline)
+    live_plat = str(history.get("platform") or "unknown")
+    if base_plat != live_plat and not allow_cross_platform:
+        out(
+            f"benchdiff: REFUSING to gate live [{live_plat}] telemetry "
+            f"against a [{base_plat}] artifact — different platforms "
+            "measure different hardware (an 'unknown' live stamp means "
+            "the node never loaded an accelerator runtime). Pass "
+            "--allow-cross-platform to compare anyway (loudly)."
+        )
+        return 2
+    value = baseline.get("value")
+    if not isinstance(value, (int, float)) or value <= 0:
+        out("benchdiff: baseline artifact has no positive headline value")
+        return 2
+    points = (history.get("series") or {}).get(series) or []
+    vals = []
+    for p in points:
+        try:
+            vals.append(float(p[1]))
+        except (TypeError, ValueError, IndexError):
+            continue
+    if len(vals) < min_points:
+        out(
+            f"benchdiff: only {len(vals)} live point(s) of {series!r} "
+            f"retained (need {min_points}) — is the observatory sampling?"
+        )
+        return 2
+    mean = sum(vals) / len(vals)
+    change = (mean - float(value)) / float(value)
+    plat_note = (
+        f"  [{base_plat} vs {live_plat}]" if base_plat != live_plat else ""
+    )
+    out(
+        f"{baseline.get('metric', 'value')} -> live {series} | "
+        f"{value:g} | {mean:g} (n={len(vals)}) | "
+        f"{change * 100:+.1f}%{plat_note}"
+    )
+    if change < -threshold and base_plat == live_plat:
+        out(
+            f"benchdiff: live {series} window mean {mean:g} regressed "
+            f"{change * 100:+.1f}% against {value:g} "
+            f"(threshold -{threshold * 100:.0f}%)"
+        )
+        return 1
+    out(f"benchdiff: ok (live window within {threshold * 100:.0f}%)")
+    return 0
+
+
+def live(
+    paths: list[str],
+    url: str,
+    series: str,
+    window_s: float,
+    threshold: float,
+    allow_cross_platform: bool,
+    out=print,
+) -> int:
+    if len(paths) != 1:
+        out("benchdiff: --live gates against exactly one recorded artifact")
+        return 2
+    try:
+        baseline = load_artifact(paths[0])
+    except (OSError, ValueError) as e:
+        out(f"benchdiff: {e}")
+        return 2
+    try:
+        history = fetch_history(url, series, window_s)
+    except Exception as e:  # noqa: BLE001 — operator-facing refusal
+        out(f"benchdiff: could not fetch {url}/metrics/history: {e}")
+        return 2
+    return compare_live(
+        baseline, history, series,
+        threshold=threshold, allow_cross_platform=allow_cross_platform,
+        out=out,
+    )
+
+
 # ------------------------------------------------------------- self-check
 
 
@@ -257,6 +372,35 @@ def _self_check() -> int:
         if diff([base, unread], out=quiet) != 2:
             failures.append("unknown schema_version was not refused")
 
+        # live mode (compare_live is pure — no server needed): the same
+        # ok / regression / cross-platform-refusal contract over a
+        # /metrics/history payload
+        def hist(vals, platform="cpu"):
+            return {
+                "platform": platform, "encoding": "raw",
+                "series": {"decode_tok_s": [[float(i), v]
+                                            for i, v in enumerate(vals)]},
+            }
+
+        b = art(100.0, 50.0, "cpu")
+        if compare_live(b, hist([99.0, 101.0, 100.0]), "decode_tok_s",
+                        out=quiet) != 0:
+            failures.append("healthy live window did not exit 0")
+        if compare_live(b, hist([60.0, 62.0, 58.0]), "decode_tok_s",
+                        out=quiet) != 1:
+            failures.append("regressed live window did not exit 1")
+        if compare_live(b, hist([99.0] * 3, platform="unknown"),
+                        "decode_tok_s", out=quiet) != 2:
+            failures.append("cross-platform live gate was not refused")
+        if compare_live(b, hist([60.0] * 3, platform="unknown"),
+                        "decode_tok_s", allow_cross_platform=True,
+                        out=quiet) != 0:
+            failures.append(
+                "--allow-cross-platform live gate still refused/regressed"
+            )
+        if compare_live(b, hist([100.0]), "decode_tok_s", out=quiet) != 2:
+            failures.append("thin live window was not refused")
+
     if failures:
         print("benchdiff self-check FAILED:")
         for f in failures:
@@ -276,9 +420,22 @@ def main(argv: list[str] | None = None) -> int:
                          "(loud per-row annotations instead of a refusal)")
     ap.add_argument("--self-check", action="store_true",
                     help="run the built-in synthetic contract suite")
+    ap.add_argument("--live", metavar="URL",
+                    help="gate a running node's /metrics/history window "
+                         "against ONE recorded artifact instead of "
+                         "diffing artifacts")
+    ap.add_argument("--series", default="decode_tok_s",
+                    help="observatory series to gate in --live mode "
+                         "(default: decode_tok_s)")
+    ap.add_argument("--window", type=float, default=600.0,
+                    help="trailing live window in seconds for --live "
+                         "(default: 600)")
     args = ap.parse_args(argv)
     if args.self_check:
         return _self_check()
+    if args.live:
+        return live(args.artifacts, args.live, args.series, args.window,
+                    args.threshold, args.allow_cross_platform)
     return diff(args.artifacts, threshold=args.threshold,
                 allow_cross_platform=args.allow_cross_platform)
 

@@ -57,6 +57,27 @@ if [ "${SKIP_BENCHDIFF:-0}" != "1" ]; then
     echo "[lint] spec_model regression (benchdiff rc=$rc)" >&2
     exit "$rc"
   fi
+
+  # observatory sampler-overhead gate (docs/OBSERVABILITY.md "History &
+  # watchdog"): re-run the obs_overhead rung and diff the on/off
+  # throughput RATIO against the recorded baseline. The rung samples at
+  # a 1000x compressed cadence, so the ratio is a hard upper bound on
+  # production overhead; pure-python and platform-independent (the
+  # artifact stamps "cpu" always, so the gate never cross-platform
+  # refuses). Threshold 0.25 absorbs shared-CPU noise on a ~0.9 ratio —
+  # a sampler regression big enough to matter at the production cadence
+  # would crater the compressed-cadence ratio far past it.
+  echo "[lint] obs_overhead rung vs BENCH_obs_overhead_r01.json"
+  FRESH="$(mktemp /tmp/obs_overhead.XXXXXX.json)"
+  "$PY" bench.py obs_overhead | tail -1 > "$FRESH"
+  rc=0
+  "$PY" scripts/benchdiff.py BENCH_obs_overhead_r01.json "$FRESH" \
+    --threshold 0.25 || rc=$?
+  rm -f "$FRESH"
+  if [ "$rc" -ne 0 ]; then
+    echo "[lint] obs_overhead regression (benchdiff rc=$rc)" >&2
+    exit "$rc"
+  fi
 fi
 
 # interleaving-fuzzer smoke (docs/SIMULATION.md "The interleaving

@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""obs_top: zero-dep terminal dashboard over a node's /mesh/history.
+
+Renders the fleet-level curves the observatory retains (obs/tsring.py;
+merged across fresh peers by /mesh/history) as unicode sparklines — the
+one-glance operator triage view docs/OBSERVABILITY.md points at:
+
+    python scripts/obs_top.py http://127.0.0.1:8080
+    python scripts/obs_top.py http://node:8080 --window 1800 --interval 10
+    python scripts/obs_top.py http://node:8080 --series decode_tok_s,mfu --once
+
+Stdlib only (urllib + ANSI): it must run from any operator box with a
+bare python, no repo install. Each row shows the series name, a
+sparkline of the windowed fleet curve, the latest value, and the window
+min/max; a cleared screen per refresh makes it a `top` for the mesh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.parse
+import urllib.request
+
+TICKS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: list[float], width: int = 48) -> str:
+    """Downsample to `width` buckets (bucket mean) and map onto TICKS."""
+    if not values:
+        return ""
+    if len(values) > width:
+        step = len(values) / width
+        buckets = []
+        for i in range(width):
+            a = int(i * step)
+            chunk = values[a: max(int((i + 1) * step), a + 1)]
+            buckets.append(sum(chunk) / len(chunk))
+        values = buckets
+    lo, hi = min(values), max(values)
+    span = hi - lo
+    if span <= 0:
+        return TICKS[0] * len(values)
+    return "".join(
+        TICKS[min(int((v - lo) / span * (len(TICKS) - 1) + 0.5), len(TICKS) - 1)]
+        for v in values
+    )
+
+
+def fetch(url: str, window_s: float, series: str | None) -> dict:
+    params = {"window": str(window_s)}
+    if series:
+        params["series"] = series
+    q = urllib.parse.urlencode(params)
+    with urllib.request.urlopen(
+        f"{url.rstrip('/')}/mesh/history?{q}", timeout=10
+    ) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
+def render(view: dict, width: int = 48) -> str:
+    peers = view.get("peers") or {}
+    reachable = sum(
+        1 for p in peers.values()
+        if not p.get("unreachable") and not p.get("no_endpoint")
+    )
+    lines = [
+        f"fleet observatory — node {view.get('node')}  "
+        f"peers {reachable}/{len(peers)} reporting  "
+        f"window {view.get('window_s')}s @ {view.get('cadence_s')}s",
+        "",
+    ]
+    fleet = view.get("fleet") or {}
+    agg = view.get("agg") or {}
+    name_w = max((len(n) for n in fleet), default=0)
+    if not fleet:
+        lines.append("(no retained history yet — is the observatory sampling?)")
+    for name in sorted(fleet):
+        vals = [float(p[1]) for p in fleet[name] if len(p) > 1]
+        if not vals:
+            continue
+        lines.append(
+            f"{name:<{name_w}} {sparkline(vals, width):<{width}} "
+            f"{vals[-1]:>10.4g}  [{min(vals):.4g} .. {max(vals):.4g}] "
+            f"({agg.get(name, '?')})"
+        )
+    unreachable = sorted(
+        pid for pid, p in peers.items()
+        if p.get("unreachable") or p.get("no_endpoint")
+    )
+    if unreachable:
+        lines += ["", "not reporting: " + ", ".join(unreachable)]
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("url", help="node API base, e.g. http://127.0.0.1:8080")
+    ap.add_argument("--window", type=float, default=3600.0,
+                    help="trailing window in seconds (default 3600)")
+    ap.add_argument("--series", default=None,
+                    help="comma-separated series subset (default: all)")
+    ap.add_argument("--interval", type=float, default=5.0,
+                    help="refresh seconds (default 5)")
+    ap.add_argument("--width", type=int, default=48,
+                    help="sparkline width in cells (default 48)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (no screen clearing)")
+    args = ap.parse_args(argv)
+    while True:
+        try:
+            view = fetch(args.url, args.window, args.series)
+        except Exception as e:  # noqa: BLE001 — operator-facing
+            print(f"obs_top: could not fetch {args.url}/mesh/history: {e}",
+                  file=sys.stderr)
+            return 1
+        frame = render(view, width=args.width)
+        if args.once:
+            print(frame)
+            return 0
+        # ANSI home+clear instead of os.system("clear"): stdlib-only and
+        # terminal-agnostic enough for the triage use case
+        sys.stdout.write("\x1b[H\x1b[2J" + frame + "\n")
+        sys.stdout.flush()
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,14 +9,17 @@ works end to end:
 - ``/metrics`` serves syntactically valid Prometheus text exposition;
 - the mandatory series are present (service execute latency observed at
   least once, node gauges, mesh frame counters registered);
-- ``/metrics?format=json`` returns the JSON snapshot twin.
+- ``/metrics?format=json`` returns the JSON snapshot twin;
+- ``/metrics/history`` parses with the full curated series set and its
+  delta encoding round-trips against the raw view (ISSUE 20).
 
 Then boots a SECOND loopback node, connects the two into a mesh and
 exercises the health plane (ISSUE 6):
 
 - after one telemetry gossip round, ``/mesh/health`` on EITHER node
   reports both peers' digests (and the Prometheus view carries one
-  ``peer``-labeled series per fresh peer);
+  ``peer``-labeled series per fresh peer), with the serving node's
+  digest carrying the observatory's trend block (ISSUE 20);
 - ``/slo`` parses, with every configured objective present and carrying
   a burn-rate evaluation;
 - telemetry-driven routing (router/policy.py) actually consumes the
@@ -118,6 +121,33 @@ async def run_smoke() -> None:
         assert r.status == 200
         snap = (await r.json())["metrics"]
         assert "service.execute_ms" in snap, "JSON snapshot missing histogram"
+
+        # the observatory's retained-history surface (ISSUE 20): two
+        # explicit samples (no 5 s cadence wait), then /metrics/history
+        # parses, carries the full curated series set, and the delta
+        # encoding round-trips against the raw view
+        from bee2bee_tpu.obs import SERIES_NAMES, delta_decode
+
+        node.obs.sample_once()
+        node.obs.sample_once()
+        r = await client.get("/metrics/history")
+        assert r.status == 200, f"/metrics/history returned {r.status}"
+        hist = await r.json()
+        assert hist["encoding"] == "delta" and hist["retained"] >= 2
+        missing = [s for s in SERIES_NAMES if s not in hist["series"]]
+        assert not missing, f"/metrics/history missing series: {missing}"
+        r = await client.get("/metrics/history", params={"format": "raw"})
+        raw = (await r.json())["series"]
+        for name in SERIES_NAMES:
+            dec = [[t, v] for t, v in delta_decode(hist["series"][name])]
+            assert len(dec) == len(raw[name]), (
+                f"delta/raw point-count mismatch for {name}"
+            )
+        # slo burn is always collectable on a live node — the history
+        # must actually retain it, not just render empty encodings
+        assert len(raw["slo_burn_fast"]) >= 2, (
+            "slo_burn_fast never sampled into the ring"
+        )
     finally:
         if client is not None:
             await client.close()
@@ -149,9 +179,14 @@ async def run_mesh_health_smoke() -> None:
             await aio.sleep(0.05)
         assert a.peers and b.peers, "hello handshake never settled"
 
-        # a generation seeds a's digest with real series, then one
-        # explicit gossip round (deterministic — no 15 s ping wait)
+        # a generation seeds a's digest with real series, and two
+        # explicit observatory samples give it a trend digest (the
+        # watchdog needs >= 2 samples of something; slo_burn_fast is
+        # always collectable on a live node) — then one explicit gossip
+        # round (deterministic — no 15 s ping wait)
         await b.request_generation(a.peer_id, "smoke", model="smoke-model")
+        a.obs.sample_once()
+        a.obs.sample_once()
         await a.gossip_telemetry()
         await b.gossip_telemetry()
         for _ in range(100):
@@ -173,6 +208,17 @@ async def run_mesh_health_smoke() -> None:
                     f"for {pid} (has {sorted(view['peers'])})"
                 )
             assert view["aggregate"]["nodes"] == 2
+            # the trend digest rides the gossiped telemetry (ISSUE 20):
+            # a's digest in EITHER view carries the versioned trend
+            # block the router's degrading penalty consumes
+            trend = (view["peers"][a.peer_id] or {}).get("trend")
+            assert isinstance(trend, dict) and trend.get("series"), (
+                f"{node.peer_id}'s view of {a.peer_id} has no trend "
+                f"digest (keys: {sorted(view['peers'][a.peer_id])})"
+            )
+            assert "slo_burn_fast" in trend["series"], (
+                f"trend digest missing slo_burn_fast: {trend['series']}"
+            )
             # the peer-labeled Prometheus twin
             r = await client.get("/mesh/health", params={"format": "prom"})
             text = await r.text()

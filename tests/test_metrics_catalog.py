@@ -66,6 +66,10 @@ ALLOWED_ABSENT = {
     # is never imported, so the families don't even register)
     "mesh.draft_served": "not a draft-role node in this boot",
     "mesh.draft_errors": "not a draft-role node in this boot",
+    # the observatory's ring gauge is set by its sampling loop, whose
+    # 5 s cadence may not elapse inside this boot's single scrape (the
+    # obs.samples/obs.anomalies counters render their 0 default)
+    "obs.ring_points": "sampling cadence may not elapse in this boot",
 }
 
 # families the economics plane MUST light up after one generation —

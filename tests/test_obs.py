@@ -1,0 +1,653 @@
+"""Fleet observatory tests (ISSUE 20).
+
+- TsRing units: bounded eviction, gap (absent-subsystem) skipping,
+  window trimming, integer-exact delta round-trip + the few-KB size
+  claim, and clock-seam determinism (scripted clock ⇒ identical encodes).
+- Trend watchdog units: slope vs level-shift detection (correct kind,
+  correct direction gating), per-series cooldown on the injected clock,
+  and the lagged baseline absorbing only graduated samples.
+- Trend digest: schema round-trips through JSON and is consumed by the
+  router's degrading penalty (the "telemetry that finally acts" loop).
+- Routes: /metrics/history (delta + raw + 400 on unknown series) and
+  /mesh/history (two live nodes merged into fleet curves).
+- Act-on-it: router demotes a degrading-but-not-yet-burning peer;
+  controller_aggregates forecasts pool exhaustion from the trend slope.
+- Simnet regression: a seeded acceptance collapse fires the SAME typed
+  incident at the SAME virtual tick across same-seed runs, and the
+  router demotes the sinking peer before its SLO trips.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from bee2bee_tpu.clock import Clock
+from bee2bee_tpu.obs import (
+    OBS_CADENCE_S,
+    SERIES_NAMES,
+    Observatory,
+    TrendWatchdog,
+    TsRing,
+    delta_decode,
+    delta_encode,
+)
+
+pytestmark = pytest.mark.filterwarnings("ignore::RuntimeWarning")
+
+
+class ManualClock(Clock):
+    """Scripted time for units: advances only when the test says so."""
+
+    def __init__(self, t: float = 1000.0):
+        self.t = float(t)
+
+    def time(self) -> float:
+        return self.t
+
+    def monotonic(self) -> float:
+        return self.t
+
+    async def sleep(self, delay: float) -> None:
+        self.t += float(delay)
+
+
+class StubRecorder:
+    """Captures watchdog incidents without touching the global recorder
+    (or disk); the stamp is the clock the test injected."""
+
+    def __init__(self, clock: Clock):
+        self.clock = clock
+        self.incidents: list[dict] = []
+
+    def incident(self, kind, detail="", trace_id=None, node=None, extra=None):
+        self.incidents.append({
+            "kind": kind,
+            "ts": self.clock.time(),
+            "node": node,
+            "extra": extra,
+        })
+        return f"inc-{len(self.incidents)}"
+
+
+# ---------------------------------------------------------------- tsring
+
+
+def test_tsring_bounded_eviction_oldest_first():
+    clock = ManualClock()
+    ring = TsRing(["decode_tok_s"], capacity=4, clock=clock)
+    for i in range(7):
+        ring.append({"decode_tok_s": float(i)}, ts=float(i))
+    assert len(ring) == 4
+    pts = ring.points("decode_tok_s")
+    assert [v for _, v in pts] == [3.0, 4.0, 5.0, 6.0]
+
+
+def test_tsring_rejects_empty_and_nonpositive():
+    with pytest.raises(ValueError):
+        TsRing(["a"], capacity=0)
+    with pytest.raises(ValueError):
+        TsRing([])
+
+
+def test_tsring_gaps_skipped_not_zeroed():
+    """A collector returning None (subsystem not running) must leave a
+    gap, not a synthetic zero — same contract as the telemetry digest."""
+    ring = TsRing(["mfu", "decode_tok_s"], clock=ManualClock())
+    ring.append({"mfu": 0.5}, ts=1.0)
+    ring.append({"mfu": None, "decode_tok_s": 10.0}, ts=2.0)
+    ring.append({"mfu": 0.7}, ts=3.0)
+    assert ring.points("mfu") == [(1.0, 0.5), (3.0, 0.7)]
+    assert ring.points("decode_tok_s") == [(2.0, 10.0)]
+    # unknown series queried -> empty, never KeyError
+    assert ring.points("nope") == []
+
+
+def test_tsring_window_trims_to_trailing_seconds():
+    ring = TsRing(["mfu"], clock=ManualClock())
+    for i in range(10):
+        ring.append({"mfu": float(i)}, ts=100.0 + 5.0 * i)
+    pts = ring.points("mfu", window_s=12.0)
+    # newest ts is 145; cutoff 133 -> samples at 135, 140, 145
+    assert [t for t, _ in pts] == [135.0, 140.0, 145.0]
+
+
+def test_delta_roundtrip_is_quantization_exact():
+    """decode(encode(pts)) must equal round(v, p) with NO accumulation
+    drift — deltas are integers, so 720 samples can't smear."""
+    pts = [(1000.0 + 5.0 * i + 0.0004 * i, 0.1 * i + 1 / 3) for i in range(720)]
+    enc = delta_encode(pts, precision=4)
+    dec = delta_decode(enc)
+    assert len(dec) == 720
+    for (t, v), (dt, dv) in zip(pts, dec):
+        assert dt == pytest.approx(round(t, 3), abs=1e-9)
+        assert dv == pytest.approx(round(v, 4), abs=1e-9)
+    assert delta_decode(delta_encode([], 4)) == []
+
+
+def test_delta_encoding_one_hour_stays_small():
+    """The retention claim: 1 h @ 5 s cadence of a realistic jittery
+    series is a few KB of JSON, not ~25 KB of float pairs."""
+    pts = [
+        (1700000000.0 + 5.0 * i, 4000.0 + (i % 13) - (i % 7))
+        for i in range(720)
+    ]
+    enc = json.dumps(delta_encode(pts, precision=2))
+    assert len(enc) < 8_000, f"delta encoding ballooned: {len(enc)}B"
+
+
+def test_tsring_clock_seam_determinism():
+    """Two rings driven by identically-scripted clocks and values produce
+    byte-identical encodes — the property simnet replay rests on."""
+
+    def build() -> dict:
+        clock = ManualClock(5000.0)
+        ring = TsRing(["mfu", "decode_tok_s"], clock=clock)
+        for i in range(50):
+            clock.t += OBS_CADENCE_S
+            ring.append({"mfu": 0.5 + 0.001 * (i % 9), "decode_tok_s": 100.0 + i})
+        return ring.encode()
+
+    assert json.dumps(build(), sort_keys=True) == json.dumps(
+        build(), sort_keys=True
+    )
+
+
+# -------------------------------------------------------------- watchdog
+
+
+def _fed_watchdog(series: str, clock: ManualClock):
+    ring = TsRing([series], clock=clock)
+    rec = StubRecorder(clock)
+    dog = TrendWatchdog(ring, recorder=rec, node_id="n-test", clock=clock)
+    return ring, dog, rec
+
+
+def _feed(ring, dog, series: str, value: float, clock: ManualClock):
+    clock.t += OBS_CADENCE_S
+    ring.append({series: value})
+    return dog.observe()
+
+
+def test_watchdog_slope_fires_in_bad_direction_only():
+    """A rising queue-wait fires kind=slope; the same magnitude of
+    IMPROVEMENT (falling wait) must stay silent — direction gating."""
+    clock = ManualClock()
+    ring, dog, rec = _fed_watchdog("queue_wait_p95_ms", clock)
+    for _ in range(18):  # 6 absorbed into baseline + 12 pending
+        assert _feed(ring, dog, "queue_wait_p95_ms", 100.0, clock) == []
+    fired = []
+    v = 100.0
+    for _ in range(6):
+        v += 6.0
+        fired += _feed(ring, dog, "queue_wait_p95_ms", v, clock)
+        if fired:
+            break
+    assert fired and fired[0]["kind"] == "slope"
+    assert fired[0]["series"] == "queue_wait_p95_ms"
+    assert rec.incidents[0]["kind"] == "trend:queue_wait_p95_ms"
+    # the offending window rides the incident for forensics
+    assert len(rec.incidents[0]["extra"]["window"]) >= 3
+
+    # mirror run: identical slope in the GOOD direction -> silence
+    clock2 = ManualClock()
+    ring2, dog2, rec2 = _fed_watchdog("queue_wait_p95_ms", clock2)
+    for _ in range(18):
+        _feed(ring2, dog2, "queue_wait_p95_ms", 200.0, clock2)
+    v = 200.0
+    for _ in range(6):
+        v -= 6.0
+        assert _feed(ring2, dog2, "queue_wait_p95_ms", v, clock2) == []
+    assert rec2.incidents == []
+
+
+def test_watchdog_level_shift_fires_on_step_change():
+    """An abrupt acceptance collapse departs the EWMA baseline by both
+    the sigma multiple and the relative fraction — the level gate fires
+    even with the slope gate disabled (a step is not a ramp)."""
+    clock = ManualClock()
+    ring, dog, rec = _fed_watchdog("spec_acceptance", clock)
+    # slope effectively off: this test isolates the level-shift gate
+    dog.set_policy("spec_acceptance", slope_per_min=999.0)
+    for _ in range(18):
+        assert _feed(ring, dog, "spec_acceptance", 0.8, clock) == []
+    fired = []
+    for _ in range(12):
+        fired += _feed(ring, dog, "spec_acceptance", 0.2, clock)
+        if fired:
+            break
+    assert fired and fired[0]["kind"] == "level_shift"
+    assert fired[0]["baseline"] == pytest.approx(0.8, abs=0.01)
+    assert fired[0]["window_mean"] < 0.8
+
+
+def test_watchdog_cooldown_spaces_repeat_incidents():
+    clock = ManualClock()
+    ring, dog, rec = _fed_watchdog("spec_acceptance", clock)
+    dog.set_policy("spec_acceptance", cooldown_s=300.0)
+    for _ in range(18):
+        _feed(ring, dog, "spec_acceptance", 0.8, clock)
+    total = 0
+    for _ in range(12):  # 60 s of sustained collapse
+        total += len(_feed(ring, dog, "spec_acceptance", 0.2, clock))
+    assert total == 1, "cooldown must suppress the sustained-anomaly storm"
+    # past the cooldown the (still anomalous) series may fire again
+    clock.t += 300.0
+    refired = _feed(ring, dog, "spec_acceptance", 0.2, clock)
+    assert len(rec.incidents) == 1 + len(refired)
+
+
+def test_watchdog_needs_baseline_before_detecting():
+    """min_baseline gates detection: a collapse in the first samples of
+    a series' life must not alarm against a baseline of nothing."""
+    clock = ManualClock()
+    ring, dog, rec = _fed_watchdog("spec_acceptance", clock)
+    for v in (0.8, 0.7, 0.3, 0.2, 0.2):
+        assert _feed(ring, dog, "spec_acceptance", v, clock) == []
+    assert rec.incidents == []
+
+
+# ------------------------------------------------- digest + router action
+
+
+def _observatory_with_script(values_by_series: dict[str, list[float]]):
+    clock = ManualClock()
+    obs = Observatory(clock=clock, collectors={}, recorder=StubRecorder(clock))
+    idx = {"i": 0}
+    for name, vals in values_by_series.items():
+        obs.set_collector(
+            name, lambda vals=vals: vals[min(idx["i"], len(vals) - 1)]
+        )
+    n = max(len(v) for v in values_by_series.values())
+    for i in range(n):
+        idx["i"] = i
+        clock.t += OBS_CADENCE_S
+        obs.sample_once()
+    return obs
+
+
+def test_trend_digest_schema_roundtrips_and_router_consumes_it():
+    """The wire contract end to end: trend_digest -> JSON -> router
+    score. Falling goodput + rising queue wait raise the degrading
+    penalty; a flat peer pays none."""
+    from bee2bee_tpu.router.policy import RouterPolicy
+
+    sinking = _observatory_with_script({
+        "goodput_tok_s": [1000.0 - 40.0 * i for i in range(12)],
+        "queue_wait_p95_ms": [50.0 + 20.0 * i for i in range(12)],
+    })
+    flat = _observatory_with_script({
+        "goodput_tok_s": [1000.0] * 12,
+        "queue_wait_p95_ms": [50.0] * 12,
+    })
+    d_bad = json.loads(json.dumps(sinking.trend_digest()))
+    d_ok = json.loads(json.dumps(flat.trend_digest()))
+    assert d_bad["v"] == 1 and d_bad["cadence_s"] == OBS_CADENCE_S
+    assert d_bad["series"]["goodput_tok_s"]["slope"] < 0
+    assert d_bad["series"]["queue_wait_p95_ms"]["slope"] > 0
+    assert d_ok["series"]["goodput_tok_s"]["slope"] == pytest.approx(0.0)
+
+    pol = RouterPolicy()
+    cand = {"provider_id": "p", "model": "m"}
+    s_bad, b_bad = pol.score(
+        cand, {"trend": d_bad}, rtt_ms=1.0, max_price=0.0, prompt_hashes=[]
+    )
+    s_ok, b_ok = pol.score(
+        cand, {"trend": d_ok}, rtt_ms=1.0, max_price=0.0, prompt_hashes=[]
+    )
+    assert b_bad["degrading"] > 0.0 and b_ok["degrading"] == 0.0
+    assert s_bad > s_ok
+    # a digest with NO trend block (absent subsystem) pays no penalty
+    _, b_none = pol.score(cand, {}, rtt_ms=1.0, max_price=0.0, prompt_hashes=[])
+    assert b_none["degrading"] == 0.0
+
+
+def test_observatory_collector_errors_store_gaps_not_crashes():
+    clock = ManualClock()
+    obs = Observatory(clock=clock, collectors={}, recorder=StubRecorder(clock))
+
+    def boom():
+        raise RuntimeError("collector died")
+
+    obs.set_collector("mfu", boom)
+    obs.set_collector("decode_tok_s", lambda: 42.0)
+    vals = obs.sample_once()
+    assert vals["mfu"] is None and vals["decode_tok_s"] == 42.0
+    assert obs.ring.points("mfu") == []
+    assert len(obs.ring.points("decode_tok_s")) == 1
+
+
+def test_router_degrading_penalty_flips_the_pick():
+    """Two otherwise-identical candidates: the one whose own watchdog
+    flagged an anomaly loses; zeroing the weight restores the tie-break
+    (bad first by candidate order) — proving the penalty is the flip."""
+    from bee2bee_tpu.router.policy import RouterPolicy, RouterWeights
+
+    anom_trend = {
+        "v": 1, "cadence_s": 5.0,
+        "series": {"queue_wait_p95_ms": {
+            "mean": 300.0, "slope": 0.4, "n": 12,
+            "anom": 1, "anom_kind": "slope",
+        }},
+    }
+    cands = [
+        {"provider_id": "a-bad", "model": "m"},
+        {"provider_id": "b-ok", "model": "m"},
+    ]
+    digests = {"a-bad": {"trend": anom_trend}, "b-ok": {}}
+    pol = RouterPolicy()
+    winner, decision = pol.pick(cands, digests)
+    assert winner["provider_id"] == "b-ok"
+    _, b_bad = pol.score(cands[0], digests["a-bad"], None, 0.0, [])
+    assert b_bad["degrading"] == 1.0
+
+    flat = RouterPolicy(RouterWeights(degrading=0.0))
+    winner2, _ = flat.pick(cands, digests)
+    assert winner2["provider_id"] == "a-bad"
+
+
+def test_controller_aggregates_forecast_pool_exhaustion():
+    """pool_eta_s from the trend: level 0.4, relative slope -0.1/min
+    -> drain 0.04/min -> ~600 s to empty; rising or flat pools forecast
+    nothing."""
+    from bee2bee_tpu.health import controller_aggregates
+
+    def digest(trend_series):
+        return {"ts": 0.0, "trend": {"v": 1, "series": trend_series}}
+
+    aggs = controller_aggregates({
+        "p-falling": digest({
+            "pool_free_frac": {"mean": 0.4, "slope": -0.1, "n": 12}
+        }),
+        "p-flat": digest({
+            "pool_free_frac": {"mean": 0.9, "slope": 0.0, "n": 12}
+        }),
+    })
+    assert aggs["pool_eta_s"] == pytest.approx(600.0, rel=0.01)
+    assert aggs["pool_eta_peer"] == "p-falling"
+
+    aggs2 = controller_aggregates({
+        "p-flat": digest({
+            "pool_free_frac": {"mean": 0.9, "slope": 0.02, "n": 12}
+        }),
+    })
+    assert aggs2["pool_eta_s"] is None and aggs2["pool_eta_peer"] is None
+
+
+def test_fleet_controller_scales_out_on_pool_forecast():
+    """The act-on-it loop's controller half: a pool forecast inside the
+    horizon builds scale-out pressure even with NOTHING burning —
+    capacity arrives before the burn, not in reaction to it."""
+    from bee2bee_tpu.fleet.controller import FleetConfig
+    from bee2bee_tpu.meshnet.node import P2PNode
+
+    def controller(**over):
+        node = P2PNode(host="127.0.0.1", port=0, fleet_controller=True)
+        node.fleet.config = FleetConfig(
+            out_sustain_ticks=2, lease_ttl_s=0.4, **over
+        )
+        node.fleet.is_leader = True
+        return node.fleet
+
+    agg = {
+        "eligible": 2, "eligible_ids": ["a", "b"], "burning": 0,
+        "burning_frac": 0.0, "fill_mean": 0.2, "queue_p95_max": 10.0,
+        "pool_eta_s": 45.0, "pool_eta_peer": "a",
+    }
+    standby = {"s": {"fleet_state": "standby"}}
+    ctrl = controller(pool_eta_out_s=120.0)
+    d, _, _ = ctrl._decide(100.0, agg, standby)
+    assert d == "noop"  # one forecast tick is a blip, not a trend
+    d, reason, target = ctrl._decide(100.1, agg, standby)
+    assert d == "scale_out" and target == "s"
+    assert "forecast" in reason and "45" in reason
+
+    # an eta BEYOND the horizon (or horizon 0) builds no pressure
+    far = {**agg, "pool_eta_s": 900.0}
+    ctrl2 = controller(pool_eta_out_s=120.0)
+    for i in range(5):
+        d, _, _ = ctrl2._decide(200.0 + i, far, standby)
+        assert d == "noop"
+    ctrl3 = controller(pool_eta_out_s=0.0)
+    for i in range(5):
+        d, _, _ = ctrl3._decide(300.0 + i, agg, standby)
+        assert d == "noop"
+
+
+# ------------------------------------------------------------------ routes
+
+
+async def _obs_node_app():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from bee2bee_tpu.api import build_app
+    from bee2bee_tpu.meshnet.node import P2PNode
+
+    node = P2PNode(host="127.0.0.1", port=0)
+    await node.start()
+    client = TestClient(TestServer(build_app(node)))
+    await client.start_server()
+    return node, client
+
+
+async def test_metrics_history_route_delta_and_raw():
+    node, client = await _obs_node_app()
+    try:
+        node.obs.set_collector("decode_tok_s", lambda: 123.45)
+        node.obs.sample_once()
+        node.obs.sample_once()
+        r = await client.get("/metrics/history")
+        assert r.status == 200
+        body = await r.json()
+        assert body["node"] == node.peer_id
+        assert body["encoding"] == "delta"
+        assert body["retained"] == 2
+        assert set(body["series"]) == set(SERIES_NAMES)
+        dec = delta_decode(body["series"]["decode_tok_s"])
+        assert [v for _, v in dec] == [123.45, 123.45]
+
+        r = await client.get(
+            "/metrics/history",
+            params={"series": "decode_tok_s", "format": "raw", "window": "60"},
+        )
+        body = await r.json()
+        assert body["encoding"] == "raw"
+        assert list(body["series"]) == ["decode_tok_s"]
+        assert [v for _, v in body["series"]["decode_tok_s"]] == [123.45, 123.45]
+    finally:
+        await client.close()
+        await node.stop()
+
+
+async def test_metrics_history_route_rejects_garbage_typed():
+    node, client = await _obs_node_app()
+    try:
+        r = await client.get("/metrics/history", params={"series": "bogus"})
+        assert r.status == 400
+        body = await r.json()
+        assert "bogus" in body["detail"]
+        assert body["known"] == list(SERIES_NAMES)
+        r = await client.get("/metrics/history", params={"window": "soon"})
+        assert r.status == 400
+    finally:
+        await client.close()
+        await node.stop()
+
+
+async def test_mesh_history_merges_two_live_nodes():
+    """Fleet curves: b's retained history is fetched over its REAL api
+    endpoint and merged with a's — sum for throughput series, mean for
+    levels — while an endpointless peer is typed, not dropped."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from bee2bee_tpu.api import build_app
+    from bee2bee_tpu.meshnet.node import P2PNode
+    from tests.test_meshnet import _settle
+
+    a = P2PNode(host="127.0.0.1", port=0)
+    b = P2PNode(host="127.0.0.1", port=0, announce_host="127.0.0.1")
+    await a.start()
+    await b.start()
+    client_a = client_b = None
+    try:
+        client_b = TestClient(TestServer(build_app(b)))
+        await client_b.start_server()
+        b.api_port = client_b.server.port  # advertise before the hello
+        assert await b.connect_bootstrap(a.addr)
+        assert await _settle(lambda: a.peers and b.peers)
+        assert a.peers[b.peer_id]["api_port"] == b.api_port
+
+        now = a.obs.ring._clock.time()
+        grid = (now // OBS_CADENCE_S) * OBS_CADENCE_S
+        for i, (va, vb) in enumerate([(100.0, 50.0), (110.0, 60.0)]):
+            ts = grid + OBS_CADENCE_S * i
+            a.obs.ring.append({"decode_tok_s": va, "mfu": 0.4}, ts=ts)
+            b.obs.ring.append({"decode_tok_s": vb, "mfu": 0.8}, ts=ts)
+
+        client_a = TestClient(TestServer(build_app(a)))
+        await client_a.start_server()
+        r = await client_a.get(
+            "/mesh/history", params={"series": "decode_tok_s,mfu"}
+        )
+        assert r.status == 200
+        view = await r.json()
+        assert set(view["peers"]) == {a.peer_id, b.peer_id}
+        assert "series" in view["peers"][b.peer_id]
+        # decode_tok_s sums across the fleet; mfu averages
+        assert [v for _, v in view["fleet"]["decode_tok_s"]] == [150.0, 170.0]
+        assert [v for _, v in view["fleet"]["mfu"]] == [0.6, 0.6]
+        assert view["agg"] == {"decode_tok_s": "sum", "mfu": "mean"}
+    finally:
+        for c in (client_a, client_b):
+            if c is not None:
+                await c.close()
+        await b.stop()
+        await a.stop()
+
+
+async def test_mesh_history_types_unreachable_peer():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from bee2bee_tpu.api import build_app
+    from bee2bee_tpu.meshnet.node import P2PNode
+    from tests.test_meshnet import _settle
+
+    a = P2PNode(host="127.0.0.1", port=0)
+    # b advertises an api port nothing listens on (9: discard/closed)
+    b = P2PNode(host="127.0.0.1", port=0, api_port=9, announce_host="127.0.0.1")
+    await a.start()
+    await b.start()
+    client = None
+    try:
+        assert await b.connect_bootstrap(a.addr)
+        assert await _settle(lambda: a.peers and b.peers)
+        client = TestClient(TestServer(build_app(a)))
+        await client.start_server()
+        view = await (await client.get("/mesh/history")).json()
+        assert view["peers"][b.peer_id] == {"unreachable": True}
+    finally:
+        if client is not None:
+            await client.close()
+        await b.stop()
+        await a.stop()
+
+
+# ------------------------------------------------------------------ simnet
+
+
+async def _seeded_collapse_run(seed: int) -> dict:
+    """One FleetSim run: node 2's acceptance collapses and its goodput
+    sinks mid-run; returns the fired incidents + post-collapse state."""
+    from bee2bee_tpu.health import digest_slo_burn
+    from bee2bee_tpu.router.policy import RouterPolicy
+    from bee2bee_tpu.simnet import FleetSim
+
+    sim = FleetSim(3, seed=seed)
+    await sim.start()
+    try:
+        clock = sim.clock
+        t0 = clock.time()
+        collapse_at = t0 + 120.0
+        recs = []
+        for node in sim.nodes:
+            rec = StubRecorder(clock)
+            node.obs.watchdog.recorder = rec
+            recs.append(rec)
+
+        sick = sim.nodes[2]
+
+        def acceptance() -> float:
+            return 0.85 if clock.time() < collapse_at else 0.25
+
+        def goodput() -> float:
+            t = clock.time()
+            if t < collapse_at:
+                return 120.0
+            return max(120.0 - 2.0 * (t - collapse_at), 20.0)
+
+        sick.obs.set_collector("spec_acceptance", acceptance)
+        sick.obs.set_collector("goodput_tok_s", goodput)
+        for healthy in sim.nodes[:2]:
+            healthy.obs.set_collector("spec_acceptance", lambda: 0.85)
+            healthy.obs.set_collector("goodput_tok_s", lambda: 120.0)
+
+        await sim.run_for(180.0)  # 120 s healthy baseline + 60 s collapse
+
+        a = sim.nodes[0]
+        fresh = a.health.fresh()
+        d_sick = fresh.get(sick.peer_id) or {}
+        d_ok = fresh.get(sim.nodes[1].peer_id) or {}
+        pol = RouterPolicy()
+        cand = {"provider_id": "x", "model": "sim-model"}
+        _, b_sick = pol.score(cand, d_sick, 1.0, 0.0, [])
+        _, b_ok = pol.score(cand, d_ok, 1.0, 0.0, [])
+        return {
+            "t0": t0,
+            "incidents": [
+                {"kind": i["kind"], "ts": i["ts"], "node": i["node"],
+                 "extra": i["extra"]}
+                for rec in recs for i in rec.incidents
+            ],
+            "sick_trend": (d_sick.get("trend") or {}).get("series") or {},
+            "degrading_sick": b_sick["degrading"],
+            "degrading_ok": b_ok["degrading"],
+            "sick_burning": digest_slo_burn(d_sick)[1],
+        }
+    finally:
+        await sim.stop()
+
+
+@pytest.mark.async_timeout(120)
+async def test_simnet_seeded_collapse_is_deterministic_and_acted_on():
+    """The ISSUE 20 acceptance walk: a seeded acceptance collapse under
+    virtual time (1) fires the typed ``trend:spec_acceptance`` incident
+    with the offending window attached, (2) at the SAME virtual tick
+    with identical payload across same-seed runs, and (3) the router
+    demotes the sinking peer — degrading penalty up, healthy peer
+    clean — BEFORE the peer's SLO reports burning."""
+    run1 = await _seeded_collapse_run(seed=7)
+    run2 = await _seeded_collapse_run(seed=7)
+
+    spec = [
+        i for i in run1["incidents"] if i["kind"] == "trend:spec_acceptance"
+    ]
+    assert spec, f"no acceptance incident fired: {run1['incidents']}"
+    assert spec[0]["node"] == "sim-0002"
+    assert spec[0]["extra"]["series"] == "spec_acceptance"
+    assert len(spec[0]["extra"]["window"]) >= 3
+    # fired AFTER the scripted collapse, not during the healthy baseline
+    assert spec[0]["ts"] > run1["t0"] + 120.0
+
+    # bit-identical replay: same incidents, same virtual ticks, same
+    # payload bytes
+    assert json.dumps(run1["incidents"], sort_keys=True) == json.dumps(
+        run2["incidents"], sort_keys=True
+    )
+
+    # telemetry that acts: the gossiped trend demotes the sick peer at
+    # the router before any SLO objective trips
+    assert run1["sick_trend"].get("goodput_tok_s", {}).get("slope", 0) < 0
+    assert run1["degrading_sick"] > 0.0
+    assert run1["degrading_ok"] == 0.0
+    assert run1["sick_burning"] is False
